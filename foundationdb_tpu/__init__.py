@@ -1,0 +1,23 @@
+"""foundationdb_tpu — a TPU-native transaction-processing framework.
+
+A brand-new, TPU-first re-design of FoundationDB's capabilities (reference:
+tclinken/foundationdb 6.1.0): an ordered, ACID, distributed key-value store
+built on a deterministic actor runtime, with its MVCC conflict resolver
+re-expressed as a vectorized JAX/XLA interval-overlap kernel.
+
+Layering (mirrors reference layer map, SURVEY.md §1, re-designed for TPU):
+
+  flow/      deterministic async actor runtime (ref: flow/)
+  rpc/       token-addressed RPC + deterministic simulator (ref: fdbrpc/)
+  ops/       JAX/TPU device kernels (key encoding, RMQ, conflict kernel)
+  models/    conflict-set backends: python / native C++ / TPU (ref: fdbserver/SkipList.cpp)
+  parallel/  device-mesh sharding of the resolver (ref: multi-resolver key sharding)
+  server/    server roles: sequencer, proxy, resolver, tlog, storage (ref: fdbserver/)
+  client/    Database / Transaction API (ref: fdbclient/NativeAPI, ReadYourWrites)
+  utils/     key manipulation helpers (ref: fdbclient/FDBTypes.h)
+
+Submodules import lazily so that host-only code (flow, server) never pulls
+in jax.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,34 @@
+"""Deterministic actor runtime (ref: flow/ — Promise/Future, Net2, knobs, trace)."""
+
+from .error import ActorCancelled, FdbError, error, internal_error
+from .future import Future, Promise, Task, error_future, ready_future
+from .scheduler import Scheduler, TaskPriority, delay, g, now, set_scheduler, spawn
+from .actors import (
+    ActorCollection,
+    AsyncTrigger,
+    AsyncVar,
+    FlowLock,
+    FutureStream,
+    NotifiedVersion,
+    PromiseStream,
+    all_of,
+    first_of,
+    timeout,
+    timeout_error,
+    wait_for_all,
+)
+from .rng import DeterministicRandom, buggify, g_random, set_seed
+from .knobs import SERVER_KNOBS, Knobs, make_server_knobs, reset_server_knobs
+from .trace import TraceEvent, g_trace, reset_trace
+
+__all__ = [
+    "ActorCancelled", "FdbError", "error", "internal_error",
+    "Future", "Promise", "Task", "error_future", "ready_future",
+    "Scheduler", "TaskPriority", "delay", "g", "now", "set_scheduler", "spawn",
+    "ActorCollection", "AsyncTrigger", "AsyncVar", "FlowLock", "FutureStream",
+    "NotifiedVersion", "PromiseStream", "all_of", "first_of", "timeout",
+    "timeout_error", "wait_for_all",
+    "DeterministicRandom", "buggify", "g_random", "set_seed",
+    "SERVER_KNOBS", "Knobs", "make_server_knobs", "reset_server_knobs",
+    "TraceEvent", "g_trace", "reset_trace",
+]

@@ -1,0 +1,72 @@
+"""Tunable knobs with simulation randomization.
+
+Reference: flow/Knobs.h/.cpp (93 flow knobs), fdbserver/Knobs.cpp (284 server
+knobs). Knobs are plain attributes initialized by ``init(name, default)``,
+optionally distorted under BUGGIFY, and overridable by ``--knob_name=value``
+style dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .rng import g_buggify, g_random
+
+
+class Knobs:
+    def __init__(self):
+        self._defaults: dict[str, float | int | str] = {}
+
+    def init(self, name: str, default, buggify_fn: Optional[Callable[[], object]] = None):
+        """Register a knob. `buggify_fn` returns a distorted value when the
+        site fires under BUGGIFY (ref: `if (randomize && BUGGIFY)` in Knobs.cpp)."""
+        value = default
+        if buggify_fn is not None and g_buggify(f"knob/{name}"):
+            value = buggify_fn()
+        self._defaults[name] = default
+        setattr(self, name.lower(), value)
+
+    def set(self, name: str, value) -> None:
+        setattr(self, name.lower(), value)
+
+
+def make_server_knobs(randomize: bool = False) -> Knobs:
+    """Server knobs used by this framework (subset of fdbserver/Knobs.cpp,
+    numerically identical defaults)."""
+    k = Knobs()
+
+    def init(name, default, buggify_fn=None):
+        k.init(name, default, buggify_fn if randomize else None)
+
+    init("VERSIONS_PER_SECOND", 1_000_000)
+    init("MAX_READ_TRANSACTION_LIFE_VERSIONS", 5 * 1_000_000,
+         lambda: g_random.random_choice([1_000_000, 100_000, 10_000_000]))
+    init("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 5 * 1_000_000,
+         lambda: 1_000_000)
+    init("MAX_VERSIONS_IN_FLIGHT", 100 * 1_000_000)
+    init("MAX_COMMIT_BATCH_INTERVAL", 0.5, lambda: 2.0)
+    init("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.001)
+    init("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 32768, lambda: 1000)
+    init("COMMIT_TRANSACTION_BATCH_BYTES_MAX", 8 << 20)
+    init("RESOLVER_STATE_MEMORY_LIMIT", 1 << 20)
+    init("PROXY_SPIN_DELAY", 0.01)
+    init("GRV_BATCH_INTERVAL", 0.0005)
+    init("DESIRED_TOTAL_BYTES", 150000)
+    init("STORAGE_DURABILITY_LAG", 5.0)
+    init("TLOG_SPILL_THRESHOLD", 1500 << 20)
+    init("MAX_TRANSACTION_BYTE_LIMIT", 10_000_000)
+    init("TRANSACTION_SIZE_LIMIT", 10_000_000)
+    init("KEY_SIZE_LIMIT", 10_000)
+    init("VALUE_SIZE_LIMIT", 100_000)
+    init("RESOLVER_COALESCE_TIME", 1.0)
+    init("SAMPLE_EXPIRATION_TIME", 1.0)
+    return k
+
+
+SERVER_KNOBS = make_server_knobs()
+
+
+def reset_server_knobs(randomize: bool = False) -> Knobs:
+    global SERVER_KNOBS
+    SERVER_KNOBS = make_server_knobs(randomize)
+    return SERVER_KNOBS

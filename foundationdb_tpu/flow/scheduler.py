@@ -1,0 +1,211 @@
+"""Deterministic event loop with task priorities and virtual time.
+
+Reference: flow/Net2.actor.cpp (`Net2::run` :558, ready/timer queues
+:183-191) and flow/network.h:33-76 (numeric task priorities). Unlike the
+reference, virtual time is the *default* — the deterministic simulator is
+the primary runtime (ref: fdbrpc/sim2.actor.cpp), and wall-clock execution
+is a mode layered on top.
+
+Determinism contract: given the same seed and the same spawn/send sequence,
+the loop executes steps in an identical order. Ready tasks run
+highest-priority first, FIFO within a priority; timers fire in (time, seq)
+order; time advances only when no task is ready.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time as _time
+from typing import Any, Coroutine, Optional
+
+from .error import FdbError, error
+from .future import Future, Task
+
+# Task priorities (ref: flow/network.h:33-76). Higher runs first.
+class TaskPriority:
+    MAX = 1000000
+    RUN_LOOP = 30000
+    WRITE_SOCKET = 10000
+    READ_SOCKET = 9000
+    COORDINATION_REPLY = 8810
+    COORDINATION = 8800
+    FAILURE_MONITOR = 8700
+    RESOLUTION_METRICS = 8700
+    CLUSTER_CONTROLLER = 8650
+    PROXY_COMMIT_DISPATCH = 8640
+    TLOG_QUEUING_METRICS = 8620
+    TLOG_POP = 8610
+    TLOG_PEEK_REPLY = 8600
+    TLOG_PEEK = 8590
+    TLOG_COMMIT_REPLY = 8580
+    TLOG_COMMIT = 8570
+    PROXY_GET_RAW_COMMITTED_VERSION = 8565
+    PROXY_RESOLVER_REPLY = 8560
+    PROXY_COMMIT_BATCHER = 8550
+    PROXY_COMMIT = 8540
+    TLOG_CONFIRM_RUNNING_REPLY = 8530
+    TLOG_CONFIRM_RUNNING = 8520
+    PROXY_GRV_TIMER = 8510
+    PROXY_GET_CONSISTENT_READ_VERSION = 8500
+    DEFAULT_PROMISE_ENDPOINT = 8000
+    DEFAULT_ON_MAIN_THREAD = 7500
+    DEFAULT_ENDPOINT = 7000
+    UNKNOWN_ENDPOINT = 6000
+    MOVE_KEYS = 3550
+    DATA_DISTRIBUTION_LAUNCH = 3530
+    RATEKEEPER = 3510
+    DATA_DISTRIBUTION = 3500
+    STORAGE = 3000
+    UPDATE_STORAGE = 3000
+    LOW_PRIORITY = 2000
+    ZERO = 0
+
+
+class Scheduler:
+    """Single-threaded deterministic run loop (Net2 + sim2 in one).
+
+    ``virtual=True`` (default): time advances instantly to the next timer —
+    whole-system simulation. ``virtual=False``: timers wait on the wall
+    clock (for real deployments/benchmarks).
+    """
+
+    def __init__(self, start_time: float = 0.0, virtual: bool = True):
+        self._now = start_time
+        self.virtual = virtual
+        # Maps the virtual timeline onto the wall clock for virtual=False:
+        # wall_time_of(t) = _wall_anchor + t.
+        self._wall_anchor = _time.monotonic() - start_time
+        self._ready: list = []  # heap of (-priority, seq, fn, args)
+        self._timers: list = []  # heap of (time, seq, promise)
+        self._seq = 0
+        self._current_task: Optional[Task] = None
+        self._stopped = False
+        self.tasks_run = 0
+
+    # -- time ---------------------------------------------------------------
+    def now(self) -> float:
+        return self._now
+
+    # -- spawning -----------------------------------------------------------
+    def spawn(self, coro: Coroutine, priority: int = TaskPriority.DEFAULT_ENDPOINT,
+              name: str = "") -> Task:
+        """Start an actor; returns its Task (a Future of the return value)."""
+        t = Task(coro, self, priority, name)
+        self._schedule_step(t, None, None)
+        return t
+
+    def _schedule_step(self, task: Task, value, exc, priority: Optional[int] = None) -> None:
+        self._seq += 1
+        if priority is None:
+            priority = task.priority
+        heapq.heappush(self._ready, (-priority, self._seq, task, value, exc))
+
+    def call_at_priority(self, priority: int, fn, *args) -> None:
+        """Run a plain callable from the loop at the given priority."""
+        async def _runner():
+            fn(*args)
+        self.spawn(_runner(), priority, name=getattr(fn, "__name__", "call"))
+
+    # -- timers -------------------------------------------------------------
+    def delay(self, seconds: float, priority: int = TaskPriority.DEFAULT_ENDPOINT) -> Future:
+        """Future that becomes ready `seconds` from now (ref: flow delay())."""
+        if seconds < 0:
+            seconds = 0.0
+        f = _TimerFuture(self, priority)
+        f.resume_priority = priority  # waiter resumes at the delay's priority
+        self._seq += 1
+        entry = (self._now + seconds, self._seq, f)
+        f._entry = entry
+        heapq.heappush(self._timers, entry)
+        return f
+
+    def yield_now(self, priority: int = TaskPriority.DEFAULT_ENDPOINT) -> Future:
+        return self.delay(0.0, priority)
+
+    # -- run loop -----------------------------------------------------------
+    def _run_one(self) -> bool:
+        """Execute one step. Returns False when no work remains."""
+        # Fire all timers due at or before now.
+        while self._timers and (self._timers[0][0] <= self._now or not self._ready):
+            if self._timers[0][0] > self._now:
+                if self._ready:
+                    break
+                # advance time
+                t = self._timers[0][0]
+                if not self.virtual:
+                    _time.sleep(max(0.0, (self._wall_anchor + t) - _time.monotonic()))
+                self._now = t
+            _, _, fut = heapq.heappop(self._timers)
+            if not fut.is_ready:
+                fut.send(None)
+        if not self._ready:
+            return False
+        _, _, task, value, exc = heapq.heappop(self._ready)
+        self.tasks_run += 1
+        task._step(value, exc)
+        return True
+
+    def run(self, until: Optional[Future] = None, timeout_time: Optional[float] = None) -> Any:
+        """Run until `until` is ready (returning its value), or until idle.
+
+        Raises ``timed_out`` if virtual time passes `timeout_time` first, and
+        ``operation_failed`` on deadlock (until-future pending but no work).
+        """
+        while not self._stopped:
+            if until is not None and until.is_ready:
+                return until.get()
+            if timeout_time is not None and self._now >= timeout_time:
+                raise error("timed_out")
+            if not self._run_one():
+                break
+        if until is not None:
+            if until.is_ready:
+                return until.get()
+            raise FdbError("operation_failed", 1000,
+                           "simulation deadlock: awaited future never became ready")
+        return None
+
+    def stop(self) -> None:
+        self._stopped = True
+
+
+class _TimerFuture(Future):
+    __slots__ = ("_sched", "_entry", "resume_priority")
+
+    def __init__(self, sched: Scheduler, priority: int):
+        super().__init__()
+        self._sched = sched
+        self._entry = None
+        self.resume_priority = priority
+
+    def cancel(self) -> None:
+        if not self.is_ready:
+            self.send_error(FdbError("operation_cancelled", 1101))
+
+
+# --- ambient scheduler -----------------------------------------------------
+# Single-threaded runtime: one active scheduler at a time (like g_network).
+_current: Optional[Scheduler] = None
+
+
+def set_scheduler(s: Optional[Scheduler]) -> None:
+    global _current
+    _current = s
+
+
+def g() -> Scheduler:
+    if _current is None:
+        raise error("internal_error")
+    return _current
+
+
+def now() -> float:
+    return g().now()
+
+
+def delay(seconds: float, priority: int = TaskPriority.DEFAULT_ENDPOINT) -> Future:
+    return g().delay(seconds, priority)
+
+
+def spawn(coro, priority: int = TaskPriority.DEFAULT_ENDPOINT, name: str = "") -> Task:
+    return g().spawn(coro, priority, name)

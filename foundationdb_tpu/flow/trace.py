@@ -1,0 +1,81 @@
+"""Structured trace events.
+
+Reference: flow/Trace.h:140 (`TraceEvent(severity, name, id).detail(...)`),
+FileTraceLogWriter / JsonTraceLogFormatter. Events are structured dicts
+collected in-memory (for tests/simulation) and optionally streamed to a
+JSON-lines file (the reference's JSON trace format).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+SevDebug = 5
+SevInfo = 10
+SevWarn = 20
+SevWarnAlways = 30
+SevError = 40
+
+
+class TraceCollector:
+    def __init__(self, path: Optional[str] = None, keep_in_memory: int = 10000):
+        self.events: list[dict] = []
+        self.keep = keep_in_memory
+        self._fh = open(path, "a") if path else None
+        self.counts: dict[str, int] = {}
+
+    def emit(self, ev: dict) -> None:
+        self.counts[ev["Type"]] = self.counts.get(ev["Type"], 0) + 1
+        if self.keep:
+            self.events.append(ev)
+            if len(self.events) > self.keep:
+                del self.events[: self.keep // 2]
+        if self._fh:
+            self._fh.write(json.dumps(ev) + "\n")
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+g_trace = TraceCollector()
+
+
+def reset_trace(path: Optional[str] = None) -> TraceCollector:
+    global g_trace
+    g_trace.close()
+    g_trace = TraceCollector(path)
+    return g_trace
+
+
+class TraceEvent:
+    """``TraceEvent("Name", id).detail(Key=value)...`` — emits on __del__ or .log()."""
+
+    __slots__ = ("_ev", "_logged")
+
+    def __init__(self, name: str, id: str = "", severity: int = SevInfo):
+        t = None
+        try:  # time is the scheduler's virtual clock when one is running
+            from .scheduler import g
+            t = g().now()
+        except Exception:
+            t = 0.0
+        self._ev = {"Severity": severity, "Time": t, "Type": name, "ID": id}
+        self._logged = False
+
+    def detail(self, **kwargs: Any) -> "TraceEvent":
+        self._ev.update(kwargs)
+        return self
+
+    def log(self) -> None:
+        if not self._logged:
+            self._logged = True
+            g_trace.emit(self._ev)
+
+    def __del__(self):
+        try:
+            self.log()
+        except Exception:
+            pass
