@@ -303,6 +303,8 @@ class ActorCollection:
         return self._error
 
     def cancel_all(self) -> None:
-        for t in self.tasks:
+        # cancel() fires on_done synchronously, which mutates self.tasks —
+        # iterate a snapshot.
+        for t in list(self.tasks):
             t.cancel()
         self.tasks.clear()

@@ -220,7 +220,10 @@ class Task(Future):
             self._waiting_on = None
             self._resume_cb = None
             w.remove_callback(cb)
-            w.cancel()
+            # Cancel downstream only if nobody else is waiting on it (ref:
+            # flow cancels an actor when the *last* Future reference drops).
+            if not w._callbacks:
+                w.cancel()
             self._step(exc=ActorCancelled())
         else:
             # Running or queued: mark done; _step will close the coroutine.

@@ -30,10 +30,11 @@ class Knobs:
         setattr(self, name.lower(), value)
 
 
-def make_server_knobs(randomize: bool = False) -> Knobs:
+def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> Knobs:
     """Server knobs used by this framework (subset of fdbserver/Knobs.cpp,
-    numerically identical defaults)."""
-    k = Knobs()
+    numerically identical defaults). Pass `into` to re-initialize an existing
+    instance in place (so importers holding a reference see new values)."""
+    k = into if into is not None else Knobs()
 
     def init(name, default, buggify_fn=None):
         k.init(name, default, buggify_fn if randomize else None)
@@ -67,6 +68,5 @@ SERVER_KNOBS = make_server_knobs()
 
 
 def reset_server_knobs(randomize: bool = False) -> Knobs:
-    global SERVER_KNOBS
-    SERVER_KNOBS = make_server_knobs(randomize)
-    return SERVER_KNOBS
+    """Re-randomize/reset the ambient knobs *in place* (shared by reference)."""
+    return make_server_knobs(randomize, into=SERVER_KNOBS)

@@ -123,8 +123,9 @@ class Scheduler:
         return self.delay(0.0, priority)
 
     # -- run loop -----------------------------------------------------------
-    def _run_one(self) -> bool:
-        """Execute one step. Returns False when no work remains."""
+    def _run_one(self, max_time: Optional[float] = None) -> bool:
+        """Execute one step. Returns False when no work remains (or none
+        before `max_time` — virtual time then rests at `max_time`)."""
         # Fire all timers due at or before now.
         while self._timers and (self._timers[0][0] <= self._now or not self._ready):
             if self._timers[0][0] > self._now:
@@ -132,6 +133,9 @@ class Scheduler:
                     break
                 # advance time
                 t = self._timers[0][0]
+                if max_time is not None and t > max_time:
+                    self._now = max_time  # deadline reached before any work
+                    return False
                 if not self.virtual:
                     _time.sleep(max(0.0, (self._wall_anchor + t) - _time.monotonic()))
                 self._now = t
@@ -156,7 +160,9 @@ class Scheduler:
                 return until.get()
             if timeout_time is not None and self._now >= timeout_time:
                 raise error("timed_out")
-            if not self._run_one():
+            if not self._run_one(max_time=timeout_time):
+                if timeout_time is not None and self._now >= timeout_time:
+                    raise error("timed_out")
                 break
         if until is not None:
             if until.is_ready:

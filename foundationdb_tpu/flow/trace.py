@@ -39,14 +39,20 @@ class TraceCollector:
             self._fh.close()
             self._fh = None
 
+    def reset(self, path: Optional[str] = None) -> None:
+        """Clear state and retarget the output file, in place (the ambient
+        g_trace is shared by reference across modules)."""
+        self.close()
+        self.events.clear()
+        self.counts.clear()
+        self._fh = open(path, "a") if path else None
+
 
 g_trace = TraceCollector()
 
 
 def reset_trace(path: Optional[str] = None) -> TraceCollector:
-    global g_trace
-    g_trace.close()
-    g_trace = TraceCollector(path)
+    g_trace.reset(path)
     return g_trace
 
 

@@ -1,0 +1,19 @@
+"""Conflict-set backends (ref: fdbserver/ConflictSet.h behind a plugin boundary)."""
+
+from .conflict_set import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    BruteForceConflictSet,
+    ConflictSetBase,
+    PyConflictSet,
+    ResolverTransaction,
+)
+from .native_backend import NativeConflictSet, create_conflict_set, native_available
+
+__all__ = [
+    "COMMITTED", "CONFLICT", "TOO_OLD",
+    "BruteForceConflictSet", "ConflictSetBase", "PyConflictSet",
+    "ResolverTransaction", "NativeConflictSet", "create_conflict_set",
+    "native_available",
+]

@@ -1,0 +1,251 @@
+"""MVCC conflict resolution — semantics and CPU baseline.
+
+Reference behavior (re-implemented, not ported):
+  - fdbserver/ConflictSet.h:37-39  verdict enum {Conflict=0, TooOld=1, Committed=2}
+  - fdbserver/SkipList.cpp:979     addTransaction — tooOld iff
+        read_snapshot < oldestVersion AND the txn has read conflict ranges;
+        a tooOld txn contributes no ranges at all
+  - fdbserver/SkipList.cpp:1163    detectConflicts pipeline:
+        (1) external check: a read range [b,e) at snapshot s conflicts iff
+            max history version over intervals intersecting [b,e) is > s
+            (strictly greater; ref CheckMax, SkipList.cpp:789-828)
+        (2) intra-batch (ref checkIntraBatchConflicts, :1133): sequential in
+            transaction order; txns already conflicted are skipped and their
+            writes excluded; a txn conflicts if any of its read ranges
+            overlaps a write range of an earlier non-conflicted txn
+        (3) non-conflicted txns' write ranges are merged into the history
+            as an interval assignment at the batch commit version
+            (ref addConflictRanges, SkipList.cpp:511-522 — end keeps the old
+            suffix version, [b,e) becomes the new version)
+        (4) window GC: oldestVersion = max(oldestVersion, newOldestVersion);
+            intervals at version < oldestVersion are semantically dead
+  - fdbserver/Resolver.actor.cpp:155  newOldestVersion =
+        commitVersion - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+
+The history is modeled as a *step function* over the keyspace: sorted
+boundary keys B[i] with V[i] = max commit version of writes to any key in
+[B[i], B[i+1}). This is exactly the information content of the reference's
+skiplist (per-node maxVersion); the data-structure choice differs because
+each backend optimizes for its hardware (sorted arrays + RMQ on TPU,
+std::map in native C++, bisect lists here).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right, insort
+from typing import Iterable, NamedTuple, Sequence
+
+CONFLICT = 0
+TOO_OLD = 1
+COMMITTED = 2
+
+VERDICT_NAMES = {CONFLICT: "conflict", TOO_OLD: "too_old", COMMITTED: "committed"}
+
+
+class ResolverTransaction(NamedTuple):
+    """One transaction's conflict information (ref: CommitTransactionRef,
+    fdbclient/CommitTransaction.h:136-168 — read/write conflict ranges +
+    read_snapshot)."""
+
+    read_snapshot: int
+    read_ranges: tuple  # of (begin: bytes, end: bytes), half-open
+    write_ranges: tuple  # of (begin: bytes, end: bytes), half-open
+
+
+class ConflictSetBase:
+    """Interface all backends implement; parity across backends is the
+    north-star acceptance criterion."""
+
+    def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
+                new_oldest_version: int) -> list[int]:
+        raise NotImplementedError
+
+    @property
+    def oldest_version(self) -> int:
+        raise NotImplementedError
+
+
+class PyConflictSet(ConflictSetBase):
+    """Pure-Python step-function baseline (sorted boundary list + bisect)."""
+
+    def __init__(self, init_version: int = 0):
+        # Invariant: _keys[0] == b"" always; _vals[i] covers [_keys[i], _keys[i+1}).
+        # init_version baselines the whole keyspace (ref: clearConflictSet /
+        # SkipList(v)); oldestVersion starts at 0 regardless (ref: ConflictSet
+        # ctor, SkipList.cpp:926).
+        self._keys: list[bytes] = [b""]
+        self._vals: list[int] = [init_version]
+        self._oldest = 0
+        self._resolved_batches = 0
+
+    @property
+    def oldest_version(self) -> int:
+        return self._oldest
+
+    # -- queries ------------------------------------------------------------
+    def _range_max(self, begin: bytes, end: bytes) -> int:
+        """Max version over intervals intersecting [begin, end)."""
+        lo = bisect_right(self._keys, begin) - 1  # interval containing begin
+        hi = bisect_left(self._keys, end)  # first boundary >= end
+        return max(self._vals[lo:hi])
+
+    # -- updates ------------------------------------------------------------
+    def _assign(self, begin: bytes, end: bytes, version: int) -> None:
+        """Set version for all keys in [begin, end) (ref: addConflictRanges)."""
+        hi = bisect_right(self._keys, end) - 1
+        v_end = self._vals[hi]  # version of the interval containing `end`
+        lo = bisect_left(self._keys, begin)
+        e_idx = bisect_left(self._keys, end)
+        has_end = e_idx < len(self._keys) and self._keys[e_idx] == end
+        repl_keys, repl_vals = [begin], [version]
+        if not has_end:
+            repl_keys.append(end)
+            repl_vals.append(v_end)
+        self._keys[lo:e_idx] = repl_keys
+        self._vals[lo:e_idx] = repl_vals
+
+    def _compact(self) -> None:
+        """Collapse adjacent intervals that are both dead (< oldest) or equal.
+
+        Dead intervals (version < oldestVersion) cannot conflict with any
+        non-tooOld read, so merging them (keeping the max) is invisible
+        (ref: removeBefore, SkipList.cpp:665 — the same window GC)."""
+        keys, vals, oldest = self._keys, self._vals, self._oldest
+        nk, nv = [keys[0]], [vals[0]]
+        for i in range(1, len(keys)):
+            v = vals[i]
+            if (v < oldest and nv[-1] < oldest) or v == nv[-1]:
+                if v > nv[-1]:
+                    nv[-1] = v
+            else:
+                nk.append(keys[i])
+                nv.append(v)
+        self._keys, self._vals = nk, nv
+
+    # -- the resolve step ---------------------------------------------------
+    def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
+                new_oldest_version: int) -> list[int]:
+        n = len(txns)
+        too_old = [False] * n
+        conflict = [False] * n
+
+        for t, tr in enumerate(txns):
+            if tr.read_snapshot < self._oldest and len(tr.read_ranges):
+                too_old[t] = True
+
+        # (1) external check against history
+        for t, tr in enumerate(txns):
+            if too_old[t]:
+                continue
+            for b, e in tr.read_ranges:
+                if b < e and self._range_max(b, e) > tr.read_snapshot:
+                    conflict[t] = True
+                    break
+
+        # (2) intra-batch, sequential in batch order
+        written: list[tuple[bytes, bytes]] = []  # sorted by begin, disjoint
+        wkeys: list[bytes] = []  # begins, for bisect
+        for t, tr in enumerate(txns):
+            if conflict[t]:
+                continue
+            c = too_old[t]
+            if not c:
+                for b, e in tr.read_ranges:
+                    if b < e and _overlaps_any(written, wkeys, b, e):
+                        c = True
+                        break
+            conflict[t] = c
+            if not c:
+                for b, e in tr.write_ranges:
+                    if b < e:
+                        _interval_union_add(written, wkeys, b, e)
+
+        # (3) merge surviving writes into history at the commit version
+        for b, e in written:
+            self._assign(b, e, commit_version)
+
+        # (4) window GC
+        if new_oldest_version > self._oldest:
+            self._oldest = new_oldest_version
+        self._resolved_batches += 1
+        if self._resolved_batches % 16 == 0:
+            self._compact()
+
+        return [TOO_OLD if too_old[t] else (CONFLICT if conflict[t] else COMMITTED)
+                for t in range(n)]
+
+
+def _overlaps_any(written: list, wkeys: list, b: bytes, e: bytes) -> bool:
+    """Does [b,e) intersect any interval in the sorted disjoint set?"""
+    i = bisect_right(wkeys, b) - 1
+    if i >= 0 and written[i][1] > b:
+        return True
+    i += 1
+    return i < len(written) and written[i][0] < e
+
+
+def _interval_union_add(written: list, wkeys: list, b: bytes, e: bytes) -> None:
+    """Insert [b,e) into a sorted disjoint interval set, coalescing overlaps."""
+    i = bisect_right(wkeys, b) - 1
+    start = i if (i >= 0 and written[i][1] >= b) else i + 1
+    j = start
+    while j < len(written) and written[j][0] <= e:
+        j += 1
+    if start < j:
+        b = min(b, written[start][0])
+        e = max(e, written[j - 1][1])
+    written[start:j] = [(b, e)]
+    wkeys[start:j] = [b]
+
+
+class BruteForceConflictSet(ConflictSetBase):
+    """O(everything) model for randomized cross-checks (ref test model:
+    workloads/ConflictRange.actor.cpp:30 — exact conflict-or-not vs a model).
+
+    Keeps every committed write range with its version; no GC compaction, so
+    it is the ground truth the optimized backends must match bit-for-bit.
+    """
+
+    def __init__(self, init_version: int = 0):
+        # \xff*64 stands in for the end of the keyspace; tests stay below it.
+        self._writes: list[tuple[bytes, bytes, int]] = [(b"", b"\xff" * 64, init_version)]
+        self._oldest = 0
+
+    @property
+    def oldest_version(self) -> int:
+        return self._oldest
+
+    def resolve(self, txns, commit_version, new_oldest_version):
+        n = len(txns)
+        verdicts = [COMMITTED] * n
+        added: list[tuple[bytes, bytes]] = []
+        for t, tr in enumerate(txns):
+            if tr.read_snapshot < self._oldest and len(tr.read_ranges):
+                verdicts[t] = TOO_OLD
+                continue
+            bad = False
+            for b, e in tr.read_ranges:
+                if b >= e:
+                    continue
+                for wb, we, wv in self._writes:
+                    if wb < e and b < we and wv > tr.read_snapshot:
+                        bad = True
+                        break
+                if not bad:
+                    for wb, we in added:
+                        if wb < e and b < we:
+                            bad = True
+                            break
+                if bad:
+                    break
+            if bad:
+                verdicts[t] = CONFLICT
+            else:
+                for b, e in tr.write_ranges:
+                    if b < e:
+                        added.append((b, e))
+        for b, e in added:
+            self._writes.append((b, e, commit_version))
+        if new_oldest_version > self._oldest:
+            self._oldest = new_oldest_version
+        return verdicts

@@ -1,0 +1,156 @@
+"""ctypes binding for the native C++ conflict-set backend.
+
+Plugin boundary analogous to the reference's LoadPlugin mechanism
+(fdbrpc/LoadPlugin.h:29-44 — loadLibrary + resolve symbols): the resolver
+selects a backend ("python" / "native" / "tpu") at startup, and all
+backends honor the same ConflictSetBase contract so the deterministic
+simulator can replay identical verdicts against any of them.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .conflict_set import ConflictSetBase, ResolverTransaction
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libfdbtpu_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_library() -> None:
+    subprocess.run(["make", "-C", os.path.join(_REPO_ROOT, "native")],
+                   check=True, capture_output=True)
+
+
+def load_native_library(build_if_missing: bool = True) -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and build_if_missing:
+        _build_library()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.fdbtpu_conflictset_new.restype = ctypes.c_void_p
+    lib.fdbtpu_conflictset_new.argtypes = [ctypes.c_int64]
+    lib.fdbtpu_conflictset_destroy.argtypes = [ctypes.c_void_p]
+    lib.fdbtpu_conflictset_oldest.restype = ctypes.c_int64
+    lib.fdbtpu_conflictset_oldest.argtypes = [ctypes.c_void_p]
+    lib.fdbtpu_conflictset_interval_count.restype = ctypes.c_int64
+    lib.fdbtpu_conflictset_interval_count.argtypes = [ctypes.c_void_p]
+    lib.fdbtpu_conflictset_resolve.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64),   # snapshots
+        ctypes.POINTER(ctypes.c_int32),   # read_counts
+        ctypes.POINTER(ctypes.c_int32),   # write_counts
+        ctypes.POINTER(ctypes.c_uint8),   # key_blob
+        ctypes.POINTER(ctypes.c_int64),   # read_ranges
+        ctypes.POINTER(ctypes.c_int64),   # write_ranges
+        ctypes.POINTER(ctypes.c_uint8),   # verdicts_out
+    ]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    try:
+        load_native_library()
+        return True
+    except Exception:
+        return False
+
+
+def _marshal(txns: Sequence[ResolverTransaction]):
+    """Flatten a batch into the C ABI arrays."""
+    n = len(txns)
+    snapshots = np.empty(n, dtype=np.int64)
+    read_counts = np.empty(n, dtype=np.int32)
+    write_counts = np.empty(n, dtype=np.int32)
+    blob_parts: list[bytes] = []
+    read_quads: list[int] = []
+    write_quads: list[int] = []
+    off = 0
+
+    def push(key: bytes) -> tuple[int, int]:
+        nonlocal off
+        blob_parts.append(key)
+        o = off
+        off += len(key)
+        return o, len(key)
+
+    for t, tr in enumerate(txns):
+        snapshots[t] = tr.read_snapshot
+        read_counts[t] = len(tr.read_ranges)
+        write_counts[t] = len(tr.write_ranges)
+        for b, e in tr.read_ranges:
+            read_quads.extend(push(b))
+            read_quads.extend(push(e))
+        for b, e in tr.write_ranges:
+            write_quads.extend(push(b))
+            write_quads.extend(push(e))
+
+    blob = np.frombuffer(b"".join(blob_parts) or b"\x00", dtype=np.uint8)
+    rr = np.asarray(read_quads or [0], dtype=np.int64)
+    wr = np.asarray(write_quads or [0], dtype=np.int64)
+    return snapshots, read_counts, write_counts, blob, rr, wr
+
+
+class NativeConflictSet(ConflictSetBase):
+    """Native C++ step-function backend (see native/conflictset.cpp)."""
+
+    def __init__(self, init_version: int = 0):
+        self._lib = load_native_library()
+        self._handle = self._lib.fdbtpu_conflictset_new(init_version)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.fdbtpu_conflictset_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+    @property
+    def oldest_version(self) -> int:
+        return self._lib.fdbtpu_conflictset_oldest(self._handle)
+
+    @property
+    def interval_count(self) -> int:
+        return self._lib.fdbtpu_conflictset_interval_count(self._handle)
+
+    def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
+                new_oldest_version: int) -> list[int]:
+        n = len(txns)
+        if n == 0:
+            return []
+        snapshots, rc, wc, blob, rr, wr = _marshal(txns)
+        out = np.empty(n, dtype=np.uint8)
+        p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))  # noqa: E731
+        self._lib.fdbtpu_conflictset_resolve(
+            self._handle, commit_version, new_oldest_version, n,
+            p(snapshots, ctypes.c_int64), p(rc, ctypes.c_int32),
+            p(wc, ctypes.c_int32), p(blob, ctypes.c_uint8),
+            p(rr, ctypes.c_int64), p(wr, ctypes.c_int64),
+            p(out, ctypes.c_uint8))
+        return out.tolist()
+
+
+def create_conflict_set(backend: str = "python", init_version: int = 0) -> ConflictSetBase:
+    """Backend factory — the plugin selection point (ref: LoadPlugin)."""
+    if backend == "python":
+        from .conflict_set import PyConflictSet
+        return PyConflictSet(init_version)
+    if backend == "native":
+        return NativeConflictSet(init_version)
+    if backend == "tpu":
+        try:
+            from .tpu_resolver import TpuConflictSet
+        except ImportError as e:
+            raise ValueError(f"tpu conflict-set backend unavailable: {e}") from e
+        return TpuConflictSet(init_version)
+    raise ValueError(f"unknown conflict-set backend: {backend}")

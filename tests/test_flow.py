@@ -359,3 +359,74 @@ def test_actor_collection_reaps():
     s.run()
     assert ac.tasks == []
     set_scheduler(None)
+
+
+def test_cancel_one_waiter_of_shared_future(sched):
+    """Cancelling one waiter must not cancel the shared producer (ref: flow
+    cancels only when the last reference drops)."""
+    async def producer():
+        await flow.delay(2.0)
+        return "product"
+
+    p = sched.spawn(producer())
+
+    async def consumer():
+        return await p
+
+    a = sched.spawn(consumer())
+    b = sched.spawn(consumer())
+
+    async def canceller():
+        await flow.delay(1.0)
+        a.cancel()
+
+    sched.spawn(canceller())
+    assert sched.run(until=b) == "product"
+    assert not p.is_error
+
+
+def test_cancel_all_cancels_every_member(sched):
+    from foundationdb_tpu.flow import ActorCollection
+    ac = ActorCollection()
+    states = []
+
+    async def member(i):
+        try:
+            await flow.delay(100.0)
+        except ActorCancelled:
+            states.append(i)
+            raise
+
+    for i in range(3):
+        ac.add(sched.spawn(member(i)))
+
+    async def canceller():
+        await flow.delay(1.0)
+        ac.cancel_all()
+
+    sched.spawn(canceller())
+    sched.run()
+    assert sorted(states) == [0, 1, 2]
+
+
+def test_run_timeout_does_not_execute_past_deadline(sched):
+    fired = []
+
+    async def late():
+        await flow.delay(10.0)
+        fired.append("late")
+
+    sched.spawn(late())
+    with pytest.raises(FdbError) as ei:
+        sched.run(until=Future(), timeout_time=5.0)
+    assert ei.value.code == 1004
+    assert fired == []
+    assert sched.now() == 5.0
+
+
+def test_knob_reset_in_place():
+    from foundationdb_tpu.flow import SERVER_KNOBS, reset_server_knobs
+    old = SERVER_KNOBS.versions_per_second
+    got = reset_server_knobs()
+    assert got is SERVER_KNOBS
+    assert SERVER_KNOBS.versions_per_second == old
