@@ -134,6 +134,9 @@ class Scheduler:
                 # advance time
                 t = self._timers[0][0]
                 if max_time is not None and t > max_time:
+                    if not self.virtual:
+                        _time.sleep(max(
+                            0.0, (self._wall_anchor + max_time) - _time.monotonic()))
                     self._now = max_time  # deadline reached before any work
                     return False
                 if not self.virtual:

@@ -33,7 +33,10 @@ def load_native_library(build_if_missing: bool = True) -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and build_if_missing:
+    if build_if_missing:
+        # make is mtime-incremental: a no-op when the .so is current, a
+        # rebuild when conflictset.cpp changed (the artifact is never
+        # committed — it is arch-specific via -march=native).
         _build_library()
     lib = ctypes.CDLL(_LIB_PATH)
     lib.fdbtpu_conflictset_new.restype = ctypes.c_void_p

@@ -1,0 +1,219 @@
+"""TPU conflict-set backend: host wrapper around the jitted kernel.
+
+Same `ConflictSetBase` contract as the CPU baselines (the plugin
+boundary, ref fdbrpc/LoadPlugin.h:29-44), so the resolver and the
+deterministic simulator can swap backends and demand bit-identical
+verdicts (ref self-check pattern: fdbserver/SkipList.cpp:1412-1551
+skipListTest vs SlowConflictSet).
+
+Host responsibilities (everything the device can't do with static
+shapes):
+  - marshal `ResolverTransaction` batches into flat padded arrays,
+    bucketing txn/range counts to powers of two to bound recompiles;
+  - track the absolute version base: the device stores int32 offsets
+    (TPU-native word size) and is re-based long before overflow — valid
+    because the MVCC window is only MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+    wide (ref fdbserver/Knobs.cpp MAX_WRITE_TRANSACTION_LIFE_VERSIONS,
+    Resolver.actor.cpp:155);
+  - the tooOld test (snapshot < oldestVersion AND has reads, ref
+    SkipList.cpp:979 addTransaction) on absolute versions;
+  - grow the history capacity by doubling when the boundary count
+    approaches it.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from .conflict_set import (COMMITTED, CONFLICT, TOO_OLD, ConflictSetBase,
+                           ResolverTransaction)
+
+# Minimum shape buckets: small batches all land in one compiled kernel
+# instead of one per size (first compile is the expensive part on TPU).
+_KERNEL_MIN_TXNS = 16
+_KERNEL_MIN_RANGES = 32
+_MIN_CAP = 1 << 10
+
+
+class TpuConflictSet(ConflictSetBase):
+    def __init__(self, init_version: int = 0, key_bytes: int = 32,
+                 capacity: int = _MIN_CAP):
+        if key_bytes % 4:
+            raise ValueError("key_bytes must be a multiple of 4")
+        from ..ops.conflict_kernel import REBASE_THRESHOLD  # noqa: F401
+        self._key_bytes = key_bytes
+        self._n_words = key_bytes // 4
+        self._cap = max(_MIN_CAP, int(capacity))
+        if init_version >= (1 << 30):
+            raise ValueError("init_version too large for the version window")
+        self._base = 0
+        self._oldest = 0
+        self._last_commit = init_version
+        self._count_hint = 1
+        self._count_dev = None
+        hk = np.full((self._cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
+        hk[0] = 0
+        hv = np.full((self._cap,), -(1 << 30), np.int32)
+        hv[0] = init_version
+        self._hk, self._hv = self._to_device(hk, hv)
+
+    # -- device state helpers -------------------------------------------
+    @staticmethod
+    def _to_device(hk: np.ndarray, hv: np.ndarray):
+        import jax.numpy as jnp
+        return jnp.asarray(hk), jnp.asarray(hv)
+
+    @property
+    def oldest_version(self) -> int:
+        return self._oldest
+
+    @property
+    def interval_count(self) -> int:
+        self._sync_count()
+        return self._count_hint
+
+    def _sync_count(self) -> None:
+        if self._count_dev is not None:
+            self._count_hint = int(self._count_dev)
+            self._count_dev = None
+
+    def _grow(self, needed: int) -> None:
+        from ..ops.keys import next_pow2
+        new_cap = max(self._cap * 2, next_pow2(needed + 2))
+        hk = np.full((new_cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
+        hv = np.full((new_cap,), -(1 << 30), np.int32)
+        hk[:self._cap] = np.asarray(self._hk)
+        hv[:self._cap] = np.asarray(self._hv)
+        self._cap = new_cap
+        self._hk, self._hv = self._to_device(hk, hv)
+
+    def _maybe_rebase(self, commit_version: int) -> None:
+        from ..ops.conflict_kernel import REBASE_THRESHOLD, make_rebase_fn
+        if commit_version - self._base < REBASE_THRESHOLD:
+            return
+        delta = self._oldest - self._base
+        if commit_version - self._oldest >= REBASE_THRESHOLD:
+            raise OverflowError(
+                "version window exceeds 2^30: advance new_oldest_version "
+                "(ref: MAX_WRITE_TRANSACTION_LIFE_VERSIONS keeps the live "
+                "window ~5e6 versions wide)")
+        import jax.numpy as jnp
+        self._hv = make_rebase_fn()(self._hv, jnp.int32(delta))
+        self._base = self._oldest
+
+    # -- resolve --------------------------------------------------------
+    def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
+                new_oldest_version: int) -> list[int]:
+        conflict, too_old, n = self._resolve_flags(
+            txns, commit_version, new_oldest_version)
+        if n == 0:
+            return []
+        conflict = np.asarray(conflict)[:n]
+        return [TOO_OLD if too_old[t] else
+                (CONFLICT if conflict[t] else COMMITTED) for t in range(n)]
+
+    def _resolve_flags(self, txns, commit_version, new_oldest_version):
+        """Dispatch one batch; returns (device conflict flags, too_old, n).
+
+        Kept separate from `resolve` so callers that can overlap host and
+        device work (the proxy pipeline / bench) can defer the readback.
+        """
+        if commit_version < self._last_commit:
+            raise ValueError("commit versions must be non-decreasing "
+                             "(ref: Resolver version ordering, "
+                             "Resolver.actor.cpp:104-115)")
+        self._last_commit = commit_version
+        n = len(txns)
+        if n == 0:
+            self._oldest = max(self._oldest, new_oldest_version)
+            return None, None, 0
+        self._maybe_rebase(commit_version)
+
+        too_old = np.zeros(n, bool)
+        snapshots = np.zeros(n, np.int64)
+        read_b: list[bytes] = []
+        read_e: list[bytes] = []
+        read_t: list[int] = []
+        write_b: list[bytes] = []
+        write_e: list[bytes] = []
+        write_t: list[int] = []
+        for t, tr in enumerate(txns):
+            snapshots[t] = tr.read_snapshot
+            if tr.read_snapshot < self._oldest and len(tr.read_ranges):
+                too_old[t] = True
+                continue
+            for b, e in tr.read_ranges:
+                if b < e:
+                    read_b.append(b)
+                    read_e.append(e)
+                    read_t.append(t)
+            for b, e in tr.write_ranges:
+                if b < e:
+                    write_b.append(b)
+                    write_e.append(e)
+                    write_t.append(t)
+
+        from ..ops.keys import encode_keys
+        nr, nw = len(read_t), len(write_t)
+        keys = encode_keys(read_b + read_e + write_b + write_e,
+                           self._key_bytes)
+        conflict = self._dispatch(
+            n, snapshots, too_old,
+            keys[:nr], keys[nr:2 * nr], np.asarray(read_t, np.int32),
+            keys[2 * nr:2 * nr + nw], keys[2 * nr + nw:],
+            np.asarray(write_t, np.int32),
+            commit_version, new_oldest_version)
+        self._oldest = max(self._oldest, new_oldest_version)
+        return conflict, too_old, n
+
+    def _dispatch(self, n, snapshots, too_old, rb, re, rt, wb, we, wt,
+                  commit_version, new_oldest_version):
+        import jax.numpy as jnp
+
+        from ..ops.conflict_kernel import SNAP_CLAMP, make_resolve_fn
+        from ..ops.keys import next_pow2
+
+        nr, nw = rb.shape[0], wb.shape[0]
+        npad = next_pow2(max(n, _KERNEL_MIN_TXNS))
+        nrp = next_pow2(max(nr + 1, _KERNEL_MIN_RANGES))
+        nwp = next_pow2(max(nw + 1, _KERNEL_MIN_RANGES))
+
+        if self._count_hint + 2 * nw + 2 > self._cap:
+            self._sync_count()
+        if self._count_hint + 2 * nw + 2 > self._cap:
+            self._grow(self._count_hint + 2 * nw)
+        self._count_hint = min(self._cap - 1, self._count_hint + 2 * nw)
+
+        def pad_keys(a, size):
+            out = np.zeros((size, self._n_words + 1), np.uint32)
+            out[:a.shape[0]] = a
+            return out
+
+        def pad_idx(a, size, fill):
+            out = np.full((size,), fill, np.int32)
+            out[:a.shape[0]] = a
+            return out
+
+        snap_off = np.clip(snapshots - self._base, 0, SNAP_CLAMP).astype(np.int32)
+        snap_p = np.zeros(npad, np.int32)
+        snap_p[:n] = snap_off
+        tooold_p = np.zeros(npad, bool)
+        tooold_p[:n] = too_old
+        rvalid = np.zeros(nrp, bool)
+        rvalid[:nr] = True
+        wvalid = np.zeros(nwp, bool)
+        wvalid[:nw] = True
+
+        fn = make_resolve_fn(self._cap, npad, nrp, nwp, self._n_words)
+        self._hk, self._hv, count, conflict = fn(
+            self._hk, self._hv, jnp.asarray(snap_p), jnp.asarray(tooold_p),
+            jnp.asarray(pad_keys(rb, nrp)), jnp.asarray(pad_keys(re, nrp)),
+            jnp.asarray(pad_idx(rt, nrp, npad)), jnp.asarray(rvalid),
+            jnp.asarray(pad_keys(wb, nwp)), jnp.asarray(pad_keys(we, nwp)),
+            jnp.asarray(pad_idx(wt, nwp, npad)), jnp.asarray(wvalid),
+            jnp.int32(commit_version - self._base),
+            jnp.int32(max(self._oldest, new_oldest_version) - self._base))
+        self._count_dev = count
+        return conflict

@@ -1,0 +1,22 @@
+"""Device-side primitive ops (JAX/XLA) shared by the TPU backends.
+
+These are the TPU-first building blocks: fixed-width multiword key
+arithmetic, branchless vectorized binary search, and sparse-table
+range-max — the array re-expression of the reference's SkipList
+traversals (fdbserver/SkipList.cpp:524-639).
+"""
+
+from .keys import (
+    INF_WORD,
+    encode_keys,
+    le_rows,
+    lt_rows,
+    next_pow2,
+    searchsorted_rows,
+)
+from .rmq import build_range_max_table, range_max
+
+__all__ = [
+    "INF_WORD", "encode_keys", "le_rows", "lt_rows", "next_pow2",
+    "searchsorted_rows", "build_range_max_table", "range_max",
+]

@@ -1,0 +1,95 @@
+"""Fixed-width key encoding and vectorized ordered search.
+
+FDB keys are variable-length byte strings ordered lexicographically
+(fdbclient/FDBTypes.h `KeyRef`; ordering contract used throughout
+fdbserver/SkipList.cpp:147-196). TPUs want fixed shapes, so a key is
+encoded as W big-endian uint32 words (zero-padded) plus one trailing
+length word. Lexicographic comparison of the (W+1)-word vectors equals
+lexicographic comparison of the original byte strings:
+
+  - within min(len_a, len_b) bytes, the first differing byte decides and
+    big-endian packing preserves that;
+  - if one key is a proper prefix of the other, the padded words are
+    equal up to the longer key's next nonzero byte (correct), or fully
+    equal, in which case the length word breaks the tie (shorter first —
+    exactly the prefix rule).
+
+The all-ones vector (length word 0xFFFFFFFF > any real length) is a
++infinity sentinel strictly above every real key; sorted device arrays
+are padded with it so searches need no explicit count.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+INF_WORD = np.uint32(0xFFFFFFFF)
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def encode_keys(keys: Sequence[bytes], key_bytes: int) -> np.ndarray:
+    """Encode byte-string keys into [n, W+1] uint32 rows (host side)."""
+    n = len(keys)
+    n_words = key_bytes // 4
+    buf = np.zeros((max(n, 1), key_bytes), dtype=np.uint8)
+    out = np.zeros((max(n, 1), n_words + 1), dtype=np.uint32)
+    for i, k in enumerate(keys):
+        kl = len(k)
+        if kl > key_bytes:
+            raise ValueError(
+                f"key length {kl} exceeds backend key width {key_bytes}")
+        if kl:
+            buf[i, :kl] = np.frombuffer(k, np.uint8)
+        out[i, n_words] = kl
+    shifts = np.array([1 << 24, 1 << 16, 1 << 8, 1], np.uint32)
+    out[:, :n_words] = (
+        buf.reshape(max(n, 1), n_words, 4).astype(np.uint32) * shifts
+    ).sum(axis=2, dtype=np.uint32)
+    return out[:n]
+
+
+def lt_rows(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic a < b over the trailing word axis ([..., W+1])."""
+    neq = a != b
+    idx = jnp.argmax(neq, axis=-1)
+    any_neq = jnp.any(neq, axis=-1)
+    av = jnp.take_along_axis(a, idx[..., None], axis=-1)[..., 0]
+    bv = jnp.take_along_axis(b, idx[..., None], axis=-1)[..., 0]
+    return any_neq & (av < bv)
+
+
+def le_rows(a: jax.Array, b: jax.Array) -> jax.Array:
+    return ~lt_rows(b, a)
+
+
+def searchsorted_rows(table: jax.Array, queries: jax.Array,
+                      side: str = "left") -> jax.Array:
+    """Vectorized multiword binary search.
+
+    `table` is [cap, W+1], sorted, cap a power of two, with at least one
+    +inf pad row (so every answer is <= cap-1). Returns for each query
+    the count of rows < query ("left") or <= query ("right") — the array
+    re-expression of the SkipList finger search
+    (fdbserver/SkipList.cpp:587-639), branchless so XLA vectorizes the
+    whole query batch per step.
+    """
+    cap = table.shape[0]
+    assert cap & (cap - 1) == 0, "table length must be a power of two"
+    logn = cap.bit_length() - 1
+    cmp = lt_rows if side == "left" else le_rows
+    pos0 = jnp.zeros(queries.shape[0], jnp.int32)
+
+    def body(i, pos):
+        step = jnp.int32(cap) >> (i + 1)
+        probe = jnp.take(table, pos + step - 1, axis=0)
+        return pos + step * cmp(probe, queries).astype(jnp.int32)
+
+    return lax.fori_loop(0, logn, body, pos0)

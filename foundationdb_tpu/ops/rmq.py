@@ -1,0 +1,45 @@
+"""Sparse-table range-max over version arrays.
+
+The reference answers "max commit version over intervals intersecting
+[begin, end)" with a per-level maxVersion pyramid inside the SkipList
+(fdbserver/SkipList.cpp:311-377 Node levels, :755-837 CheckMax). The
+array equivalent: an O(n log n) doubling table built once per batch,
+then O(1) per query via two overlapping power-of-two windows — every
+query in the batch resolved in one vectorized gather pair.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+VDEAD = -(1 << 30)  # version of padded / dead slots; below any live version
+
+
+def build_range_max_table(vals: jax.Array) -> jax.Array:
+    """vals: [n] int32, n a power of two. Returns [L, n] with
+    table[k, i] = max(vals[i : i + 2**k])."""
+    n = vals.shape[0]
+    levels = [vals]
+    k = 1
+    while (1 << k) <= n:
+        prev = levels[-1]
+        half = 1 << (k - 1)
+        shifted = jnp.concatenate(
+            [prev[half:], jnp.full((half,), VDEAD, prev.dtype)])
+        levels.append(jnp.maximum(prev, shifted))
+        k += 1
+    return jnp.stack(levels)
+
+
+def range_max(table: jax.Array, lo: jax.Array, hi: jax.Array) -> jax.Array:
+    """Max over [lo, hi) per query; empty ranges give VDEAD."""
+    n = table.shape[1]
+    length = hi - lo
+    safe_len = jnp.maximum(length, 1)
+    k = 31 - lax.clz(safe_len)
+    flat = table.reshape(-1)
+    a = jnp.take(flat, k * n + lo)
+    b = jnp.take(flat, k * n + hi - (jnp.int32(1) << k))
+    return jnp.where(length > 0, jnp.maximum(a, b), jnp.int32(VDEAD))

@@ -3,6 +3,7 @@ parity (ref test model: workloads/ConflictRange.actor.cpp randomized
 conflict-or-not checks vs a model, and -r skiplisttest self-check vs
 SlowConflictSet, SkipList.cpp:1412-1551)."""
 
+import importlib.util
 import random
 
 import pytest
@@ -30,6 +31,9 @@ def backends():
     if native_available():
         from foundationdb_tpu.models import NativeConflictSet
         out.append(("native", NativeConflictSet))
+    if importlib.util.find_spec("jax") is not None:
+        from foundationdb_tpu.models.tpu_resolver import TpuConflictSet
+        out.append(("tpu", TpuConflictSet))
     return out
 
 
@@ -172,7 +176,7 @@ def test_empty_transaction_commits(cs_factory):
 def test_initial_version_covers_keyspace(cs_factory):
     """After init at version V, reads below V conflict everywhere
     (ref: clearConflictSet / SkipList(v) header maxVersion)."""
-    cs = cs_factory(1000) if cs_factory is not BruteForceConflictSet else cs_factory(1000)
+    cs = cs_factory(1000)
     assert cs.resolve([txn(500, reads=[(b"anything", b"anythinh")])], 2000, 0) == [CONFLICT]
     assert cs.resolve([txn(1000, reads=[(b"anything", b"anythinh")])], 2000, 0) == [COMMITTED]
 

@@ -1,0 +1,119 @@
+"""TPU conflict-set backend specifics: capacity growth, version rebasing,
+key-width limits, and heavier randomized parity at larger batch sizes
+(the directed + cross-backend semantics live in test_conflict_semantics)."""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.models import (
+    COMMITTED,
+    CONFLICT,
+    BruteForceConflictSet,
+    ResolverTransaction,
+    create_conflict_set,
+)
+from foundationdb_tpu.models.tpu_resolver import TpuConflictSet
+
+MWTLV = 5_000_000
+
+
+def txn(snapshot, reads=(), writes=()):
+    return ResolverTransaction(snapshot, tuple(reads), tuple(writes))
+
+
+def test_factory_builds_tpu_backend():
+    cs = create_conflict_set("tpu")
+    assert isinstance(cs, TpuConflictSet)
+    assert cs.resolve([txn(0, writes=[(b"a", b"b")])], 100, 0) == [COMMITTED]
+
+
+def test_capacity_growth_preserves_history():
+    cs = TpuConflictSet(capacity=1024)
+    # >1024 distinct boundary keys force at least one doubling
+    v = 0
+    for i in range(40):
+        v += 10
+        writes = [(b"k%04d" % (i * 40 + j), b"k%04d\x00" % (i * 40 + j))
+                  for j in range(40)]
+        cs.resolve([txn(v - 10, writes=writes)], v, 0)
+    assert cs._cap > 1024
+    # every one of those writes is still visible to an old snapshot
+    rng = random.Random(7)
+    for _ in range(20):
+        k = b"k%04d" % rng.randrange(40 * 40)
+        got = cs.resolve([txn(0, reads=[(k, k + b"\x00")])], v + 1, 0)
+        assert got == [CONFLICT]
+
+
+def test_rebase_at_large_versions():
+    """Versions past 2^30 must keep working via int32 offset rebasing."""
+    cs = TpuConflictSet()
+    brute = BruteForceConflictSet()
+    v = 0
+    rng = random.Random(3)
+    for _ in range(12):
+        v += 300_000_000  # crosses the 2^30 rebase threshold repeatedly
+        oldest = v - MWTLV
+        batch = [txn(v - rng.randrange(0, MWTLV // 2),
+                     reads=[(b"a", b"c")] if rng.random() < 0.5 else [],
+                     writes=[(b"b", b"b\x00")] if rng.random() < 0.5 else [])
+                 for _ in range(5)]
+        assert cs.resolve(batch, v, oldest) == brute.resolve(batch, v, oldest)
+    assert cs._base > 0  # a rebase actually happened
+
+
+def test_window_must_advance_past_threshold():
+    cs = TpuConflictSet()
+    cs.resolve([txn(0, writes=[(b"a", b"b")])], 100, 0)
+    with pytest.raises(OverflowError):
+        # huge version jump with a stale window: cannot rebase
+        cs.resolve([txn(0, writes=[(b"a", b"b")])], 1 << 31, 0)
+
+
+def test_key_longer_than_width_rejected():
+    cs = TpuConflictSet(key_bytes=16)
+    with pytest.raises(ValueError):
+        cs.resolve([txn(0, writes=[(b"x" * 17, b"y" * 17)])], 100, 0)
+
+
+def test_commit_version_regression_rejected():
+    cs = TpuConflictSet()
+    cs.resolve([txn(0, writes=[(b"a", b"b")])], 100, 0)
+    with pytest.raises(ValueError):
+        cs.resolve([txn(0, writes=[(b"a", b"b")])], 50, 0)
+
+
+def test_empty_batch_advances_window():
+    cs = TpuConflictSet()
+    assert cs.resolve([], 100, 40) == []
+    assert cs.oldest_version == 40
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_randomized_parity_large_batches(seed):
+    """Bigger batches than the cross-backend suite: exercises the
+    intra-batch fixpoint at real batch sizes and periodic compaction."""
+    rng = random.Random(seed)
+    tpu = TpuConflictSet(capacity=1024)
+    brute = BruteForceConflictSet()
+    version = 0
+
+    def rrange():
+        a = bytes([rng.randrange(10), rng.randrange(10)])
+        b = bytes([rng.randrange(10), rng.randrange(10)])
+        if a > b:
+            a, b = b, a
+        if a == b:
+            b = a + b"\x00"
+        return a, b
+
+    for _ in range(12):
+        version += rng.randrange(1, 400_000)
+        oldest = max(0, version - MWTLV)
+        batch = [txn(max(0, version - rng.randrange(0, MWTLV)),
+                     [rrange() for _ in range(rng.randrange(0, 5))],
+                     [rrange() for _ in range(rng.randrange(0, 5))])
+                 for _ in range(100)]
+        assert tpu.resolve(batch, version, oldest) == \
+            brute.resolve(batch, version, oldest)
