@@ -36,8 +36,13 @@ def load_native_library(build_if_missing: bool = True) -> ctypes.CDLL:
     if build_if_missing:
         # make is mtime-incremental: a no-op when the .so is current, a
         # rebuild when conflictset.cpp changed (the artifact is never
-        # committed — it is arch-specific via -march=native).
-        _build_library()
+        # committed — it is arch-specific via -march=native). If the
+        # toolchain is absent but a usable .so exists, fall back to it.
+        try:
+            _build_library()
+        except Exception:
+            if not os.path.exists(_LIB_PATH):
+                raise
     lib = ctypes.CDLL(_LIB_PATH)
     lib.fdbtpu_conflictset_new.restype = ctypes.c_void_p
     lib.fdbtpu_conflictset_new.argtypes = [ctypes.c_int64]

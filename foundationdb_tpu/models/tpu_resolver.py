@@ -76,7 +76,8 @@ class TpuConflictSet(ConflictSetBase):
 
     def _sync_count(self) -> None:
         if self._count_dev is not None:
-            self._count_hint = int(self._count_dev)
+            # scalar for the single-shard backend, [n_shards] when sharded
+            self._count_hint = int(np.max(np.asarray(self._count_dev)))
             self._count_dev = None
 
     def _grow(self, needed: int) -> None:
@@ -89,19 +90,58 @@ class TpuConflictSet(ConflictSetBase):
         self._cap = new_cap
         self._hk, self._hv = self._to_device(hk, hv)
 
-    def _maybe_rebase(self, commit_version: int) -> None:
+    def _prepare_versions(self, commit_version: int, new_oldest_version: int,
+                          window_floor: int):
+        """Pick int32 offsets for this batch, re-basing if needed.
+
+        Returns (commit_off, oldest_off, fixup). `window_floor` is the
+        lowest version whose exact ordering still matters this batch:
+        min over (the incoming oldestVersion, every non-tooOld read
+        snapshot). Stored versions <= the base can never exceed any
+        checked snapshot again, so clamping them during a shift is
+        verdict-invariant.
+
+        If the batch itself spans >= 2^30 versions (a recovery-style
+        jump with pre-jump snapshots still live), verdicts are computed
+        as usual — they never depend on the commit version's magnitude —
+        with the merge done at a placeholder offset; the returned fixup
+        (applied right after the kernel) rewrites placeholder entries to
+        the true commit version relative to a fresh base. Valid because
+        after the jump every earlier version is below the new
+        oldestVersion, hence below every future checked snapshot."""
         from ..ops.conflict_kernel import REBASE_THRESHOLD, make_rebase_fn
-        if commit_version - self._base < REBASE_THRESHOLD:
-            return
-        delta = self._oldest - self._base
-        if commit_version - self._oldest >= REBASE_THRESHOLD:
-            raise OverflowError(
-                "version window exceeds 2^30: advance new_oldest_version "
-                "(ref: MAX_WRITE_TRANSACTION_LIFE_VERSIONS keeps the live "
-                "window ~5e6 versions wide)")
         import jax.numpy as jnp
-        self._hv = make_rebase_fn()(self._hv, jnp.int32(delta))
-        self._base = self._oldest
+
+        target = max(self._oldest, new_oldest_version)
+        if commit_version - self._base >= REBASE_THRESHOLD:
+            new_base = max(self._base, min(target, window_floor))
+            if commit_version - new_base < REBASE_THRESHOLD:
+                self._hv = make_rebase_fn()(
+                    self._hv, jnp.int32(new_base - self._base))
+                self._base = new_base
+            elif commit_version - target < REBASE_THRESHOLD:
+                p = REBASE_THRESHOLD
+                oldest_off = min(max(target - self._base, 0), p)
+                return p, oldest_off, (commit_version, max(self._base, target))
+            else:
+                raise OverflowError(
+                    "version window exceeds 2^30: advance new_oldest_version "
+                    "(ref: MAX_WRITE_TRANSACTION_LIFE_VERSIONS keeps the "
+                    "live window ~5e6 versions wide)")
+        return (commit_version - self._base,
+                max(self._oldest, new_oldest_version) - self._base, None)
+
+    def _apply_fixup(self, fixup) -> None:
+        if fixup is None:
+            return
+        from ..ops.conflict_kernel import REBASE_THRESHOLD, make_jump_fixup_fn
+        import jax.numpy as jnp
+        commit_version, new_base = fixup
+        self._hv = make_jump_fixup_fn()(
+            self._hv, jnp.int32(REBASE_THRESHOLD),
+            jnp.int32(commit_version - new_base),
+            jnp.int32(new_base - self._base))
+        self._base = new_base
 
     # -- resolve --------------------------------------------------------
     def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
@@ -110,9 +150,7 @@ class TpuConflictSet(ConflictSetBase):
             txns, commit_version, new_oldest_version)
         if n == 0:
             return []
-        conflict = np.asarray(conflict)[:n]
-        return [TOO_OLD if too_old[t] else
-                (CONFLICT if conflict[t] else COMMITTED) for t in range(n)]
+        return self.finalize_verdicts(conflict, too_old)
 
     def _resolve_flags(self, txns, commit_version, new_oldest_version):
         """Dispatch one batch; returns (device conflict flags, too_old, n).
@@ -124,12 +162,16 @@ class TpuConflictSet(ConflictSetBase):
             raise ValueError("commit versions must be non-decreasing "
                              "(ref: Resolver version ordering, "
                              "Resolver.actor.cpp:104-115)")
-        self._last_commit = commit_version
         n = len(txns)
         if n == 0:
+            self._last_commit = commit_version
             self._oldest = max(self._oldest, new_oldest_version)
             return None, None, 0
-        self._maybe_rebase(commit_version)
+        live_snaps = [tr.read_snapshot for tr in txns
+                      if len(tr.read_ranges) and tr.read_snapshot >= self._oldest]
+        offsets = self._prepare_versions(
+            commit_version, new_oldest_version,
+            min([max(self._oldest, new_oldest_version)] + live_snaps))
 
         too_old = np.zeros(n, bool)
         snapshots = np.zeros(n, np.int64)
@@ -163,16 +205,60 @@ class TpuConflictSet(ConflictSetBase):
             n, snapshots, too_old,
             keys[:nr], keys[nr:2 * nr], np.asarray(read_t, np.int32),
             keys[2 * nr:2 * nr + nw], keys[2 * nr + nw:],
-            np.asarray(write_t, np.int32),
-            commit_version, new_oldest_version)
+            np.asarray(write_t, np.int32), offsets)
+        self._last_commit = commit_version  # only after a successful batch
         self._oldest = max(self._oldest, new_oldest_version)
         return conflict, too_old, n
 
+    def resolve_arrays(self, snapshots: np.ndarray, has_reads: np.ndarray,
+                       rb: np.ndarray, re: np.ndarray, rt: np.ndarray,
+                       wb: np.ndarray, we: np.ndarray, wt: np.ndarray,
+                       commit_version: int, new_oldest_version: int):
+        """Pre-encoded fast path: keys already packed via ops.keys.encode_keys,
+        ranges flattened with per-range txn ids. Skips Python marshalling so
+        benchmarks/pipelines measure device throughput, and defers the
+        verdict readback (returns the device conflict flags + host too_old).
+        Ranges of tooOld txns may be included — their writes are excluded by
+        the kernel and their reads only affect their own (overridden) flag."""
+        if commit_version < self._last_commit:
+            raise ValueError("commit versions must be non-decreasing")
+        too_old = (snapshots < self._oldest) & has_reads.astype(bool)
+        live = has_reads.astype(bool) & ~too_old
+        floor = min(int(snapshots[live].min()) if live.any() else commit_version,
+                    max(self._oldest, new_oldest_version))
+        offsets = self._prepare_versions(commit_version, new_oldest_version,
+                                         floor)
+        conflict = self._dispatch(
+            snapshots.shape[0], snapshots, too_old, rb, re,
+            np.asarray(rt, np.int32), wb, we, np.asarray(wt, np.int32),
+            offsets)
+        self._last_commit = commit_version  # only after a successful batch
+        self._oldest = max(self._oldest, new_oldest_version)
+        return conflict, too_old
+
+    @staticmethod
+    def finalize_verdicts(conflict, too_old) -> list[int]:
+        n = too_old.shape[0]
+        conflict = np.asarray(conflict)[:n]
+        return [TOO_OLD if too_old[t] else
+                (CONFLICT if conflict[t] else COMMITTED) for t in range(n)]
+
+    def _call_kernel(self, npad, nrp, nwp, args):
+        """Run one padded batch through the single-shard jitted kernel.
+
+        Subclasses (the sharded resolver) override this to dispatch the
+        same padded batch across a device mesh."""
+        from ..ops.conflict_kernel import make_resolve_fn
+        fn = make_resolve_fn(self._cap, npad, nrp, nwp, self._n_words)
+        self._hk, self._hv, count, conflict = fn(self._hk, self._hv, *args)
+        return count, conflict
+
     def _dispatch(self, n, snapshots, too_old, rb, re, rt, wb, we, wt,
-                  commit_version, new_oldest_version):
+                  offsets):
+        commit_off, oldest_off, fixup = offsets
         import jax.numpy as jnp
 
-        from ..ops.conflict_kernel import SNAP_CLAMP, make_resolve_fn
+        from ..ops.conflict_kernel import SNAP_CLAMP
         from ..ops.keys import next_pow2
 
         nr, nw = rb.shape[0], wb.shape[0]
@@ -206,14 +292,13 @@ class TpuConflictSet(ConflictSetBase):
         wvalid = np.zeros(nwp, bool)
         wvalid[:nw] = True
 
-        fn = make_resolve_fn(self._cap, npad, nrp, nwp, self._n_words)
-        self._hk, self._hv, count, conflict = fn(
-            self._hk, self._hv, jnp.asarray(snap_p), jnp.asarray(tooold_p),
+        count, conflict = self._call_kernel(npad, nrp, nwp, (
+            jnp.asarray(snap_p), jnp.asarray(tooold_p),
             jnp.asarray(pad_keys(rb, nrp)), jnp.asarray(pad_keys(re, nrp)),
             jnp.asarray(pad_idx(rt, nrp, npad)), jnp.asarray(rvalid),
             jnp.asarray(pad_keys(wb, nwp)), jnp.asarray(pad_keys(we, nwp)),
             jnp.asarray(pad_idx(wt, nwp, npad)), jnp.asarray(wvalid),
-            jnp.int32(commit_version - self._base),
-            jnp.int32(max(self._oldest, new_oldest_version) - self._base))
+            jnp.int32(commit_off), jnp.int32(oldest_off)))
+        self._apply_fixup(fixup)
         self._count_dev = count
         return conflict

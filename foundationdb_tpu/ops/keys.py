@@ -57,13 +57,16 @@ def encode_keys(keys: Sequence[bytes], key_bytes: int) -> np.ndarray:
 
 
 def lt_rows(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Lexicographic a < b over the trailing word axis ([..., W+1])."""
-    neq = a != b
-    idx = jnp.argmax(neq, axis=-1)
-    any_neq = jnp.any(neq, axis=-1)
-    av = jnp.take_along_axis(a, idx[..., None], axis=-1)[..., 0]
-    bv = jnp.take_along_axis(b, idx[..., None], axis=-1)[..., 0]
-    return any_neq & (av < bv)
+    """Lexicographic a < b over the trailing word axis ([..., W+1]).
+
+    Unrolled fold from the least-significant word up: pure elementwise
+    compare/select chains, no gathers — XLA fuses the whole thing."""
+    width = a.shape[-1]
+    r = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), bool)
+    for w in range(width - 1, -1, -1):
+        aw, bw = a[..., w], b[..., w]
+        r = (aw < bw) | ((aw == bw) & r)
+    return r
 
 
 def le_rows(a: jax.Array, b: jax.Array) -> jax.Array:

@@ -1,0 +1,13 @@
+"""Multi-device parallelism: key-range sharded resolution over a Mesh.
+
+The reference scales conflict resolution by partitioning the keyspace
+across resolver processes (fdbserver/MasterProxyServer.actor.cpp
+keyResolvers map, ResolutionRequestBuilder :265-341; rebalanced by
+masterserver.actor.cpp resolutionBalancing :1008). Here the partition is
+a jax.sharding.Mesh axis, and cross-shard combines ride ICI collectives
+instead of RPC.
+"""
+
+from .sharded_resolver import ShardedTpuConflictSet, default_split_keys
+
+__all__ = ["ShardedTpuConflictSet", "default_split_keys"]

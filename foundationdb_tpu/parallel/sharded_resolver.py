@@ -1,0 +1,185 @@
+"""Key-range sharded conflict resolution over a device mesh.
+
+The TPU analogue of FDB's multi-resolver deployment: each device owns a
+contiguous keyspace shard (ref: keyResolvers KeyRangeMap,
+fdbserver/MasterProxyServer.actor.cpp:204; range splits moved by
+resolutionBalancing, fdbserver/masterserver.actor.cpp:1008). The batch
+is replicated to every shard; each shard clips conflict ranges to its
+own interval and runs the same resolve kernel on its local history
+partition (shard_map over a `resolvers` mesh axis).
+
+Where the reference combines per-resolver verdicts with min() at the
+proxy (MasterProxyServer.actor.cpp:585-592) and each resolver's
+intra-batch check runs on local knowledge only — recording writes of
+transactions another resolver aborted — here every external verdict and
+every intra-batch fixpoint round is psum-combined over ICI (see
+make_resolve_core's axis_name). The sharded resolver is therefore
+bit-identical to the single-shard one: strictly fewer false conflicts
+than the reference design, at the cost of one tiny collective per
+fixpoint round (a few per batch, latency-hidden inside the step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..models.tpu_resolver import TpuConflictSet, _MIN_CAP
+
+
+def default_split_keys(n_shards: int) -> list[bytes]:
+    """Evenly spaced single-byte split points over the keyspace."""
+    return [bytes([(i * 256) // n_shards]) for i in range(1, n_shards)]
+
+
+def _clip_and_resolve(core):
+    """Wrap the resolve core with per-shard range clipping."""
+    import jax.numpy as jnp
+
+    from ..ops.keys import lt_rows
+
+    def rows_max(a, b):  # lexicographic per-row max of [n,width] vs [width]
+        bb = jnp.broadcast_to(b, a.shape)
+        return jnp.where(lt_rows(a, bb)[:, None], bb, a)
+
+    def rows_min(a, b):
+        bb = jnp.broadcast_to(b, a.shape)
+        return jnp.where(lt_rows(bb, a)[:, None], bb, a)
+
+    def fn(shard_lo, shard_hi, hk, hv, snap, too_old,
+           rb, re, rtxn, rvalid, wb, we, wtxn, wvalid, commit, oldest):
+        shard_lo, shard_hi = shard_lo[0], shard_hi[0]
+        hk, hv = hk[0], hv[0]
+        rb2, re2 = rows_max(rb, shard_lo), rows_min(re, shard_hi)
+        wb2, we2 = rows_max(wb, shard_lo), rows_min(we, shard_hi)
+        rvalid2 = rvalid & lt_rows(rb2, re2)
+        wvalid2 = wvalid & lt_rows(wb2, we2)
+        hk2, hv2, count, conflict = core(
+            hk, hv, snap, too_old, rb2, re2, rtxn, rvalid2,
+            wb2, we2, wtxn, wvalid2, commit, oldest)
+        return (hk2[None], hv2[None], count[None], conflict[None])
+
+    return fn
+
+
+class ShardedTpuConflictSet(TpuConflictSet):
+    """Drop-in ConflictSet whose history is key-range sharded over a Mesh.
+
+    Verdicts are bit-identical to `TpuConflictSet` (and therefore to the
+    CPU baselines) — the acceptance criterion for the multi-resolver
+    path, mirroring how the simulator replays verdicts across backends.
+    """
+
+    AXIS = "resolvers"
+
+    def __init__(self, init_version: int = 0, key_bytes: int = 32,
+                 capacity: int = _MIN_CAP, mesh=None,
+                 n_shards: Optional[int] = None,
+                 split_keys: Optional[Sequence[bytes]] = None):
+        import jax
+        from jax.sharding import Mesh
+
+        if mesh is None:
+            devs = jax.devices()
+            n = n_shards or len(devs)
+            mesh = Mesh(np.asarray(devs[:n]), (self.AXIS,))
+        self._mesh = mesh
+        self._n_shards = mesh.devices.size
+        if split_keys is None:
+            split_keys = default_split_keys(self._n_shards)
+        if len(split_keys) != self._n_shards - 1:
+            raise ValueError("need n_shards-1 split keys")
+        if list(split_keys) != sorted(split_keys):
+            raise ValueError("split keys must be sorted")
+        self._split_keys = [b""] + list(split_keys)
+        self._shard_fns: dict = {}
+        super().__init__(init_version=init_version, key_bytes=key_bytes,
+                         capacity=capacity)
+
+    # -- sharded state --------------------------------------------------
+    def _to_device(self, hk: np.ndarray, hv: np.ndarray):
+        """Expand single-shard init/grow arrays to [n_shards, ...]; shard 0
+        keeps slot 0 at b"", every other shard's slot 0 is its own lower
+        bound (the first boundary must be <= any clipped query begin)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.keys import encode_keys
+
+        s = self._n_shards
+        cap = hk.shape[0]
+        shk = np.broadcast_to(hk, (s, cap, hk.shape[1])).copy()
+        shv = np.broadcast_to(hv, (s, cap)).copy()
+        lows = encode_keys(self._split_keys, self._key_bytes)
+        base_version = hv[0]
+        for i in range(1, s):
+            shk[i, 0] = lows[i]
+            shv[i, 0] = base_version
+        self._shard_bounds = self._make_bounds(lows)
+        dev = jax.device_put(
+            (shk, shv),
+            NamedSharding(self._mesh, P(self.AXIS)))
+        return dev
+
+    def _make_bounds(self, lows: np.ndarray):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        highs = np.full_like(lows, 0xFFFFFFFF)
+        highs[:-1] = lows[1:]
+        return jax.device_put((lows.copy(), highs),
+                              NamedSharding(self._mesh, P(self.AXIS)))
+
+    def _grow(self, needed: int) -> None:
+        from ..ops.keys import next_pow2
+        new_cap = max(self._cap * 2, next_pow2(needed + 2))
+        s = self._n_shards
+        shk = np.full((s, new_cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
+        shv = np.full((s, new_cap), -(1 << 30), np.int32)
+        shk[:, :self._cap] = np.asarray(self._hk)
+        shv[:, :self._cap] = np.asarray(self._hv)
+        self._cap = new_cap
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self._hk, self._hv = jax.device_put(
+            (shk, shv), NamedSharding(self._mesh, P(self.AXIS)))
+        self._shard_fns.clear()
+
+    # -- sharded kernel dispatch ---------------------------------------
+    def _get_shard_fn(self, npad, nrp, nwp):
+        key = (self._cap, npad, nrp, nwp)
+        fn = self._shard_fns.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.conflict_kernel import make_resolve_core
+
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        core = make_resolve_core(self._cap, npad, nrp, nwp, self._n_words,
+                                 axis_name=self.AXIS)
+        wrapped = _clip_and_resolve(core)
+        sharded = P(self.AXIS)
+        repl = P()
+        fn = jax.jit(shard_map(
+            wrapped, mesh=self._mesh,
+            in_specs=(sharded, sharded, sharded, sharded,
+                      repl, repl, repl, repl, repl, repl,
+                      repl, repl, repl, repl, repl, repl),
+            out_specs=(sharded, sharded, sharded, sharded),
+            check_vma=False))
+        self._shard_fns[key] = fn
+        return fn
+
+    def _call_kernel(self, npad, nrp, nwp, args):
+        fn = self._get_shard_fn(npad, nrp, nwp)
+        lows, highs = self._shard_bounds
+        self._hk, self._hv, count, conflict = fn(
+            lows, highs, self._hk, self._hv, *args)
+        return count, conflict[0]

@@ -8,10 +8,19 @@ is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment pins JAX_PLATFORMS to the real
+# TPU tunnel, and tests must run on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+try:  # the platform may already be initialized via sitecustomize
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,103 @@
+"""Key-range sharded resolver over the virtual 8-device mesh: verdicts
+must be bit-identical to the single-shard TPU backend and to the
+brute-force model (the multi-resolver parity criterion; ref combine rule
+MasterProxyServer.actor.cpp:585-592, here made exact via ICI collectives)."""
+
+import random
+
+import jax
+import pytest
+
+from foundationdb_tpu.models import (
+    BruteForceConflictSet,
+    ResolverTransaction,
+)
+from foundationdb_tpu.models.tpu_resolver import TpuConflictSet
+from foundationdb_tpu.parallel import ShardedTpuConflictSet, default_split_keys
+
+MWTLV = 5_000_000
+
+
+def txn(snapshot, reads=(), writes=()):
+    return ResolverTransaction(snapshot, tuple(reads), tuple(writes))
+
+
+def test_mesh_has_eight_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_default_split_keys():
+    ks = default_split_keys(4)
+    assert ks == [b"\x40", b"\x80", b"\xc0"]
+    assert ks == sorted(ks)
+
+
+def test_cross_shard_range_conflict():
+    """A single range spanning every shard boundary must behave as one."""
+    sh = ShardedTpuConflictSet(capacity=1024)
+    assert sh._n_shards == 8
+    sh.resolve([txn(0, writes=[(b"\x01", b"\xfe")])], 100, 0)
+    got = sh.resolve(
+        [txn(50, reads=[(b"\x70", b"\x90")]),   # crosses the 0x80 split
+         txn(50, reads=[(b"\x00", b"\x01")]),   # before the write
+         txn(100, reads=[(b"\x01", b"\xfe")])], 200, 0)
+    assert got == [0, 2, 2]
+
+
+def test_intra_batch_across_shards():
+    """Writer on one shard, reader on another, in the same batch: the
+    psum'd fixpoint must see the dependency."""
+    sh = ShardedTpuConflictSet(capacity=1024)
+    got = sh.resolve(
+        [txn(0, writes=[(b"\x10", b"\x11")]),                   # shard 0
+         txn(0, reads=[(b"\x10", b"\x11")],
+             writes=[(b"\xf0", b"\xf1")]),                      # reads s0, writes s7
+         txn(0, reads=[(b"\xf0", b"\xf1")])], 100, 0)           # reads s7
+    # t1 conflicts on t0's write; t1's own write is therefore dropped,
+    # so t2 commits — requires cross-shard knowledge of t1's conflict.
+    assert got == [2, 0, 2]
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33])
+def test_randomized_sharded_parity(seed):
+    rng = random.Random(seed)
+    sh = ShardedTpuConflictSet(capacity=1024)
+    single = TpuConflictSet(capacity=1024)
+    brute = BruteForceConflictSet()
+
+    def rrange():
+        a = bytes([rng.randrange(256), rng.randrange(8)])
+        b = bytes([rng.randrange(256), rng.randrange(8)])
+        if a > b:
+            a, b = b, a
+        if a == b:
+            b = a + b"\x00"
+        return a, b
+
+    version = 0
+    for bi in range(20):
+        version += rng.randrange(1, 300_000)
+        oldest = max(0, version - MWTLV)
+        batch = [txn(max(0, version - rng.randrange(0, int(1.2 * MWTLV))),
+                     [rrange() for _ in range(rng.randrange(0, 4))],
+                     [rrange() for _ in range(rng.randrange(0, 4))])
+                 for _ in range(rng.randrange(1, 16))]
+        vs = sh.resolve(batch, version, oldest)
+        v1 = single.resolve(batch, version, oldest)
+        vb = brute.resolve(batch, version, oldest)
+        assert vs == v1 == vb, (bi, vs, v1, vb)
+
+
+def test_sharded_growth():
+    sh = ShardedTpuConflictSet(capacity=1024)
+    v = 0
+    for i in range(30):
+        v += 10
+        writes = [(bytes([j % 256]) + b"%04d" % (i * 50 + j),
+                   bytes([j % 256]) + b"%04d\x00" % (i * 50 + j))
+                  for j in range(50)]
+        sh.resolve([txn(v - 10, writes=writes)], v, 0)
+    # all shards share one capacity; shard 0 only grows if its own load did,
+    # so just assert correctness after sustained load:
+    got = sh.resolve([txn(0, reads=[(b"\x00", b"\xff")])], v + 1, 0)
+    assert got == [0]

@@ -63,6 +63,20 @@ def test_rebase_at_large_versions():
     assert cs._base > 0  # a rebase actually happened
 
 
+def test_recovery_style_version_jump():
+    """A single huge version jump WITH an advanced window must resolve
+    (regression: rebase previously consulted only the stale oldest)."""
+    cs = TpuConflictSet()
+    brute = BruteForceConflictSet()
+    for impl in (cs, brute):
+        impl.resolve([txn(0, writes=[(b"a", b"b")])], 100, 0)
+    v = (1 << 31) + 500
+    old = v - MWTLV
+    batch = [txn(v - 10, reads=[(b"a", b"b")]), txn(50, reads=[(b"a", b"b")]),
+             txn(v - 10, writes=[(b"c", b"d")])]
+    assert cs.resolve(batch, v, old) == brute.resolve(batch, v, old)
+
+
 def test_window_must_advance_past_threshold():
     cs = TpuConflictSet()
     cs.resolve([txn(0, writes=[(b"a", b"b")])], 100, 0)
