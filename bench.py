@@ -126,8 +126,11 @@ def bench_tpu(n_txns, n_batches, keyspace):
     hv0 = np.full((cap,), -(1 << 30), np.int32)
     hv0[0] = 0
 
+    first_elem = jax.jit(lambda a: a.reshape(-1)[0])  # jit once: sync()
+    # must measure the link round-trip, not retrace/recompile time
+
     def sync(x):
-        return np.asarray(jax.jit(lambda a: a.reshape(-1)[0])(x))
+        return np.asarray(first_elem(x))
 
     # warmup/compile, then measure the tunnel sync floor, then the run;
     # remote-link latency fluctuates wildly, so take the best of several

@@ -1,0 +1,5 @@
+"""Client API (ref: fdbclient/ — NativeAPI + ReadYourWrites)."""
+
+from .transaction import Database, Transaction, run_transaction
+
+__all__ = ["Database", "Transaction", "run_transaction"]

@@ -1,0 +1,177 @@
+"""Client transactions: snapshot reads, read-your-writes, OCC commit.
+
+Reference: fdbclient/NativeAPI.actor.cpp — GRV (:2854 readVersionBatcher,
+lazily fetched on first read), reads through the location cache to
+storage (:1273 getValue, :1712 getRange), commit (:2498 tryCommit: ship
+read/write conflict ranges + mutations to a proxy), and the retry loop
+(:2956 onError: backoff then reset). Read-your-writes semantics come
+from overlaying the transaction's uncommitted writes on every read
+(fdbclient/ReadYourWrites.actor.cpp WriteMap merge), and reads record
+read-conflict ranges so the resolver can detect conflicts exactly.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right, insort
+from typing import Dict, List, Optional, Tuple
+
+from .. import flow
+from ..flow import TaskPriority, error
+from ..rpc import NetworkRef, SimProcess
+from ..server.types import (CLEAR_RANGE, SET_VALUE, CommitRequest, MutationRef,
+                            StorageGetRangeRequest, StorageGetRequest)
+
+RETRYABLE = {"not_committed", "transaction_too_old", "future_version",
+             "broken_promise", "commit_unknown_result", "timed_out"}
+
+
+def _next_key(k: bytes) -> bytes:
+    return k + b"\x00"
+
+
+class Database:
+    """Handle to the cluster (ref: Database/Cluster in NativeAPI)."""
+
+    def __init__(self, process: SimProcess, grv_ref: NetworkRef,
+                 commit_ref: NetworkRef, storage_get: NetworkRef,
+                 storage_range: NetworkRef):
+        self.process = process
+        self.grv_ref = grv_ref
+        self.commit_ref = commit_ref
+        self.storage_get = storage_get
+        self.storage_range = storage_range
+
+    def create_transaction(self) -> "Transaction":
+        return Transaction(self)
+
+
+class Transaction:
+    def __init__(self, db: Database):
+        self.db = db
+        self.reset()
+
+    def reset(self) -> None:
+        self._read_version: Optional[int] = None
+        self._writes: Dict[bytes, Optional[bytes]] = {}  # RYW write map
+        self._write_order: List[bytes] = []              # sorted keys
+        self._cleared: List[Tuple[bytes, bytes]] = []    # ordered clears
+        self._mutations: List[MutationRef] = []
+        self._read_conflicts: List[Tuple[bytes, bytes]] = []
+        self._write_conflicts: List[Tuple[bytes, bytes]] = []
+        self.committed_version: Optional[int] = None
+
+    # -- read version ---------------------------------------------------
+    async def get_read_version(self) -> int:
+        if self._read_version is None:
+            reply = await self.db.grv_ref.get_reply(None, self.db.process)
+            self._read_version = reply.version
+        return self._read_version
+
+    # -- RYW overlay ----------------------------------------------------
+    def _overlay_get(self, key: bytes):
+        """(found, value) against uncommitted writes, newest-first."""
+        if key in self._writes:
+            return True, self._writes[key]
+        for b, e in reversed(self._cleared):
+            if b <= key < e:
+                return True, None
+        return False, None
+
+    # -- reads ----------------------------------------------------------
+    async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        if not snapshot:
+            self._read_conflicts.append((key, _next_key(key)))
+        found, val = self._overlay_get(key)
+        if found:
+            return val
+        version = await self.get_read_version()
+        return await self.db.storage_get.get_reply(
+            StorageGetRequest(key, version), self.db.process)
+
+    async def get_range(self, begin: bytes, end: bytes, limit: int = 1 << 20,
+                        snapshot: bool = False) -> List[Tuple[bytes, bytes]]:
+        if begin >= end:
+            return []
+        if not snapshot:
+            self._read_conflicts.append((begin, end))
+        version = await self.get_read_version()
+        base = await self.db.storage_range.get_reply(
+            StorageGetRangeRequest(begin, end, version, limit),
+            self.db.process)
+        # overlay uncommitted writes (ref: RYWIterator merge)
+        merged: Dict[bytes, bytes] = {k: v for k, v in base}
+        for b, e in self._cleared:
+            for k in [k for k in merged if b <= k < e]:
+                del merged[k]
+        lo = bisect_left(self._write_order, begin)
+        hi = bisect_left(self._write_order, end)
+        for k in self._write_order[lo:hi]:
+            v = self._writes[k]
+            if v is None:
+                merged.pop(k, None)
+            else:
+                merged[k] = v
+        return sorted(merged.items())[:limit]
+
+    # -- writes ---------------------------------------------------------
+    def _record_write(self, key: bytes, value: Optional[bytes]) -> None:
+        if key not in self._writes:
+            insort(self._write_order, key)
+        self._writes[key] = value
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._record_write(key, value)
+        self._mutations.append(MutationRef(SET_VALUE, key, value))
+        self._write_conflicts.append((key, _next_key(key)))
+
+    def clear(self, key: bytes) -> None:
+        self.clear_range(key, _next_key(key))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        if begin >= end:
+            return
+        self._cleared.append((begin, end))
+        lo = bisect_left(self._write_order, begin)
+        hi = bisect_left(self._write_order, end)
+        for k in self._write_order[lo:hi]:
+            self._writes[k] = None
+        self._mutations.append(MutationRef(CLEAR_RANGE, begin, end))
+        self._write_conflicts.append((begin, end))
+
+    # -- commit ---------------------------------------------------------
+    async def commit(self) -> int:
+        """(ref: Transaction::commit :2710 / tryCommit :2498)"""
+        if not self._mutations:
+            # read-only: succeeds at the read version without a round trip
+            self.committed_version = self._read_version or 0
+            return self.committed_version
+        snapshot = await self.get_read_version()
+        req = CommitRequest(snapshot, tuple(self._read_conflicts),
+                            tuple(self._write_conflicts),
+                            tuple(self._mutations))
+        reply = await self.db.commit_ref.get_reply(req, self.db.process)
+        self.committed_version = reply.version
+        return reply.version
+
+    # -- retry loop -----------------------------------------------------
+    async def on_error(self, e: BaseException) -> None:
+        """(ref: Transaction::onError :2956 — backoff and reset)"""
+        if not (isinstance(e, flow.FdbError) and e.name in RETRYABLE):
+            raise e
+        await flow.delay(0.001 + flow.g_random.random01() * 0.01,
+                         TaskPriority.DEFAULT_ENDPOINT)
+        self.reset()
+
+
+async def run_transaction(db: Database, body, max_retries: int = 100):
+    """The standard retry loop (ref: the `doTransaction` idiom / python
+    binding @fdb.transactional)."""
+    tr = db.create_transaction()
+    for _ in range(max_retries):
+        try:
+            result = await body(tr)
+            await tr.commit()
+            return result
+        except flow.FdbError as e:
+            await tr.on_error(e)
+    raise error("transaction_timed_out")

@@ -36,11 +36,13 @@ def load_native_library(build_if_missing: bool = True) -> ctypes.CDLL:
     if build_if_missing:
         # make is mtime-incremental: a no-op when the .so is current, a
         # rebuild when conflictset.cpp changed (the artifact is never
-        # committed — it is arch-specific via -march=native). If the
-        # toolchain is absent but a usable .so exists, fall back to it.
+        # committed — it is arch-specific via -march=native). Only an
+        # absent toolchain may fall back to an existing .so; a failed
+        # BUILD must surface, or a stale binary would silently run old
+        # conflict semantics.
         try:
             _build_library()
-        except Exception:
+        except FileNotFoundError:
             if not os.path.exists(_LIB_PATH):
                 raise
     lib = ctypes.CDLL(_LIB_PATH)

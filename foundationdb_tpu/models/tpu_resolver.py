@@ -116,8 +116,14 @@ class TpuConflictSet(ConflictSetBase):
         if commit_version - self._base >= REBASE_THRESHOLD:
             new_base = max(self._base, min(target, window_floor))
             if commit_version - new_base < REBASE_THRESHOLD:
-                self._hv = make_rebase_fn()(
-                    self._hv, jnp.int32(new_base - self._base))
+                delta = new_base - self._base
+                if delta > (1 << 31) - 1:
+                    # shift exceeds int32 arithmetic; every stored version
+                    # is below the new base, so clamp them all dead
+                    from ..ops.conflict_kernel import make_reset_fn
+                    self._hv = make_reset_fn()(self._hv)
+                else:
+                    self._hv = make_rebase_fn()(self._hv, jnp.int32(delta))
                 self._base = new_base
             elif commit_version - target < REBASE_THRESHOLD:
                 p = REBASE_THRESHOLD
@@ -134,13 +140,20 @@ class TpuConflictSet(ConflictSetBase):
     def _apply_fixup(self, fixup) -> None:
         if fixup is None:
             return
-        from ..ops.conflict_kernel import REBASE_THRESHOLD, make_jump_fixup_fn
+        from ..ops.conflict_kernel import (REBASE_THRESHOLD,
+                                           make_jump_fixup_fn,
+                                           make_jump_fixup_large_fn)
         import jax.numpy as jnp
         commit_version, new_base = fixup
-        self._hv = make_jump_fixup_fn()(
-            self._hv, jnp.int32(REBASE_THRESHOLD),
-            jnp.int32(commit_version - new_base),
-            jnp.int32(new_base - self._base))
+        delta = new_base - self._base
+        if delta > (1 << 31) - 1:
+            self._hv = make_jump_fixup_large_fn()(
+                self._hv, jnp.int32(REBASE_THRESHOLD),
+                jnp.int32(commit_version - new_base))
+        else:
+            self._hv = make_jump_fixup_fn()(
+                self._hv, jnp.int32(REBASE_THRESHOLD),
+                jnp.int32(commit_version - new_base), jnp.int32(delta))
         self._base = new_base
 
     # -- resolve --------------------------------------------------------

@@ -243,6 +243,15 @@ def make_rebase_fn():
 
 
 @functools.lru_cache(maxsize=None)
+def make_reset_fn():
+    """Rebase for deltas too large for int32 arithmetic (> 2^31-1): every
+    stored version is below the new base, hence dead — clamp them all."""
+    def reset(hv):
+        return jnp.full_like(hv, jnp.int32(VDEAD))
+    return jax.jit(reset)
+
+
+@functools.lru_cache(maxsize=None)
 def make_jump_fixup_fn():
     """Post-merge fixup for recovery-style version jumps: entries written
     at the placeholder offset become the true commit offset under the new
@@ -251,4 +260,14 @@ def make_jump_fixup_fn():
     def fixup(hv, placeholder, commit_off, delta):
         shifted = jnp.maximum(hv, jnp.int32(VDEAD) + delta) - delta
         return jnp.where(hv == placeholder, commit_off, shifted)
+    return jax.jit(fixup)
+
+
+@functools.lru_cache(maxsize=None)
+def make_jump_fixup_large_fn():
+    """Jump fixup when the base shift exceeds int32: placeholder entries
+    get the commit offset, everything else is dead."""
+    def fixup(hv, placeholder, commit_off):
+        return jnp.where(hv == placeholder, commit_off,
+                         jnp.int32(VDEAD))
     return jax.jit(fixup)

@@ -1,0 +1,251 @@
+"""Deterministic simulated network: processes, endpoints, kills, clogs.
+
+Reference behaviors re-implemented (not ported):
+  - token-addressed delivery to typed request streams
+    (fdbrpc/FlowTransport.actor.cpp:48-113 EndpointMap, :517 deliver)
+  - request/reply as paired endpoints: the reply rides back through the
+    network with its own latency (fdbrpc/fdbrpc.h ReplyPromise /
+    networksender.actor.h)
+  - simulated latency per message and clogged links
+    (fdbrpc/sim2.actor.cpp:127-160 SimClogging, :176 Sim2Conn)
+  - process kill semantics: in-flight requests and replies owned by the
+    dead process break; new sends to it hang until failure detection or
+    break immediately, per knob (fdbrpc/sim2.actor.cpp:1222
+    killProcess_internal; broken_promise surfaces to callers the way a
+    closed connection does)
+  - machine model grouping processes (fdbrpc/simulator.h:47-147)
+
+Everything randomized draws from the flow deterministic RNG, so a seed
+replays the identical message schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..flow import error
+from ..flow.actors import PromiseStream
+from ..flow.future import Future, Promise
+from ..flow.scheduler import Scheduler, TaskPriority
+
+
+class Endpoint:
+    """A delivery token: (process, stream id)."""
+
+    __slots__ = ("process", "token")
+
+    def __init__(self, process: "SimProcess", token: int):
+        self.process = process
+        self.token = token
+
+    def __repr__(self):
+        return f"Endpoint({self.process.name}:{self.token})"
+
+
+class SimProcess:
+    """A simulated process hosting request streams (ref: simulator.h
+    ProcessInfo). Kill breaks everything it owns."""
+
+    def __init__(self, net: "SimNetwork", name: str, machine: str = ""):
+        self.net = net
+        self.name = name
+        self.machine = machine or name
+        self.alive = True
+        self._streams: Dict[int, PromiseStream] = {}
+        self._pending_replies: list[Promise] = []
+        self._on_kill: list[Callable[[], None]] = []
+
+    def register(self, stream: PromiseStream) -> Endpoint:
+        token = self.net._next_token()
+        self._streams[token] = stream
+        return Endpoint(self, token)
+
+    def on_kill(self, fn: Callable[[], None]) -> None:
+        self._on_kill.append(fn)
+
+    def _track_reply(self, p: Promise) -> None:
+        self._pending_replies.append(p)
+        if len(self._pending_replies) > 64:  # drop settled entries
+            self._pending_replies = [
+                q for q in self._pending_replies if not q.is_set]
+
+    def __repr__(self):
+        return f"SimProcess({self.name}, alive={self.alive})"
+
+
+class RequestStream:
+    """Server side of a typed endpoint: a PromiseStream of envelopes.
+
+    Each received item is ``(request, reply)`` where ``reply`` is a
+    Promise whose send travels back through the network."""
+
+    def __init__(self, process: SimProcess):
+        self.stream = PromiseStream()
+        self.endpoint = process.register(self.stream)
+
+    def ref(self) -> "NetworkRef":
+        return NetworkRef(self.endpoint)
+
+    def pop(self) -> Future:
+        """Future of the next (request, reply) pair (ref: waitNext)."""
+        return self.stream.stream.pop()
+
+
+class NetworkRef:
+    """Client handle to a remote RequestStream (ref: RequestStream<T> as
+    carried inside interface structs)."""
+
+    __slots__ = ("endpoint",)
+
+    def __init__(self, endpoint: Endpoint):
+        self.endpoint = endpoint
+
+    def get_reply(self, request: Any, src: SimProcess) -> Future:
+        """Send and return a Future of the reply (ref: getReply pattern,
+        fdbrpc/fdbrpc.h)."""
+        return self.endpoint.process.net.send_request(
+            src, self.endpoint, request)
+
+    def send(self, request: Any, src: SimProcess) -> None:
+        """Fire-and-forget (best-effort datagram semantics)."""
+        self.endpoint.process.net.send_oneway(src, self.endpoint, request)
+
+
+class SimNetwork:
+    """The simulated transport + fault API (ref: sim2.actor.cpp)."""
+
+    def __init__(self, sched: Scheduler, rng, min_latency: float = 0.0002,
+                 max_latency: float = 0.002):
+        self.sched = sched
+        self.rng = rng
+        self.min_latency = min_latency
+        self.max_latency = max_latency
+        self.processes: Dict[str, SimProcess] = {}
+        self._token = 0
+        # (src_machine, dst_machine) -> unclog time
+        self._clogged: Dict[Tuple[str, str], float] = {}
+        self.messages_sent = 0
+        self.messages_dropped = 0
+
+    # -- topology -------------------------------------------------------
+    def new_process(self, name: str, machine: str = "") -> SimProcess:
+        p = SimProcess(self, name, machine)
+        self.processes[name] = p
+        return p
+
+    def _next_token(self) -> int:
+        self._token += 1
+        return self._token
+
+    # -- faults ---------------------------------------------------------
+    def kill(self, process: SimProcess) -> None:
+        """Kill a process: break its owned replies; its streams stop
+        receiving (ref: killProcess_internal, sim2.actor.cpp:1222)."""
+        if not process.alive:
+            return
+        process.alive = False
+        for fn in process._on_kill:
+            fn()
+        for p in process._pending_replies:
+            if not p.is_set:
+                p.send_error(error("broken_promise"))
+        process._pending_replies.clear()
+
+    def clog_pair(self, a: str, b: str, seconds: float) -> None:
+        """Delay all messages between two machines until now+seconds
+        (ref: clogPair, sim2.actor.cpp:1532)."""
+        until = self.sched.now() + seconds
+        for k in ((a, b), (b, a)):
+            self._clogged[k] = max(self._clogged.get(k, 0.0), until)
+
+    def _delivery_delay(self, src: SimProcess, dst: SimProcess) -> float:
+        lat = self.min_latency + self.rng.random01() * (
+            self.max_latency - self.min_latency)
+        key = (src.machine, dst.machine)
+        unclog = self._clogged.get(key, 0.0)
+        now = self.sched.now()
+        if unclog > now:
+            lat += unclog - now
+        return lat
+
+    # -- delivery -------------------------------------------------------
+    def send_request(self, src: SimProcess, dst: Endpoint, request) -> Future:
+        reply = Promise()
+        dst.process._track_reply(reply)
+        self._deliver(src, dst, (request, _NetReply(self, dst.process, src,
+                                                    reply)), reply)
+        return reply.future
+
+    def send_oneway(self, src: SimProcess, dst: Endpoint, request) -> None:
+        self._deliver(src, dst, (request, None), None)
+
+    def _deliver(self, src: SimProcess, dst: Endpoint, item,
+                 reply: Optional[Promise]) -> None:
+        self.messages_sent += 1
+        if not src.alive:
+            return  # a dead process sends nothing
+        delay = self._delivery_delay(src, dst.process)
+        timer = self.sched.delay(delay, TaskPriority.DEFAULT_ENDPOINT)
+
+        def on_time(_f):
+            if not dst.process.alive:
+                # connection failure surfaces as broken_promise to the
+                # requester (after the latency, like a RST would)
+                self.messages_dropped += 1
+                if reply is not None and not reply.is_set:
+                    reply.send_error(error("broken_promise"))
+                return
+            stream = dst.process._streams.get(dst.token)
+            if stream is None:
+                if reply is not None and not reply.is_set:
+                    reply.send_error(error("broken_promise"))
+                return
+            stream.send(item)
+
+        timer.on_ready(on_time)
+
+
+class _NetReply:
+    """Reply promise that routes back through the network with latency.
+
+    Breaks (broken_promise) if the replying process dies first — tracked
+    via SimProcess._pending_replies."""
+
+    __slots__ = ("net", "owner", "dst", "promise")
+
+    def __init__(self, net: SimNetwork, owner: SimProcess, dst: SimProcess,
+                 promise: Promise):
+        self.net = net
+        self.owner = owner  # the serving process
+        self.dst = dst      # the original requester
+        self.promise = promise
+
+    def send(self, value=None) -> None:
+        if self.promise.is_set:
+            return
+        if not self.owner.alive:
+            return  # the kill path already broke the promise
+        delay = self.net._delivery_delay(self.owner, self.dst)
+        timer = self.net.sched.delay(delay, TaskPriority.DEFAULT_PROMISE_ENDPOINT)
+        p = self.promise
+
+        def on_time(_f, p=p, value=value):
+            if not p.is_set:
+                p.send(value)
+
+        timer.on_ready(on_time)
+
+    def send_error(self, err) -> None:
+        if self.promise.is_set:
+            return
+        if not self.owner.alive:
+            return
+        delay = self.net._delivery_delay(self.owner, self.dst)
+        timer = self.net.sched.delay(delay, TaskPriority.DEFAULT_PROMISE_ENDPOINT)
+        p = self.promise
+
+        def on_time(_f, p=p, err=err):
+            if not p.is_set:
+                p.send_error(err)
+
+        timer.on_ready(on_time)

@@ -1,0 +1,18 @@
+"""Server roles: the transaction subsystem (ref: fdbserver/).
+
+The minimum end-to-end slice per the build plan: master version
+authority + proxy commit pipeline + resolver (pluggable conflict-set
+backend) + in-memory tag log + versioned storage, all hosted on
+simulated processes over the deterministic network.
+"""
+
+from .cluster import SimCluster
+from .types import (
+    CLEAR_RANGE,
+    SET_VALUE,
+    CommitRequest,
+    MutationRef,
+)
+
+__all__ = ["SimCluster", "CommitRequest", "MutationRef", "SET_VALUE",
+           "CLEAR_RANGE"]

@@ -1,0 +1,62 @@
+"""SimCluster: the whole transaction subsystem on one deterministic loop.
+
+Reference: fdbserver/SimulatedCluster.actor.cpp setupSimulatedSystem
+(:1078) — build simulated processes, start role actors on them, hand
+back client handles; the same role code would run on real transports in
+production (the INetwork seam). Fault API surfaces the sim2 primitives
+(kill/clog) for workload tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import flow
+from ..rpc import SimNetwork
+from .master import Master
+from .proxy import Proxy
+from .resolver_role import Resolver
+from .storage import StorageServer
+from .tlog import TLog
+
+
+class SimCluster:
+    """Single-region, single-proxy minimum slice; grows toward the full
+    recruitment flow (ClusterController/recovery) in later stages."""
+
+    def __init__(self, seed: int = 0, conflict_backend: str = "python",
+                 start_time: float = 0.0):
+        flow.set_seed(seed)
+        self.sched = flow.Scheduler(start_time=start_time, virtual=True)
+        flow.set_scheduler(self.sched)
+        self.net = SimNetwork(self.sched, flow.g_random)
+
+        p = self.net.new_process
+        self.master = Master(p("master", machine="m1"))
+        self.resolver = Resolver(p("resolver", machine="m2"),
+                                 backend=conflict_backend)
+        self.tlog = TLog(p("tlog", machine="m3"))
+        self.proxy = Proxy(p("proxy", machine="m1"),
+                           self.master.version_requests.ref(),
+                           self.resolver.resolves.ref(),
+                           self.tlog.commits.ref())
+        self.storage = StorageServer(p("storage", machine="m4"),
+                                     self.tlog.peeks.ref())
+        for role in (self.master, self.resolver, self.tlog, self.proxy,
+                     self.storage):
+            role.start()
+
+    def client(self, name: str = "client", machine: str = ""):
+        from ..client import Database  # avoid package-init cycle
+        proc = self.net.new_process(name, machine or name)
+        return Database(proc, self.proxy.grvs.ref(), self.proxy.commits.ref(),
+                        self.storage.gets.ref(), self.storage.ranges.ref())
+
+    # -- running --------------------------------------------------------
+    def run(self, coro, timeout_time: Optional[float] = None):
+        """Drive the loop until the given actor completes."""
+        task = flow.spawn(coro, name="test-main")
+        return self.sched.run(until=task, timeout_time=timeout_time)
+
+    def shutdown(self) -> None:
+        flow.set_scheduler(None)

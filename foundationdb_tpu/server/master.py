@@ -1,0 +1,56 @@
+"""Master role: commit-version authority.
+
+Reference: fdbserver/masterserver.actor.cpp `getVersion` (:875-940) —
+versions advance with real time (`version += VERSIONS_PER_SECOND * dt`,
+capped per request by MAX_READ_TRANSACTION_LIFE_VERSIONS) so that a
+version is also a coarse clock; each batch receives (prev_version,
+version) so downstream stages can sequence without gaps.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from .. import flow
+from ..flow import TaskPriority
+from ..rpc import RequestStream, SimProcess
+
+VERSIONS_PER_SECOND = 1_000_000          # ref: Knobs.cpp VERSIONS_PER_SECOND
+MAX_VERSION_ADVANCE = 5_000_000          # cap per request (ref: :918)
+
+
+class GetCommitVersionReply(NamedTuple):
+    prev_version: int
+    version: int
+
+
+class Master:
+    def __init__(self, process: SimProcess, recovery_version: int = 0):
+        self.process = process
+        self.version = recovery_version
+        self._last_time = None
+        self.version_requests = RequestStream(process)
+        self._actors = flow.ActorCollection()
+
+    def start(self) -> None:
+        self._actors.add(flow.spawn(self._version_loop(),
+                                    TaskPriority.PROXY_GET_CONSISTENT_READ_VERSION,
+                                    name=f"{self.process.name}.getVersion"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    def _next_version(self) -> GetCommitVersionReply:
+        t = flow.now()
+        if self._last_time is None:
+            advance = 1
+        else:
+            advance = max(1, min(MAX_VERSION_ADVANCE,
+                                 int(VERSIONS_PER_SECOND * (t - self._last_time))))
+        self._last_time = t
+        prev = self.version
+        self.version = prev + advance
+        return GetCommitVersionReply(prev, self.version)
+
+    async def _version_loop(self):
+        while True:
+            _req, reply = await self.version_requests.pop()
+            reply.send(self._next_version())

@@ -1,0 +1,121 @@
+"""Proxy role: GRV path + the pipelined commit batcher.
+
+Reference: fdbserver/MasterProxyServer.actor.cpp —
+  - commitBatcher (:344): group commit requests by time window / count;
+  - commitBatch (:410), five phases kept as distinct awaits here:
+      1 order via latestLocalCommitBatchResolving + master.getVersion
+      2 resolver.resolve (key-range split when sharded — the TPU
+        sharded backend does that split on-device instead)
+      3 verdict combine + mutation assembly
+      4 log push, sequenced via latestLocalCommitBatchLogging
+      5 per-txn replies: committed / not_committed / too_old
+  - transactionStarter / getLiveCommittedVersion (:1102/:1019): GRV
+    returns the proxy's committed version (single-proxy slice of the
+    all-proxies confirmation).
+Batches overlap: while one batch waits on the log fsync, the next can
+already be resolving — the NotifiedVersion pair is the software
+pipeline's interlock, exactly the reference's structure.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import flow
+from ..flow import NotifiedVersion, TaskPriority, error
+from ..models import COMMITTED, CONFLICT, TOO_OLD
+from ..rpc import NetworkRef, RequestStream, SimProcess
+from .types import (CommitReply, CommitRequest, GetReadVersionReply,
+                    ResolveRequest, TLogCommitRequest)
+
+
+class Proxy:
+    def __init__(self, process: SimProcess, master_ref: NetworkRef,
+                 resolver_ref: NetworkRef, tlog_ref: NetworkRef,
+                 recovery_version: int = 0,
+                 batch_window: float = 0.001, max_batch: int = 512):
+        self.process = process
+        self.master_ref = master_ref
+        self.resolver_ref = resolver_ref
+        self.tlog_ref = tlog_ref
+        self.batch_window = batch_window
+        self.max_batch = max_batch
+        self.committed_version = NotifiedVersion(recovery_version)
+        self.batch_resolving = NotifiedVersion(recovery_version)
+        self.batch_logging = NotifiedVersion(recovery_version)
+        self.commits = RequestStream(process)
+        self.grvs = RequestStream(process)
+        self._actors = flow.ActorCollection()
+
+    def start(self) -> None:
+        self._actors.add(flow.spawn(self._batcher(),
+                                    TaskPriority.PROXY_COMMIT_BATCHER,
+                                    name=f"{self.process.name}.batcher"))
+        self._actors.add(flow.spawn(self._grv_loop(),
+                                    TaskPriority.PROXY_GET_CONSISTENT_READ_VERSION,
+                                    name=f"{self.process.name}.grv"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    # -- GRV ------------------------------------------------------------
+    async def _grv_loop(self):
+        while True:
+            _req, reply = await self.grvs.pop()
+            reply.send(GetReadVersionReply(self.committed_version.get()))
+
+    # -- commit pipeline ------------------------------------------------
+    async def _batcher(self):
+        """(ref: commitBatcher :344 — batch by window/count)"""
+        while True:
+            req, reply = await self.commits.pop()
+            batch: List = [(req, reply)]
+            deadline = flow.delay(self.batch_window,
+                                  TaskPriority.PROXY_COMMIT_BATCHER)
+            while len(batch) < self.max_batch:
+                nxt = self.commits.pop()
+                got = await flow.first_of(nxt, deadline)
+                if got[0] == 1:  # window expired
+                    break
+                batch.append(got[1])
+            deadline.cancel()
+            flow.spawn(self._commit_batch(batch), TaskPriority.PROXY_COMMIT)
+
+    async def _commit_batch(self, batch):
+        reqs = [r for r, _ in batch]
+        replies = [p for _, p in batch]
+        try:
+            # phase 1: version assignment, ordered with earlier batches
+            ver = await self.master_ref.get_reply(None, self.process)
+            await self.batch_resolving.when_at_least(ver.prev_version)
+
+            # phase 2: conflict resolution
+            verdicts = await self.resolver_ref.get_reply(
+                ResolveRequest(ver.prev_version, ver.version, tuple(reqs)),
+                self.process)
+            self.batch_resolving.set(ver.version)
+
+            # phase 3: assemble mutations of committed transactions
+            mutations = []
+            for req, verdict in zip(reqs, verdicts):
+                if verdict == COMMITTED:
+                    mutations.extend(req.mutations)
+
+            # phase 4: log push, ordered (ref: latestLocalCommitBatchLogging)
+            await self.batch_logging.when_at_least(ver.prev_version)
+            await self.tlog_ref.get_reply(
+                TLogCommitRequest(ver.prev_version, ver.version,
+                                  tuple(mutations)), self.process)
+            self.batch_logging.set(ver.version)
+            if self.committed_version.get() < ver.version:
+                self.committed_version.set(ver.version)
+
+            # phase 5: per-transaction replies
+            for verdict, reply in zip(verdicts, replies):
+                if verdict == COMMITTED:
+                    reply.send(CommitReply(ver.version))
+                elif verdict == TOO_OLD:
+                    reply.send_error(error("transaction_too_old"))
+                else:
+                    reply.send_error(error("not_committed"))
+        except flow.FdbError as e:
+            for reply in replies:
+                reply.send_error(e)

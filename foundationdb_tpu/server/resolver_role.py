@@ -1,0 +1,59 @@
+"""Resolver role: ordered conflict-batch processing over a pluggable
+conflict-set backend.
+
+Reference: fdbserver/Resolver.actor.cpp `resolveBatch` (:71) — batches
+arrive tagged (prev_version, version); processing waits until the
+resolver has seen prev_version (NotifiedVersion ordering, :104-115),
+runs the ConflictSet (SkipList.cpp; here any backend behind the
+create_conflict_set plugin seam: python / native C++ / tpu / sharded
+tpu), advances the window to version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+(:155), and replies one verdict per transaction.
+"""
+
+from __future__ import annotations
+
+from .. import flow
+from ..flow import NotifiedVersion, TaskPriority
+from ..models import ResolverTransaction, create_conflict_set
+from ..rpc import RequestStream, SimProcess
+from .types import ResolveRequest
+
+MAX_WRITE_TRANSACTION_LIFE_VERSIONS = 5_000_000  # ref: Knobs.cpp:35
+
+
+class Resolver:
+    def __init__(self, process: SimProcess, backend: str = "python",
+                 recovery_version: int = 0):
+        self.process = process
+        self.conflict_set = create_conflict_set(backend, recovery_version)
+        self.version = NotifiedVersion(recovery_version)
+        self.resolves = RequestStream(process)
+        self._actors = flow.ActorCollection()
+
+    def start(self) -> None:
+        self._actors.add(flow.spawn(self._resolve_loop(),
+                                    TaskPriority.PROXY_RESOLVER_REPLY,
+                                    name=f"{self.process.name}.resolve"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    async def _resolve_loop(self):
+        while True:
+            req, reply = await self.resolves.pop()
+            flow.spawn(self._resolve_batch(req, reply),
+                       TaskPriority.PROXY_RESOLVER_REPLY)
+
+    async def _resolve_batch(self, req: ResolveRequest, reply):
+        # order batches by version, whatever the arrival order
+        await self.version.when_at_least(req.prev_version)
+        if self.version.get() >= req.version:
+            # duplicate delivery (e.g. proxy retry): conflict everything;
+            # the proxy treats it as not_committed and clients retry
+            reply.send([0] * len(req.transactions))
+            return
+        txns = [ResolverTransaction(t.read_snapshot, t.read_conflict_ranges,
+                                    t.write_conflict_ranges)
+                for t in req.transactions]
+        new_oldest = max(0, req.version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        verdicts = self.conflict_set.resolve(txns, req.version, new_oldest)
+        self.version.set(req.version)
+        reply.send(verdicts)

@@ -1,0 +1,138 @@
+"""Versioned in-memory storage server role.
+
+Reference: fdbserver/storageserver.actor.cpp — a 5-second MVCC window in
+a versioned map (:265-306) updated by pulling the log (`update` :2461,
+applyMutation :1664), serving `getValueQ` (:763) and `getKeyValues`
+(:1274) at a requested version, waiting for the version to arrive and
+throwing future_version if it is too far ahead. The versioned map here
+is per-key version chains + a range-clear list over a bisect-sorted key
+index (the PTree of fdbclient/VersionedMap.h:43 re-expressed for host
+Python; the TPU-resident sorted-array engine reuses ops/keys.py).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, insort
+from typing import Dict, List, Optional, Tuple
+
+from .. import flow
+from ..flow import NotifiedVersion, TaskPriority, error
+from ..rpc import NetworkRef, RequestStream, SimProcess
+from .types import (CLEAR_RANGE, SET_VALUE, MutationRef, StorageGetRangeRequest,
+                    StorageGetRequest, TLogPeekRequest)
+
+MAX_READ_AHEAD_VERSIONS = 5_000_000  # ref: MAX_READ_TRANSACTION_LIFE_VERSIONS
+
+
+class VersionedMap:
+    """Per-key version chains + version-stamped range clears."""
+
+    def __init__(self):
+        self._keys: List[bytes] = []           # sorted index
+        self._chains: Dict[bytes, List[Tuple[int, Optional[bytes]]]] = {}
+        self._clears: List[Tuple[int, bytes, bytes]] = []
+
+    def apply(self, version: int, m: MutationRef) -> None:
+        if m.type == SET_VALUE:
+            chain = self._chains.get(m.param1)
+            if chain is None:
+                self._chains[m.param1] = [(version, m.param2)]
+                insort(self._keys, m.param1)
+            else:
+                chain.append((version, m.param2))
+        elif m.type == CLEAR_RANGE:
+            self._clears.append((version, m.param1, m.param2))
+            i = bisect_left(self._keys, m.param1)
+            while i < len(self._keys) and self._keys[i] < m.param2:
+                self._chains[self._keys[i]].append((version, None))
+                i += 1
+        else:
+            raise error("client_invalid_operation")
+
+    def get(self, key: bytes, version: int) -> Optional[bytes]:
+        chain = self._chains.get(key)
+        if not chain:
+            return None
+        for v, val in reversed(chain):
+            if v <= version:
+                return val
+        return None
+
+    def get_range(self, begin: bytes, end: bytes, version: int,
+                  limit: int) -> List[Tuple[bytes, bytes]]:
+        out = []
+        i = bisect_left(self._keys, begin)
+        while i < len(self._keys) and self._keys[i] < end:
+            k = self._keys[i]
+            val = self.get(k, version)
+            if val is not None:
+                out.append((k, val))
+                if len(out) >= limit:
+                    break
+            i += 1
+        return out
+
+
+class StorageServer:
+    def __init__(self, process: SimProcess, tlog_peek: NetworkRef):
+        self.process = process
+        self.tlog_peek = tlog_peek
+        self.data = VersionedMap()
+        self.version = NotifiedVersion(0)
+        self.gets = RequestStream(process)
+        self.ranges = RequestStream(process)
+        self._actors = flow.ActorCollection()
+
+    def start(self) -> None:
+        for coro, prio, name in (
+                (self._pull_loop(), TaskPriority.UPDATE_STORAGE, "pull"),
+                (self._get_loop(), TaskPriority.STORAGE, "get"),
+                (self._range_loop(), TaskPriority.STORAGE, "getrange")):
+            self._actors.add(flow.spawn(coro, prio,
+                                        name=f"{self.process.name}.{name}"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    async def _pull_loop(self):
+        """Pull committed mutations from the log (ref: update :2461)."""
+        while True:
+            reply = await self.tlog_peek.get_reply(
+                TLogPeekRequest(self.version.get() + 1), self.process)
+            for version, mutations in reply.entries:
+                if version <= self.version.get():
+                    continue
+                for m in mutations:
+                    self.data.apply(version, m)
+                self.version.set(version)
+            if reply.committed_version > self.version.get():
+                self.version.set(reply.committed_version)
+
+    async def _wait_version(self, version: int):
+        """(ref: waitForVersion — future_version when too far ahead)"""
+        if version > self.version.get() + MAX_READ_AHEAD_VERSIONS:
+            raise error("future_version")
+        await self.version.when_at_least(version)
+
+    async def _get_loop(self):
+        while True:
+            req, reply = await self.gets.pop()
+            flow.spawn(self._serve_get(req, reply), TaskPriority.STORAGE)
+
+    async def _serve_get(self, req: StorageGetRequest, reply):
+        try:
+            await self._wait_version(req.version)
+            reply.send(self.data.get(req.key, req.version))
+        except flow.FdbError as e:
+            reply.send_error(e)
+
+    async def _range_loop(self):
+        while True:
+            req, reply = await self.ranges.pop()
+            flow.spawn(self._serve_range(req, reply), TaskPriority.STORAGE)
+
+    async def _serve_range(self, req: StorageGetRangeRequest, reply):
+        try:
+            await self._wait_version(req.version)
+            reply.send(self.data.get_range(req.begin, req.end, req.version,
+                                           req.limit))
+        except flow.FdbError as e:
+            reply.send_error(e)

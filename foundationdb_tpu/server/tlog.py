@@ -1,0 +1,77 @@
+"""In-memory transaction log role.
+
+Reference: fdbserver/TLogServer.actor.cpp — `tLogCommit` (:1468) appends
+versioned mutation sets in strict version order (commits carrying
+prev_version sequence via NotifiedVersion) and acks after the queue
+commit becomes durable (doQueueCommit :1382 — here a simulated fsync
+delay); `tLogPeekMessages` (:1138) long-polls readers from a version;
+`tLogPop` (:1050) discards acked prefixes. Tag partitioning arrives with
+multi-storage; this slice logs one tag.
+"""
+
+from __future__ import annotations
+
+from .. import flow
+from ..flow import NotifiedVersion, TaskPriority
+from ..rpc import RequestStream, SimProcess
+from .types import TLogCommitRequest, TLogPeekReply, TLogPeekRequest
+
+
+class TLog:
+    def __init__(self, process: SimProcess, fsync_delay: float = 0.0005):
+        self.process = process
+        self.fsync_delay = fsync_delay
+        self.entries: list = []  # [(version, mutations)] sorted
+        self.version = NotifiedVersion(0)   # highest durable version
+        self.queue_version = NotifiedVersion(0)  # highest accepted version
+        self.popped = 0
+        self.commits = RequestStream(process)
+        self.peeks = RequestStream(process)
+        self._actors = flow.ActorCollection()
+
+    def start(self) -> None:
+        self._actors.add(flow.spawn(self._commit_loop(), TaskPriority.TLOG_COMMIT,
+                                    name=f"{self.process.name}.commit"))
+        self._actors.add(flow.spawn(self._peek_loop(), TaskPriority.TLOG_PEEK,
+                                    name=f"{self.process.name}.peek"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    async def _commit_loop(self):
+        while True:
+            req, reply = await self.commits.pop()
+            assert isinstance(req, TLogCommitRequest)
+            # strict version ordering (ref: tLogCommit waits for
+            # logData->version == req.prevVersion)
+            await self.queue_version.when_at_least(req.prev_version)
+            if self.version.get() >= req.version:
+                reply.send(self.version.get())  # duplicate after recovery
+                continue
+            self.queue_version.set(req.version)
+            self.entries.append((req.version, req.mutations))
+            # durability: simulated fsync before ack
+            flow.spawn(self._make_durable(req.version, reply),
+                       TaskPriority.TLOG_COMMIT_REPLY)
+
+    async def _make_durable(self, version, reply):
+        await flow.delay(self.fsync_delay, TaskPriority.TLOG_COMMIT_REPLY)
+        if self.version.get() < version:
+            self.version.set(version)
+        reply.send(version)
+
+    async def _peek_loop(self):
+        while True:
+            req, reply = await self.peeks.pop()
+            assert isinstance(req, TLogPeekRequest)
+            flow.spawn(self._serve_peek(req, reply), TaskPriority.TLOG_PEEK_REPLY)
+
+    async def _serve_peek(self, req: TLogPeekRequest, reply):
+        # long-poll: wait until something at/after begin_version is durable
+        await self.version.when_at_least(req.begin_version)
+        out = tuple((v, m) for v, m in self.entries
+                    if v >= req.begin_version)
+        reply.send(TLogPeekReply(out, self.version.get()))
+
+    def pop(self, version: int) -> None:
+        """Discard entries at or below `version` (ref: tLogPop)."""
+        self.popped = max(self.popped, version)
+        self.entries = [(v, m) for v, m in self.entries if v > version]

@@ -1,0 +1,75 @@
+"""Shared transaction-subsystem types.
+
+Reference: fdbclient/CommitTransaction.h — `MutationRef` (:49-109, 21
+mutation types; the slice carries SetValue/ClearRange, atomic ops land
+with the storage engine work) and `CommitTransactionRef` (:136-168:
+read/write conflict ranges + mutations + read_snapshot).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+SET_VALUE = 0
+CLEAR_RANGE = 1
+
+Range = Tuple[bytes, bytes]
+
+
+class MutationRef(NamedTuple):
+    type: int
+    param1: bytes  # key / range begin
+    param2: bytes  # value / range end
+
+
+class CommitRequest(NamedTuple):
+    """One transaction's commit payload (ref: CommitTransactionRequest)."""
+
+    read_snapshot: int
+    read_conflict_ranges: Tuple[Range, ...]
+    write_conflict_ranges: Tuple[Range, ...]
+    mutations: Tuple[MutationRef, ...]
+
+
+class CommitReply(NamedTuple):
+    version: int  # the commit version
+
+
+class GetReadVersionReply(NamedTuple):
+    version: int
+
+
+class ResolveRequest(NamedTuple):
+    """Ordered batch for a resolver (ref: ResolveTransactionBatchRequest,
+    fdbserver/ResolverInterface.h)."""
+
+    prev_version: int
+    version: int
+    transactions: Tuple[CommitRequest, ...]
+
+
+class StorageGetRequest(NamedTuple):
+    key: bytes
+    version: int
+
+
+class StorageGetRangeRequest(NamedTuple):
+    begin: bytes
+    end: bytes
+    version: int
+    limit: int
+
+
+class TLogCommitRequest(NamedTuple):
+    prev_version: int
+    version: int
+    mutations: Tuple[MutationRef, ...]
+
+
+class TLogPeekRequest(NamedTuple):
+    begin_version: int
+
+
+class TLogPeekReply(NamedTuple):
+    entries: Tuple[Tuple[int, Tuple[MutationRef, ...]], ...]
+    committed_version: int

@@ -77,6 +77,25 @@ def test_recovery_style_version_jump():
     assert cs.resolve(batch, v, old) == brute.resolve(batch, v, old)
 
 
+def test_giant_version_jump_beyond_int32():
+    """Jumps whose base shift exceeds int32 range entirely (regression:
+    jnp.int32(delta) overflowed)."""
+    cs = TpuConflictSet()
+    brute = BruteForceConflictSet()
+    for impl in (cs, brute):
+        impl.resolve([txn(0, writes=[(b"a", b"b")])], 100, 0)
+    for jump in (1 << 32, 1 << 33):
+        old = jump - MWTLV
+        batch = [txn(jump - 10, reads=[(b"a", b"b")]),
+                 txn(jump - 10, writes=[(b"c", b"d")])]
+        assert cs.resolve(batch, jump, old) == brute.resolve(batch, jump, old)
+    # post-jump writes must be visible at exact versions
+    v = (1 << 33) + 50
+    batch = [txn((1 << 33) - 5, reads=[(b"c", b"d")])]
+    assert cs.resolve(batch, v, v - MWTLV) == \
+        brute.resolve(batch, v, v - MWTLV) == [CONFLICT]
+
+
 def test_window_must_advance_past_threshold():
     cs = TpuConflictSet()
     cs.resolve([txn(0, writes=[(b"a", b"b")])], 100, 0)
