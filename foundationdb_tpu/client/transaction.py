@@ -16,10 +16,23 @@ from bisect import bisect_left, bisect_right, insort
 from typing import Dict, List, Optional, Tuple
 
 from .. import flow
-from ..flow import TaskPriority, error
+from ..flow import Future, TaskPriority, error
 from ..rpc import NetworkRef, SimProcess
-from ..server.types import (CLEAR_RANGE, SET_VALUE, CommitRequest, MutationRef,
-                            StorageGetRangeRequest, StorageGetRequest)
+from ..server import atomic as _atomic
+from ..server.types import (ADD_VALUE, AND, APPEND_IF_FITS, ATOMIC_OPS,
+                            BYTE_MAX, BYTE_MIN, CLEAR_RANGE,
+                            COMPARE_AND_CLEAR, CommitRequest, KeySelector,
+                            MAX, MIN, MutationRef, OR, SET_VALUE,
+                            SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
+                            StorageGetKeyRequest, StorageGetRangeRequest,
+                            StorageGetRequest, StorageWatchRequest, XOR)
+
+_ATOMIC_APPLY = {
+    ADD_VALUE: _atomic.add, AND: _atomic.bit_and, OR: _atomic.bit_or,
+    XOR: _atomic.bit_xor, APPEND_IF_FITS: _atomic.append_if_fits,
+    MAX: _atomic.vmax, MIN: _atomic.vmin, BYTE_MIN: _atomic.byte_min,
+    BYTE_MAX: _atomic.byte_max, COMPARE_AND_CLEAR: _atomic.compare_and_clear,
+}
 
 RETRYABLE = {"not_committed", "transaction_too_old", "future_version",
              "broken_promise", "commit_unknown_result", "timed_out"}
@@ -34,12 +47,15 @@ class Database:
 
     def __init__(self, process: SimProcess, grv_ref: NetworkRef,
                  commit_ref: NetworkRef, storage_get: NetworkRef,
-                 storage_range: NetworkRef):
+                 storage_range: NetworkRef, storage_key: NetworkRef = None,
+                 storage_watch: NetworkRef = None):
         self.process = process
         self.grv_ref = grv_ref
         self.commit_ref = commit_ref
         self.storage_get = storage_get
         self.storage_range = storage_range
+        self.storage_key = storage_key
+        self.storage_watch = storage_watch
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
@@ -55,10 +71,13 @@ class Transaction:
         self._writes: Dict[bytes, Optional[bytes]] = {}  # RYW write map
         self._write_order: List[bytes] = []              # sorted keys
         self._cleared: List[Tuple[bytes, bytes]] = []    # ordered clears
+        self._ops: Dict[bytes, List[Tuple[int, bytes]]] = {}  # pending atomics
         self._mutations: List[MutationRef] = []
         self._read_conflicts: List[Tuple[bytes, bytes]] = []
         self._write_conflicts: List[Tuple[bytes, bytes]] = []
+        self._watches: List[Tuple[bytes, Future]] = []
         self.committed_version: Optional[int] = None
+        self.committed_batch_index: Optional[int] = None
 
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:
@@ -78,9 +97,7 @@ class Transaction:
         return False, None
 
     # -- reads ----------------------------------------------------------
-    async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
-        if not snapshot:
-            self._read_conflicts.append((key, _next_key(key)))
+    async def _base_get(self, key: bytes) -> Optional[bytes]:
         found, val = self._overlay_get(key)
         if found:
             return val
@@ -88,15 +105,42 @@ class Transaction:
         return await self.db.storage_get.get_reply(
             StorageGetRequest(key, version), self.db.process)
 
-    async def get_range(self, begin: bytes, end: bytes, limit: int = 1 << 20,
-                        snapshot: bool = False) -> List[Tuple[bytes, bytes]]:
+    async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        if not snapshot:
+            self._read_conflicts.append((key, _next_key(key)))
+        val = await self._base_get(key)
+        # pending atomic ops computed over the base (ref: RYW reads of
+        # atomically-modified keys, ReadYourWrites.actor.cpp)
+        for op, param in self._ops.get(key, ()):
+            val = _ATOMIC_APPLY[op](val, param)
+        return val
+
+    async def get_key(self, selector: KeySelector,
+                      snapshot: bool = False) -> bytes:
+        """Resolve a key selector (ref: Transaction::getKey)."""
+        version = await self.get_read_version()
+        resolved = await self.db.storage_key.get_reply(
+            StorageGetKeyRequest(selector, version), self.db.process)
+        if not snapshot:
+            lo = min(resolved, selector.key)
+            hi = max(resolved, selector.key)
+            self._read_conflicts.append((lo, _next_key(hi)))
+        return resolved
+
+    async def get_range(self, begin, end, limit: int = 1 << 20,
+                        snapshot: bool = False,
+                        reverse: bool = False) -> List[Tuple[bytes, bytes]]:
+        if isinstance(begin, KeySelector):
+            begin = await self.get_key(begin, snapshot=snapshot)
+        if isinstance(end, KeySelector):
+            end = await self.get_key(end, snapshot=snapshot)
         if begin >= end:
             return []
         if not snapshot:
             self._read_conflicts.append((begin, end))
         version = await self.get_read_version()
         base = await self.db.storage_range.get_reply(
-            StorageGetRangeRequest(begin, end, version, limit),
+            StorageGetRangeRequest(begin, end, version, 1 << 20, False),
             self.db.process)
         # overlay uncommitted writes (ref: RYWIterator merge)
         merged: Dict[bytes, bytes] = {k: v for k, v in base}
@@ -111,7 +155,22 @@ class Transaction:
                 merged.pop(k, None)
             else:
                 merged[k] = v
-        return sorted(merged.items())[:limit]
+        # keys with pending atomic ops materialize from their base value
+        for k, ops in self._ops.items():
+            if begin <= k < end:
+                val = merged.get(k)
+                if val is None and k not in self._writes and \
+                        not any(b <= k < e for b, e in self._cleared):
+                    val = await self.db.storage_get.get_reply(
+                        StorageGetRequest(k, version), self.db.process)
+                for op, param in ops:
+                    val = _ATOMIC_APPLY[op](val, param)
+                if val is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = val
+        out = sorted(merged.items(), reverse=reverse)
+        return out[:limit]
 
     # -- writes ---------------------------------------------------------
     def _record_write(self, key: bytes, value: Optional[bytes]) -> None:
@@ -121,6 +180,7 @@ class Transaction:
 
     def set(self, key: bytes, value: bytes) -> None:
         self._record_write(key, value)
+        self._ops.pop(key, None)  # a set supersedes pending atomics
         self._mutations.append(MutationRef(SET_VALUE, key, value))
         self._write_conflicts.append((key, _next_key(key)))
 
@@ -135,8 +195,45 @@ class Transaction:
         hi = bisect_left(self._write_order, end)
         for k in self._write_order[lo:hi]:
             self._writes[k] = None
+        for k in [k for k in self._ops if begin <= k < end]:
+            del self._ops[k]
         self._mutations.append(MutationRef(CLEAR_RANGE, begin, end))
         self._write_conflicts.append((begin, end))
+
+    def atomic_op(self, key: bytes, param: bytes, op_type: int) -> None:
+        """(ref: Transaction::atomicOp / fdbclient/Atomic.h op table)"""
+        if op_type in (SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE):
+            # transformed at the proxy with the commit version; the
+            # operand's trailing 4 bytes are the placeholder offset
+            self._mutations.append(MutationRef(op_type, key, param))
+            wkey = key[:-4] if op_type == SET_VERSIONSTAMPED_KEY else key
+            self._write_conflicts.append((wkey, _next_key(wkey)))
+            return
+        if op_type not in ATOMIC_OPS:
+            raise error("client_invalid_operation")
+        # a set/clear'd key has a known value: fold the op in directly
+        found, cur = self._overlay_get(key)
+        if found and key not in self._ops:
+            result = _ATOMIC_APPLY[op_type](cur, param)
+            if result is None:
+                self._record_write(key, None)
+                self._mutations.append(
+                    MutationRef(CLEAR_RANGE, key, _next_key(key)))
+            else:
+                self._record_write(key, result)
+                self._mutations.append(MutationRef(SET_VALUE, key, result))
+        else:
+            self._ops.setdefault(key, []).append((op_type, param))
+            self._mutations.append(MutationRef(op_type, key, param))
+        self._write_conflicts.append((key, _next_key(key)))
+
+    def watch(self, key: bytes) -> Future:
+        """Future that fires when the key's value changes after this
+        transaction commits (ref: Transaction::watch / storage watches).
+        Errors with transaction_cancelled if the commit fails."""
+        f = Future()
+        self._watches.append((key, f))
+        return f
 
     # -- commit ---------------------------------------------------------
     async def commit(self) -> int:
@@ -144,14 +241,44 @@ class Transaction:
         if not self._mutations:
             # read-only: succeeds at the read version without a round trip
             self.committed_version = self._read_version or 0
+            self._arm_watches(self.committed_version)
             return self.committed_version
         snapshot = await self.get_read_version()
         req = CommitRequest(snapshot, tuple(self._read_conflicts),
                             tuple(self._write_conflicts),
                             tuple(self._mutations))
-        reply = await self.db.commit_ref.get_reply(req, self.db.process)
+        try:
+            reply = await self.db.commit_ref.get_reply(req, self.db.process)
+        except flow.FdbError as e:
+            for _k, f in self._watches:
+                if not f.is_ready:
+                    f.send_error(error("transaction_cancelled"))
+            raise e
         self.committed_version = reply.version
+        self.committed_batch_index = reply.batch_index
+        self._arm_watches(reply.version)
         return reply.version
+
+    def get_versionstamp(self) -> bytes:
+        """The committed transaction's 10-byte versionstamp."""
+        if self.committed_version is None:
+            raise error("client_invalid_operation")
+        from ..server.proxy import make_versionstamp
+        return make_versionstamp(self.committed_version,
+                                 self.committed_batch_index or 0)
+
+    def _arm_watches(self, version: int) -> None:
+        """Wire pending watches to storage at the commit version."""
+        for key, f in self._watches:
+            if f.is_ready:
+                continue
+            storage_fut = self.db.storage_watch.get_reply(
+                StorageWatchRequest(key, version), self.db.process)
+            storage_fut.on_ready(
+                lambda sf, f=f: (f.send(sf.get()) if not sf.is_error
+                                 else f.send_error(sf.exception()))
+                if not f.is_ready else None)
+        self._watches = []
 
     # -- retry loop -----------------------------------------------------
     async def on_error(self, e: BaseException) -> None:

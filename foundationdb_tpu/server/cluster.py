@@ -25,7 +25,7 @@ class SimCluster:
     recruitment flow (ClusterController/recovery) in later stages."""
 
     def __init__(self, seed: int = 0, conflict_backend: str = "python",
-                 start_time: float = 0.0):
+                 start_time: float = 0.0, n_resolvers: int = 1):
         flow.set_seed(seed)
         self.sched = flow.Scheduler(start_time=start_time, virtual=True)
         flow.set_scheduler(self.sched)
@@ -33,16 +33,24 @@ class SimCluster:
 
         p = self.net.new_process
         self.master = Master(p("master", machine="m1"))
-        self.resolver = Resolver(p("resolver", machine="m2"),
-                                 backend=conflict_backend)
+        self.resolvers = [
+            Resolver(p(f"resolver{i}", machine=f"m2.{i}"),
+                     backend=conflict_backend)
+            for i in range(n_resolvers)]
+        self.resolver = self.resolvers[0]
+        # evenly spaced single-byte split points (rebalancing arrives with
+        # the resolutionBalancing equivalent)
+        splits = [bytes([(i * 256) // n_resolvers])
+                  for i in range(1, n_resolvers)]
         self.tlog = TLog(p("tlog", machine="m3"))
         self.proxy = Proxy(p("proxy", machine="m1"),
                            self.master.version_requests.ref(),
-                           self.resolver.resolves.ref(),
-                           self.tlog.commits.ref())
+                           [r.resolves.ref() for r in self.resolvers],
+                           self.tlog.commits.ref(),
+                           resolver_splits=splits)
         self.storage = StorageServer(p("storage", machine="m4"),
                                      self.tlog.peeks.ref())
-        for role in (self.master, self.resolver, self.tlog, self.proxy,
+        for role in (self.master, *self.resolvers, self.tlog, self.proxy,
                      self.storage):
             role.start()
 
@@ -50,7 +58,9 @@ class SimCluster:
         from ..client import Database  # avoid package-init cycle
         proc = self.net.new_process(name, machine or name)
         return Database(proc, self.proxy.grvs.ref(), self.proxy.commits.ref(),
-                        self.storage.gets.ref(), self.storage.ranges.ref())
+                        self.storage.gets.ref(), self.storage.ranges.ref(),
+                        self.storage.get_keys.ref(),
+                        self.storage.watches.ref())
 
     # -- running --------------------------------------------------------
     def run(self, coro, timeout_time: Optional[float] = None):

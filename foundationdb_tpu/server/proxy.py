@@ -25,18 +25,48 @@ from .. import flow
 from ..flow import NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
-from .types import (CommitReply, CommitRequest, GetReadVersionReply,
-                    ResolveRequest, TLogCommitRequest)
+from .types import (SET_VALUE, SET_VERSIONSTAMPED_KEY,
+                    SET_VERSIONSTAMPED_VALUE, CommitReply, CommitRequest,
+                    GetReadVersionReply, MutationRef, ResolveRequest,
+                    TLogCommitRequest)
+
+
+def make_versionstamp(version: int, batch_index: int) -> bytes:
+    """10-byte versionstamp: 8B big-endian commit version + 2B big-endian
+    batch index (ref: Versionstamp encoding, CommitTransaction.h /
+    design/tuple.md)."""
+    return version.to_bytes(8, "big") + batch_index.to_bytes(2, "big")
+
+
+def _apply_versionstamp(m: MutationRef, stamp: bytes) -> MutationRef:
+    """Rewrite a versionstamped mutation into a plain set (ref:
+    MasterProxyServer commitBatch applying transformations before
+    logging). The operand's trailing 4 bytes are the little-endian
+    offset of the 10-byte placeholder."""
+    if m.type == SET_VERSIONSTAMPED_KEY:
+        off = int.from_bytes(m.param1[-4:], "little")
+        key = m.param1[:-4]
+        return MutationRef(SET_VALUE, key[:off] + stamp + key[off + 10:],
+                           m.param2)
+    off = int.from_bytes(m.param2[-4:], "little")
+    val = m.param2[:-4]
+    return MutationRef(SET_VALUE, m.param1,
+                       val[:off] + stamp + val[off + 10:])
 
 
 class Proxy:
     def __init__(self, process: SimProcess, master_ref: NetworkRef,
-                 resolver_ref: NetworkRef, tlog_ref: NetworkRef,
-                 recovery_version: int = 0,
+                 resolver_refs, tlog_ref: NetworkRef,
+                 resolver_splits=(), recovery_version: int = 0,
                  batch_window: float = 0.001, max_batch: int = 512):
+        if not isinstance(resolver_refs, (list, tuple)):
+            resolver_refs = [resolver_refs]
+        assert len(resolver_splits) == len(resolver_refs) - 1
         self.process = process
         self.master_ref = master_ref
-        self.resolver_ref = resolver_ref
+        self.resolver_refs = list(resolver_refs)
+        # keyResolvers boundaries: resolver i owns [bounds[i], bounds[i+1})
+        self._bounds = [b""] + list(resolver_splits) + [None]
         self.tlog_ref = tlog_ref
         self.batch_window = batch_window
         self.max_batch = max_batch
@@ -87,17 +117,31 @@ class Proxy:
             ver = await self.master_ref.get_reply(None, self.process)
             await self.batch_resolving.when_at_least(ver.prev_version)
 
-            # phase 2: conflict resolution
-            verdicts = await self.resolver_ref.get_reply(
-                ResolveRequest(ver.prev_version, ver.version, tuple(reqs)),
-                self.process)
+            # phase 2: conflict resolution — single resolver fast path, or
+            # key-range split across resolvers with min-combined verdicts
+            # (ref: ResolutionRequestBuilder :265-341, combine :585-592)
+            if len(self.resolver_refs) == 1:
+                verdicts = await self.resolver_refs[0].get_reply(
+                    ResolveRequest(ver.prev_version, ver.version,
+                                   tuple(reqs)), self.process)
+            else:
+                verdicts = await self._resolve_split(ver, reqs)
             self.batch_resolving.set(ver.version)
 
-            # phase 3: assemble mutations of committed transactions
+            # phase 3: assemble mutations of committed transactions,
+            # resolving versionstamped operations with the commit version
             mutations = []
-            for req, verdict in zip(reqs, verdicts):
-                if verdict == COMMITTED:
-                    mutations.extend(req.mutations)
+            for idx, (req, verdict) in enumerate(zip(reqs, verdicts)):
+                if verdict != COMMITTED:
+                    continue
+                stamp = None
+                for m in req.mutations:
+                    if m.type in (SET_VERSIONSTAMPED_KEY,
+                                  SET_VERSIONSTAMPED_VALUE):
+                        if stamp is None:
+                            stamp = make_versionstamp(ver.version, idx)
+                        m = _apply_versionstamp(m, stamp)
+                    mutations.append(m)
 
             # phase 4: log push, ordered (ref: latestLocalCommitBatchLogging)
             await self.batch_logging.when_at_least(ver.prev_version)
@@ -109,9 +153,9 @@ class Proxy:
                 self.committed_version.set(ver.version)
 
             # phase 5: per-transaction replies
-            for verdict, reply in zip(verdicts, replies):
+            for idx, (verdict, reply) in enumerate(zip(verdicts, replies)):
                 if verdict == COMMITTED:
-                    reply.send(CommitReply(ver.version))
+                    reply.send(CommitReply(ver.version, idx))
                 elif verdict == TOO_OLD:
                     reply.send_error(error("transaction_too_old"))
                 else:
@@ -119,3 +163,47 @@ class Proxy:
         except flow.FdbError as e:
             for reply in replies:
                 reply.send_error(e)
+
+    async def _resolve_split(self, ver, reqs):
+        """Send each transaction's ranges clipped per resolver shard; every
+        resolver sees every batch version (possibly with no transactions)
+        so its NotifiedVersion ordering advances; a transaction's verdict
+        is the min over the resolvers that saw it."""
+        n_res = len(self.resolver_refs)
+        per = [[] for _ in range(n_res)]   # [(orig_idx, clipped_req)]
+        for idx, req in enumerate(reqs):
+            placed = False
+            for i in range(n_res):
+                lo, hi = self._bounds[i], self._bounds[i + 1]
+                rr = _clip_ranges(req.read_conflict_ranges, lo, hi)
+                wr = _clip_ranges(req.write_conflict_ranges, lo, hi)
+                if rr or wr:
+                    per[i].append((idx, req._replace(
+                        read_conflict_ranges=rr, write_conflict_ranges=wr,
+                        mutations=())))
+                    placed = True
+            if not placed:  # no conflict ranges at all -> resolver 0
+                per[0].append((idx, req._replace(mutations=())))
+        futs = [ref.get_reply(
+            ResolveRequest(ver.prev_version, ver.version,
+                           tuple(r for _, r in plist)), self.process)
+            for ref, plist in zip(self.resolver_refs, per)]
+        results = await flow.all_of(futs)
+        combined = [COMMITTED] * len(reqs)
+        for plist, verdicts in zip(per, results):
+            for (idx, _), v in zip(plist, verdicts):
+                combined[idx] = min(combined[idx], v)
+        return combined
+
+
+def _clip_ranges(ranges, lo, hi):
+    out = []
+    for b, e in ranges:
+        b2 = max(b, lo)
+        e2 = e if hi is None else min(e, hi)
+        if hi is None:
+            if b2 < e:
+                out.append((b2, e))
+        elif b2 < e2:
+            out.append((b2, e2))
+    return tuple(out)

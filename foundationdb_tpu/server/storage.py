@@ -12,16 +12,33 @@ Python; the TPU-resident sorted-array engine reuses ops/keys.py).
 
 from __future__ import annotations
 
-from bisect import bisect_left, insort
+from bisect import bisect_left, bisect_right, insort
 from typing import Dict, List, Optional, Tuple
 
 from .. import flow
 from ..flow import NotifiedVersion, TaskPriority, error
 from ..rpc import NetworkRef, RequestStream, SimProcess
-from .types import (CLEAR_RANGE, SET_VALUE, MutationRef, StorageGetRangeRequest,
-                    StorageGetRequest, TLogPeekRequest)
+from . import atomic
+from .types import (ADD_VALUE, AND, APPEND_IF_FITS, BYTE_MAX, BYTE_MIN,
+                    CLEAR_RANGE, COMPARE_AND_CLEAR, KeySelector, MAX, MIN,
+                    MutationRef, OR, SET_VALUE, StorageGetKeyRequest,
+                    StorageGetRangeRequest, StorageGetRequest,
+                    StorageWatchRequest, TLogPeekRequest, XOR)
 
 MAX_READ_AHEAD_VERSIONS = 5_000_000  # ref: MAX_READ_TRANSACTION_LIFE_VERSIONS
+
+_ATOMIC_APPLY = {
+    ADD_VALUE: atomic.add,
+    AND: atomic.bit_and,
+    OR: atomic.bit_or,
+    XOR: atomic.bit_xor,
+    APPEND_IF_FITS: atomic.append_if_fits,
+    MAX: atomic.vmax,
+    MIN: atomic.vmin,
+    BYTE_MIN: atomic.byte_min,
+    BYTE_MAX: atomic.byte_max,
+    COMPARE_AND_CLEAR: atomic.compare_and_clear,
+}
 
 
 class VersionedMap:
@@ -32,20 +49,29 @@ class VersionedMap:
         self._chains: Dict[bytes, List[Tuple[int, Optional[bytes]]]] = {}
         self._clears: List[Tuple[int, bytes, bytes]] = []
 
+    def _set(self, version: int, key: bytes, value: Optional[bytes]) -> None:
+        chain = self._chains.get(key)
+        if chain is None:
+            self._chains[key] = [(version, value)]
+            insort(self._keys, key)
+        else:
+            chain.append((version, value))
+
     def apply(self, version: int, m: MutationRef) -> None:
         if m.type == SET_VALUE:
-            chain = self._chains.get(m.param1)
-            if chain is None:
-                self._chains[m.param1] = [(version, m.param2)]
-                insort(self._keys, m.param1)
-            else:
-                chain.append((version, m.param2))
+            self._set(version, m.param1, m.param2)
         elif m.type == CLEAR_RANGE:
             self._clears.append((version, m.param1, m.param2))
             i = bisect_left(self._keys, m.param1)
             while i < len(self._keys) and self._keys[i] < m.param2:
                 self._chains[self._keys[i]].append((version, None))
                 i += 1
+        elif m.type in _ATOMIC_APPLY:
+            # read-modify-write at apply time, in version order (ref:
+            # storageserver applyMutation -> Atomic.h apply functions)
+            existing = self.get(m.param1, version)
+            self._set(version, m.param1, _ATOMIC_APPLY[m.type](existing,
+                                                               m.param2))
         else:
             raise error("client_invalid_operation")
 
@@ -59,8 +85,19 @@ class VersionedMap:
         return None
 
     def get_range(self, begin: bytes, end: bytes, version: int,
-                  limit: int) -> List[Tuple[bytes, bytes]]:
+                  limit: int, reverse: bool = False) -> List[Tuple[bytes, bytes]]:
         out = []
+        if reverse:
+            i = bisect_left(self._keys, end) - 1
+            while i >= 0 and self._keys[i] >= begin:
+                k = self._keys[i]
+                val = self.get(k, version)
+                if val is not None:
+                    out.append((k, val))
+                    if len(out) >= limit:
+                        break
+                i -= 1
+            return out
         i = bisect_left(self._keys, begin)
         while i < len(self._keys) and self._keys[i] < end:
             k = self._keys[i]
@@ -72,6 +109,24 @@ class VersionedMap:
             i += 1
         return out
 
+    def resolve_selector(self, sel: KeySelector, version: int) -> bytes:
+        """Resolve a KeySelector against the keys present at `version`
+        (ref: storageserver findKey / fdbclient KeySelectorRef semantics:
+        start from the last key < (or <= when or_equal) the reference
+        key, then move `offset` present keys forward). Clamps to b'' on
+        underflow and to \\xff on overflow."""
+        present = [k for k in self._keys if self.get(k, version) is not None]
+        if sel.or_equal:
+            base = bisect_right(present, sel.key) - 1
+        else:
+            base = bisect_left(present, sel.key) - 1
+        idx = base + sel.offset
+        if idx < 0:
+            return b""
+        if idx >= len(present):
+            return b"\xff"
+        return present[idx]
+
 
 class StorageServer:
     def __init__(self, process: SimProcess, tlog_peek: NetworkRef):
@@ -81,13 +136,19 @@ class StorageServer:
         self.version = NotifiedVersion(0)
         self.gets = RequestStream(process)
         self.ranges = RequestStream(process)
+        self.get_keys = RequestStream(process)
+        self.watches = RequestStream(process)
+        # key -> list of (value_at_registration, reply)
+        self._watch_map: Dict[bytes, list] = {}
         self._actors = flow.ActorCollection()
 
     def start(self) -> None:
         for coro, prio, name in (
                 (self._pull_loop(), TaskPriority.UPDATE_STORAGE, "pull"),
                 (self._get_loop(), TaskPriority.STORAGE, "get"),
-                (self._range_loop(), TaskPriority.STORAGE, "getrange")):
+                (self._range_loop(), TaskPriority.STORAGE, "getrange"),
+                (self._get_key_loop(), TaskPriority.STORAGE, "getkey"),
+                (self._watch_loop(), TaskPriority.STORAGE, "watch")):
             self._actors.add(flow.spawn(coro, prio,
                                         name=f"{self.process.name}.{name}"))
         self.process.on_kill(self._actors.cancel_all)
@@ -103,8 +164,37 @@ class StorageServer:
                 for m in mutations:
                     self.data.apply(version, m)
                 self.version.set(version)
+                self._check_watches(version, mutations)
             if reply.committed_version > self.version.get():
                 self.version.set(reply.committed_version)
+
+    # -- watches --------------------------------------------------------
+    def _check_watches(self, version: int, mutations) -> None:
+        """Fire watches whose key's value changed (ref: storageserver
+        watch triggering on mutation apply)."""
+        if not self._watch_map:
+            return
+        touched = set()
+        for m in mutations:
+            if m.type == CLEAR_RANGE:
+                touched.update(k for k in self._watch_map
+                               if m.param1 <= k < m.param2)
+            else:
+                if m.param1 in self._watch_map:
+                    touched.add(m.param1)
+        for k in touched:
+            waiters = self._watch_map.get(k, [])
+            still = []
+            now_val = self.data.get(k, version)
+            for expected, reply in waiters:
+                if now_val != expected:
+                    reply.send(version)
+                else:
+                    still.append((expected, reply))
+            if still:
+                self._watch_map[k] = still
+            else:
+                self._watch_map.pop(k, None)
 
     async def _wait_version(self, version: int):
         """(ref: waitForVersion — future_version when too far ahead)"""
@@ -133,6 +223,35 @@ class StorageServer:
         try:
             await self._wait_version(req.version)
             reply.send(self.data.get_range(req.begin, req.end, req.version,
-                                           req.limit))
+                                           req.limit, req.reverse))
+        except flow.FdbError as e:
+            reply.send_error(e)
+
+    async def _get_key_loop(self):
+        while True:
+            req, reply = await self.get_keys.pop()
+            flow.spawn(self._serve_get_key(req, reply), TaskPriority.STORAGE)
+
+    async def _serve_get_key(self, req: StorageGetKeyRequest, reply):
+        try:
+            await self._wait_version(req.version)
+            reply.send(self.data.resolve_selector(req.selector, req.version))
+        except flow.FdbError as e:
+            reply.send_error(e)
+
+    async def _watch_loop(self):
+        while True:
+            req, reply = await self.watches.pop()
+            flow.spawn(self._serve_watch(req, reply), TaskPriority.STORAGE)
+
+    async def _serve_watch(self, req: StorageWatchRequest, reply):
+        try:
+            await self._wait_version(req.version)
+            expected = self.data.get(req.key, req.version)
+            current = self.data.get(req.key, self.version.get())
+            if current != expected:
+                reply.send(self.version.get())
+                return
+            self._watch_map.setdefault(req.key, []).append((expected, reply))
         except flow.FdbError as e:
             reply.send_error(e)

@@ -12,8 +12,48 @@ from typing import NamedTuple, Optional, Sequence, Tuple
 
 SET_VALUE = 0
 CLEAR_RANGE = 1
+ADD_VALUE = 2
+AND = 6                 # applied with V2 (absent -> operand) semantics
+OR = 7
+XOR = 8
+APPEND_IF_FITS = 9
+MAX = 12
+MIN = 13                # applied with V2 semantics
+SET_VERSIONSTAMPED_KEY = 14
+SET_VERSIONSTAMPED_VALUE = 15
+BYTE_MIN = 16
+BYTE_MAX = 17
+COMPARE_AND_CLEAR = 20
+
+ATOMIC_OPS = frozenset({ADD_VALUE, AND, OR, XOR, APPEND_IF_FITS, MAX, MIN,
+                        BYTE_MIN, BYTE_MAX, COMPARE_AND_CLEAR})
 
 Range = Tuple[bytes, bytes]
+
+
+class KeySelector(NamedTuple):
+    """(ref: fdbclient/FDBTypes.h KeySelectorRef — resolves to the key
+    `offset` keys past the first key `>=`/`>` the reference key)."""
+
+    key: bytes
+    or_equal: bool
+    offset: int
+
+    @classmethod
+    def last_less_than(cls, key: bytes) -> "KeySelector":
+        return cls(key, False, 0)
+
+    @classmethod
+    def last_less_or_equal(cls, key: bytes) -> "KeySelector":
+        return cls(key, True, 0)
+
+    @classmethod
+    def first_greater_than(cls, key: bytes) -> "KeySelector":
+        return cls(key, True, 1)
+
+    @classmethod
+    def first_greater_or_equal(cls, key: bytes) -> "KeySelector":
+        return cls(key, False, 1)
 
 
 class MutationRef(NamedTuple):
@@ -32,7 +72,9 @@ class CommitRequest(NamedTuple):
 
 
 class CommitReply(NamedTuple):
-    version: int  # the commit version
+    version: int       # the commit version
+    batch_index: int   # transaction's index within the commit batch
+                       # (second half of the versionstamp)
 
 
 class GetReadVersionReply(NamedTuple):
@@ -58,6 +100,20 @@ class StorageGetRangeRequest(NamedTuple):
     end: bytes
     version: int
     limit: int
+    reverse: bool = False
+
+
+class StorageGetKeyRequest(NamedTuple):
+    selector: "KeySelector"
+    version: int
+
+
+class StorageWatchRequest(NamedTuple):
+    """Fire when the key's value differs from its value at `version`
+    (ref: storageserver watches / fdbclient watch semantics)."""
+
+    key: bytes
+    version: int
 
 
 class TLogCommitRequest(NamedTuple):
