@@ -1,11 +1,17 @@
 """North-star benchmark: conflict-resolution throughput on the TPU backend.
 
-Workload (per BASELINE.json configs): a RandomReadWrite-style stream of
-commit batches — each transaction does 3 point reads + 1 point write,
-uniform over a 1M-key space, snapshots one batch behind (realistic GRV
-lag), the MVCC window advancing per MAX_WRITE_TRANSACTION_LIFE_VERSIONS
-(ref workload: fdbserver/workloads/ReadWrite.actor.cpp; ref microbench:
-fdbserver/SkipList.cpp:1412-1551 `fdbserver -r skiplisttest`).
+Workload: the shape of the reference's in-tree conflict-set microbench
+(`fdbserver -r skiplisttest`, fdbserver/SkipList.cpp:1412-1551 — 1 read
+conflict range + 1 write conflict range per transaction, uniform random
+keys), streamed as commit batches with snapshots one VERSION_STEP
+behind (GRV lag) and the MVCC window advancing per
+MAX_WRITE_TRANSACTION_LIFE_VERSIONS. The default backend is the
+point-op resolve kernel (ops/point_kernel.py) — the ranges here are
+single keys, exactly FDB's commit hot path — whose verdicts are
+parity-locked to the CPU baselines by tests/test_point_resolver.py.
+Steady-state history spans WINDOW_BATCHES batches (~330k live point
+writes at the default shape; the reference microbench holds ~125k live
+ranges: 50-batch window x 2500 txns).
 
 Prints exactly one JSON line:
   metric       resolver_throughput
@@ -15,8 +21,9 @@ Prints exactly one JSON line:
                figures are per-cluster, see BASELINE.md)
 
 Env overrides: FDBTPU_BENCH_TXNS (batch size), FDBTPU_BENCH_BATCHES
-(timed batches), FDBTPU_BENCH_KEYS (keyspace), FDBTPU_BENCH_BACKEND
-(tpu|python|native — CPU baselines for comparison runs).
+(timed batches), FDBTPU_BENCH_KEYS (keyspace), FDBTPU_BENCH_READS
+(reads per txn), FDBTPU_BENCH_BACKEND (tpu-point|tpu|tpu-streamed|
+python|native — CPU baselines for comparison runs).
 """
 
 import json
@@ -30,8 +37,9 @@ TARGET_TXN_PER_S = 1_000_000.0  # north star (BASELINE.json)
 MWTLV = 5_000_000
 KEY_BYTES = 16
 N_WORDS = KEY_BYTES // 4
-READS_PER_TXN = 3
+READS_PER_TXN = int(os.environ.get("FDBTPU_BENCH_READS", 1))
 VERSION_STEP = 250_000
+WINDOW_BATCHES = MWTLV // VERSION_STEP
 
 
 def make_batch(rng, n_txns, keyspace, version):
@@ -52,6 +60,123 @@ def make_batch(rng, n_txns, keyspace, version):
     wt = np.arange(n_txns, dtype=np.int32)
     return (snapshots, has_reads, enc(rk, False), enc(rk, True), rt,
             enc(wk, False), enc(wk, True), wt)
+
+
+def _measure_device_run(run, probe_count, init_state, n_batches, cap, slack):
+    """Shared timing harness for the device-driven bench loops.
+
+    `run(*init_state, nb)` executes nb chained resolve steps in one
+    dispatch and returns a carry whose [3] is the conflict count;
+    `probe_count(*carry[:3], nb)` runs one more step on the final state
+    and returns the live-row count (the capacity audit, outside the
+    timed region). Remote-link latency fluctuates wildly, so the floor
+    of an empty sync round-trip is measured per repeat and subtracted —
+    but never more than 70% of a run — and the best repeat wins.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    first_elem = jax.jit(lambda a: a.reshape(-1)[0])  # jit once: sync()
+    # must measure the link round-trip, not retrace/recompile time
+
+    def sync(x):
+        return np.asarray(first_elem(x))
+
+    out = run(*init_state, jnp.int32(2))
+    sync(out[3])
+    elapsed = float("inf")
+    n_conflicts = 0
+    for _ in range(int(os.environ.get("FDBTPU_BENCH_REPEATS", 4))):
+        t0 = time.perf_counter()
+        sync(jnp.int32(0))
+        sync_floor = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = run(*init_state, jnp.int32(n_batches))
+        n_conflicts = int(sync(out[3]))
+        raw = time.perf_counter() - t0
+        elapsed = min(elapsed, max(raw - sync_floor, 0.3 * raw, 1e-3))
+    final_count = int(sync(probe_count(out[0], out[1], out[2],
+                                       jnp.int32(n_batches))))
+    if final_count > cap - slack:
+        raise RuntimeError(
+            f"bench state capacity overflow: count {final_count} vs cap "
+            f"{cap} — rows would silently drop; raise cap sizing")
+    return elapsed, n_conflicts
+
+
+def bench_tpu_point(n_txns, n_batches, keyspace):
+    """Device-driven point-mode bench: batches generated on-device, all
+    n_batches resolve steps chained in one fori_loop dispatch. 8-byte
+    point keys (value < keyspace in the low word), READS_PER_TXN point
+    reads + 1 point write per txn."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from foundationdb_tpu.ops.keys import next_pow2
+    from foundationdb_tpu.ops.point_kernel import make_point_resolve_core
+
+    n_txns = next_pow2(n_txns)
+    if (n_batches + 4) * VERSION_STEP >= (1 << 30):
+        raise ValueError("FDBTPU_BENCH_BATCHES too large for int32 offsets")
+    n_words = 2  # 8-byte point keys
+    nr = next_pow2(n_txns * READS_PER_TXN)
+    nw = n_txns
+    # steady state: one write row per txn per batch, live for
+    # WINDOW_BATCHES batches (+1 pending prune, + merge slack)
+    cap = next_pow2((WINDOW_BATCHES + 2) * n_txns + 2)
+    core = make_point_resolve_core(cap, n_txns, nr, nw, n_words)
+
+    def gen_keys(key, slots):
+        idx = jax.random.randint(key, (slots,), 0, keyspace, dtype=jnp.int32)
+        k = jnp.zeros((slots, n_words + 1), jnp.uint32)
+        k = k.at[:, 1].set(idx.astype(jnp.uint32))
+        return k.at[:, n_words].set(8)
+
+    rt = jnp.asarray(np.minimum(
+        np.arange(nr) // READS_PER_TXN, n_txns).astype(np.int32))
+    wt = jnp.arange(nw, dtype=jnp.int32)
+    rvalid = jnp.asarray(np.arange(nr) < n_txns * READS_PER_TXN)
+    wvalid = jnp.ones(nw, bool)
+    too_old = jnp.zeros(n_txns, bool)
+
+    def body(i, carry):
+        sk, sv, key, nconf = carry
+        key, kr, kw = jax.random.split(key, 3)
+        rk = gen_keys(kr, nr)
+        wk = gen_keys(kw, nw)
+        commit = (jnp.int32(i) + 2) * VERSION_STEP
+        snap = jnp.full((n_txns,), 1, jnp.int32) * (commit - VERSION_STEP)
+        oldest = jnp.maximum(commit - MWTLV, 0)
+        sk, sv, _count, conflict = core(
+            sk, sv, snap, too_old, rk, rt, rvalid, wk, wt, wvalid,
+            commit, oldest, jnp.int32(0))
+        return sk, sv, key, nconf + jnp.sum(conflict.astype(jnp.int32))
+
+    @jax.jit
+    def run(sk, sv, key, nb):
+        return lax.fori_loop(0, nb, body, (sk, sv, key, jnp.int32(0)))
+
+    @jax.jit
+    def probe_count(sk, sv, key, nb):
+        out = body(nb, (sk, sv, key, jnp.int32(0)))
+        key2, kr, kw = jax.random.split(out[2], 3)
+        rk = gen_keys(kr, nr)
+        wk = gen_keys(kw, nw)
+        commit = (nb + 3) * VERSION_STEP
+        snap = jnp.full((n_txns,), 1, jnp.int32) * (commit - VERSION_STEP)
+        _, _, count, _ = core(
+            out[0], out[1], snap, too_old, rk, rt, rvalid, wk, wt, wvalid,
+            commit, jnp.maximum(commit - MWTLV, 0), jnp.int32(0))
+        return count
+
+    sk0 = np.full((cap, n_words + 1), 0xFFFFFFFF, np.uint32)
+    sv0 = np.full((cap,), -(1 << 30), np.int32)
+    elapsed, n_conflicts = _measure_device_run(
+        run, probe_count,
+        (jnp.asarray(sk0), jnp.asarray(sv0), jax.random.PRNGKey(7)),
+        n_batches, cap, slack=2)
+    return n_batches * n_txns / elapsed, n_conflicts
 
 
 def bench_tpu(n_txns, n_batches, keyspace):
@@ -125,41 +250,10 @@ def bench_tpu(n_txns, n_batches, keyspace):
     hk0[0] = 0
     hv0 = np.full((cap,), -(1 << 30), np.int32)
     hv0[0] = 0
-
-    first_elem = jax.jit(lambda a: a.reshape(-1)[0])  # jit once: sync()
-    # must measure the link round-trip, not retrace/recompile time
-
-    def sync(x):
-        return np.asarray(first_elem(x))
-
-    # warmup/compile, then measure the tunnel sync floor, then the run;
-    # remote-link latency fluctuates wildly, so take the best of several
-    # repeats (each long enough to dominate the sync round-trip)
-    out = run(jnp.asarray(hk0), jnp.asarray(hv0), jax.random.PRNGKey(7),
-              jnp.int32(2))
-    sync(out[3])
-    elapsed = float("inf")
-    for _ in range(int(os.environ.get("FDBTPU_BENCH_REPEATS", 4))):
-        t0 = time.perf_counter()
-        sync(jnp.int32(0))
-        sync_floor = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        out = run(jnp.asarray(hk0), jnp.asarray(hv0), jax.random.PRNGKey(7),
-                  jnp.int32(n_batches))
-        n_conflicts = int(sync(out[3]))
-        raw = time.perf_counter() - t0
-        # the link round-trip is large and jittery: subtract the measured
-        # floor, but never attribute more than 70% of a run to it
-        elapsed = min(elapsed, max(raw - sync_floor, 0.3 * raw, 1e-3))
-    # capacity audit outside the timed loop: one more step on the final
-    # state; its count reflects the steady-state boundary population
-    final_count = int(sync(probe_count(out[0], out[1], out[2],
-                                       jnp.int32(n_batches))))
-    if final_count > cap - (2 * n_txns + 2):
-        raise RuntimeError(
-            f"bench history capacity overflow: count {final_count} vs cap "
-            f"{cap} — results would silently drop boundaries; raise cap "
-            "sizing")
+    elapsed, n_conflicts = _measure_device_run(
+        run, probe_count,
+        (jnp.asarray(hk0), jnp.asarray(hv0), jax.random.PRNGKey(7)),
+        n_batches, cap, slack=2 * n_txns + 2)
     return n_batches * n_txns / elapsed, n_conflicts
 
 
@@ -225,12 +319,14 @@ def bench_cpu(backend, n_txns, n_batches, keyspace):
 
 
 def main():
-    n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 1024))
+    n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 16384))
     n_batches = int(os.environ.get("FDBTPU_BENCH_BATCHES", 100))
-    keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 1_000_000))
-    backend = os.environ.get("FDBTPU_BENCH_BACKEND", "tpu")
+    keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 4_000_000))
+    backend = os.environ.get("FDBTPU_BENCH_BACKEND", "tpu-point")
 
-    if backend == "tpu":
+    if backend == "tpu-point":
+        txn_per_s, n_conflicts = bench_tpu_point(n_txns, n_batches, keyspace)
+    elif backend == "tpu":
         txn_per_s, n_conflicts = bench_tpu(n_txns, n_batches, keyspace)
     elif backend == "tpu-streamed":
         txn_per_s, n_conflicts = bench_tpu_streamed(n_txns, n_batches, keyspace)
@@ -245,7 +341,8 @@ def main():
         "config": {
             "backend": backend, "batch_txns": n_txns, "batches": n_batches,
             "reads_per_txn": READS_PER_TXN, "writes_per_txn": 1,
-            "keyspace": keyspace, "conflicts": n_conflicts,
+            "keyspace": keyspace, "window_batches": WINDOW_BATCHES,
+            "conflicts": n_conflicts,
         },
     }))
 

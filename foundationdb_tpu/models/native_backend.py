@@ -163,4 +163,10 @@ def create_conflict_set(backend: str = "python", init_version: int = 0) -> Confl
         except ImportError as e:
             raise ValueError(f"tpu conflict-set backend unavailable: {e}") from e
         return TpuConflictSet(init_version)
+    if backend == "tpu-point":
+        try:
+            from .point_resolver import PointConflictSet
+        except ImportError as e:
+            raise ValueError(f"tpu conflict-set backend unavailable: {e}") from e
+        return PointConflictSet(init_version)
     raise ValueError(f"unknown conflict-set backend: {backend}")

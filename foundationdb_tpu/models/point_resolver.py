@@ -1,0 +1,133 @@
+"""Point-op TPU conflict-set backend (host wrapper).
+
+Same `ConflictSetBase` contract and version-offset machinery as the
+interval backend (tpu_resolver.TpuConflictSet), specialized to batches
+whose conflict ranges are all single keys ([k, k+'\\x00')). The hot
+commit path of an FDB-style workload is exactly this shape (ref:
+NativeAPI point reads/sets produce single-key conflict ranges,
+fdbclient/ReadYourWrites.actor.cpp), and the point restriction admits a
+far cheaper device step (ops/point_kernel.py).
+
+Raises ValueError for non-point ranges — callers that may see general
+ranges use TpuConflictSet; `create_conflict_set("tpu-point")` is an
+explicit opt-in. Parity: tests/test_point_resolver.py replays random
+point workloads bit-exactly against BruteForce/PyConflictSet.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from .conflict_set import ResolverTransaction
+from .tpu_resolver import (_KERNEL_MIN_RANGES, _KERNEL_MIN_TXNS, _MIN_CAP,
+                           TpuConflictSet)
+
+_POINT_KEY_BYTES = 8  # max key length the point bucket stores
+
+
+class PointConflictSet(TpuConflictSet):
+    """Latest-version-per-key map on device; single-sort merge step."""
+
+    def __init__(self, init_version: int = 0, key_bytes: int = _POINT_KEY_BYTES,
+                 capacity: int = _MIN_CAP):
+        self._init_version = init_version  # read by _initial_state hooks
+        super().__init__(init_version=init_version, key_bytes=key_bytes,
+                         capacity=capacity)
+        self._count_hint = 0
+
+    def _initial_state(self, init_version: int):
+        """No whole-keyspace sentinel row: state starts empty (all +inf);
+        the init_version baseline is enforced via init_off in the kernel."""
+        hk = np.full((self._cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
+        hv = np.full((self._cap,), -(1 << 30), np.int32)
+        return hk, hv
+
+    def _marshal_ranges(self, txns: Sequence[ResolverTransaction], too_old):
+        """Point marshalling: end keys are never encoded (they are
+        begin+'\\x00', one byte past the bucket width); each range is
+        validated to be a point instead."""
+        read_k: list[bytes] = []
+        read_t: list[int] = []
+        write_k: list[bytes] = []
+        write_t: list[int] = []
+        for t, tr in enumerate(txns):
+            if too_old[t]:
+                continue
+            for b, e in tr.read_ranges:
+                if b >= e:
+                    continue
+                self._check_point(b, e)
+                read_k.append(b)
+                read_t.append(t)
+            for b, e in tr.write_ranges:
+                if b >= e:
+                    continue
+                self._check_point(b, e)
+                write_k.append(b)
+                write_t.append(t)
+
+        from ..ops.keys import encode_keys
+        keys = encode_keys(read_k + write_k, self._key_bytes)
+        nr = len(read_t)
+        return (keys[:nr], None, np.asarray(read_t, np.int32),
+                keys[nr:], None, np.asarray(write_t, np.int32))
+
+    @staticmethod
+    def _check_point(b: bytes, e: bytes) -> None:
+        if e != b + b"\x00":
+            raise ValueError(
+                "PointConflictSet handles single-key ranges only "
+                f"(got [{b!r}, {e!r})); use the interval backend")
+        if len(b) > _POINT_KEY_BYTES:
+            raise ValueError(
+                f"point key length {len(b)} exceeds bucket width "
+                f"{_POINT_KEY_BYTES}")
+
+    def resolve_arrays(self, *a, **k):
+        raise NotImplementedError(
+            "point backend takes object batches (resolve) or direct kernel "
+            "drives (bench); the pre-encoded interval array path encodes "
+            "end keys the point bucket cannot hold")
+
+    def _dispatch(self, n, snapshots, too_old, rb, re, rt, wb, we, wt,
+                  offsets):
+        commit_off, oldest_off, fixup = offsets
+        import jax.numpy as jnp
+
+        from ..ops.conflict_kernel import SNAP_CLAMP
+        from ..ops.keys import next_pow2
+
+        nr, nw = rb.shape[0], wb.shape[0]
+        npad = next_pow2(max(n, _KERNEL_MIN_TXNS))
+        nrp = next_pow2(max(nr + 1, _KERNEL_MIN_RANGES))
+        nwp = next_pow2(max(nw + 1, _KERNEL_MIN_RANGES))
+        self._audit_capacity(nw)  # one state row per point write
+
+        snap_off = np.clip(snapshots - self._base, 0, SNAP_CLAMP).astype(np.int32)
+        snap_p = np.zeros(npad, np.int32)
+        snap_p[:n] = snap_off
+        tooold_p = np.zeros(npad, bool)
+        tooold_p[:n] = too_old
+        rvalid = np.zeros(nrp, bool)
+        rvalid[:nr] = True
+        wvalid = np.zeros(nwp, bool)
+        wvalid[:nw] = True
+        init_off = int(np.clip(self._init_version - self._base, 0,
+                               SNAP_CLAMP + 1))
+
+        from ..ops.point_kernel import make_point_resolve_fn
+        fn = make_point_resolve_fn(self._cap, npad, nrp, nwp, self._n_words)
+        self._hk, self._hv, count, conflict = fn(
+            self._hk, self._hv,
+            jnp.asarray(snap_p), jnp.asarray(tooold_p),
+            jnp.asarray(self._pad_keys(rb, nrp)),
+            jnp.asarray(self._pad_idx(rt, nrp, npad)), jnp.asarray(rvalid),
+            jnp.asarray(self._pad_keys(wb, nwp)),
+            jnp.asarray(self._pad_idx(wt, nwp, npad)), jnp.asarray(wvalid),
+            jnp.int32(commit_off), jnp.int32(oldest_off),
+            jnp.int32(init_off))
+        self._apply_fixup(fixup)
+        self._count_dev = count
+        return conflict

@@ -53,11 +53,16 @@ class TpuConflictSet(ConflictSetBase):
         self._last_commit = init_version
         self._count_hint = 1
         self._count_dev = None
+        self._hk, self._hv = self._to_device(*self._initial_state(init_version))
+
+    def _initial_state(self, init_version: int):
+        """Host arrays for the fresh history: one sentinel row baselining
+        the whole keyspace at init_version (subclasses may differ)."""
         hk = np.full((self._cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
         hk[0] = 0
         hv = np.full((self._cap,), -(1 << 30), np.int32)
         hv[0] = init_version
-        self._hk, self._hv = self._to_device(hk, hv)
+        return hk, hv
 
     # -- device state helpers -------------------------------------------
     @staticmethod
@@ -170,6 +175,8 @@ class TpuConflictSet(ConflictSetBase):
 
         Kept separate from `resolve` so callers that can overlap host and
         device work (the proxy pipeline / bench) can defer the readback.
+        The per-range encoding is delegated to `_marshal_ranges` so the
+        point backend can share everything else.
         """
         if commit_version < self._last_commit:
             raise ValueError("commit versions must be non-decreasing "
@@ -188,6 +195,24 @@ class TpuConflictSet(ConflictSetBase):
 
         too_old = np.zeros(n, bool)
         snapshots = np.zeros(n, np.int64)
+        for t, tr in enumerate(txns):
+            snapshots[t] = tr.read_snapshot
+            if tr.read_snapshot < self._oldest and len(tr.read_ranges):
+                too_old[t] = True
+
+        conflict = self._dispatch(
+            n, snapshots, too_old, *self._marshal_ranges(txns, too_old),
+            offsets)
+        self._last_commit = commit_version  # only after a successful batch
+        self._oldest = max(self._oldest, new_oldest_version)
+        return conflict, too_old, n
+
+    def _marshal_ranges(self, txns, too_old):
+        """Flatten and encode the batch's conflict ranges in txn order.
+
+        Returns the 6-tuple (rb, re, rt, wb, we, wt) handed to
+        `_dispatch`. tooOld txns contribute no ranges at all (ref:
+        SkipList.cpp:979 addTransaction)."""
         read_b: list[bytes] = []
         read_e: list[bytes] = []
         read_t: list[int] = []
@@ -195,9 +220,7 @@ class TpuConflictSet(ConflictSetBase):
         write_e: list[bytes] = []
         write_t: list[int] = []
         for t, tr in enumerate(txns):
-            snapshots[t] = tr.read_snapshot
-            if tr.read_snapshot < self._oldest and len(tr.read_ranges):
-                too_old[t] = True
+            if too_old[t]:
                 continue
             for b, e in tr.read_ranges:
                 if b < e:
@@ -214,14 +237,9 @@ class TpuConflictSet(ConflictSetBase):
         nr, nw = len(read_t), len(write_t)
         keys = encode_keys(read_b + read_e + write_b + write_e,
                            self._key_bytes)
-        conflict = self._dispatch(
-            n, snapshots, too_old,
-            keys[:nr], keys[nr:2 * nr], np.asarray(read_t, np.int32),
-            keys[2 * nr:2 * nr + nw], keys[2 * nr + nw:],
-            np.asarray(write_t, np.int32), offsets)
-        self._last_commit = commit_version  # only after a successful batch
-        self._oldest = max(self._oldest, new_oldest_version)
-        return conflict, too_old, n
+        return (keys[:nr], keys[nr:2 * nr], np.asarray(read_t, np.int32),
+                keys[2 * nr:2 * nr + nw], keys[2 * nr + nw:],
+                np.asarray(write_t, np.int32))
 
     def resolve_arrays(self, snapshots: np.ndarray, has_reads: np.ndarray,
                        rb: np.ndarray, re: np.ndarray, rt: np.ndarray,
@@ -256,6 +274,29 @@ class TpuConflictSet(ConflictSetBase):
         return [TOO_OLD if too_old[t] else
                 (CONFLICT if conflict[t] else COMMITTED) for t in range(n)]
 
+    # -- shared marshalling helpers (used by the point subclass too) ----
+    def _pad_keys(self, a: np.ndarray, size: int) -> np.ndarray:
+        out = np.zeros((size, self._n_words + 1), np.uint32)
+        out[:a.shape[0]] = a
+        return out
+
+    @staticmethod
+    def _pad_idx(a: np.ndarray, size: int, fill: int) -> np.ndarray:
+        out = np.full((size,), fill, np.int32)
+        out[:a.shape[0]] = a
+        return out
+
+    def _audit_capacity(self, new_rows: int) -> None:
+        """Grow the device state if this batch could overflow it.
+
+        `new_rows` = state rows this batch can add (2 boundaries per
+        write for the interval backend, 1 per write for points)."""
+        if self._count_hint + new_rows + 2 > self._cap:
+            self._sync_count()
+        if self._count_hint + new_rows + 2 > self._cap:
+            self._grow(self._count_hint + new_rows)
+        self._count_hint = min(self._cap - 1, self._count_hint + new_rows)
+
     def _call_kernel(self, npad, nrp, nwp, args):
         """Run one padded batch through the single-shard jitted kernel.
 
@@ -278,22 +319,7 @@ class TpuConflictSet(ConflictSetBase):
         npad = next_pow2(max(n, _KERNEL_MIN_TXNS))
         nrp = next_pow2(max(nr + 1, _KERNEL_MIN_RANGES))
         nwp = next_pow2(max(nw + 1, _KERNEL_MIN_RANGES))
-
-        if self._count_hint + 2 * nw + 2 > self._cap:
-            self._sync_count()
-        if self._count_hint + 2 * nw + 2 > self._cap:
-            self._grow(self._count_hint + 2 * nw)
-        self._count_hint = min(self._cap - 1, self._count_hint + 2 * nw)
-
-        def pad_keys(a, size):
-            out = np.zeros((size, self._n_words + 1), np.uint32)
-            out[:a.shape[0]] = a
-            return out
-
-        def pad_idx(a, size, fill):
-            out = np.full((size,), fill, np.int32)
-            out[:a.shape[0]] = a
-            return out
+        self._audit_capacity(2 * nw)
 
         snap_off = np.clip(snapshots - self._base, 0, SNAP_CLAMP).astype(np.int32)
         snap_p = np.zeros(npad, np.int32)
@@ -307,10 +333,12 @@ class TpuConflictSet(ConflictSetBase):
 
         count, conflict = self._call_kernel(npad, nrp, nwp, (
             jnp.asarray(snap_p), jnp.asarray(tooold_p),
-            jnp.asarray(pad_keys(rb, nrp)), jnp.asarray(pad_keys(re, nrp)),
-            jnp.asarray(pad_idx(rt, nrp, npad)), jnp.asarray(rvalid),
-            jnp.asarray(pad_keys(wb, nwp)), jnp.asarray(pad_keys(we, nwp)),
-            jnp.asarray(pad_idx(wt, nwp, npad)), jnp.asarray(wvalid),
+            jnp.asarray(self._pad_keys(rb, nrp)),
+            jnp.asarray(self._pad_keys(re, nrp)),
+            jnp.asarray(self._pad_idx(rt, nrp, npad)), jnp.asarray(rvalid),
+            jnp.asarray(self._pad_keys(wb, nwp)),
+            jnp.asarray(self._pad_keys(we, nwp)),
+            jnp.asarray(self._pad_idx(wt, nwp, npad)), jnp.asarray(wvalid),
             jnp.int32(commit_off), jnp.int32(oldest_off)))
         self._apply_fixup(fixup)
         self._count_dev = count

@@ -1,0 +1,206 @@
+"""Point-op MVCC conflict resolution: the TPU fast path.
+
+FDB's commit hot path is dominated by point reads/writes — conflict
+ranges of the form [k, k+'\\x00') (single keys). The reference resolves
+them through the same SkipList interval machinery as general ranges
+(fdbserver/SkipList.cpp:979 addTransaction explodes them into point
+boundaries); on TPU the interval kernel's strength (range algebra) is
+wasted on points while its costs (big merges, range coverage) remain.
+
+This module is a second, shape-compatible resolve core specialized to
+batches whose conflict ranges are all points. Semantics are identical
+to the general kernel (and to the reference ConflictBatch) restricted
+to point ranges — the host wrapper (models/point_resolver.py) proves it
+by replaying randomized point workloads bit-exactly against the CPU
+baselines, exactly like the interval backend.
+
+Design, driven by measured TPU cost model (see the scatter-free notes
+in conflict_kernel.py; on this part scatters and large scalar gathers
+run ~100-300M elem/s while multi-column `lax.sort` sustains orders of
+magnitude more):
+
+  state      sorted rows (key words, len, version) — the "latest write
+             version per key" map, the point restriction of the
+             reference's skiplist step function. Duplicate keys are
+             allowed (newest last, the only row ext ever reads);
+             rows older than oldestVersion are pruned lazily at the
+             next merge sort (ref removeBefore, SkipList.cpp:665).
+
+  ext check  one vectorized binary search of the read keys (query
+             count = reads, small) + exact-match compare + version
+             vs snapshot (ref CheckMax, SkipList.cpp:755-837).
+
+  intra      batch endpoints sorted by (key, txn, read<write); within
+             each equal-key run a segmented prefix-OR of "alive write
+             before me" answers every read at once; the same
+             antitone-fixpoint iteration as the general kernel
+             resolves write-dependency chains (ref MiniConflictSet,
+             SkipList.cpp:1028-1161). Per-round routing between
+             key-sorted and flat order is a 2-column sort (cheap)
+             instead of a scatter.
+
+  merge+GC   ONE 4-key-column sort of [masked state; surviving writes]
+             — pre-sort masking (+inf keys) handles both GC pruning
+             and conflicted-write exclusion, the version column as the
+             last sort key makes the newest duplicate sort last, and
+             the slice back to `cap` drops only +inf tails. No
+             scatters, no compaction pass.
+
+All versions are int32 offsets from the host-tracked base, identical
+to the interval kernel's contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .conflict_kernel import SNAP_CLAMP
+from .keys import searchsorted_i32, searchsorted_rows
+
+VMASK = SNAP_CLAMP + 1  # version column for masked rows (sorts, never read)
+INF = 0xFFFFFFFF
+
+
+def _seg_or_scan(vals, seg_start):
+    """Inclusive segmented prefix-OR: resets at seg_start rows."""
+    def op(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av | bv), af | bf
+    out, _ = lax.associative_scan(op, (vals, seg_start))
+    return out
+
+
+def make_point_resolve_core(cap: int, n_txns: int, n_reads: int,
+                            n_writes: int, n_words: int):
+    """Build the point-mode resolve step for one static shape bucket.
+
+    Shapes: `cap` state rows, `n_txns` txn slots, `n_reads`/`n_writes`
+    flat point slots (powers of two). Keys are [*, n_words+1] uint32
+    rows (ops.keys.encode_keys layout: big-endian words + length word).
+    Returns
+      fn(sk, sv, snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid,
+         commit, oldest, init_off) -> (sk', sv', count, conflict[n_txns])
+    `rtxn`/`wtxn` must be non-decreasing with pad slots = n_txns.
+    `count` is the total real-row count BEFORE the slice to cap — the
+    host overflow audit compares it against cap. `init_off` is the
+    whole-keyspace baseline version (offset): any txn with a valid
+    read and snapshot below it conflicts (the point map cannot store
+    the "everything written at init_version" interval the general
+    backends keep as history row 0).
+    """
+    assert all(x & (x - 1) == 0 for x in (cap, n_txns, n_reads, n_writes))
+    width = n_words + 1
+    nb = n_reads + n_writes
+
+    def step(sk, sv, snap, too_old, rk, rtxn, rvalid,
+             wk, wtxn, wvalid, commit, oldest, init_off):
+        n = n_txns
+        inf_row = jnp.full((width,), INF, jnp.uint32)
+        r_starts = searchsorted_i32(rtxn, jnp.arange(n + 2, dtype=jnp.int32))
+        snap_pad = jnp.concatenate(
+            [snap, jnp.full((1,), SNAP_CLAMP, jnp.int32)])
+
+        # ---- 1. external check: point lookup in the state map -----------
+        pos = jnp.maximum(searchsorted_rows(sk, rk, side="right") - 1, 0)
+        hit_k = jnp.take(sk, pos, axis=0)
+        hit_v = jnp.take(sv, pos)
+        match = jnp.all(hit_k == rk, axis=1)
+        ext_r = rvalid & match & (hit_v > jnp.take(snap_pad, rtxn))
+
+        def seg_count(flags):
+            cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(flags.astype(jnp.int32))])
+            at = jnp.take(cum, r_starts)
+            return at[1:] - at[:-1]
+
+        has_read = seg_count(rvalid)[:n] > 0
+        ext = (seg_count(ext_r)[:n] > 0) | (has_read & (snap < init_off))
+
+        # ---- 2. intra-batch fixpoint over (key, txn)-sorted rows --------
+        bk = jnp.concatenate([rk, wk], axis=0)
+        bvalid = jnp.concatenate([rvalid, wvalid])
+        btxn = jnp.concatenate([rtxn, wtxn])
+        is_w_slot = (jnp.arange(nb, dtype=jnp.int32) >=
+                     n_reads).astype(jnp.int32)
+        tie = jnp.where(bvalid, (btxn << 1) | is_w_slot,
+                        jnp.int32(0x7FFFFFFF))
+        bk = jnp.where(bvalid[:, None], bk, inf_row[None, :])
+        meta = jnp.arange(nb, dtype=jnp.int32)
+        ops = lax.sort(tuple(bk[:, w] for w in range(width)) + (tie, meta),
+                       num_keys=width + 1)
+        sk_cols = ops[:width]
+        tie_s, meta_s = ops[width], ops[width + 1]
+        valid_s = tie_s != jnp.int32(0x7FFFFFFF)
+        txn_s = jnp.where(valid_s, tie_s >> 1, jnp.int32(n))
+        isw_s = valid_s & ((tie_s & 1) == 1)
+        isr_s = valid_s & ((tie_s & 1) == 0)
+        prev_ne = jnp.zeros((nb,), bool)
+        for w in range(width):
+            col = sk_cols[w]
+            prev_ne = prev_ne | jnp.concatenate(
+                [jnp.ones((1,), bool), col[1:] != col[:-1]])
+        seg_start = prev_ne
+
+        base_c = jnp.concatenate([ext | too_old, jnp.ones((1,), bool)])
+        nhot = jnp.arange(n + 1) == n
+
+        def s_map(c):
+            alive = isw_s & ~jnp.take(c, txn_s)
+            # alive-write-strictly-before-me within my key run
+            shifted = jnp.concatenate([jnp.zeros((1,), bool), alive[:-1]])
+            shifted = shifted & ~seg_start
+            pref = _seg_or_scan(shifted, seg_start)
+            hit_row = isr_s & pref
+            # route back to flat order via a 2-column sort (meta is a
+            # permutation of arange, so the sorted payload IS flat order)
+            _, hit_flat = lax.sort((meta_s, hit_row.astype(jnp.int32)),
+                                   num_keys=1)
+            hit = seg_count(hit_flat[:n_reads] > 0) > 0
+            return base_c | hit | nhot
+
+        def cond(carry):
+            prev, cur, i = carry
+            return jnp.any(prev != cur) & (i < n + 2)
+
+        def body(carry):
+            _, cur, i = carry
+            return cur, s_map(cur), i + 1
+
+        first = s_map(base_c)
+        _, conflict_pad, _ = lax.while_loop(
+            cond, body, (base_c, first, jnp.int32(1)))
+        conflict = conflict_pad[:n]
+
+        # ---- 3. merge + GC: one sort, pre-masked ------------------------
+        surv = wvalid & ~jnp.take(conflict_pad, wtxn)
+        live = sv >= jnp.maximum(oldest, jnp.int32(0))
+        live = live & (sk[:, -1] != jnp.uint32(INF))
+        mk = jnp.where(live[:, None], sk, inf_row[None, :])
+        mv = jnp.where(live, sv, jnp.int32(VMASK))
+        ik = jnp.where(surv[:, None], wk, inf_row[None, :])
+        iv = jnp.where(surv, commit, jnp.int32(VMASK))
+        allk = jnp.concatenate([mk, ik], axis=0)
+        allv = jnp.concatenate([mv, iv])
+        sorted_ops = lax.sort(
+            tuple(allk[:, w] for w in range(width)) + (allv,),
+            num_keys=width + 1)
+        out_k = jnp.stack(sorted_ops[:width], axis=1)[:cap]
+        out_v = sorted_ops[width][:cap]
+        count = (jnp.sum(live.astype(jnp.int32)) +
+                 jnp.sum(surv.astype(jnp.int32)))
+        return out_k, out_v, count, conflict
+
+    return step
+
+
+@functools.lru_cache(maxsize=None)
+def make_point_resolve_fn(cap: int, n_txns: int, n_reads: int,
+                          n_writes: int, n_words: int):
+    """Jitted point-mode resolve step (see make_point_resolve_core)."""
+    return jax.jit(
+        make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words))

@@ -1,0 +1,252 @@
+"""Point-mode TPU conflict-set backend: bit-exact parity vs the CPU
+baselines on randomized point workloads, plus point-specific edges
+(duplicate keys in a batch, same-txn read+write of one key, init_version
+baseline, GC pruning, growth, version rebasing).
+
+Acceptance mirrors the interval backend's (ref self-check pattern:
+fdbserver/SkipList.cpp:1412-1551 skipListTest vs SlowConflictSet).
+"""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.models import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    BruteForceConflictSet,
+    PyConflictSet,
+    ResolverTransaction,
+    create_conflict_set,
+)
+from foundationdb_tpu.models.point_resolver import PointConflictSet
+
+MWTLV = 5_000_000
+
+
+def txn(snapshot, reads=(), writes=()):
+    return ResolverTransaction(snapshot, tuple(reads), tuple(writes))
+
+
+def pt(k: bytes):
+    return (k, k + b"\x00")
+
+
+def random_point_batch(rng, n_txns, keyspace, version, spread,
+                       max_reads=3, max_writes=2):
+    batch = []
+    for _ in range(n_txns):
+        reads = [pt(b"%07d" % rng.randrange(keyspace))
+                 for _ in range(rng.randrange(max_reads + 1))]
+        writes = [pt(b"%07d" % rng.randrange(keyspace))
+                  for _ in range(rng.randrange(max_writes + 1))]
+        snap = version - rng.randrange(spread)
+        batch.append(txn(snap, reads, writes))
+    return batch
+
+
+def test_factory_builds_point_backend():
+    cs = create_conflict_set("tpu-point")
+    assert isinstance(cs, PointConflictSet)
+    assert cs.resolve([txn(0, writes=[pt(b"a")])], 100, 0) == [COMMITTED]
+
+
+def test_rejects_non_point_ranges():
+    cs = PointConflictSet()
+    with pytest.raises(ValueError):
+        cs.resolve([txn(0, reads=[(b"a", b"c")])], 10, 0)
+    with pytest.raises(ValueError):
+        cs.resolve([txn(0, writes=[(b"a" * 9, b"a" * 9 + b"\x00")])], 10, 0)
+
+
+def test_point_basics_and_intra_batch_order():
+    cs = PointConflictSet()
+    # write k at v=100
+    assert cs.resolve([txn(0, writes=[pt(b"k")])], 100, 0) == [COMMITTED]
+    # read k at old snapshot conflicts; at new snapshot commits
+    out = cs.resolve([txn(50, reads=[pt(b"k")]),
+                      txn(100, reads=[pt(b"k")])], 200, 0)
+    assert out == [CONFLICT, COMMITTED]
+    # intra-batch: earlier writer aborts later reader; own write is fine
+    out = cs.resolve([txn(200, reads=[pt(b"x")], writes=[pt(b"x")]),
+                      txn(200, reads=[pt(b"x")]),
+                      txn(200, reads=[pt(b"y")], writes=[pt(b"y")])], 300, 0)
+    assert out == [COMMITTED, CONFLICT, COMMITTED]
+    # chain: t0 writes a; t1 reads a (conflict) so t1's write of b is dead;
+    # t2 reads b and must NOT conflict with the dead write
+    out = cs.resolve([txn(300, writes=[pt(b"a")]),
+                      txn(300, reads=[pt(b"a")], writes=[pt(b"b")]),
+                      txn(300, reads=[pt(b"b")])], 400, 0)
+    assert out == [COMMITTED, CONFLICT, COMMITTED]
+
+
+def test_too_old_and_init_version():
+    cs = PointConflictSet(init_version=500)
+    brute = BruteForceConflictSet(init_version=500)
+    batch = [txn(400, reads=[pt(b"q")]),  # below init baseline -> conflict
+             txn(600, reads=[pt(b"q")]),  # above -> committed
+             txn(400, writes=[pt(b"w")])]  # write-only: baseline irrelevant
+    for impl in (cs, brute):
+        assert impl.resolve(batch, 1000, 0) == [CONFLICT, COMMITTED, COMMITTED]
+    # advance the window first; then a pre-window snapshot with reads
+    # is TOO_OLD (the new_oldest of a batch applies to LATER batches)
+    batch2 = [txn(100, reads=[pt(b"q")]), txn(100, writes=[pt(b"r")])]
+    for impl in (cs, brute):
+        impl.resolve([], 1500, 900)
+        assert impl.resolve(batch2, 2000, 950) == [TOO_OLD, COMMITTED]
+
+
+@pytest.mark.parametrize("baseline", ["brute", "python"])
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_randomized_point_parity(baseline, seed):
+    rng = random.Random(seed)
+    cs = PointConflictSet()
+    ref = (BruteForceConflictSet() if baseline == "brute"
+           else PyConflictSet())
+    version = 0
+    for batch_i in range(25):
+        version += rng.randrange(1, 50_000)
+        oldest = max(0, version - rng.randrange(20_000, 120_000))
+        batch = random_point_batch(
+            rng, n_txns=rng.randrange(0, 24), keyspace=200,
+            version=version, spread=100_000)
+        got = cs.resolve(batch, version, oldest)
+        want = ref.resolve(batch, version, oldest)
+        assert got == want, f"batch {batch_i} diverged: {got} vs {want}"
+
+
+def test_duplicate_keys_same_batch_and_txn():
+    cs = PointConflictSet()
+    brute = BruteForceConflictSet()
+    # several txns write the same key; duplicates within one txn too
+    batch = [txn(0, writes=[pt(b"d"), pt(b"d")]),
+             txn(0, reads=[pt(b"d"), pt(b"d")]),
+             txn(0, writes=[pt(b"d")])]
+    for impl in (cs, brute):
+        assert impl.resolve(batch, 10, 0) == [COMMITTED, CONFLICT, COMMITTED]
+    # history now holds duplicate rows for d; newest must win
+    batch2 = [txn(5, reads=[pt(b"d")]), txn(10, reads=[pt(b"d")])]
+    for impl in (cs, brute):
+        assert impl.resolve(batch2, 20, 0) == [CONFLICT, COMMITTED]
+
+
+def test_gc_prunes_and_growth_preserves():
+    cs = PointConflictSet(capacity=1024)
+    v = 0
+    for i in range(40):
+        v += 10
+        writes = [pt(b"g%05d" % (i * 40 + j)) for j in range(40)]
+        assert cs.resolve([txn(v - 10, writes=writes)], v, 0) == [COMMITTED]
+    assert cs._cap > 1024
+    rng = random.Random(11)
+    for _ in range(20):
+        k = b"g%05d" % rng.randrange(40 * 40)
+        assert cs.resolve([txn(0, reads=[pt(k)])], v + 1, 0) == [CONFLICT]
+    # advance the window past everything: entries must be pruned away
+    v2 = v + MWTLV + 1000
+    cs.resolve([], v2, v2 - 10)
+    cs.resolve([txn(v2 - 5, writes=[pt(b"zz")])], v2 + 1, v2 - 10)
+    cs._sync_count()
+    assert cs._count_hint <= 4  # only the fresh write (+ slack) remains
+
+
+def test_rebase_at_large_versions_point():
+    cs = PointConflictSet()
+    brute = BruteForceConflictSet()
+    v = 0
+    rng = random.Random(3)
+    for _ in range(12):
+        v += 300_000_000  # crosses the 2^30 rebase threshold repeatedly
+        oldest = v - MWTLV
+        batch = [txn(v - rng.randrange(0, MWTLV // 2),
+                     reads=[pt(b"a")] if rng.random() < 0.5 else [],
+                     writes=[pt(b"b")] if rng.random() < 0.5 else [])
+                 for _ in range(5)]
+        assert cs.resolve(batch, v, oldest) == brute.resolve(batch, v, oldest)
+    assert cs._base > 0
+
+
+def test_recovery_style_version_jump_point():
+    cs = PointConflictSet()
+    brute = BruteForceConflictSet()
+    for impl in (cs, brute):
+        impl.resolve([txn(0, writes=[pt(b"a")])], 100, 0)
+    v = (1 << 31) + 500
+    old = v - MWTLV
+    batch = [txn(v - 10, reads=[pt(b"a")]), txn(50, reads=[pt(b"a")]),
+             txn(v - 10, writes=[pt(b"c")])]
+    assert cs.resolve(batch, v, old) == brute.resolve(batch, v, old)
+    # post-jump: the jumped write must be visible at its true version
+    batch2 = [txn(v - 1, reads=[pt(b"c")]), txn(v + 1, reads=[pt(b"c")])]
+    assert cs.resolve(batch2, v + 10, old) == \
+        brute.resolve(batch2, v + 10, old)
+
+
+def test_searchsorted_i32_full_array_exact():
+    """Counts must reach len(table) for queries above every element
+    (regression: the branchless loop alone caps at len-1, silently
+    emptying the LAST txn's read segment in pad-free kernel drives)."""
+    import numpy as np
+    from foundationdb_tpu.ops.keys import searchsorted_i32
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    for n in (1, 2, 8, 64):
+        tab = np.sort(rng.integers(0, 50, n).astype(np.int32))
+        q = np.arange(-1, 52, dtype=np.int32)
+        for side in ("left", "right"):
+            got = np.asarray(searchsorted_i32(jnp.asarray(tab),
+                                              jnp.asarray(q), side=side))
+            want = np.searchsorted(tab, q, side=side)
+            assert (got == want).all(), (n, side, tab, got, want)
+
+
+def test_kernel_direct_no_pad_last_txn_checked():
+    """Drive the kernel exactly like the bench: nr == n_txns with every
+    slot valid (no pad row). The LAST txn's read must still be
+    conflict-checked (regression for the bench-shape segment bug)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from foundationdb_tpu.ops.keys import encode_keys
+    from foundationdb_tpu.ops.point_kernel import make_point_resolve_fn
+
+    n = 16
+    fn = make_point_resolve_fn(64, n, n, n, 2)
+    sk = np.full((64, 3), 0xFFFFFFFF, np.uint32)
+    sv = np.full((64,), -(1 << 30), np.int32)
+    keys = encode_keys([b"k%02d" % i for i in range(n)], 8)
+    rt = np.arange(n, dtype=np.int32)
+    valid = np.ones(n, bool)
+    # batch 1: txn i writes key i
+    sk2, sv2, _cnt, conflict = fn(
+        jnp.asarray(sk), jnp.asarray(sv),
+        jnp.zeros(n, jnp.int32), jnp.zeros(n, bool),
+        jnp.asarray(np.zeros((n, 3), np.uint32)),  # reads: all-zero keys
+        jnp.asarray(rt), jnp.asarray(np.zeros(n, bool)),
+        jnp.asarray(keys), jnp.asarray(rt), jnp.asarray(valid),
+        jnp.int32(100), jnp.int32(0), jnp.int32(0))
+    assert not np.asarray(conflict).any()
+    # batch 2: txn i reads key i at a pre-write snapshot -> ALL conflict,
+    # including txn n-1 (the one a pad-free segment table would skip)
+    _sk3, _sv3, _c, conflict = fn(
+        sk2, sv2, jnp.full(n, 50, jnp.int32), jnp.zeros(n, bool),
+        jnp.asarray(keys), jnp.asarray(rt), jnp.asarray(valid),
+        jnp.asarray(np.zeros((n, 3), np.uint32)), jnp.asarray(rt),
+        jnp.asarray(np.zeros(n, bool)),
+        jnp.int32(200), jnp.int32(0), jnp.int32(0))
+    assert np.asarray(conflict).all(), np.asarray(conflict)
+
+
+def test_large_batch_parity():
+    """One big batch through the padded shape buckets (512 txns)."""
+    rng = random.Random(99)
+    cs = PointConflictSet()
+    brute = BruteForceConflictSet()
+    version = 1000
+    for _ in range(3):
+        version += 40_000
+        batch = random_point_batch(rng, 512, keyspace=600, version=version,
+                                   spread=60_000)
+        assert cs.resolve(batch, version, version - 80_000) == \
+            brute.resolve(batch, version, version - 80_000)
