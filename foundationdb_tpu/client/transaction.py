@@ -136,11 +136,16 @@ class Transaction:
             end = await self.get_key(end, snapshot=snapshot)
         if begin >= end:
             return []
-        if not snapshot:
-            self._read_conflicts.append((begin, end))
         version = await self.get_read_version()
+        # With no RYW overlay in the range the storage server honors the
+        # caller's limit/reverse directly; an overlay (clears/writes/
+        # atomics) can remove or add rows, so fetch the full range and
+        # merge (ref: RYWIterator reads through the WriteMap instead).
+        has_overlay = bool(self._cleared or self._write_order or self._ops)
         base = await self.db.storage_range.get_reply(
-            StorageGetRangeRequest(begin, end, version, 1 << 20, False),
+            StorageGetRangeRequest(begin, end, version,
+                                   (1 << 20) if has_overlay else limit,
+                                   False if has_overlay else reverse),
             self.db.process)
         # overlay uncommitted writes (ref: RYWIterator merge)
         merged: Dict[bytes, bytes] = {k: v for k, v in base}
@@ -169,8 +174,21 @@ class Transaction:
                     merged.pop(k, None)
                 else:
                     merged[k] = val
-        out = sorted(merged.items(), reverse=reverse)
-        return out[:limit]
+        out = sorted(merged.items(), reverse=reverse)[:limit]
+        if not snapshot:
+            # record only the observed portion: when the limit truncates,
+            # keys past the last returned row were never promised to the
+            # caller (ref: record-what-was-read conflict semantics,
+            # NativeAPI getRange → tr.addReadConflictRange of the
+            # readThrough bound)
+            if len(out) == limit and out:
+                if reverse:
+                    self._read_conflicts.append((out[-1][0], end))
+                else:
+                    self._read_conflicts.append((begin, _next_key(out[-1][0])))
+            else:
+                self._read_conflicts.append((begin, end))
+        return out
 
     # -- writes ---------------------------------------------------------
     def _record_write(self, key: bytes, value: Optional[bytes]) -> None:

@@ -45,7 +45,11 @@ def wait_for_all(futures: Iterable[Future]) -> Future:
 
 
 def first_of(*futures: Future) -> Future:
-    """Future of (index, value) of the first ready input (ref: choose/when)."""
+    """Future of (index, value) of the first ready input (ref: choose/when).
+
+    Losing inputs still pending when one wins are marked abandoned so
+    a FutureStream waiter among them re-queues later deliveries instead
+    of losing them (see Future.abandon)."""
     out = Future()
 
     def make(i):
@@ -56,6 +60,9 @@ def first_of(*futures: Future) -> Future:
                 out.send_error(f.exception())
             else:
                 out.send((i, f.get()))
+            for other in futures:
+                if not other.is_ready:
+                    other.abandon()
         return cb
 
     for i, f in enumerate(futures):
@@ -82,6 +89,7 @@ def timeout(fut: Future, seconds: float, default: Any = None,
         if out.is_ready or t.is_error:
             return
         out.send(default)
+        fut.abandon()  # a stream waiter must re-queue later deliveries
 
     fut.on_ready(on_fut)
     timer.on_ready(on_timer)
@@ -105,6 +113,7 @@ def timeout_error(fut: Future, seconds: float,
     def on_timer(t: Future):
         if not out.is_ready and not t.is_error:
             out.send_error(error(err_name))
+            fut.abandon()
 
     fut.on_ready(on_fut)
     timer.on_ready(on_timer)
@@ -187,17 +196,23 @@ class FutureStream:
         self._closed: Optional[BaseException] = None
 
     def _push(self, value: Any) -> None:
-        if self._waiter is not None and not self._waiter.is_ready:
+        if (self._waiter is not None and not self._waiter.is_ready
+                and not self._waiter.is_abandoned):
             w, self._waiter = self._waiter, None
             w.send(value)
         else:
+            # no live waiter (none, already delivered, or abandoned by a
+            # losing choose/when branch): queue, never lose the value
+            if self._waiter is not None and self._waiter.is_abandoned:
+                self._waiter = None
             self._queue.append(value)
 
     def _close(self, err: BaseException) -> None:
         self._closed = err
         if self._waiter is not None and not self._waiter.is_ready:
             w, self._waiter = self._waiter, None
-            w.send_error(err)
+            if not w.is_abandoned:
+                w.send_error(err)
 
     def pop(self) -> Future:
         """Future of the next value (ref: waitNext)."""
@@ -207,6 +222,9 @@ class FutureStream:
             return error_future(self._closed)
         if self._waiter is None or self._waiter.is_ready:
             self._waiter = Future()
+        else:
+            # a new pop re-adopts a previously abandoned pending waiter
+            self._waiter._abandoned = False
         return self._waiter
 
     def is_empty(self) -> bool:

@@ -30,12 +30,13 @@ class Future:
     registration order, synchronously from :meth:`send`).
     """
 
-    __slots__ = ("_state", "_result", "_callbacks")
+    __slots__ = ("_state", "_result", "_callbacks", "_abandoned")
 
     def __init__(self):
         self._state = _PENDING
         self._result: Any = None
         self._callbacks: Optional[list] = None
+        self._abandoned = False
 
     # -- inspection ---------------------------------------------------------
     @property
@@ -103,6 +104,20 @@ class Future:
 
     def cancel(self) -> None:
         """Cancel the computation producing this future (no-op for plain futures)."""
+
+    # -- abandonment ---------------------------------------------------------
+    # The reference's choose/when unhooks losing callbacks from a stream
+    # before any value can be delivered into them; combinators here mark
+    # losing branches "abandoned" instead, and FutureStream re-queues a
+    # value rather than deliver it into an abandoned waiter (otherwise a
+    # commit request racing a batch deadline is silently lost).
+    def abandon(self) -> None:
+        """Declare that no one will consume this future's value."""
+        self._abandoned = True
+
+    @property
+    def is_abandoned(self) -> bool:
+        return self._abandoned
 
 
 def ready_future(value: Any = None) -> Future:

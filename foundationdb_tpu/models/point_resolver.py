@@ -74,16 +74,15 @@ class PointConflictSet(TpuConflictSet):
         return (keys[:nr], None, np.asarray(read_t, np.int32),
                 keys[nr:], None, np.asarray(write_t, np.int32))
 
-    @staticmethod
-    def _check_point(b: bytes, e: bytes) -> None:
+    def _check_point(self, b: bytes, e: bytes) -> None:
         if e != b + b"\x00":
             raise ValueError(
                 "PointConflictSet handles single-key ranges only "
                 f"(got [{b!r}, {e!r})); use the interval backend")
-        if len(b) > _POINT_KEY_BYTES:
+        if len(b) > self._key_bytes:
             raise ValueError(
                 f"point key length {len(b)} exceeds bucket width "
-                f"{_POINT_KEY_BYTES}")
+                f"{self._key_bytes}")
 
     def resolve_arrays(self, *a, **k):
         raise NotImplementedError(

@@ -143,12 +143,17 @@ class Proxy:
                         m = _apply_versionstamp(m, stamp)
                     mutations.append(m)
 
-            # phase 4: log push, ordered (ref: latestLocalCommitBatchLogging)
+            # phase 4: log push, ordered (ref: latestLocalCommitBatchLogging).
+            # The interlock is released at PUSH time, not at fsync ack —
+            # the TLog itself sequences commits via queue_version — so
+            # successive batches' fsyncs overlap (ref: commitBatch releases
+            # logging order before waiting on the push reply, :910-937).
             await self.batch_logging.when_at_least(ver.prev_version)
-            await self.tlog_ref.get_reply(
+            log_done = self.tlog_ref.get_reply(
                 TLogCommitRequest(ver.prev_version, ver.version,
                                   tuple(mutations)), self.process)
             self.batch_logging.set(ver.version)
+            await log_done
             if self.committed_version.get() < ver.version:
                 self.committed_version.set(ver.version)
 

@@ -12,6 +12,8 @@ tpu), advances the window to version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
 
 from __future__ import annotations
 
+from collections import deque
+
 from .. import flow
 from ..flow import NotifiedVersion, TaskPriority
 from ..models import ResolverTransaction, create_conflict_set
@@ -29,6 +31,12 @@ class Resolver:
         self.version = NotifiedVersion(recovery_version)
         self.resolves = RequestStream(process)
         self._actors = flow.ActorCollection()
+        # reply cache for duplicate delivery (proxy retry after a broken
+        # reply): version -> verdicts, evicted incrementally once a
+        # bounded number of newer batches exist
+        # (ref: outstandingBatches, Resolver.actor.cpp:159,:241-257)
+        self._reply_cache: dict[int, list[int]] = {}
+        self._reply_order: deque[int] = deque()
 
     def start(self) -> None:
         self._actors.add(flow.spawn(self._resolve_loop(),
@@ -46,14 +54,33 @@ class Resolver:
         # order batches by version, whatever the arrival order
         await self.version.when_at_least(req.prev_version)
         if self.version.get() >= req.version:
-            # duplicate delivery (e.g. proxy retry): conflict everything;
-            # the proxy treats it as not_committed and clients retry
-            reply.send([0] * len(req.transactions))
+            # duplicate delivery (e.g. proxy retry): replay the original
+            # verdicts so a retrying proxy cannot livelock
+            # (ref: Resolver.actor.cpp:241-257). Conflict-everything only
+            # if the entry aged out of the window.
+            cached = self._reply_cache.get(req.version)
+            reply.send(cached if cached is not None
+                       else [0] * len(req.transactions))
             return
         txns = [ResolverTransaction(t.read_snapshot, t.read_conflict_ranges,
                                     t.write_conflict_ranges)
                 for t in req.transactions]
         new_oldest = max(0, req.version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
-        verdicts = self.conflict_set.resolve(txns, req.version, new_oldest)
+        try:
+            verdicts = self.conflict_set.resolve(txns, req.version, new_oldest)
+        except (ValueError, OverflowError) as e:
+            # A malformed batch (e.g. a key wider than the backend's key
+            # bucket) must not wedge the pipeline: conflict the whole
+            # batch — clients see not_committed and retry — and still
+            # advance the version so later batches proceed.
+            flow.TraceEvent("ResolverBatchRejected", self.process.name,
+                            severity=flow.trace.SevWarnAlways).detail(
+                Version=req.version, Error=str(e)).log()
+            verdicts = [0] * len(req.transactions)
+            self.conflict_set.resolve([], req.version, new_oldest)
+        self._reply_cache[req.version] = verdicts
+        self._reply_order.append(req.version)
+        while len(self._reply_order) > 256:
+            self._reply_cache.pop(self._reply_order.popleft(), None)
         self.version.set(req.version)
         reply.send(verdicts)

@@ -37,26 +37,43 @@ class TLog:
         self.process.on_kill(self._actors.cancel_all)
 
     async def _commit_loop(self):
+        # spawn per request: pushes from successive proxy batches are in
+        # flight concurrently (the proxy releases its logging interlock at
+        # push time) and the network can deliver them out of order; a
+        # serial loop awaiting prev_version would wedge behind a
+        # reordered pair (same per-request tolerance as the resolver).
         while True:
             req, reply = await self.commits.pop()
             assert isinstance(req, TLogCommitRequest)
-            # strict version ordering (ref: tLogCommit waits for
-            # logData->version == req.prevVersion)
-            await self.queue_version.when_at_least(req.prev_version)
-            if self.version.get() >= req.version:
-                reply.send(self.version.get())  # duplicate after recovery
-                continue
-            self.queue_version.set(req.version)
-            self.entries.append((req.version, req.mutations))
-            # durability: simulated fsync before ack
-            flow.spawn(self._make_durable(req.version, reply),
-                       TaskPriority.TLOG_COMMIT_REPLY)
+            flow.spawn(self._handle_commit(req, reply),
+                       TaskPriority.TLOG_COMMIT)
+
+    async def _handle_commit(self, req: TLogCommitRequest, reply):
+        # strict version ordering (ref: tLogCommit waits for
+        # logData->version == req.prevVersion)
+        await self.queue_version.when_at_least(req.prev_version)
+        if self.queue_version.get() >= req.version:
+            # duplicate delivery: the entry is already queued (possibly
+            # not yet fsynced) — ack only once it IS durable, never
+            # append twice (ADVICE r1: comparing against the durable
+            # version raced the in-flight fsync)
+            await self._ack_when_durable(req.version, reply)
+            return
+        self.queue_version.set(req.version)
+        self.entries.append((req.version, req.mutations))
+        # durability: simulated fsync before ack
+        flow.spawn(self._make_durable(req.version, reply),
+                   TaskPriority.TLOG_COMMIT_REPLY)
 
     async def _make_durable(self, version, reply):
         await flow.delay(self.fsync_delay, TaskPriority.TLOG_COMMIT_REPLY)
         if self.version.get() < version:
             self.version.set(version)
         reply.send(version)
+
+    async def _ack_when_durable(self, version, reply):
+        await self.version.when_at_least(version)
+        reply.send(self.version.get())
 
     async def _peek_loop(self):
         while True:

@@ -424,6 +424,75 @@ def test_run_timeout_does_not_execute_past_deadline(sched):
     assert sched.now() == 5.0
 
 
+def test_stream_value_survives_lost_race_with_deadline(sched):
+    """A value delivered to a pop() waiter that lost a first_of race must
+    be re-queued, not dropped (ADVICE r1: the proxy batcher's
+    first_of(nxt, deadline) pattern lost commit requests that tied with
+    the batch deadline)."""
+    ps = PromiseStream()
+    got = []
+
+    async def batcher():
+        # round 1: deadline wins; the pending pop is abandoned
+        nxt = ps.stream.pop()
+        deadline = flow.delay(1.0)
+        idx, _ = await first_of(nxt, deadline)
+        assert idx == 1  # deadline fired first
+        # a value arrives AFTER the deadline won, into the abandoned waiter
+        # (the producer below sends at t=2.0)
+        await flow.delay(2.0)
+        # round 2: the value must still be obtainable
+        got.append(await ps.stream.pop())
+
+    async def producer():
+        await flow.delay(2.0)
+        ps.send("precious")
+
+    t = sched.spawn(batcher())
+    sched.spawn(producer())
+    sched.run(until=t)
+    assert got == ["precious"]
+
+
+def test_timeout_abandons_stream_waiter(sched):
+    """timeout(stream.pop(), ...) hitting the deadline must not eat the
+    next value sent into the stream."""
+    ps = PromiseStream()
+
+    async def consumer():
+        v = await timeout(ps.stream.pop(), 0.5, default="none")
+        assert v == "none"
+        await flow.delay(1.0)  # value arrives at t=1.0 (after abandon)
+        return await ps.stream.pop()
+
+    async def producer():
+        await flow.delay(1.0)
+        ps.send(41)
+
+    t = sched.spawn(consumer())
+    sched.spawn(producer())
+    assert sched.run(until=t) == 41
+
+
+def test_reused_pop_waiter_after_abandon_still_delivers(sched):
+    """pop() re-adopts a previously abandoned pending waiter; direct
+    delivery into it must work again."""
+    ps = PromiseStream()
+
+    async def consumer():
+        v = await timeout(ps.stream.pop(), 0.5, default=None)
+        assert v is None
+        return await ps.stream.pop()  # re-adopted waiter, direct delivery
+
+    async def producer():
+        await flow.delay(1.0)
+        ps.send("direct")
+
+    t = sched.spawn(consumer())
+    sched.spawn(producer())
+    assert sched.run(until=t) == "direct"
+
+
 def test_knob_reset_in_place():
     from foundationdb_tpu.flow import SERVER_KNOBS, reset_server_knobs
     old = SERVER_KNOBS.versions_per_second
