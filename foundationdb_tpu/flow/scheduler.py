@@ -47,6 +47,7 @@ class TaskPriority:
     TLOG_CONFIRM_RUNNING = 8520
     PROXY_GRV_TIMER = 8510
     PROXY_GET_CONSISTENT_READ_VERSION = 8500
+    DISK_IO_LATENCY = 8100
     DEFAULT_PROMISE_ENDPOINT = 8000
     DEFAULT_ON_MAIN_THREAD = 7500
     DEFAULT_ENDPOINT = 7000

@@ -8,6 +8,7 @@ virtual time. Here the simulated transport is the primary runtime; a
 real TCP transport can slot in behind the same NetworkRef seam.
 """
 
+from .disk import SimDisk, SimFile
 from .network import (
     Endpoint,
     NetworkRef,
@@ -17,4 +18,4 @@ from .network import (
 )
 
 __all__ = ["Endpoint", "NetworkRef", "RequestStream", "SimNetwork",
-           "SimProcess"]
+           "SimProcess", "SimDisk", "SimFile"]

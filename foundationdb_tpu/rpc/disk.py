@@ -1,0 +1,152 @@
+"""Simulated disks: machine-scoped files with power-loss semantics.
+
+Reference behaviors re-implemented (not ported):
+  - async file API with explicit sync barriers (fdbrpc/IAsyncFile.h)
+  - simulated IO latency drawn from the deterministic RNG
+    (fdbrpc/sim2.actor.cpp SimDiskSpace / file ops)
+  - NONDURABLE kill semantics: writes issued since the last sync have
+    no durability guarantee — on an untimely process death each one is
+    independently kept or dropped, so recovery code must tolerate any
+    prefix/subset surviving (fdbrpc/AsyncFileNonDurable.actor.h — the
+    heart of FDB's power-loss testing)
+
+Files belong to a MACHINE, not a process: a restarted process opens the
+same file set and sees whatever survived (ref: simulator.h machine
+folders; restartSimulatedSystem).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..flow import TaskPriority, error
+
+
+class SimFile:
+    """One simulated file: durable bytes + an unsynced write buffer."""
+
+    __slots__ = ("disk", "name", "owner", "_durable", "_pending", "_open")
+
+    def __init__(self, disk: "SimDisk", name: str, owner=None):
+        self.disk = disk
+        self.name = name
+        self.owner = owner  # the SimProcess whose death power-fails this file
+        self._durable = bytearray()
+        self._pending: List[Tuple[int, bytes]] = []  # (offset, data)
+        self._open = True
+
+    # -- async API (ref: IAsyncFile) ------------------------------------
+    async def write(self, offset: int, data: bytes) -> None:
+        """Buffered write; durable only after sync()."""
+        self._check_open()
+        await self.disk._io_latency()
+        self._check_open()
+        self._pending.append((offset, bytes(data)))
+
+    async def sync(self) -> None:
+        """Barrier: all previously written data becomes durable
+        (ref: IAsyncFile::sync / fsync)."""
+        self._check_open()
+        await self.disk._io_latency(sync=True)
+        self._check_open()
+        for offset, data in self._pending:
+            self._apply(offset, data)
+        self._pending.clear()
+
+    async def read(self, offset: int, length: int) -> bytes:
+        """Read through the OS view (durable + buffered writes) — a live
+        process sees its own unsynced writes."""
+        self._check_open()
+        await self.disk._io_latency()
+        self._check_open()
+        view = bytearray(self._durable)
+        for off, data in self._pending:
+            self._apply_to(view, off, data)
+        return bytes(view[offset:offset + length])
+
+    async def truncate(self, size: int) -> None:
+        self._check_open()
+        await self.disk._io_latency()
+        self._check_open()
+        self._pending.append((size, None))  # type: ignore[arg-type]
+
+    async def size(self) -> int:
+        self._check_open()
+        view_len = len(self._durable)
+        for off, data in self._pending:
+            if data is None:
+                view_len = off
+            else:
+                view_len = max(view_len, off + len(data))
+        return view_len
+
+    # -- internals ------------------------------------------------------
+    def _check_open(self) -> None:
+        if not self._open:
+            raise error("io_error")
+
+    def _apply(self, offset: int, data: Optional[bytes]) -> None:
+        self._apply_to(self._durable, offset, data)
+
+    @staticmethod
+    def _apply_to(buf: bytearray, offset: int, data: Optional[bytes]) -> None:
+        if data is None:  # truncate record
+            del buf[offset:]
+            return
+        end = offset + len(data)
+        if len(buf) < end:
+            buf.extend(b"\x00" * (end - len(buf)))
+        buf[offset:end] = data
+
+    def _power_loss(self, rng) -> None:
+        """Each unsynced write independently survives or vanishes — the
+        OS may or may not have flushed it (ref: AsyncFileNonDurable
+        KILLED mode). Ordering of survivors is preserved."""
+        for offset, data in self._pending:
+            if rng.random01() < 0.5:
+                self._apply(offset, data)
+        self._pending.clear()
+        self._open = False
+
+    def _close(self) -> None:
+        self._open = False
+
+
+class SimDisk:
+    """A machine's file namespace + IO model (survives process kills)."""
+
+    def __init__(self, net, machine: str):
+        self.net = net
+        self.machine = machine
+        self.files: Dict[str, SimFile] = {}
+
+    def open(self, name: str, owner=None) -> SimFile:
+        """Open-or-create. Reopening after a kill hands back a fresh
+        handle onto whatever bytes survived."""
+        f = self.files.get(name)
+        if f is None or not f._open:
+            nf = SimFile(self, name, owner)
+            if f is not None:
+                nf._durable = f._durable  # survives the crash
+            self.files[name] = nf
+            f = nf
+        elif owner is not None:
+            f.owner = owner
+        return f
+
+    def exists(self, name: str) -> bool:
+        return name in self.files
+
+    async def _io_latency(self, sync: bool = False):
+        from .. import flow
+        base = 0.0001 if not sync else 0.0005
+        jitter = flow.g_random.random01() * (0.0002 if not sync else 0.002)
+        await flow.delay(base + jitter, TaskPriority.DISK_IO_LATENCY)
+
+    def power_loss(self, rng, owner=None) -> None:
+        """Crash semantics: with `owner`, only that process's files lose
+        their unsynced writes (process crash); without, the whole
+        machine does (power failure)."""
+        for f in self.files.values():
+            if f._open and (owner is None or f.owner is owner):
+                f._power_loss(rng)

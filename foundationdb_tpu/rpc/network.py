@@ -126,12 +126,21 @@ class SimNetwork:
         self._clogged: Dict[Tuple[str, str], float] = {}
         self.messages_sent = 0
         self.messages_dropped = 0
+        self.disks: Dict[str, "SimDisk"] = {}
 
     # -- topology -------------------------------------------------------
     def new_process(self, name: str, machine: str = "") -> SimProcess:
         p = SimProcess(self, name, machine)
         self.processes[name] = p
         return p
+
+    def disk(self, machine: str) -> "SimDisk":
+        """The machine's persistent file namespace (survives kills)."""
+        from .disk import SimDisk
+        d = self.disks.get(machine)
+        if d is None:
+            d = self.disks[machine] = SimDisk(self, machine)
+        return d
 
     def _next_token(self) -> int:
         self._token += 1
@@ -140,7 +149,9 @@ class SimNetwork:
     # -- faults ---------------------------------------------------------
     def kill(self, process: SimProcess) -> None:
         """Kill a process: break its owned replies; its streams stop
-        receiving (ref: killProcess_internal, sim2.actor.cpp:1222)."""
+        receiving; its open files lose unsynced writes
+        (ref: killProcess_internal, sim2.actor.cpp:1222 +
+        AsyncFileNonDurable power-loss semantics)."""
         if not process.alive:
             return
         process.alive = False
@@ -150,6 +161,18 @@ class SimNetwork:
             if not p.is_set:
                 p.send_error(error("broken_promise"))
         process._pending_replies.clear()
+        d = self.disks.get(process.machine)
+        if d is not None:
+            d.power_loss(self.rng, owner=process)
+
+    def reboot(self, name: str) -> SimProcess:
+        """Kill (if alive) and re-create a process of the same name on
+        the same machine. The caller restarts role actors on the new
+        process; they recover from the machine's surviving files
+        (ref: simulatedFDBDRebooter, SimulatedCluster.actor.cpp:194)."""
+        old = self.processes[name]
+        self.kill(old)
+        return self.new_process(name, old.machine)
 
     def clog_pair(self, a: str, b: str, seconds: float) -> None:
         """Delay all messages between two machines until now+seconds

@@ -4,7 +4,9 @@ Reference: fdbserver/SimulatedCluster.actor.cpp setupSimulatedSystem
 (:1078) — build simulated processes, start role actors on them, hand
 back client handles; the same role code would run on real transports in
 production (the INetwork seam). Fault API surfaces the sim2 primitives
-(kill/clog) for workload tests.
+(kill/clog/reboot) for workload tests; the TLog and storage roles keep
+their state on the machines' simulated disks, so a rebooted role
+recovers it (ref: simulatedFDBDRebooter, restartSimulatedSystem).
 """
 
 from __future__ import annotations
@@ -13,6 +15,7 @@ from typing import Optional
 
 from .. import flow
 from ..rpc import SimNetwork
+from .kvstore import KeyValueStoreMemory
 from .master import Master
 from .proxy import Proxy
 from .resolver_role import Resolver
@@ -25,11 +28,16 @@ class SimCluster:
     recruitment flow (ClusterController/recovery) in later stages."""
 
     def __init__(self, seed: int = 0, conflict_backend: str = "python",
-                 start_time: float = 0.0, n_resolvers: int = 1):
+                 start_time: float = 0.0, n_resolvers: int = 1,
+                 durable: bool = False,
+                 storage_lag_versions: Optional[int] = None):
         flow.set_seed(seed)
         self.sched = flow.Scheduler(start_time=start_time, virtual=True)
         flow.set_scheduler(self.sched)
         self.net = SimNetwork(self.sched, flow.g_random)
+        self.conflict_backend = conflict_backend
+        self.durable = durable
+        self.storage_lag_versions = storage_lag_versions
 
         p = self.net.new_process
         self.master = Master(p("master", machine="m1"))
@@ -42,17 +50,48 @@ class SimCluster:
         # the resolutionBalancing equivalent)
         splits = [bytes([(i * 256) // n_resolvers])
                   for i in range(1, n_resolvers)]
-        self.tlog = TLog(p("tlog", machine="m3"))
+        self.tlog = self._make_tlog(p("tlog", machine="m3"))
         self.proxy = Proxy(p("proxy", machine="m1"),
                            self.master.version_requests.ref(),
                            [r.resolves.ref() for r in self.resolvers],
                            self.tlog.commits.ref(),
                            resolver_splits=splits)
-        self.storage = StorageServer(p("storage", machine="m4"),
-                                     self.tlog.peeks.ref())
+        self.storage = self._make_storage(p("storage", machine="m4"))
         for role in (self.master, *self.resolvers, self.tlog, self.proxy,
                      self.storage):
             role.start()
+
+    # -- role construction (also used by reboots) -----------------------
+    def _make_tlog(self, process) -> TLog:
+        disk = self.net.disk(process.machine) if self.durable else None
+        return TLog(process, disk=disk)
+
+    def _make_storage(self, process) -> StorageServer:
+        kv = None
+        if self.durable:
+            kv = KeyValueStoreMemory(self.net.disk(process.machine),
+                                     "storage", owner=process)
+        return StorageServer(process, self.tlog.peeks.ref(), kv=kv,
+                             tlog_pop=self.tlog.pops.ref(),
+                             durability_lag_versions=self.storage_lag_versions)
+
+    # -- faults ---------------------------------------------------------
+    def reboot_tlog(self) -> TLog:
+        """Kill the tlog process and restart the role from its disk
+        files. Note: the proxy holds the OLD commit endpoint until a
+        recovery re-wires it — restart tests talk to the new role
+        directly, full re-recruitment arrives with the master recovery
+        state machine."""
+        proc = self.net.reboot("tlog")
+        self.tlog = self._make_tlog(proc)
+        self.tlog.start()
+        return self.tlog
+
+    def reboot_storage(self) -> StorageServer:
+        proc = self.net.reboot("storage")
+        self.storage = self._make_storage(proc)
+        self.storage.start()
+        return self.storage
 
     def client(self, name: str = "client", machine: str = ""):
         from ..client import Database  # avoid package-init cycle

@@ -1,40 +1,87 @@
-"""In-memory transaction log role.
+"""Transaction log role (durable over a DiskQueue).
 
 Reference: fdbserver/TLogServer.actor.cpp — `tLogCommit` (:1468) appends
 versioned mutation sets in strict version order (commits carrying
 prev_version sequence via NotifiedVersion) and acks after the queue
-commit becomes durable (doQueueCommit :1382 — here a simulated fsync
-delay); `tLogPeekMessages` (:1138) long-polls readers from a version;
-`tLogPop` (:1050) discards acked prefixes. Tag partitioning arrives with
-multi-storage; this slice logs one tag.
+commit becomes durable (doQueueCommit :1382 — a DiskQueue push+sync on
+the machine's simulated disk, or a plain fsync delay in memory mode);
+`tLogPeekMessages` (:1138) long-polls readers from a version (served by
+bisect over the in-memory index, not a rescan); `tLogPop` (:1050)
+discards acked prefixes from memory AND reclaims DiskQueue space; on
+reboot the log recovers every acked entry from disk (ref: TLog restart
+via initPersistentState/restorePersistentState). Tag partitioning
+arrives with multi-storage; this slice logs one tag.
 """
 
 from __future__ import annotations
 
+from bisect import bisect_left, bisect_right
+from typing import Optional
+
 from .. import flow
-from ..flow import NotifiedVersion, TaskPriority
+from ..flow import FlowLock, NotifiedVersion, TaskPriority
 from ..rpc import RequestStream, SimProcess
-from .types import TLogCommitRequest, TLogPeekReply, TLogPeekRequest
+from ..rpc.disk import SimDisk
+from .diskqueue import DiskQueue
+from .types import (TLogCommitRequest, TLogPeekReply, TLogPeekRequest,
+                    TLogPopRequest)
+from .wire import decode_log_entry, encode_log_entry
 
 
 class TLog:
-    def __init__(self, process: SimProcess, fsync_delay: float = 0.0005):
+    def __init__(self, process: SimProcess, disk: Optional[SimDisk] = None,
+                 name: str = "tlog", fsync_delay: float = 0.0005):
         self.process = process
         self.fsync_delay = fsync_delay
-        self.entries: list = []  # [(version, mutations)] sorted
+        self._dq = (DiskQueue(disk, name, owner=process)
+                    if disk is not None else None)
+        self.entries: list = []  # [(version, mutations, seq)] sorted
+        self._versions: list = []  # parallel sorted version index
         self.version = NotifiedVersion(0)   # highest durable version
         self.queue_version = NotifiedVersion(0)  # highest accepted version
         self.popped = 0
         self.commits = RequestStream(process)
         self.peeks = RequestStream(process)
+        self.pops = RequestStream(process)
+        self._dq_lock = FlowLock()
+        self._recovered = flow.Future()
         self._actors = flow.ActorCollection()
 
     def start(self) -> None:
-        self._actors.add(flow.spawn(self._commit_loop(), TaskPriority.TLOG_COMMIT,
+        self._actors.add(flow.spawn(self._run(), TaskPriority.TLOG_COMMIT,
+                                    name=f"{self.process.name}.run"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    async def _run(self) -> None:
+        await self._recover()
+        self._actors.add(flow.spawn(self._commit_loop(),
+                                    TaskPriority.TLOG_COMMIT,
                                     name=f"{self.process.name}.commit"))
         self._actors.add(flow.spawn(self._peek_loop(), TaskPriority.TLOG_PEEK,
                                     name=f"{self.process.name}.peek"))
-        self.process.on_kill(self._actors.cancel_all)
+        self._actors.add(flow.spawn(self._pop_loop(), TaskPriority.TLOG_POP,
+                                    name=f"{self.process.name}.pop"))
+
+    async def _recover(self) -> None:
+        """Rebuild the in-memory index from whatever the DiskQueue's
+        committed prefix preserved; versions resume from the last
+        durable entry."""
+        if self._dq is not None:
+            payloads = await self._dq.recover()
+            seq0 = self._dq.next_seq - len(payloads)
+            for i, payload in enumerate(payloads):
+                version, mutations = decode_log_entry(payload)
+                self.entries.append((version, mutations, seq0 + i))
+                self._versions.append(version)
+            if self.entries:
+                last = self.entries[-1][0]
+                self.version.set(last)
+                self.queue_version.set(last)
+        if not self._recovered.is_ready:
+            self._recovered.send(None)
+
+    def recovered(self) -> flow.Future:
+        return self._recovered
 
     async def _commit_loop(self):
         # spawn per request: pushes from successive proxy batches are in
@@ -60,13 +107,31 @@ class TLog:
             await self._ack_when_durable(req.version, reply)
             return
         self.queue_version.set(req.version)
-        self.entries.append((req.version, req.mutations))
-        # durability: simulated fsync before ack
-        flow.spawn(self._make_durable(req.version, reply),
+        self.entries.append((req.version, req.mutations, -1))
+        self._versions.append(req.version)
+        flow.spawn(self._make_durable(req, reply),
                    TaskPriority.TLOG_COMMIT_REPLY)
 
-    async def _make_durable(self, version, reply):
-        await flow.delay(self.fsync_delay, TaskPriority.TLOG_COMMIT_REPLY)
+    async def _make_durable(self, req: TLogCommitRequest, reply):
+        """Durability: DiskQueue push+commit (ref: doQueueCommit), or the
+        simulated fsync delay in memory mode. The FlowLock is FIFO and
+        durable actors are spawned in version order, so log records land
+        on disk in version order."""
+        version = req.version
+        if self._dq is None:
+            await flow.delay(self.fsync_delay, TaskPriority.TLOG_COMMIT_REPLY)
+        else:
+            await self._dq_lock.take()
+            try:
+                seq = await self._dq.push(
+                    encode_log_entry(version, req.mutations))
+                await self._dq.commit()
+            finally:
+                self._dq_lock.release()
+            i = bisect_left(self._versions, version)
+            if i < len(self._versions) and self._versions[i] == version:
+                e = self.entries[i]
+                self.entries[i] = (e[0], e[1], seq)
         if self.version.get() < version:
             self.version.set(version)
         reply.send(version)
@@ -84,11 +149,29 @@ class TLog:
     async def _serve_peek(self, req: TLogPeekRequest, reply):
         # long-poll: wait until something at/after begin_version is durable
         await self.version.when_at_least(req.begin_version)
-        out = tuple((v, m) for v, m in self.entries
-                    if v >= req.begin_version)
-        reply.send(TLogPeekReply(out, self.version.get()))
+        lo = bisect_left(self._versions, req.begin_version)
+        durable = self.version.get()
+        hi = bisect_right(self._versions, durable)
+        out = tuple((v, m) for v, m, _s in self.entries[lo:hi])
+        reply.send(TLogPeekReply(out, durable))
+
+    async def _pop_loop(self):
+        while True:
+            req, _reply = await self.pops.pop()
+            assert isinstance(req, TLogPopRequest)
+            self.pop(req.version)
 
     def pop(self, version: int) -> None:
-        """Discard entries at or below `version` (ref: tLogPop)."""
-        self.popped = max(self.popped, version)
-        self.entries = [(v, m) for v, m in self.entries if v > version]
+        """Discard entries at or below `version` from memory and disk
+        (ref: tLogPop driven by storage durability)."""
+        if version <= self.popped:
+            return
+        self.popped = version
+        hi = bisect_right(self._versions, version)
+        if hi == 0:
+            return
+        max_seq = max((s for _v, _m, s in self.entries[:hi]), default=-1)
+        del self.entries[:hi]
+        del self._versions[:hi]
+        if self._dq is not None and max_seq >= 0:
+            self._dq.pop(max_seq)

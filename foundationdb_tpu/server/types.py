@@ -126,6 +126,13 @@ class TLogPeekRequest(NamedTuple):
     begin_version: int
 
 
+class TLogPopRequest(NamedTuple):
+    """Discard log entries at or below version (ref: TLogPopRequest,
+    fdbserver/TLogInterface.h — sent by storage once durable)."""
+
+    version: int
+
+
 class TLogPeekReply(NamedTuple):
     entries: Tuple[Tuple[int, Tuple[MutationRef, ...]], ...]
     committed_version: int

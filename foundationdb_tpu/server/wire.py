@@ -1,0 +1,62 @@
+"""Binary encoding of mutations and log entries.
+
+Reference: flow/serialize.h — byte-identical, versioned archives; the
+TLog's persisted format and (later) the RPC wire format both build on
+this. Little-endian, length-prefixed; a one-byte protocol version
+leads every entry so future formats can evolve (ref: IncludeVersion,
+flow/serialize.h:276).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+from ..flow import error
+from .types import MutationRef
+
+PROTOCOL_VERSION = 1
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def encode_mutations(mutations) -> bytes:
+    out = [_U32.pack(len(mutations))]
+    for m in mutations:
+        out.append(bytes([m.type]))
+        out.append(_U32.pack(len(m.param1)))
+        out.append(m.param1)
+        out.append(_U32.pack(len(m.param2)))
+        out.append(m.param2)
+    return b"".join(out)
+
+
+def decode_mutations(buf: bytes, off: int = 0):
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    out = []
+    for _ in range(n):
+        t = buf[off]
+        off += 1
+        (l1,) = _U32.unpack_from(buf, off)
+        p1 = bytes(buf[off + 4:off + 4 + l1])
+        off += 4 + l1
+        (l2,) = _U32.unpack_from(buf, off)
+        p2 = bytes(buf[off + 4:off + 4 + l2])
+        off += 4 + l2
+        out.append(MutationRef(t, p1, p2))
+    return tuple(out), off
+
+
+def encode_log_entry(version: int, mutations) -> bytes:
+    """One TLog record: [proto u8][version u64][mutations]."""
+    return bytes([PROTOCOL_VERSION]) + _U64.pack(version) + \
+        encode_mutations(mutations)
+
+
+def decode_log_entry(buf: bytes) -> Tuple[int, Tuple[MutationRef, ...]]:
+    if not buf or buf[0] != PROTOCOL_VERSION:
+        raise error("incompatible_protocol_version")
+    (version,) = _U64.unpack_from(buf, 1)
+    mutations, _ = decode_mutations(buf, 9)
+    return version, mutations
