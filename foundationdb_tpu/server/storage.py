@@ -57,20 +57,26 @@ class VersionedMap:
 
     def __init__(self, base: Optional[IKeyValueStore] = None):
         self._keys: List[bytes] = []           # sorted index of window keys
-        self._chains: Dict[bytes, List[Tuple[int, Optional[bytes]]]] = {}
-        self._clears: List[Tuple[int, bytes, bytes]] = []
+        # key -> [(version, seq, value)]; seq is a map-wide monotonic
+        # stamp so mutations within one version keep their apply order
+        # (ref: storageserver.actor.cpp:1664 applyMutation applies the
+        # batch strictly in order)
+        self._chains: Dict[bytes, List[Tuple[int, int, Optional[bytes]]]] = {}
+        self._clears: List[Tuple[int, int, bytes, bytes]] = []
         self._base = base
+        self._seq = 0
 
     def _base_get(self, key: bytes) -> Optional[bytes]:
         return self._base.get(key) if self._base is not None else None
 
     def _set(self, version: int, key: bytes, value: Optional[bytes]) -> None:
+        self._seq += 1
         chain = self._chains.get(key)
         if chain is None:
-            self._chains[key] = [(version, value)]
+            self._chains[key] = [(version, self._seq, value)]
             insort(self._keys, key)
         else:
-            chain.append((version, value))
+            chain.append((version, self._seq, value))
 
     def apply(self, version: int, m: MutationRef) -> None:
         if m.type == SET_VALUE:
@@ -78,7 +84,8 @@ class VersionedMap:
         elif m.type == CLEAR_RANGE:
             # clears are kept as stamped ranges; gets consult them, so
             # base keys need no materialized tombstones
-            self._clears.append((version, m.param1, m.param2))
+            self._seq += 1
+            self._clears.append((version, self._seq, m.param1, m.param2))
         elif m.type in _ATOMIC_APPLY:
             # read-modify-write at apply time, in version order (ref:
             # storageserver applyMutation -> Atomic.h apply functions)
@@ -88,22 +95,25 @@ class VersionedMap:
         else:
             raise error("client_invalid_operation")
 
-    def _clear_version(self, key: bytes, version: int) -> int:
-        """Newest clear at or below `version` covering `key` (-1: none)."""
-        best = -1
-        for v, b, e in self._clears:
-            if v <= version and b <= key < e and v > best:
-                best = v
+    def _clear_stamp(self, key: bytes,
+                     version: int) -> Optional[Tuple[int, int]]:
+        """Latest (version, seq) clear at or below `version` covering
+        `key`, or None."""
+        best: Optional[Tuple[int, int]] = None
+        for v, s, b, e in self._clears:
+            if v <= version and b <= key < e and (best is None
+                                                  or (v, s) > best):
+                best = (v, s)
         return best
 
     def get(self, key: bytes, version: int) -> Optional[bytes]:
-        cv = self._clear_version(key, version)
+        cs = self._clear_stamp(key, version)
         chain = self._chains.get(key)
         if chain:
-            for v, val in reversed(chain):
+            for v, s, val in reversed(chain):
                 if v <= version:
-                    return None if cv > v else val
-        return None if cv >= 0 else self._base_get(key)
+                    return None if cs is not None and cs > (v, s) else val
+        return None if cs is not None else self._base_get(key)
 
     def _merged_keys(self, begin: bytes, end: bytes) -> List[bytes]:
         """Sorted candidate keys in [begin, end): window ∪ base."""
@@ -156,7 +166,7 @@ class VersionedMap:
         now (ref: VersionedMap::forgetVersionsBefore via updateStorage)."""
         self._clears = [c for c in self._clears if c[0] > up_to]
         dead = []
-        for k, chain in self._chains.items():
+        for k, chain in list(self._chains.items()):
             keep = [e for e in chain if e[0] > up_to]
             if keep:
                 self._chains[k] = keep

@@ -481,7 +481,7 @@ def test_tlog_tolerates_reordered_pushes():
             v2 = await f2
             v1 = await f1
             assert v1 >= 100 and v2 >= 200
-            assert [v for v, _ in tlog.entries] == [100, 200]
+            assert [v for v, _m, _s in tlog.entries] == [100, 200]
             return True
 
         t = s.spawn(main())
