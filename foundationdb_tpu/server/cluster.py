@@ -54,7 +54,7 @@ class SimCluster:
         self.proxy = Proxy(p("proxy", machine="m1"),
                            self.master.version_requests.ref(),
                            [r.resolves.ref() for r in self.resolvers],
-                           self.tlog.commits.ref(),
+                           [self.tlog.commits.ref()],
                            resolver_splits=splits)
         self.storage = self._make_storage(p("storage", machine="m4"))
         for role in (self.master, *self.resolvers, self.tlog, self.proxy,

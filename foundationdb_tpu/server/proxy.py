@@ -25,10 +25,10 @@ from .. import flow
 from ..flow import NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
-from .types import (SET_VALUE, SET_VERSIONSTAMPED_KEY,
+from .types import (CLEAR_RANGE, SET_VALUE, SET_VERSIONSTAMPED_KEY,
                     SET_VERSIONSTAMPED_VALUE, CommitReply, CommitRequest,
                     GetReadVersionReply, MutationRef, ResolveRequest,
-                    TLogCommitRequest)
+                    TLogCommitRequest, TaggedMutation)
 
 
 def make_versionstamp(version: int, batch_index: int) -> bytes:
@@ -56,18 +56,23 @@ def _apply_versionstamp(m: MutationRef, stamp: bytes) -> MutationRef:
 
 class Proxy:
     def __init__(self, process: SimProcess, master_ref: NetworkRef,
-                 resolver_refs, tlog_ref: NetworkRef,
-                 resolver_splits=(), recovery_version: int = 0,
+                 resolver_refs, tlog_refs,
+                 resolver_splits=(), storage_splits=(),
+                 recovery_version: int = 0,
                  batch_window: float = 0.001, max_batch: int = 512):
         if not isinstance(resolver_refs, (list, tuple)):
             resolver_refs = [resolver_refs]
+        if not isinstance(tlog_refs, (list, tuple)):
+            tlog_refs = [tlog_refs]
         assert len(resolver_splits) == len(resolver_refs) - 1
         self.process = process
         self.master_ref = master_ref
         self.resolver_refs = list(resolver_refs)
         # keyResolvers boundaries: resolver i owns [bounds[i], bounds[i+1})
         self._bounds = [b""] + list(resolver_splits) + [None]
-        self.tlog_ref = tlog_ref
+        # keyServers boundaries: storage tag i owns [sbounds[i], sbounds[i+1])
+        self._sbounds = [b""] + list(storage_splits) + [None]
+        self.tlog_refs = list(tlog_refs)
         self.batch_window = batch_window
         self.max_batch = max_batch
         self.committed_version = NotifiedVersion(recovery_version)
@@ -91,6 +96,29 @@ class Proxy:
         while True:
             _req, reply = await self.grvs.pop()
             reply.send(GetReadVersionReply(self.committed_version.get()))
+
+    def _tags_for(self, m: MutationRef):
+        """Destination storage tags for a mutation (ref: LogPushData tag
+        routing via the keyServers map). A point mutation goes to its
+        shard's tag; a clear goes to every shard it overlaps."""
+        n = len(self._sbounds) - 1
+        if n == 1:
+            return (0,)
+        if m.type == CLEAR_RANGE:
+            tags = []
+            for i in range(n):
+                lo, hi = self._sbounds[i], self._sbounds[i + 1]
+                if (hi is None or m.param1 < hi) and lo < m.param2:
+                    tags.append(i)
+            return tuple(tags)
+        return (self._shard_of(m.param1),)
+
+    def _shard_of(self, key: bytes) -> int:
+        n = len(self._sbounds) - 1
+        for i in range(n - 1, -1, -1):
+            if key >= self._sbounds[i]:
+                return i
+        return 0
 
     # -- commit pipeline ------------------------------------------------
     async def _batcher(self):
@@ -128,8 +156,10 @@ class Proxy:
                 verdicts = await self._resolve_split(ver, reqs)
             self.batch_resolving.set(ver.version)
 
-            # phase 3: assemble mutations of committed transactions,
-            # resolving versionstamped operations with the commit version
+            # phase 3: assemble mutations of committed transactions with
+            # their destination storage tags, resolving versionstamped
+            # operations with the commit version (ref: commitBatch phase 3
+            # — tag assignment per mutation via keyServers)
             mutations = []
             for idx, (req, verdict) in enumerate(zip(reqs, verdicts)):
                 if verdict != COMMITTED:
@@ -141,17 +171,22 @@ class Proxy:
                         if stamp is None:
                             stamp = make_versionstamp(ver.version, idx)
                         m = _apply_versionstamp(m, stamp)
-                    mutations.append(m)
+                    mutations.append(TaggedMutation(self._tags_for(m), m))
 
-            # phase 4: log push, ordered (ref: latestLocalCommitBatchLogging).
-            # The interlock is released at PUSH time, not at fsync ack —
-            # the TLog itself sequences commits via queue_version — so
-            # successive batches' fsyncs overlap (ref: commitBatch releases
-            # logging order before waiting on the push reply, :910-937).
+            # phase 4: log push to the whole log set, ordered (ref:
+            # latestLocalCommitBatchLogging + TagPartitionedLogSystem push
+            # :404 — a commit is acked only when EVERY log in the set has
+            # made it durable, so any single survivor carries all acked
+            # data at recovery). The interlock is released at PUSH time,
+            # not at fsync ack — the TLog itself sequences commits via
+            # queue_version — so successive batches' fsyncs overlap (ref:
+            # commitBatch releases logging order before waiting, :910-937).
             await self.batch_logging.when_at_least(ver.prev_version)
-            log_done = self.tlog_ref.get_reply(
-                TLogCommitRequest(ver.prev_version, ver.version,
-                                  tuple(mutations)), self.process)
+            creq = TLogCommitRequest(ver.prev_version, ver.version,
+                                     tuple(mutations),
+                                     self.committed_version.get())
+            log_done = flow.all_of([ref.get_reply(creq, self.process)
+                                    for ref in self.tlog_refs])
             self.batch_logging.set(ver.version)
             await log_done
             if self.committed_version.get() < ver.version:

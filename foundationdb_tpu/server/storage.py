@@ -183,11 +183,14 @@ class StorageServer:
     def __init__(self, process: SimProcess, tlog_peek: NetworkRef,
                  kv: Optional[IKeyValueStore] = None,
                  tlog_pop: Optional[NetworkRef] = None,
-                 durability_lag_versions: Optional[int] = None):
+                 durability_lag_versions: Optional[int] = None,
+                 tag: int = 0):
         self.process = process
         self.tlog_peek = tlog_peek
         self.tlog_pop = tlog_pop
         self.kv = kv
+        self.tag = tag
+        self.known_committed = 0  # replicated log-set-wide (peek piggyback)
         self.data = VersionedMap(base=kv)
         self.version = NotifiedVersion(0)
         self.durable_version = NotifiedVersion(0)
@@ -237,10 +240,14 @@ class StorageServer:
             self.version.set(v)
 
     async def _pull_loop(self):
-        """Pull committed mutations from the log (ref: update :2461)."""
+        """Pull this tag's committed mutations from the log
+        (ref: update :2461, peeking the server's own tag)."""
         while True:
             reply = await self.tlog_peek.get_reply(
-                TLogPeekRequest(self.version.get() + 1), self.process)
+                TLogPeekRequest(self.version.get() + 1, self.tag),
+                self.process)
+            if reply.known_committed > self.known_committed:
+                self.known_committed = reply.known_committed
             for version, mutations in reply.entries:
                 if version <= self.version.get():
                     continue
@@ -260,7 +267,13 @@ class StorageServer:
             return
         while True:
             await flow.delay(0.05, TaskPriority.UPDATE_STORAGE)
-            target = self.version.get() - self._lag
+            # never make durable a version that could still be rolled
+            # back by an epoch recovery: cap at the highest version known
+            # replicated across the whole log set (ref: storageserver
+            # updateStorage bounded by knownCommittedVersion semantics)
+            target = min(self.version.get() - self._lag,
+                         max(self.known_committed,
+                             self.durable_version.get()))
             if target <= self.durable_version.get() or not self._pending:
                 continue
             made = self.durable_version.get()
@@ -279,7 +292,8 @@ class StorageServer:
             self.durable_version.set(made)
             self.data.forget(made)
             if self.tlog_pop is not None:
-                self.tlog_pop.send(TLogPopRequest(made), self.process)
+                self.tlog_pop.send(TLogPopRequest(made, self.tag),
+                                   self.process)
 
     def _apply_to_kv(self, m: MutationRef) -> None:
         if m.type == SET_VALUE:

@@ -1,48 +1,64 @@
-"""Transaction log role (durable over a DiskQueue).
+"""Transaction log role: tag-partitioned, durable over a DiskQueue,
+lockable for epoch recovery.
 
 Reference: fdbserver/TLogServer.actor.cpp — `tLogCommit` (:1468) appends
-versioned mutation sets in strict version order (commits carrying
+versioned tagged mutation sets in strict version order (commits carrying
 prev_version sequence via NotifiedVersion) and acks after the queue
 commit becomes durable (doQueueCommit :1382 — a DiskQueue push+sync on
 the machine's simulated disk, or a plain fsync delay in memory mode);
-`tLogPeekMessages` (:1138) long-polls readers from a version (served by
-bisect over the in-memory index, not a rescan); `tLogPop` (:1050)
-discards acked prefixes from memory AND reclaims DiskQueue space; on
-reboot the log recovers every acked entry from disk (ref: TLog restart
-via initPersistentState/restorePersistentState). Tag partitioning
-arrives with multi-storage; this slice logs one tag.
+`tLogPeekMessages` (:1138) long-polls readers *per tag* from a version;
+`tLogPop` (:1050) discards a tag's acked prefix from memory and reclaims
+DiskQueue space once every tag has popped past a record; `TLogLock`
+(epochEnd, TagPartitionedLogSystem.actor.cpp:1265) stops the log — it
+rejects further commits with tlog_stopped but keeps serving peeks so the
+next generation and the storage servers can drain it. On reboot the log
+recovers every acked entry from disk (ref: restorePersistentState).
 """
 
 from __future__ import annotations
 
 from bisect import bisect_left, bisect_right
-from typing import Optional
+from typing import Dict, List, Optional
 
 from .. import flow
-from ..flow import FlowLock, NotifiedVersion, TaskPriority
+from ..flow import FlowLock, NotifiedVersion, TaskPriority, error
 from ..rpc import RequestStream, SimProcess
 from ..rpc.disk import SimDisk
 from .diskqueue import DiskQueue
-from .types import (TLogCommitRequest, TLogPeekReply, TLogPeekRequest,
-                    TLogPopRequest)
+from .types import (TLogCommitRequest, TLogLockReply, TLogLockRequest,
+                    TLogPeekReply, TLogPeekRequest, TLogPopRequest)
 from .wire import decode_log_entry, encode_log_entry
+
+
+def _tag_set(tagged) -> frozenset:
+    tags = set()
+    for tm in tagged:
+        tags.update(tm.tags)
+    return frozenset(tags)
 
 
 class TLog:
     def __init__(self, process: SimProcess, disk: Optional[SimDisk] = None,
-                 name: str = "tlog", fsync_delay: float = 0.0005):
+                 name: str = "tlog", fsync_delay: float = 0.0005,
+                 recovery_version: int = 0):
         self.process = process
         self.fsync_delay = fsync_delay
         self._dq = (DiskQueue(disk, name, owner=process)
                     if disk is not None else None)
-        self.entries: list = []  # [(version, mutations, seq)] sorted
+        # [(version, tagged_mutations, seq)] sorted by version
+        self.entries: list = []
         self._versions: list = []  # parallel sorted version index
-        self.version = NotifiedVersion(0)   # highest durable version
-        self.queue_version = NotifiedVersion(0)  # highest accepted version
-        self.popped = 0
+        self._entry_tags: list = []  # parallel per-record tag sets
+        self.version = NotifiedVersion(recovery_version)  # highest durable
+        self.queue_version = NotifiedVersion(recovery_version)  # accepted
+        self.known_committed = recovery_version  # replicated log-set-wide
+        self.popped: Dict[int, int] = {}         # per-tag popped version
+        self.stopped = False                     # locked by recovery
+        self._stop_future = flow.Future()        # fires when locked
         self.commits = RequestStream(process)
         self.peeks = RequestStream(process)
         self.pops = RequestStream(process)
+        self.locks = RequestStream(process)
         self._dq_lock = FlowLock()
         self._recovered = flow.Future()
         self._actors = flow.ActorCollection()
@@ -54,13 +70,13 @@ class TLog:
 
     async def _run(self) -> None:
         await self._recover()
-        self._actors.add(flow.spawn(self._commit_loop(),
-                                    TaskPriority.TLOG_COMMIT,
-                                    name=f"{self.process.name}.commit"))
-        self._actors.add(flow.spawn(self._peek_loop(), TaskPriority.TLOG_PEEK,
-                                    name=f"{self.process.name}.peek"))
-        self._actors.add(flow.spawn(self._pop_loop(), TaskPriority.TLOG_POP,
-                                    name=f"{self.process.name}.pop"))
+        for coro, prio, name in (
+                (self._commit_loop(), TaskPriority.TLOG_COMMIT, "commit"),
+                (self._peek_loop(), TaskPriority.TLOG_PEEK, "peek"),
+                (self._pop_loop(), TaskPriority.TLOG_POP, "pop"),
+                (self._lock_loop(), TaskPriority.TLOG_COMMIT, "lock")):
+            self._actors.add(flow.spawn(coro, prio,
+                                        name=f"{self.process.name}.{name}"))
 
     async def _recover(self) -> None:
         """Rebuild the in-memory index from whatever the DiskQueue's
@@ -70,9 +86,10 @@ class TLog:
             payloads = await self._dq.recover()
             seq0 = self._dq.next_seq - len(payloads)
             for i, payload in enumerate(payloads):
-                version, mutations = decode_log_entry(payload)
-                self.entries.append((version, mutations, seq0 + i))
+                version, tagged = decode_log_entry(payload)
+                self.entries.append((version, tagged, seq0 + i))
                 self._versions.append(version)
+                self._entry_tags.append(_tag_set(tagged))
             if self.entries:
                 last = self.entries[-1][0]
                 self.version.set(last)
@@ -96,9 +113,21 @@ class TLog:
                        TaskPriority.TLOG_COMMIT)
 
     async def _handle_commit(self, req: TLogCommitRequest, reply):
+        if self.stopped:
+            reply.send_error(error("tlog_stopped"))
+            return
         # strict version ordering (ref: tLogCommit waits for
-        # logData->version == req.prevVersion)
-        await self.queue_version.when_at_least(req.prev_version)
+        # logData->version == req.prevVersion). A lock wakes parked
+        # waiters: their gap will never be filled by a dead proxy, so
+        # they must fail out instead of wedging the batch forever.
+        await flow.first_of(
+            self.queue_version.when_at_least(req.prev_version),
+            self._stop_future)
+        if self.stopped and self.queue_version.get() < req.prev_version:
+            reply.send_error(error("tlog_stopped"))
+            return
+        if req.known_committed > self.known_committed:
+            self.known_committed = req.known_committed
         if self.queue_version.get() >= req.version:
             # duplicate delivery: the entry is already queued (possibly
             # not yet fsynced) — ack only once it IS durable, never
@@ -106,9 +135,13 @@ class TLog:
             # version raced the in-flight fsync)
             await self._ack_when_durable(req.version, reply)
             return
+        if self.stopped:
+            reply.send_error(error("tlog_stopped"))
+            return
         self.queue_version.set(req.version)
         self.entries.append((req.version, req.mutations, -1))
         self._versions.append(req.version)
+        self._entry_tags.append(_tag_set(req.mutations))
         flow.spawn(self._make_durable(req, reply),
                    TaskPriority.TLOG_COMMIT_REPLY)
 
@@ -140,38 +173,79 @@ class TLog:
         await self.version.when_at_least(version)
         reply.send(self.version.get())
 
+    # -- lock (epoch end) ----------------------------------------------
+    async def _lock_loop(self):
+        while True:
+            req, reply = await self.locks.pop()
+            assert isinstance(req, TLogLockRequest)
+            flow.spawn(self._serve_lock(reply), TaskPriority.TLOG_COMMIT)
+
+    async def _serve_lock(self, reply):
+        if not self.stopped:
+            self.stopped = True
+            self._stop_future.send(None)  # wake parked commit/peek waiters
+        # accepted-but-unfsynced commits are still in flight; the end
+        # version must cover them or a commit could be acked to a client
+        # AFTER recovery chose a lower end (acked-data loss). Wait for
+        # the fsyncs to drain (ref: TLogServer lock waits for the queue
+        # to catch up before replying).
+        await self.version.when_at_least(self.queue_version.get())
+        reply.send(TLogLockReply(self.version.get(), self.known_committed))
+
+    # -- peek / pop -----------------------------------------------------
     async def _peek_loop(self):
         while True:
             req, reply = await self.peeks.pop()
             assert isinstance(req, TLogPeekRequest)
-            flow.spawn(self._serve_peek(req, reply), TaskPriority.TLOG_PEEK_REPLY)
+            flow.spawn(self._serve_peek(req, reply),
+                       TaskPriority.TLOG_PEEK_REPLY)
 
     async def _serve_peek(self, req: TLogPeekRequest, reply):
-        # long-poll: wait until something at/after begin_version is durable
-        await self.version.when_at_least(req.begin_version)
+        # long-poll: wait until something at/after begin_version is
+        # durable. A locked log replies immediately — there will never be
+        # more (the reader fails over to the next generation) — and a
+        # lock arriving mid-wait wakes the parked poll the same way.
+        if not self.stopped:
+            await flow.first_of(
+                self.version.when_at_least(req.begin_version),
+                self._stop_future)
         lo = bisect_left(self._versions, req.begin_version)
         durable = self.version.get()
         hi = bisect_right(self._versions, durable)
-        out = tuple((v, m) for v, m, _s in self.entries[lo:hi])
-        reply.send(TLogPeekReply(out, durable))
+        out = []
+        for v, tagged, _s in self.entries[lo:hi]:
+            ms = tuple(tm.mutation for tm in tagged if req.tag in tm.tags)
+            if ms:
+                out.append((v, ms))
+        reply.send(TLogPeekReply(tuple(out), durable, self.known_committed))
 
     async def _pop_loop(self):
         while True:
             req, _reply = await self.pops.pop()
             assert isinstance(req, TLogPopRequest)
-            self.pop(req.version)
+            self.pop(req.version, req.tag)
 
-    def pop(self, version: int) -> None:
-        """Discard entries at or below `version` from memory and disk
-        (ref: tLogPop driven by storage durability)."""
-        if version <= self.popped:
+    def pop(self, version: int, tag: int = 0) -> None:
+        """Record that `tag` no longer needs entries at or below
+        `version`; free memory and disk once *every* tag with data in a
+        record has popped past it (ref: tLogPop + popDiskQueue)."""
+        if version <= self.popped.get(tag, -1):
             return
-        self.popped = version
-        hi = bisect_right(self._versions, version)
+        self.popped[tag] = version
+        # free the poppable prefix: walk until the first record some tag
+        # still needs (per-record tag sets are precomputed at append, so
+        # the scan costs O(records freed + 1))
+        hi = 0
+        for i, v in enumerate(self._versions):
+            tags = self._entry_tags[i]
+            if tags and any(self.popped.get(t, -1) < v for t in tags):
+                break
+            hi = i + 1
         if hi == 0:
             return
         max_seq = max((s for _v, _m, s in self.entries[:hi]), default=-1)
         del self.entries[:hi]
         del self._versions[:hi]
+        del self._entry_tags[:hi]
         if self._dq is not None and max_seq >= 0:
             self._dq.pop(max_seq)

@@ -116,23 +116,57 @@ class StorageWatchRequest(NamedTuple):
     version: int
 
 
+class TaggedMutation(NamedTuple):
+    """A mutation routed to the storage tags that own its keys (ref:
+    fdbserver/LogSystem.h LogPushData tag routing — each mutation is
+    tagged per destination storage server; clears spanning shards carry
+    several tags)."""
+
+    tags: Tuple[int, ...]
+    mutation: MutationRef
+
+
 class TLogCommitRequest(NamedTuple):
+    """(ref: TLogCommitRequest, fdbserver/TLogInterface.h — versioned
+    tagged mutation payload; known_committed is the highest version the
+    proxy knows is replicated on the whole log set, bounding what
+    storage may safely make durable.)"""
+
     prev_version: int
     version: int
-    mutations: Tuple[MutationRef, ...]
+    mutations: Tuple[TaggedMutation, ...]
+    known_committed: int = 0
 
 
 class TLogPeekRequest(NamedTuple):
+    """(ref: TLogPeekRequest :1138 — per-tag long poll)"""
+
     begin_version: int
+    tag: int = 0
 
 
 class TLogPopRequest(NamedTuple):
-    """Discard log entries at or below version (ref: TLogPopRequest,
-    fdbserver/TLogInterface.h — sent by storage once durable)."""
+    """Discard this tag's log entries at or below version (ref:
+    TLogPopRequest, fdbserver/TLogInterface.h — sent by storage once
+    durable)."""
 
     version: int
+    tag: int = 0
 
 
 class TLogPeekReply(NamedTuple):
     entries: Tuple[Tuple[int, Tuple[MutationRef, ...]], ...]
     committed_version: int
+    known_committed: int = 0
+
+
+class TLogLockRequest(NamedTuple):
+    """Stop the log and report how far it got (ref: TLogLockResult /
+    epochEnd locking, TagPartitionedLogSystem.actor.cpp:1265 — a locked
+    tlog accepts no further commits but keeps serving peeks so storage
+    servers can finish pulling the old generation)."""
+
+
+class TLogLockReply(NamedTuple):
+    end_version: int        # highest durable version in this log
+    known_committed: int    # highest version known replicated log-set-wide

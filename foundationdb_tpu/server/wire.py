@@ -13,21 +13,35 @@ import struct
 from typing import Tuple
 
 from ..flow import error
-from .types import MutationRef
+from .types import MutationRef, TaggedMutation
 
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2
+_U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
+
+
+def encode_mutation(m: MutationRef) -> bytes:
+    return b"".join((bytes([m.type]), _U32.pack(len(m.param1)), m.param1,
+                     _U32.pack(len(m.param2)), m.param2))
+
+
+def decode_mutation(buf: bytes, off: int):
+    t = buf[off]
+    off += 1
+    (l1,) = _U32.unpack_from(buf, off)
+    p1 = bytes(buf[off + 4:off + 4 + l1])
+    off += 4 + l1
+    (l2,) = _U32.unpack_from(buf, off)
+    p2 = bytes(buf[off + 4:off + 4 + l2])
+    off += 4 + l2
+    return MutationRef(t, p1, p2), off
 
 
 def encode_mutations(mutations) -> bytes:
     out = [_U32.pack(len(mutations))]
     for m in mutations:
-        out.append(bytes([m.type]))
-        out.append(_U32.pack(len(m.param1)))
-        out.append(m.param1)
-        out.append(_U32.pack(len(m.param2)))
-        out.append(m.param2)
+        out.append(encode_mutation(m))
     return b"".join(out)
 
 
@@ -36,27 +50,47 @@ def decode_mutations(buf: bytes, off: int = 0):
     off += 4
     out = []
     for _ in range(n):
-        t = buf[off]
-        off += 1
-        (l1,) = _U32.unpack_from(buf, off)
-        p1 = bytes(buf[off + 4:off + 4 + l1])
-        off += 4 + l1
-        (l2,) = _U32.unpack_from(buf, off)
-        p2 = bytes(buf[off + 4:off + 4 + l2])
-        off += 4 + l2
-        out.append(MutationRef(t, p1, p2))
+        m, off = decode_mutation(buf, off)
+        out.append(m)
     return tuple(out), off
 
 
-def encode_log_entry(version: int, mutations) -> bytes:
-    """One TLog record: [proto u8][version u64][mutations]."""
+def encode_tagged_mutations(tagged) -> bytes:
+    out = [_U32.pack(len(tagged))]
+    for tm in tagged:
+        out.append(_U16.pack(len(tm.tags)))
+        for t in tm.tags:
+            out.append(_U16.pack(t))
+        out.append(encode_mutation(tm.mutation))
+    return b"".join(out)
+
+
+def decode_tagged_mutations(buf: bytes, off: int = 0):
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    out = []
+    for _ in range(n):
+        (ntags,) = _U16.unpack_from(buf, off)
+        off += 2
+        tags = []
+        for _t in range(ntags):
+            (tag,) = _U16.unpack_from(buf, off)
+            tags.append(tag)
+            off += 2
+        m, off = decode_mutation(buf, off)
+        out.append(TaggedMutation(tuple(tags), m))
+    return tuple(out), off
+
+
+def encode_log_entry(version: int, tagged_mutations) -> bytes:
+    """One TLog record: [proto u8][version u64][tagged mutations]."""
     return bytes([PROTOCOL_VERSION]) + _U64.pack(version) + \
-        encode_mutations(mutations)
+        encode_tagged_mutations(tagged_mutations)
 
 
-def decode_log_entry(buf: bytes) -> Tuple[int, Tuple[MutationRef, ...]]:
+def decode_log_entry(buf: bytes) -> Tuple[int, Tuple[TaggedMutation, ...]]:
     if not buf or buf[0] != PROTOCOL_VERSION:
         raise error("incompatible_protocol_version")
     (version,) = _U64.unpack_from(buf, 1)
-    mutations, _ = decode_mutations(buf, 9)
-    return version, mutations
+    tagged, _ = decode_tagged_mutations(buf, 9)
+    return version, tagged

@@ -457,7 +457,8 @@ def test_tlog_tolerates_reordered_pushes():
     LATER one first. The TLog must sequence them via queue_version
     without wedging (review r2: a serial commit loop deadlocked here)."""
     from foundationdb_tpu.server.tlog import TLog
-    from foundationdb_tpu.server.types import TLogCommitRequest, MutationRef, SET_VALUE
+    from foundationdb_tpu.server.types import (TLogCommitRequest, MutationRef,
+                                           SET_VALUE, TaggedMutation)
 
     import foundationdb_tpu.flow as fl
     from foundationdb_tpu.rpc import SimNetwork
@@ -471,7 +472,7 @@ def test_tlog_tolerates_reordered_pushes():
         tlog.start()
 
         async def main():
-            m = (MutationRef(SET_VALUE, b"k", b"v"),)
+            m = (TaggedMutation((0,), MutationRef(SET_VALUE, b"k", b"v")),)
             # deliver the SECOND batch first
             f2 = tlog.commits.ref().get_reply(
                 TLogCommitRequest(100, 200, m), proc)

@@ -1,0 +1,146 @@
+"""TLog unit tests: tag partitioning, lock (epoch end), per-tag pop.
+
+Ref: fdbserver/TLogServer.actor.cpp tLogPeekMessages (:1138, per-tag),
+tLogPop (:1050), TLogLock / epochEnd
+(TagPartitionedLogSystem.actor.cpp:1265).
+"""
+
+import pytest
+
+import foundationdb_tpu.flow as fl
+from foundationdb_tpu.rpc import SimNetwork
+from foundationdb_tpu.server.tlog import TLog
+from foundationdb_tpu.server.types import (MutationRef, SET_VALUE,
+                                           TLogCommitRequest,
+                                           TLogLockRequest, TLogPeekRequest,
+                                           TLogPopRequest, TaggedMutation)
+
+
+def _tm(tag, key, val):
+    return TaggedMutation((tag,), MutationRef(SET_VALUE, key, val))
+
+
+@pytest.fixture
+def env():
+    fl.set_seed(11)
+    s = fl.Scheduler(virtual=True)
+    fl.set_scheduler(s)
+    net = SimNetwork(s, fl.g_random)
+    proc = net.new_process("tlog", machine="m")
+    client = net.new_process("client", machine="c")
+    tlog = TLog(proc)
+    tlog.start()
+    yield s, tlog, client
+    fl.set_scheduler(None)
+
+
+def test_per_tag_peek_and_pop(env):
+    s, tlog, client = env
+
+    async def main():
+        await tlog.commits.ref().get_reply(
+            TLogCommitRequest(0, 10, (_tm(0, b"a", b"1"), _tm(1, b"x", b"9"))),
+            client)
+        await tlog.commits.ref().get_reply(
+            TLogCommitRequest(10, 20, (_tm(1, b"y", b"8"),)), client)
+        r0 = await tlog.peeks.ref().get_reply(TLogPeekRequest(1, 0), client)
+        assert [v for v, _ in r0.entries] == [10]
+        assert r0.entries[0][1] == (MutationRef(SET_VALUE, b"a", b"1"),)
+        r1 = await tlog.peeks.ref().get_reply(TLogPeekRequest(1, 1), client)
+        assert [v for v, _ in r1.entries] == [10, 20]
+        # tag 0 pops past everything it has; entries with tag-1 data stay
+        tlog.pops.ref().send(TLogPopRequest(20, 0), client)
+        await fl.delay(0.05)
+        assert [e[0] for e in tlog.entries] == [10, 20]
+        tlog.pops.ref().send(TLogPopRequest(10, 1), client)
+        await fl.delay(0.05)
+        assert [e[0] for e in tlog.entries] == [20]
+        tlog.pops.ref().send(TLogPopRequest(20, 1), client)
+        await fl.delay(0.05)
+        assert tlog.entries == []
+        return True
+
+    t = s.spawn(main())
+    assert s.run(until=t, timeout_time=30)
+
+
+def test_lock_waits_for_inflight_fsync(env):
+    """A commit accepted but not yet fsynced when the lock arrives must
+    be covered by the lock's end_version — otherwise the commit could be
+    acked to a client after recovery chose a lower end (code review r3:
+    acked-data loss)."""
+    s, tlog, client = env
+
+    async def main():
+        f = tlog.commits.ref().get_reply(
+            TLogCommitRequest(0, 10, (_tm(0, b"a", b"1"),)), client)
+        # lock races the in-flight fsync
+        lock = await tlog.locks.ref().get_reply(TLogLockRequest(), client)
+        assert lock.end_version == 10
+        assert await f == 10  # the ack and the lock agree
+        return True
+
+    t = s.spawn(main())
+    assert s.run(until=t, timeout_time=30)
+
+
+def test_lock_wakes_parked_commit_waiter(env):
+    """A reordered push parked on queue_version must fail out with
+    tlog_stopped when the lock arrives, not hang forever (code review
+    r3: the gap will never be filled by a dead proxy)."""
+    s, tlog, client = env
+
+    async def main():
+        # later batch arrives first and parks awaiting prev_version=10
+        f2 = tlog.commits.ref().get_reply(
+            TLogCommitRequest(10, 20, (_tm(0, b"b", b"2"),)), client)
+        await fl.delay(0.01)
+        await tlog.locks.ref().get_reply(TLogLockRequest(), client)
+        with pytest.raises(fl.FdbError) as ei:
+            await f2
+        assert ei.value.name == "tlog_stopped"
+        return True
+
+    t = s.spawn(main())
+    assert s.run(until=t, timeout_time=30)
+
+
+def test_lock_wakes_parked_peek(env):
+    """A long-poll peek already parked when the lock arrives returns
+    (empty) instead of blocking the storage drain forever (code review
+    r3)."""
+    s, tlog, client = env
+
+    async def main():
+        f = tlog.peeks.ref().get_reply(TLogPeekRequest(1, 0), client)
+        await fl.delay(0.01)
+        await tlog.locks.ref().get_reply(TLogLockRequest(), client)
+        r = await f
+        assert r.entries == ()
+        return True
+
+    t = s.spawn(main())
+    assert s.run(until=t, timeout_time=30)
+
+
+def test_lock_stops_commits_keeps_peeks(env):
+    s, tlog, client = env
+
+    async def main():
+        await tlog.commits.ref().get_reply(
+            TLogCommitRequest(0, 10, (_tm(0, b"a", b"1"),)), client)
+        lock = await tlog.locks.ref().get_reply(TLogLockRequest(), client)
+        assert lock.end_version == 10
+        with pytest.raises(fl.FdbError) as ei:
+            await tlog.commits.ref().get_reply(
+                TLogCommitRequest(10, 20, (_tm(0, b"b", b"2"),)), client)
+        assert ei.value.name == "tlog_stopped"
+        # peeks still served, and return immediately even past the end
+        r = await tlog.peeks.ref().get_reply(TLogPeekRequest(1, 0), client)
+        assert [v for v, _ in r.entries] == [10]
+        r2 = await tlog.peeks.ref().get_reply(TLogPeekRequest(11, 0), client)
+        assert r2.entries == ()
+        return True
+
+    t = s.spawn(main())
+    assert s.run(until=t, timeout_time=30)
