@@ -19,6 +19,8 @@ from .. import flow
 from ..flow import Future, TaskPriority, error
 from ..rpc import NetworkRef, SimProcess
 from ..server import atomic as _atomic
+from ..server.cluster_controller import \
+    OpenDatabaseRequest as _OpenDatabaseRequest
 from ..server.types import (ADD_VALUE, AND, APPEND_IF_FITS, ATOMIC_OPS,
                             BYTE_MAX, BYTE_MIN, CLEAR_RANGE,
                             COMPARE_AND_CLEAR, CommitRequest, KeySelector,
@@ -35,7 +37,22 @@ _ATOMIC_APPLY = {
 }
 
 RETRYABLE = {"not_committed", "transaction_too_old", "future_version",
-             "broken_promise", "commit_unknown_result", "timed_out"}
+             "broken_promise", "commit_unknown_result", "timed_out",
+             "tlog_stopped", "coordinators_changed"}
+
+# errors that mean our picture of the cluster may be stale: re-fetch the
+# ServerDBInfo before retrying (ref: the client reconnecting through
+# MonitorLeader / refreshing the location cache on wrong_shard_server)
+REFRESH_ERRORS = {"broken_promise", "commit_unknown_result", "tlog_stopped",
+                  "coordinators_changed", "wrong_shard_server"}
+
+
+REQUEST_TIMEOUT = 5.0  # seconds; a hung role surfaces as retryable
+                       # timed_out (ref: failure-monitored getReply)
+
+
+def _rpc(fut: Future) -> Future:
+    return flow.timeout_error(fut, REQUEST_TIMEOUT)
 
 
 def _next_key(k: bytes) -> bytes:
@@ -43,22 +60,57 @@ def _next_key(k: bytes) -> bytes:
 
 
 class Database:
-    """Handle to the cluster (ref: Database/Cluster in NativeAPI)."""
+    """Handle to the cluster (ref: Database/Cluster in NativeAPI). Holds
+    a cached ServerDBInfo fetched from the ClusterController's
+    openDatabase endpoint (ref: MonitorLeader + openDatabase handshake);
+    reads route through the shard map, commits through the proxies."""
 
-    def __init__(self, process: SimProcess, grv_ref: NetworkRef,
-                 commit_ref: NetworkRef, storage_get: NetworkRef,
-                 storage_range: NetworkRef, storage_key: NetworkRef = None,
-                 storage_watch: NetworkRef = None):
+    def __init__(self, process: SimProcess, cluster_ref: NetworkRef):
         self.process = process
-        self.grv_ref = grv_ref
-        self.commit_ref = commit_ref
-        self.storage_get = storage_get
-        self.storage_range = storage_range
-        self.storage_key = storage_key
-        self.storage_watch = storage_watch
+        self.cluster_ref = cluster_ref
+        self._info = None
+
+    async def info(self):
+        if self._info is None:
+            self._info = await self.cluster_ref.get_reply(
+                _OpenDatabaseRequest(-1), self.process)
+        return self._info
+
+    async def refresh(self) -> None:
+        """Long-poll the CC for a newer picture (after a failure, this
+        resolves once recovery has produced one)."""
+        known = self._info.seq if self._info is not None else -1
+        self._info = await self.cluster_ref.get_reply(
+            _OpenDatabaseRequest(known), self.process)
+
+    async def proxy(self):
+        info = await self.info()
+        return info.proxies[flow.g_random.random_int(
+            0, len(info.proxies))]
+
+    async def shard_for(self, key: bytes):
+        info = await self.info()
+        return info.storages[_shard_index(info.storages, key)]
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
+
+
+def _shard_index(storages, key: bytes) -> int:
+    """Last shard whose begin <= key (storages sorted by begin)."""
+    for i in range(len(storages) - 1, -1, -1):
+        if key >= storages[i].begin:
+            return i
+    return 0
+
+
+def _overlapping_shards(storages, begin: bytes, end: bytes):
+    out = []
+    for s in storages:
+        s_end = s.end
+        if (s_end is None or begin < s_end) and s.begin < end:
+            out.append(s)
+    return out
 
 
 class Transaction:
@@ -82,7 +134,8 @@ class Transaction:
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            reply = await self.db.grv_ref.get_reply(None, self.db.process)
+            proxy = await self.db.proxy()
+            reply = await _rpc(proxy.grvs.get_reply(None, self.db.process))
             self._read_version = reply.version
         return self._read_version
 
@@ -102,8 +155,9 @@ class Transaction:
         if found:
             return val
         version = await self.get_read_version()
-        return await self.db.storage_get.get_reply(
-            StorageGetRequest(key, version), self.db.process)
+        shard = await self.db.shard_for(key)
+        return await _rpc(shard.gets.get_reply(
+            StorageGetRequest(key, version), self.db.process))
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
         if not snapshot:
@@ -117,10 +171,35 @@ class Transaction:
 
     async def get_key(self, selector: KeySelector,
                       snapshot: bool = False) -> bytes:
-        """Resolve a key selector (ref: Transaction::getKey)."""
+        """Resolve a key selector, walking across shard boundaries when
+        the offset leaves the anchor shard (ref: Transaction::getKey /
+        NativeAPI getKey readThrough iteration)."""
         version = await self.get_read_version()
-        resolved = await self.db.storage_key.get_reply(
-            StorageGetKeyRequest(selector, version), self.db.process)
+        info = await self.db.info()
+        storages = info.storages
+        i = _shard_index(storages, selector.key)
+        sel = selector
+        while True:
+            key, leftover = await _rpc(storages[i].get_keys.get_reply(
+                StorageGetKeyRequest(sel, version), self.db.process))
+            if leftover == 0:
+                resolved = key
+                break
+            if leftover < 0:
+                if i == 0:
+                    resolved = b""
+                    break
+                i -= 1
+                # the |leftover|-th present key left of the boundary:
+                # anchor "last key < boundary", advance leftover+1
+                sel = KeySelector(storages[i + 1].begin, False, leftover + 1)
+            else:
+                if i == len(storages) - 1:
+                    resolved = b"\xff"
+                    break
+                i += 1
+                # the leftover-th present key right of the boundary
+                sel = KeySelector(storages[i].begin, False, leftover)
         if not snapshot:
             lo = min(resolved, selector.key)
             hi = max(resolved, selector.key)
@@ -137,16 +216,14 @@ class Transaction:
         if begin >= end:
             return []
         version = await self.get_read_version()
-        # With no RYW overlay in the range the storage server honors the
+        # With no RYW overlay in the range the storage servers honor the
         # caller's limit/reverse directly; an overlay (clears/writes/
         # atomics) can remove or add rows, so fetch the full range and
         # merge (ref: RYWIterator reads through the WriteMap instead).
         has_overlay = bool(self._cleared or self._write_order or self._ops)
-        base = await self.db.storage_range.get_reply(
-            StorageGetRangeRequest(begin, end, version,
-                                   (1 << 20) if has_overlay else limit,
-                                   False if has_overlay else reverse),
-            self.db.process)
+        base = await self._fetch_range(
+            begin, end, version, (1 << 20) if has_overlay else limit,
+            False if has_overlay else reverse)
         # overlay uncommitted writes (ref: RYWIterator merge)
         merged: Dict[bytes, bytes] = {k: v for k, v in base}
         for b, e in self._cleared:
@@ -166,8 +243,9 @@ class Transaction:
                 val = merged.get(k)
                 if val is None and k not in self._writes and \
                         not any(b <= k < e for b, e in self._cleared):
-                    val = await self.db.storage_get.get_reply(
-                        StorageGetRequest(k, version), self.db.process)
+                    shard = await self.db.shard_for(k)
+                    val = await _rpc(shard.gets.get_reply(
+                        StorageGetRequest(k, version), self.db.process))
                 for op, param in ops:
                     val = _ATOMIC_APPLY[op](val, param)
                 if val is None:
@@ -188,6 +266,27 @@ class Transaction:
                     self._read_conflicts.append((begin, _next_key(out[-1][0])))
             else:
                 self._read_conflicts.append((begin, end))
+        return out
+
+    async def _fetch_range(self, begin: bytes, end: bytes, version: int,
+                           limit: int, reverse: bool):
+        """Fan a range read across the shards it overlaps, honoring the
+        limit shard by shard (ref: NativeAPI getRange iterating the
+        location cache)."""
+        info = await self.db.info()
+        shards = _overlapping_shards(info.storages, begin, end)
+        if reverse:
+            shards = shards[::-1]
+        out: List[Tuple[bytes, bytes]] = []
+        for s in shards:
+            b = max(begin, s.begin)
+            e = end if s.end is None else min(end, s.end)
+            part = await _rpc(s.ranges.get_reply(
+                StorageGetRangeRequest(b, e, version, limit - len(out),
+                                       reverse), self.db.process))
+            out.extend(part)
+            if len(out) >= limit:
+                break
         return out
 
     # -- writes ---------------------------------------------------------
@@ -266,7 +365,8 @@ class Transaction:
                             tuple(self._write_conflicts),
                             tuple(self._mutations))
         try:
-            reply = await self.db.commit_ref.get_reply(req, self.db.process)
+            proxy = await self.db.proxy()
+            reply = await _rpc(proxy.commits.get_reply(req, self.db.process))
         except flow.FdbError as e:
             for _k, f in self._watches:
                 if not f.is_ready:
@@ -286,23 +386,34 @@ class Transaction:
                                  self.committed_batch_index or 0)
 
     def _arm_watches(self, version: int) -> None:
-        """Wire pending watches to storage at the commit version."""
-        for key, f in self._watches:
+        """Wire pending watches to their shards at the commit version."""
+        watches, self._watches = self._watches, []
+        if watches:
+            flow.spawn(self._arm_watches_async(watches, version),
+                       TaskPriority.DEFAULT_ENDPOINT)
+
+    async def _arm_watches_async(self, watches, version: int) -> None:
+        for key, f in watches:
             if f.is_ready:
                 continue
-            storage_fut = self.db.storage_watch.get_reply(
+            shard = await self.db.shard_for(key)
+            storage_fut = shard.watches.get_reply(
                 StorageWatchRequest(key, version), self.db.process)
             storage_fut.on_ready(
                 lambda sf, f=f: (f.send(sf.get()) if not sf.is_error
                                  else f.send_error(sf.exception()))
                 if not f.is_ready else None)
-        self._watches = []
 
     # -- retry loop -----------------------------------------------------
     async def on_error(self, e: BaseException) -> None:
-        """(ref: Transaction::onError :2956 — backoff and reset)"""
+        """(ref: Transaction::onError :2956 — backoff and reset; a
+        failure that implies a stale cluster picture re-fetches the
+        ServerDBInfo first, which long-polls across an in-flight
+        recovery)"""
         if not (isinstance(e, flow.FdbError) and e.name in RETRYABLE):
             raise e
+        if e.name in REFRESH_ERRORS:
+            await self.db.refresh()
         await flow.delay(0.001 + flow.g_random.random01() * 0.01,
                          TaskPriority.DEFAULT_ENDPOINT)
         self.reset()

@@ -12,6 +12,7 @@ from .actors import (
     NotifiedVersion,
     PromiseStream,
     all_of,
+    catch_errors,
     first_of,
     timeout,
     timeout_error,
@@ -26,7 +27,8 @@ __all__ = [
     "Future", "Promise", "Task", "error_future", "ready_future",
     "Scheduler", "TaskPriority", "delay", "g", "now", "set_scheduler", "spawn",
     "ActorCollection", "AsyncTrigger", "AsyncVar", "FlowLock", "FutureStream",
-    "NotifiedVersion", "PromiseStream", "all_of", "first_of", "timeout",
+    "NotifiedVersion", "PromiseStream", "all_of", "catch_errors",
+    "first_of", "timeout",
     "timeout_error", "wait_for_all",
     "DeterministicRandom", "buggify", "g_random", "set_seed",
     "SERVER_KNOBS", "Knobs", "make_server_knobs", "reset_server_knobs",

@@ -70,6 +70,20 @@ def first_of(*futures: Future) -> Future:
     return out
 
 
+def catch_errors(fut: Future) -> Future:
+    """Future of the input future itself once settled — never errors
+    (ref: genericactors errorOr / waitForAllReady): callers inspect
+    is_error/get on the settled inner future."""
+    out = Future()
+
+    def on_ready(f: Future):
+        if not out.is_ready:
+            out.send(f)
+
+    fut.on_ready(on_ready)
+    return out
+
+
 def timeout(fut: Future, seconds: float, default: Any = None,
             priority: int = TaskPriority.DEFAULT_ENDPOINT) -> Future:
     """Value of `fut`, or `default` after `seconds` (ref: genericactors timeout)."""
@@ -185,6 +199,22 @@ class NotifiedVersion:
         f = Future()
         self._waiters.append((version, f))
         return f
+
+    def rollback(self, version: int) -> None:
+        """Epoch recovery rewound this counter: waiters at or below the
+        new value fire; higher waiters came from requests whose read
+        versions the recovery invalidated — they error with
+        transaction_too_old so their clients retry with a fresh snapshot
+        (ref: storageserver rollback semantics)."""
+        self._version = version
+        waiters, self._waiters = self._waiters, []
+        for v, f in waiters:
+            if f.is_ready:
+                continue
+            if v <= version:
+                f.send(version)
+            else:
+                f.send_error(error("transaction_too_old"))
 
 
 class FutureStream:

@@ -90,6 +90,19 @@ class RequestStream:
         """Future of the next (request, reply) pair (ref: waitNext)."""
         return self.stream.stream.pop()
 
+    def close(self) -> None:
+        """Deregister the endpoint: later requests break with
+        broken_promise, exactly like a closed connection, and requests
+        already queued but never popped break too (ref: endpoint removal
+        from the EndpointMap when a role's actors die)."""
+        self.endpoint.process._streams.pop(self.endpoint.token, None)
+        q = self.stream.stream._queue
+        while q:
+            item = q.popleft()
+            if isinstance(item, tuple) and len(item) == 2 and \
+                    item[1] is not None:
+                item[1].send_error(error("broken_promise"))
+
 
 class NetworkRef:
     """Client handle to a remote RequestStream (ref: RequestStream<T> as

@@ -1,12 +1,15 @@
-"""SimCluster: the whole transaction subsystem on one deterministic loop.
+"""SimCluster: the whole cluster on one deterministic loop — workers,
+coordinators, ClusterController, recruitment, recovery, and faults.
 
 Reference: fdbserver/SimulatedCluster.actor.cpp setupSimulatedSystem
-(:1078) — build simulated processes, start role actors on them, hand
-back client handles; the same role code would run on real transports in
-production (the INetwork seam). Fault API surfaces the sim2 primitives
-(kill/clog/reboot) for workload tests; the TLog and storage roles keep
-their state on the machines' simulated disks, so a rebooted role
-recovers it (ref: simulatedFDBDRebooter, restartSimulatedSystem).
+(:1078) — build simulated machines with workers, start coordination and
+the cluster controller, and let recruitment bring up the transaction
+subsystem exactly the way a real cluster boots (§3.4 call stack: worker
+registration -> leader election -> masterCore recovery). Kills go
+through the sim network's process-kill semantics; killed workers
+auto-reboot after a delay (ref: simulatedFDBDRebooter,
+SimulatedCluster.actor.cpp:194) and recover their disk stores, so the
+recovery state machine — not test scaffolding — heals the cluster.
 """
 
 from __future__ import annotations
@@ -15,93 +18,127 @@ from typing import Optional
 
 from .. import flow
 from ..rpc import SimNetwork
-from .kvstore import KeyValueStoreMemory
-from .master import Master
-from .proxy import Proxy
-from .resolver_role import Resolver
-from .storage import StorageServer
-from .tlog import TLog
+from .cluster_controller import ClusterConfig, ClusterController
+from .coordination import Coordinator
+from .worker import RegisterWorkerRequest, Worker
+
+REBOOT_DELAY = 0.5   # seconds before a killed worker restarts
 
 
 class SimCluster:
-    """Single-region, single-proxy minimum slice; grows toward the full
-    recruitment flow (ClusterController/recovery) in later stages."""
-
     def __init__(self, seed: int = 0, conflict_backend: str = "python",
                  start_time: float = 0.0, n_resolvers: int = 1,
                  durable: bool = False,
-                 storage_lag_versions: Optional[int] = None):
+                 storage_lag_versions: Optional[int] = None,
+                 n_proxies: int = 1, n_logs: int = 1, n_storage: int = 1,
+                 n_workers: Optional[int] = None, n_coordinators: int = 1,
+                 auto_reboot: bool = True):
         flow.set_seed(seed)
         self.sched = flow.Scheduler(start_time=start_time, virtual=True)
         flow.set_scheduler(self.sched)
         self.net = SimNetwork(self.sched, flow.g_random)
-        self.conflict_backend = conflict_backend
         self.durable = durable
+        self.auto_reboot = auto_reboot
+        self.conflict_backend = conflict_backend
         self.storage_lag_versions = storage_lag_versions
+        self.config = ClusterConfig(n_proxies=n_proxies,
+                                    n_resolvers=n_resolvers,
+                                    n_logs=n_logs, n_storage=n_storage,
+                                    conflict_backend=conflict_backend,
+                                    durable=durable)
 
-        p = self.net.new_process
-        self.master = Master(p("master", machine="m1"))
-        self.resolvers = [
-            Resolver(p(f"resolver{i}", machine=f"m2.{i}"),
-                     backend=conflict_backend)
-            for i in range(n_resolvers)]
-        self.resolver = self.resolvers[0]
-        # evenly spaced single-byte split points (rebalancing arrives with
-        # the resolutionBalancing equivalent)
-        splits = [bytes([(i * 256) // n_resolvers])
-                  for i in range(1, n_resolvers)]
-        self.tlog = self._make_tlog(p("tlog", machine="m3"))
-        self.proxy = Proxy(p("proxy", machine="m1"),
-                           self.master.version_requests.ref(),
-                           [r.resolves.ref() for r in self.resolvers],
-                           [self.tlog.commits.ref()],
-                           resolver_splits=splits)
-        self.storage = self._make_storage(p("storage", machine="m4"))
-        for role in (self.master, *self.resolvers, self.tlog, self.proxy,
-                     self.storage):
-            role.start()
+        # coordinators (ref: coordinationServer)
+        self.coordinators = []
+        for i in range(n_coordinators):
+            c = Coordinator(self.net.new_process(f"coord{i}",
+                                                 machine=f"coord{i}"))
+            c.start()
+            self.coordinators.append(c)
 
-    # -- role construction (also used by reboots) -----------------------
-    def _make_tlog(self, process) -> TLog:
-        disk = self.net.disk(process.machine) if self.durable else None
-        return TLog(process, disk=disk)
+        # the cluster controller (single candidate; contested elections
+        # are exercised in the coordination unit tests)
+        self.cc = ClusterController(
+            self.net.new_process("cc", machine="cc"),
+            [(c.reads.ref(), c.writes.ref(), c.candidacies.ref())
+             for c in self.coordinators],
+            self.config)
+        self.cc.start()
 
-    def _make_storage(self, process) -> StorageServer:
-        kv = None
-        if self.durable:
-            kv = KeyValueStoreMemory(self.net.disk(process.machine),
-                                     "storage", owner=process)
-        return StorageServer(process, self.tlog.peeks.ref(), kv=kv,
-                             tlog_pop=self.tlog.pops.ref(),
-                             durability_lag_versions=self.storage_lag_versions)
+        # workers, one per simulated machine
+        if n_workers is None:
+            n_workers = max(4, n_logs + 1, n_storage, n_resolvers)
+        self.n_workers = n_workers
+        self.workers: dict = {}
+        for i in range(n_workers):
+            self._start_worker(f"worker{i}", f"w{i}")
 
-    # -- faults ---------------------------------------------------------
-    def reboot_tlog(self) -> TLog:
-        """Kill the tlog process and restart the role from its disk
-        files. Note: the proxy holds the OLD commit endpoint until a
-        recovery re-wires it — restart tests talk to the new role
-        directly, full re-recruitment arrives with the master recovery
-        state machine."""
-        proc = self.net.reboot("tlog")
-        self.tlog = self._make_tlog(proc)
-        self.tlog.start()
-        return self.tlog
+    # -- worker lifecycle ------------------------------------------------
+    def _start_worker(self, name: str, machine: str) -> Worker:
+        proc = self.net.new_process(name, machine=machine)
+        w = Worker(proc, self.net, durable=self.durable,
+                   dbinfo=self.cc.dbinfo,
+                   conflict_backend=self.conflict_backend,
+                   storage_lag_versions=self.storage_lag_versions)
+        w.start()
+        self.workers[name] = w
+        flow.spawn(self._register_worker(w), name=f"{name}.register")
+        if self.auto_reboot:
+            proc.on_kill(lambda: flow.spawn(
+                self._reboot_worker(name, machine),
+                name=f"{name}.rebooter"))
+        return w
 
-    def reboot_storage(self) -> StorageServer:
-        proc = self.net.reboot("storage")
-        self.storage = self._make_storage(proc)
-        self.storage.start()
-        return self.storage
+    async def _register_worker(self, w: Worker) -> None:
+        logs, storages = await w.recover_stores()
+        await self.cc.registrations.ref().get_reply(
+            RegisterWorkerRequest(w.process.name, w.process.machine, w,
+                                  logs, storages), w.process)
 
+    async def _reboot_worker(self, name: str, machine: str) -> None:
+        """(ref: simulatedFDBDRebooter — the machine comes back after a
+        delay and its worker recovers whatever the disk kept)"""
+        await flow.delay(REBOOT_DELAY)
+        if name in self.net.processes and self.net.processes[name].alive:
+            return
+        self._start_worker(name, machine)
+
+    # -- faults ----------------------------------------------------------
+    def kill_worker(self, name: str) -> None:
+        self.net.kill(self.net.processes[name])
+
+    def _find_worker_of(self, prefix: str) -> Optional[str]:
+        """Name of a live worker hosting a role whose name starts with
+        `prefix` in the CURRENT epoch."""
+        epoch = self.cc.dbinfo.get().epoch
+        for name, w in self.workers.items():
+            if not w.process.alive:
+                continue
+            for role_name in w.roles:
+                if role_name.startswith(prefix) and \
+                        (f"-e{epoch}-" in role_name
+                         or not role_name.startswith(("proxy", "resolver",
+                                                      "tlog"))):
+                    return name
+        return None
+
+    def kill_role(self, kind: str) -> str:
+        """Kill the worker hosting a role of this kind ('tlog', 'proxy',
+        'resolver', 'storage'); returns the worker name killed."""
+        prefix = {"tlog": "tlog-e", "proxy": "proxy-e",
+                  "resolver": "resolver-e", "storage": "storage-"}[kind]
+        name = self._find_worker_of(prefix)
+        if name is None:
+            raise KeyError(f"no live worker hosts a {kind}")
+        self.kill_worker(name)
+        return name
+
+    # -- clients ---------------------------------------------------------
     def client(self, name: str = "client", machine: str = ""):
         from ..client import Database  # avoid package-init cycle
         proc = self.net.new_process(name, machine or name)
-        return Database(proc, self.proxy.grvs.ref(), self.proxy.commits.ref(),
-                        self.storage.gets.ref(), self.storage.ranges.ref(),
-                        self.storage.get_keys.ref(),
-                        self.storage.watches.ref())
+        return Database(proc, self.cc.open_db.ref())
 
-    # -- running --------------------------------------------------------
+    # -- running ---------------------------------------------------------
     def run(self, coro, timeout_time: Optional[float] = None):
         """Drive the loop until the given actor completes."""
         task = flow.spawn(coro, name="test-main")

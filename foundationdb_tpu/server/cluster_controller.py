@@ -1,0 +1,249 @@
+"""ClusterController: the elected brain — worker registry, recruitment,
+ServerDBInfo broadcast, failure monitoring, master lifecycle.
+
+Reference: fdbserver/ClusterController.actor.cpp — leader-elected via
+the coordinators (LeaderElection.actor.cpp:78), keeps the worker
+registry (registrationClient handshakes), recruits the transaction
+subsystem per configuration (clusterRecruitFromConfiguration :1593),
+broadcasts ServerDBInfo, runs the failure detection server, and
+restarts the master — which re-runs the whole epoch recovery — whenever
+any transaction-subsystem role fails (masterProcessFailure paths).
+Failure detection here is the waitFailure heartbeat pattern
+(fdbserver/WaitFailure.actor.cpp): ping every critical process; a
+broken or timed-out ping is a failure.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+from .. import flow
+from ..flow import AsyncVar, TaskPriority, error
+from ..rpc import RequestStream, SimProcess
+from .coordination import CoordinatedState, elect_leader
+from .dbinfo import EMPTY_DBINFO, FULLY_RECOVERED, ServerDBInfo, StorageRefs
+from .master import MasterRecovery
+from .worker import RegisterWorkerRequest
+
+
+class ClusterConfig(NamedTuple):
+    """(ref: DatabaseConfiguration — the subset this slice understands)"""
+
+    n_proxies: int = 1
+    n_resolvers: int = 1
+    n_logs: int = 1            # log replication factor
+    n_storage: int = 1         # storage shards
+    conflict_backend: str = "python"
+    durable: bool = False
+
+
+class OpenDatabaseRequest(NamedTuple):
+    """Client handshake: long-polls until the broadcast sequence exceeds
+    known_seq and recovery is complete (ref: openDatabase in
+    ClusterController + MonitorLeader client polling)."""
+
+    known_seq: int
+
+
+class _WorkerInfo(NamedTuple):
+    name: str
+    machine: str
+    worker: object
+    roles: Tuple[str, ...]
+
+
+class ClusterController:
+    def __init__(self, process: SimProcess, coordinators,
+                 config: ClusterConfig):
+        self.process = process
+        self.config = config
+        self.coordinators = coordinators   # [(reads, writes, candidacy)]
+        self.dbinfo = AsyncVar(EMPTY_DBINFO)
+        self.workers: dict = {}            # name -> _WorkerInfo
+        self.log_stores: dict = {}         # store name -> LogRefs (live)
+        self.registrations = RequestStream(process)
+        self.open_db = RequestStream(process)
+        self._recovery: Optional[MasterRecovery] = None
+        self._recovery_task = None
+        self._storage_objs: dict = {}      # name -> StorageServer (registry)
+        self._rr = 0                       # recruitment round-robin
+        self._seq = 0                      # dbinfo broadcast counter
+        self._actors = flow.ActorCollection()
+
+    def publish(self, info: ServerDBInfo) -> None:
+        """Broadcast a new ServerDBInfo with a fresh sequence number —
+        clients long-poll on the sequence so same-epoch updates (e.g. a
+        rebooted storage's new endpoints) also reach them."""
+        self._seq += 1
+        self.dbinfo.set(info._replace(seq=self._seq))
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        for coro, name in ((self._run(), "run"),
+                           (self._registration_loop(), "register"),
+                           (self._open_db_loop(), "openDatabase")):
+            self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
+                                        name=f"{self.process.name}.{name}"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    async def _run(self) -> None:
+        await elect_leader([c[2] for c in self.coordinators],
+                           b"\xff/clusterLeader", self.process.name,
+                           self.process)
+        cstate = CoordinatedState(
+            [(c[0], c[1]) for c in self.coordinators], self.process)
+        while True:
+            await self._wait_for_workers()
+            self._recovery = MasterRecovery(self.process, self, cstate,
+                                            self.config)
+            self._recovery_task = flow.spawn(
+                self._recovery.run(), TaskPriority.CLUSTER_CONTROLLER,
+                name=f"master-recovery-e{self._recovery.epoch}")
+            # wait for recovery to fail, or for any critical role to die
+            # after recovery completes (ref: masterFailure handling)
+            failed = await self._watch_epoch(self._recovery_task)
+            flow.TraceEvent("MasterEpochFailed", self.process.name).detail(
+                Reason=failed).log()
+            self._recovery_task.cancel()
+            if self._recovery.master is not None:
+                self._recovery.master.stop()
+            self._cancel_old_roles()
+
+    async def _wait_for_workers(self) -> None:
+        need = max(self.config.n_logs, 1)
+        while len(self.workers) < need:
+            await flow.delay(0.05, TaskPriority.CLUSTER_CONTROLLER)
+
+    async def _watch_epoch(self, recovery_task) -> str:
+        """Resolve when this epoch is over: recovery errored, or a
+        critical process died post-recovery."""
+        # phase 1: wait for full recovery (or recovery failure)
+        while True:
+            info = self.dbinfo.get()
+            if info.recovery_state == FULLY_RECOVERED:
+                break
+            got = await flow.first_of(flow.catch_errors(recovery_task),
+                                      self.dbinfo.on_change())
+            if got[0] == 0:
+                inner = got[1]
+                if inner.is_error:
+                    return f"recovery_error:{inner.exception()}"
+                return "recovery_returned"
+        # phase 2: monitor the recruited processes (ref: waitFailure
+        # heartbeats; the sim checks liveness directly — a ping RPC to a
+        # dead process would report the same thing a beat later)
+        while True:
+            for proc in self._recovery.critical_procs:
+                if not proc.alive:
+                    return f"process_failed:{proc.name}"
+            await flow.delay(0.1, TaskPriority.FAILURE_MONITOR)
+
+    def _cancel_old_roles(self) -> None:
+        """Cancel surviving roles of the failed epoch so stale proxies
+        and resolvers stop answering (ref: the old generation's actors
+        dying with the master's lifetime)."""
+        epoch = self._recovery.epoch if self._recovery else 0
+        for wi in self.workers.values():
+            w = wi.worker
+            for name, role in list(w.roles.items()):
+                if name.startswith((f"proxy-e{epoch}", f"resolver-e{epoch}")):
+                    stop = getattr(role, "stop", None)
+                    if stop is not None:
+                        stop()
+                    else:
+                        role._actors.cancel_all()
+                    del w.roles[name]
+
+    # -- worker registry -------------------------------------------------
+    async def _registration_loop(self):
+        while True:
+            req, reply = await self.registrations.pop()
+            assert isinstance(req, RegisterWorkerRequest)
+            self.workers[req.name] = _WorkerInfo(req.name, req.machine,
+                                                 req.worker, ())
+            for lr in req.recovered_logs:
+                self.log_stores[lr.store] = lr
+            if req.recovered_storages:
+                for r in req.recovered_storages:
+                    obj = req.worker.roles.get(r.name)
+                    if obj is not None:
+                        self._storage_objs[r.name] = obj
+                self._merge_storages(req.recovered_storages)
+            reply.send(None)
+
+    def _merge_storages(self, refs: Tuple[StorageRefs, ...]) -> None:
+        """A rebooted worker re-registered storage shards: swap the new
+        endpoints into the shard map and re-broadcast."""
+        info = self.dbinfo.get()
+        by_name = {s.name: s for s in info.storages}
+        for r in refs:
+            by_name[r.name] = r
+        storages = tuple(sorted(by_name.values(), key=lambda s: s.begin))
+        self.publish(info._replace(storages=storages))
+
+    # -- recruitment helpers (used by MasterRecovery) -------------------
+    def pick_workers(self, n: int, role: str):
+        """Round-robin over live workers (ref: fitness-ranked selection
+        in clusterRecruitFromConfiguration — the sim has one process
+        class, so rotation stands in for fitness)."""
+        live = [wi.worker for wi in self.workers.values()
+                if wi.worker.process.alive]
+        if not live:
+            raise error("no_more_servers")
+        out = []
+        for _ in range(n):
+            out.append(live[self._rr % len(live)])
+            self._rr += 1
+        return out
+
+    def storage_splits(self) -> Tuple[bytes, ...]:
+        info = self.dbinfo.get()
+        if info.storages:
+            return tuple(s.begin for s in info.storages[1:])
+        return tuple(bytes([(i * 256) // self.config.n_storage])
+                     for i in range(1, self.config.n_storage))
+
+    def recruit_initial_storages(self) -> None:
+        """First boot only: create the shard set (ref: the initial
+        `configure new` creating storage servers via DD; static shards
+        here until DataDistribution arrives)."""
+        info = self.dbinfo.get()
+        if info.storages:
+            return
+        splits = list(self.storage_splits())
+        bounds = [b""] + splits + [None]
+        workers = self.pick_workers(self.config.n_storage, role="storage")
+        storages = []
+        for i, w in enumerate(workers):
+            refs = w.recruit_storage(f"storage-{i}", i, bounds[i],
+                                     bounds[i + 1])
+            storages.append(refs)
+            self._storage_objs[refs.name] = w.roles[refs.name]
+        self.publish(info._replace(storages=tuple(storages)))
+
+    def min_storage_version(self) -> int:
+        """Smallest pulled version across shards (drain progress for old
+        log cleanup)."""
+        info = self.dbinfo.get()
+        vs = []
+        for s in info.storages:
+            obj = self._storage_objs.get(s.name)
+            if obj is not None and obj.process.alive:
+                vs.append(obj.version.get())
+        return min(vs) if vs else 0
+
+    # -- client handshake -----------------------------------------------
+    async def _open_db_loop(self):
+        while True:
+            req, reply = await self.open_db.pop()
+            flow.spawn(self._serve_open_db(req, reply),
+                       TaskPriority.CLUSTER_CONTROLLER)
+
+    async def _serve_open_db(self, req: OpenDatabaseRequest, reply):
+        while True:
+            info = self.dbinfo.get()
+            if info.seq > req.known_seq and \
+                    info.recovery_state == FULLY_RECOVERED and info.storages:
+                reply.send(info)
+                return
+            await self.dbinfo.on_change()

@@ -1,0 +1,205 @@
+"""Coordination: generation registers, quorum coordinated state, and
+leader election.
+
+Reference: fdbserver/Coordination.actor.cpp (GenerationRegInterface —
+a per-coordinator two-field generation register), CoordinatedState
+.actor.cpp:60-197 (read / setExclusive with majority quorums: a reader
+picks a fresh generation, performs a quorum read that also raises each
+register's read-generation, then a quorum write commits at that
+generation; a competing writer with a newer generation makes the older
+one fail with coordinated_state_conflict), and LeaderElection.actor.cpp
+:78 (candidacy polling with majority nomination).
+
+The registers live in coordinator processes reached over the simulated
+network, so partitions/kills exercise the quorum logic for real. State
+is in-memory per coordinator process lifetime — the reference persists
+it via an OnDemandStore; killing a majority of coordinators here is
+cluster loss, same as the reference's guidance.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+from .. import flow
+from ..flow import TaskPriority, error
+from ..rpc import RequestStream, SimProcess
+
+
+class UniqueGeneration(NamedTuple):
+    """(ref: UniqueGeneration in CoordinationInterface.h — ordered by
+    (generation, uid) so concurrent readers with the same count still
+    totally order)."""
+
+    gen: int
+    uid: int
+
+
+ZERO_GEN = UniqueGeneration(0, 0)
+
+
+class GenRegReadRequest(NamedTuple):
+    key: bytes
+    gen: UniqueGeneration
+
+
+class GenRegReadReply(NamedTuple):
+    value: Optional[object]
+    gen: UniqueGeneration        # generation the value was written at
+    read_gen: UniqueGeneration   # the register's (raised) read generation
+
+
+class GenRegWriteRequest(NamedTuple):
+    key: bytes
+    gen: UniqueGeneration
+    value: object
+
+
+class GenRegWriteReply(NamedTuple):
+    gen: UniqueGeneration        # register's read gen (== req.gen on success)
+
+
+class CandidacyRequest(NamedTuple):
+    key: bytes
+    candidate: object            # opaque leader info, ordered by id
+    prev_change_id: int
+
+
+class CandidacyReply(NamedTuple):
+    leader: object
+    change_id: int
+
+
+class Coordinator:
+    """One coordination server (ref: coordinationServer,
+    Coordination.actor.cpp)."""
+
+    def __init__(self, process: SimProcess):
+        self.process = process
+        # generation register: key -> (value, write_gen, read_gen)
+        self._reg: dict = {}
+        # leader election register: key -> (leader, change_id)
+        self._leader: dict = {}
+        self.reads = RequestStream(process)
+        self.writes = RequestStream(process)
+        self.candidacies = RequestStream(process)
+        self._actors = flow.ActorCollection()
+
+    def start(self) -> None:
+        for coro, name in ((self._read_loop(), "genReads"),
+                           (self._write_loop(), "genWrites"),
+                           (self._leader_loop(), "leader")):
+            self._actors.add(flow.spawn(coro, TaskPriority.COORDINATION,
+                                        name=f"{self.process.name}.{name}"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    async def _read_loop(self):
+        while True:
+            req, reply = await self.reads.pop()
+            value, wgen, rgen = self._reg.get(req.key, (None, ZERO_GEN,
+                                                        ZERO_GEN))
+            if req.gen > rgen:
+                rgen = req.gen
+                self._reg[req.key] = (value, wgen, rgen)
+            reply.send(GenRegReadReply(value, wgen, rgen))
+
+    async def _write_loop(self):
+        while True:
+            req, reply = await self.writes.pop()
+            value, wgen, rgen = self._reg.get(req.key, (None, ZERO_GEN,
+                                                        ZERO_GEN))
+            if req.gen >= rgen and req.gen >= wgen:
+                self._reg[req.key] = (req.value, req.gen,
+                                      max(rgen, req.gen))
+                reply.send(GenRegWriteReply(req.gen))
+            else:
+                # a newer reader/writer got here first
+                reply.send(GenRegWriteReply(max(rgen, wgen)))
+
+    async def _leader_loop(self):
+        while True:
+            req, reply = await self.candidacies.pop()
+            cur, change = self._leader.get(req.key, (None, 0))
+            if cur is None or (req.candidate is not None
+                               and req.candidate < cur):
+                # smaller id wins (deterministic; ref: LeaderElection
+                # nominates the best candidate)
+                cur, change = req.candidate, change + 1
+                self._leader[req.key] = (cur, change)
+            reply.send(CandidacyReply(cur, change))
+
+
+class CoordinatedState:
+    """Majority-quorum client over the coordinators' generation
+    registers (ref: CoordinatedState.actor.cpp:60-197)."""
+
+    def __init__(self, coordinators, process: SimProcess,
+                 key: bytes = b"\xff/coordinatedState"):
+        self.coordinators = list(coordinators)  # [(reads, writes) refs]
+        self.process = process
+        self.key = key
+        self._gen = ZERO_GEN
+
+    def _fresh_gen(self) -> UniqueGeneration:
+        return UniqueGeneration(self._gen.gen + 1,
+                                flow.g_random.random_int(0, 1 << 30))
+
+    async def _quorum(self, futs):
+        """Wait until every attempt settles (sends to dead coordinators
+        error rather than hang in sim), then require a majority of
+        successes (ref: replicatedRead/Write quorum checks)."""
+        need = len(futs) // 2 + 1
+        settled = await flow.all_of(futs)  # catch_errors wrappers
+        oks = [f.get() for f in settled if not f.is_error]
+        if len(oks) < need:
+            raise error("coordinators_changed")
+        return oks
+
+    async def read(self):
+        """Quorum read, raising read generations so any older in-flight
+        write can no longer succeed (ref: replicatedRead)."""
+        g = self._fresh_gen()
+        futs = [flow.catch_errors(reads.get_reply(
+            GenRegReadRequest(self.key, g), self.process))
+            for reads, _w in self.coordinators]
+        replies = await self._quorum(futs)
+        best = max(replies, key=lambda r: r.gen)
+        max_rgen = max(r.read_gen for r in replies)
+        self._gen = max(g, max_rgen, best.gen)
+        return best.value
+
+    async def set_exclusive(self, value) -> None:
+        """Quorum write at the generation observed by the last read;
+        fails with coordinated_state_conflict if any newer reader or
+        writer intervened (ref: replicatedWrite + seq checks)."""
+        g = self._gen
+        futs = [flow.catch_errors(writes.get_reply(
+            GenRegWriteRequest(self.key, g, value), self.process))
+            for _r, writes in self.coordinators]
+        replies = await self._quorum(futs)
+        if any(r.gen > g for r in replies):
+            raise error("coordinated_state_conflict")
+
+
+async def elect_leader(candidacy_refs, key: bytes, candidate,
+                       process: SimProcess) -> None:
+    """Poll the coordinators until a majority nominate `candidate`
+    (ref: tryBecomeLeaderInternal, LeaderElection.actor.cpp:78).
+    Returns when elected; raises operation_failed if a different
+    candidate holds a majority."""
+    while True:
+        futs = [flow.catch_errors(ref.get_reply(
+            CandidacyRequest(key, candidate, 0), process))
+            for ref in candidacy_refs]
+        settled = await flow.all_of(futs)
+        replies = [f.get() for f in settled if not f.is_error]
+        votes: dict = {}
+        for r in replies:
+            votes[r.leader] = votes.get(r.leader, 0) + 1
+        need = len(candidacy_refs) // 2 + 1
+        if votes.get(candidate, 0) >= need:
+            return
+        for other, n in votes.items():
+            if other != candidate and n >= need:
+                raise error("operation_failed")
+        await flow.delay(0.05, TaskPriority.COORDINATION)

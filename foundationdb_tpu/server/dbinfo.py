@@ -1,0 +1,81 @@
+"""ServerDBInfo: the broadcast picture of the current transaction
+subsystem.
+
+Reference: fdbserver/ServerDBInfo.h — the ClusterController assembles a
+struct naming the master, proxies, resolvers, log system and recovery
+state, and broadcasts it to every worker; roles and clients act on
+changes (new epochs re-point storage pull loops and client endpoints).
+Here the broadcast seam is a flow AsyncVar owned by the
+ClusterController — the simulated stand-in for the CC's push RPC; a
+real transport would ship the same tuple as bytes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+# recovery states (ref: fdbserver/RecoveryState.h)
+UNINITIALIZED = "uninitialized"
+READING_CSTATE = "reading_coordinated_state"
+LOCKING_CSTATE = "locking_coordinated_state"
+RECRUITING = "recruiting_transaction_servers"
+ACCEPTING_COMMITS = "accepting_commits"
+FULLY_RECOVERED = "fully_recovered"
+
+
+class LogRefs(NamedTuple):
+    """One TLog replica's endpoints (ref: TLogInterface.h)."""
+
+    store: str            # durable store name, stable across reboots
+    machine: str
+    commits: object       # NetworkRef
+    peeks: object
+    pops: object
+    locks: object
+
+
+class LogSetInfo(NamedTuple):
+    """One log generation (ref: LogSystemConfig / OldTLogConf)."""
+
+    epoch: int
+    begin_version: int    # first version this generation may contain
+    end_version: int      # last version (locked gens; -1 = open)
+    logs: Tuple[LogRefs, ...]
+
+
+class ProxyRefs(NamedTuple):
+    """(ref: MasterProxyInterface.h)"""
+
+    name: str
+    grvs: object
+    commits: object
+    raw_committed: object = None   # getRawCommittedVersion (peer GRV)
+
+
+class StorageRefs(NamedTuple):
+    """A storage shard: tag + owned range + endpoints
+    (ref: StorageServerInterface.h + the keyServers map)."""
+
+    name: str
+    tag: int
+    begin: bytes
+    end: bytes            # b"" sentinel in `end` is not used; None = +inf
+    gets: object
+    ranges: object
+    get_keys: object
+    watches: object
+
+
+class ServerDBInfo(NamedTuple):
+    epoch: int
+    recovery_state: str
+    recovery_version: int
+    proxies: Tuple[ProxyRefs, ...]
+    logs: LogSetInfo                      # current generation
+    old_logs: Tuple[LogSetInfo, ...]      # locked gens still draining
+    storages: Tuple[StorageRefs, ...]     # shard map ordered by begin
+    seq: int = 0                          # broadcast sequence number
+
+
+EMPTY_DBINFO = ServerDBInfo(0, UNINITIALIZED, 0, (), LogSetInfo(0, 0, -1, ()),
+                            (), (), 0)

@@ -1,19 +1,31 @@
-"""Master role: commit-version authority.
+"""Master role: commit-version authority + the epoch recovery state
+machine.
 
-Reference: fdbserver/masterserver.actor.cpp `getVersion` (:875-940) —
-versions advance with real time (`version += VERSIONS_PER_SECOND * dt`,
-capped per request by MAX_READ_TRANSACTION_LIFE_VERSIONS) so that a
-version is also a coarse clock; each batch receives (prev_version,
-version) so downstream stages can sequence without gaps.
+Reference: fdbserver/masterserver.actor.cpp —
+  - `getVersion` (:875-940): versions advance with real time
+    (`version += VERSIONS_PER_SECOND * dt`, capped per request) so a
+    version is also a coarse clock; each batch receives
+    (prev_version, version) so downstream stages sequence without gaps.
+  - `masterCore` (:1212): the recovery phases — read the coordinated
+    state, end the previous epoch by locking its logs
+    (TagPartitionedLogSystem.actor.cpp:1265 epochEnd), recruit a new
+    transaction subsystem (recruitEverything :537), commit the new core
+    state exclusively (a competing newer master makes the write fail
+    with coordinated_state_conflict), broadcast the new ServerDBInfo,
+    and prove the pipeline live with a recovery transaction before
+    declaring FULLY_RECOVERED.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional, Tuple
 
 from .. import flow
-from ..flow import TaskPriority
+from ..flow import TaskPriority, error
 from ..rpc import RequestStream, SimProcess
+from . import dbinfo as dbi
+from .dbinfo import LogSetInfo, ServerDBInfo
+from .types import CommitRequest, TLogLockRequest
 
 VERSIONS_PER_SECOND = 1_000_000          # ref: Knobs.cpp VERSIONS_PER_SECOND
 MAX_VERSION_ADVANCE = 5_000_000          # cap per request (ref: :918)
@@ -24,7 +36,21 @@ class GetCommitVersionReply(NamedTuple):
     version: int
 
 
+class CoreState(NamedTuple):
+    """What survives in the coordinated state (ref: DBCoreState,
+    fdbserver/DBCoreState.h — enough to find and lock the previous
+    epoch's logs after any set of failures)."""
+
+    epoch: int
+    recovery_version: int                 # first version of this epoch
+    logs: Tuple[Tuple[str, str], ...]     # (store name, machine)
+    old_logs: Tuple[Tuple[int, int, int, Tuple[Tuple[str, str], ...]], ...]
+    # ^ (epoch, begin_version, end_version, stores) still draining
+
+
 class Master:
+    """The version authority (one per epoch)."""
+
     def __init__(self, process: SimProcess, recovery_version: int = 0):
         self.process = process
         self.version = recovery_version
@@ -37,6 +63,9 @@ class Master:
                                     TaskPriority.PROXY_GET_CONSISTENT_READ_VERSION,
                                     name=f"{self.process.name}.getVersion"))
         self.process.on_kill(self._actors.cancel_all)
+
+    def stop(self) -> None:
+        self._actors.cancel_all()
 
     def _next_version(self) -> GetCommitVersionReply:
         t = flow.now()
@@ -54,3 +83,174 @@ class Master:
         while True:
             _req, reply = await self.version_requests.pop()
             reply.send(self._next_version())
+
+
+class MasterRecovery:
+    """One epoch's recovery attempt + lifetime (ref: masterCore)."""
+
+    def __init__(self, process: SimProcess, cc, cstate, config):
+        self.process = process
+        self.cc = cc                      # ClusterController (registry)
+        self.cstate = cstate              # CoordinatedState client
+        self.config = config
+        self.master: Optional[Master] = None
+        self.epoch = 0
+        # processes whose death ends this epoch (ref: the master's
+        # waitFailure clients on proxies/resolvers/tlogs)
+        self.critical_procs: set = set()
+
+    def _trace(self, event: str, **details) -> None:
+        flow.TraceEvent(event, self.process.name).detail(**details).log()
+
+    async def run(self) -> None:
+        """Drive recovery to FULLY_RECOVERED, then serve versions until
+        cancelled (the CC cancels us and starts a successor on
+        failure)."""
+        cfg = self.config
+
+        # Phase 1: read the coordinated state (ref: masterCore phase
+        # READING_CSTATE via ReusableCoordinatedState)
+        self._set_state(dbi.READING_CSTATE)
+        prev: Optional[CoreState] = await self.cstate.read()
+
+        # Phase 2: end the previous epoch — lock its logs and find the
+        # recovery version (ref: epochEnd)
+        recovery_version = 0
+        old_log_sets: Tuple[LogSetInfo, ...] = ()
+        if prev is not None:
+            self._set_state(dbi.LOCKING_CSTATE)
+            recovery_version, locked = await self._epoch_end(prev)
+            old_log_sets = (LogSetInfo(prev.epoch, prev.recovery_version,
+                                       recovery_version, locked),)
+            # older generations still draining chain through
+            for oe, ob, oend, stores in prev.old_logs:
+                refs = tuple(r for r in (self.cc.log_stores.get(s)
+                                         for s, _m in stores)
+                             if r is not None)
+                old_log_sets += (LogSetInfo(oe, ob, oend, refs),)
+        self.epoch = (prev.epoch if prev is not None else 0) + 1
+
+        # Phase 3: recruit the new transaction subsystem
+        # (ref: recruitEverything :537)
+        self._set_state(dbi.RECRUITING)
+        self.master = Master(self.process, recovery_version=recovery_version)
+        self.master.start()
+        self.critical_procs = {self.process}
+        log_workers = self.cc.pick_workers(cfg.n_logs, role="tlog")
+        new_logs = []
+        new_log_stores = []
+        for i, w in enumerate(log_workers):
+            store = f"tlog-e{self.epoch}-{i}"
+            refs = w.recruit_tlog(store, recovery_version)
+            self.cc.log_stores[store] = refs
+            new_logs.append(refs)
+            new_log_stores.append((store, w.process.machine))
+            self.critical_procs.add(w.process)
+        res_workers = self.cc.pick_workers(cfg.n_resolvers, role="resolver")
+        resolver_refs = []
+        for i, w in enumerate(res_workers):
+            resolver_refs.append(w.recruit_resolver(
+                f"resolver-e{self.epoch}-{i}", recovery_version))
+            self.critical_procs.add(w.process)
+        resolver_splits = tuple(bytes([(i * 256) // cfg.n_resolvers])
+                                for i in range(1, cfg.n_resolvers))
+        self.cc.recruit_initial_storages()
+        storage_splits = self.cc.storage_splits()
+        proxy_workers = self.cc.pick_workers(cfg.n_proxies, role="proxy")
+        proxies = []
+        for i, w in enumerate(proxy_workers):
+            proxies.append(w.recruit_proxy(
+                f"proxy-e{self.epoch}-{i}",
+                self.master.version_requests.ref(),
+                resolver_refs, [r.commits for r in new_logs],
+                resolver_splits, storage_splits,
+                recovery_version))
+            self.critical_procs.add(w.process)
+        proxies = tuple(proxies)
+        # each proxy confirms GRVs with every other proxy (ref:
+        # getLiveCommittedVersion)
+        for i, w in enumerate(proxy_workers):
+            w.roles[f"proxy-e{self.epoch}-{i}"].set_peers(
+                [p.raw_committed for j, p in enumerate(proxies) if j != i])
+
+        # Phase 4: commit the new core state; a conflict means a newer
+        # master exists and this one must die (ref: trackTlogRecovery /
+        # cstate.write exclusivity)
+        old_for_cstate = tuple(
+            (ls.epoch, ls.begin_version, ls.end_version,
+             tuple((r.store, r.machine) for r in ls.logs))
+            for ls in old_log_sets)
+        await self.cstate.set_exclusive(CoreState(
+            self.epoch, recovery_version, tuple(new_log_stores),
+            old_for_cstate))
+
+        # Phase 5: broadcast the new picture; commits may now flow
+        info = ServerDBInfo(
+            self.epoch, dbi.ACCEPTING_COMMITS, recovery_version, proxies,
+            LogSetInfo(self.epoch, recovery_version, -1, tuple(new_logs)),
+            old_log_sets, self.cc.dbinfo.get().storages)
+        self.cc.publish(info)
+        self._trace("MasterRecoveryState", State=dbi.ACCEPTING_COMMITS,
+                    Epoch=self.epoch, RecoveryVersion=recovery_version)
+
+        # Phase 6: the recovery transaction proves the new pipeline live
+        # end-to-end (ref: the recovery txn in masterCore phase 5)
+        await proxies[0].commits.get_reply(
+            CommitRequest(recovery_version, (), (), ()), self.process)
+        # re-read at publish time: a worker that rebooted while we
+        # awaited the recovery txn may have merged fresh storage
+        # endpoints into the broadcast — never clobber them with the
+        # snapshot captured above (code review r3)
+        cur = self.cc.dbinfo.get()
+        self.cc.publish(cur._replace(recovery_state=dbi.FULLY_RECOVERED))
+        self._trace("MasterRecoveredFully", Epoch=self.epoch)
+
+        # Lifetime: drop drained old generations; serve until cancelled
+        await self._cleanup_old_logs()
+
+    def _set_state(self, state: str) -> None:
+        cur = self.cc.dbinfo.get()
+        self.cc.publish(cur._replace(recovery_state=state))
+        self._trace("MasterRecoveryState", State=state)
+
+    async def _epoch_end(self, prev: CoreState):
+        """Lock the previous generation's logs; the recovery version is
+        the max durable version across reachable replicas — the push
+        path acks only when EVERY replica is durable, so any single
+        survivor covers all acked commits (ref: epochEnd,
+        TagPartitionedLogSystem.actor.cpp:1265)."""
+        while True:
+            refs = [self.cc.log_stores.get(store)
+                    for store, _m in prev.logs]
+            refs = [r for r in refs if r is not None]
+            locked = []
+            if refs:
+                futs = [flow.catch_errors(flow.timeout_error(
+                    r.locks.get_reply(TLogLockRequest(), self.process), 2.0))
+                    for r in refs]
+                settled = await flow.all_of(futs)
+                locked = [(r, f.get()) for r, f in zip(refs, settled)
+                          if not f.is_error]
+            if locked:
+                recovery_version = max(rep.end_version for _r, rep in locked)
+                return recovery_version, tuple(r for r, _rep in locked)
+            # nothing reachable: wait for a worker reboot to re-register
+            # a surviving store (ref: recovery waits for tlogs)
+            self._trace("MasterRecoveryWaitingForLogs",
+                        Stores=",".join(s for s, _m in prev.logs))
+            await flow.delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
+
+    async def _cleanup_old_logs(self) -> None:
+        """Drop a drained old generation from the broadcast picture once
+        every storage server has pulled past its end (ref: the oldest
+        log epoch retiring in TagPartitionedLogSystem)."""
+        while True:
+            await flow.delay(1.0, TaskPriority.CLUSTER_CONTROLLER)
+            info = self.cc.dbinfo.get()
+            if not info.old_logs:
+                continue
+            floor = self.cc.min_storage_version()
+            keep = tuple(ls for ls in info.old_logs
+                         if ls.end_version > floor)
+            if len(keep) != len(info.old_logs):
+                self.cc.publish(info._replace(old_logs=keep))

@@ -76,11 +76,25 @@ class Proxy:
         self.batch_window = batch_window
         self.max_batch = max_batch
         self.committed_version = NotifiedVersion(recovery_version)
-        self.batch_resolving = NotifiedVersion(recovery_version)
-        self.batch_logging = NotifiedVersion(recovery_version)
+        # pipeline interlocks sequence THIS proxy's batches by local
+        # batch number (ref: localBatchNumber + latestLocalCommitBatch*
+        # NotifiedVersions, MasterProxyServer.actor.cpp:453,:517); the
+        # global version chain is enforced downstream by the resolver
+        # and TLog, so local numbering keeps multiple proxies from
+        # deadlocking on each other's versions.
+        self.batch_resolving = NotifiedVersion(0)
+        self.batch_logging = NotifiedVersion(0)
+        self._local_batch = 0
+        self._peers = []               # other proxies' raw-committed refs
         self.commits = RequestStream(process)
         self.grvs = RequestStream(process)
+        self.raw_committed = RequestStream(process)
         self._actors = flow.ActorCollection()
+
+    def set_peers(self, raw_refs) -> None:
+        """Raw-committed-version endpoints of the OTHER proxies (ref:
+        getLiveCommittedVersion asking all proxies)."""
+        self._peers = list(raw_refs)
 
     def start(self) -> None:
         self._actors.add(flow.spawn(self._batcher(),
@@ -89,13 +103,51 @@ class Proxy:
         self._actors.add(flow.spawn(self._grv_loop(),
                                     TaskPriority.PROXY_GET_CONSISTENT_READ_VERSION,
                                     name=f"{self.process.name}.grv"))
+        self._actors.add(flow.spawn(self._raw_committed_loop(),
+                                    TaskPriority.PROXY_GET_RAW_COMMITTED_VERSION,
+                                    name=f"{self.process.name}.rawCommitted"))
         self.process.on_kill(self._actors.cancel_all)
+
+    def stop(self) -> None:
+        """Epoch over: stop serving and break queued/future requests so
+        stale clients fail over instead of hanging (ref: the proxy's
+        actors dying with the master's lifetime)."""
+        self._actors.cancel_all()
+        self.commits.close()
+        self.grvs.close()
+        self.raw_committed.close()
 
     # -- GRV ------------------------------------------------------------
     async def _grv_loop(self):
         while True:
             _req, reply = await self.grvs.pop()
-            reply.send(GetReadVersionReply(self.committed_version.get()))
+            if not self._peers:
+                reply.send(GetReadVersionReply(self.committed_version.get()))
+            else:
+                flow.spawn(self._serve_grv(reply),
+                           TaskPriority.PROXY_GET_CONSISTENT_READ_VERSION)
+
+    async def _serve_grv(self, reply):
+        """Causally-correct GRV with multiple proxies: the read version
+        is the max committed version across ALL of them, so a client
+        never reads below its own acknowledged commit through a
+        different proxy (ref: getLiveCommittedVersion,
+        MasterProxyServer.actor.cpp:1019 — asks all other proxies; a
+        dead peer fails the request and the client retries after
+        recovery)."""
+        try:
+            futs = [flow.timeout_error(p.get_reply(None, self.process), 2.0)
+                    for p in self._peers]
+            others = await flow.all_of(futs)
+            reply.send(GetReadVersionReply(
+                max([self.committed_version.get()] + list(others))))
+        except flow.FdbError as e:
+            reply.send_error(e)
+
+    async def _raw_committed_loop(self):
+        while True:
+            _req, reply = await self.raw_committed.pop()
+            reply.send(self.committed_version.get())
 
     def _tags_for(self, m: MutationRef):
         """Destination storage tags for a mutation (ref: LogPushData tag
@@ -135,26 +187,37 @@ class Proxy:
                     break
                 batch.append(got[1])
             deadline.cancel()
-            flow.spawn(self._commit_batch(batch), TaskPriority.PROXY_COMMIT)
+            self._local_batch += 1
+            flow.spawn(self._commit_batch(batch, self._local_batch),
+                       TaskPriority.PROXY_COMMIT)
 
-    async def _commit_batch(self, batch):
+    async def _commit_batch(self, batch, local: int):
         reqs = [r for r, _ in batch]
         replies = [p for _, p in batch]
         try:
-            # phase 1: version assignment, ordered with earlier batches
+            # phase 1: version assignment, ordered with this proxy's
+            # earlier batches by local batch number (the finally below
+            # always advances the interlocks so a failed batch can never
+            # wedge its successors)
+            await self.batch_resolving.when_at_least(local - 1)
             ver = await self.master_ref.get_reply(None, self.process)
-            await self.batch_resolving.when_at_least(ver.prev_version)
 
             # phase 2: conflict resolution — single resolver fast path, or
             # key-range split across resolvers with min-combined verdicts
-            # (ref: ResolutionRequestBuilder :265-341, combine :585-592)
+            # (ref: ResolutionRequestBuilder :265-341, combine :585-592).
+            # The interlock releases once the requests are IN FLIGHT, so
+            # successive batches resolve concurrently and the resolver
+            # orders them by the global version chain (ref: commitBatch
+            # sets latestLocalCommitBatchResolving before awaiting).
             if len(self.resolver_refs) == 1:
-                verdicts = await self.resolver_refs[0].get_reply(
+                vf = self.resolver_refs[0].get_reply(
                     ResolveRequest(ver.prev_version, ver.version,
                                    tuple(reqs)), self.process)
             else:
-                verdicts = await self._resolve_split(ver, reqs)
-            self.batch_resolving.set(ver.version)
+                vf = flow.spawn(self._resolve_split(ver, reqs),
+                                TaskPriority.PROXY_COMMIT)
+            self._advance(self.batch_resolving, local)
+            verdicts = await vf
 
             # phase 3: assemble mutations of committed transactions with
             # their destination storage tags, resolving versionstamped
@@ -181,13 +244,13 @@ class Proxy:
             # not at fsync ack — the TLog itself sequences commits via
             # queue_version — so successive batches' fsyncs overlap (ref:
             # commitBatch releases logging order before waiting, :910-937).
-            await self.batch_logging.when_at_least(ver.prev_version)
+            await self.batch_logging.when_at_least(local - 1)
             creq = TLogCommitRequest(ver.prev_version, ver.version,
                                      tuple(mutations),
                                      self.committed_version.get())
             log_done = flow.all_of([ref.get_reply(creq, self.process)
                                     for ref in self.tlog_refs])
-            self.batch_logging.set(ver.version)
+            self._advance(self.batch_logging, local)
             await log_done
             if self.committed_version.get() < ver.version:
                 self.committed_version.set(ver.version)
@@ -201,8 +264,24 @@ class Proxy:
                 else:
                     reply.send_error(error("not_committed"))
         except flow.FdbError as e:
+            # a dead or locked downstream role means this proxy's epoch
+            # is over; the batch may or may not have reached a log, so
+            # clients get commit_unknown_result and retry through a
+            # refreshed proxy (ref: the proxy dying with its epoch and
+            # NativeAPI mapping broken connections to
+            # commit_unknown_result)
+            if e.name in ("tlog_stopped", "broken_promise"):
+                e = error("commit_unknown_result")
             for reply in replies:
                 reply.send_error(e)
+        finally:
+            self._advance(self.batch_resolving, local)
+            self._advance(self.batch_logging, local)
+
+    @staticmethod
+    def _advance(nv: NotifiedVersion, to: int) -> None:
+        if nv.get() < to:
+            nv.set(to)
 
     async def _resolve_split(self, ver, reqs):
         """Send each transaction's ranges clipped per resolver shard; every

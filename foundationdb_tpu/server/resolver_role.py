@@ -44,6 +44,10 @@ class Resolver:
                                     name=f"{self.process.name}.resolve"))
         self.process.on_kill(self._actors.cancel_all)
 
+    def stop(self) -> None:
+        self._actors.cancel_all()
+        self.resolves.close()
+
     async def _resolve_loop(self):
         while True:
             req, reply = await self.resolves.pop()

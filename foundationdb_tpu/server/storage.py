@@ -34,6 +34,24 @@ from .types import (ADD_VALUE, AND, APPEND_IF_FITS, BYTE_MAX, BYTE_MIN,
 
 MAX_READ_AHEAD_VERSIONS = 5_000_000  # ref: MAX_READ_TRANSACTION_LIFE_VERSIONS
 DURABLE_VERSION_KEY = b"\xff\xff/storageDurableVersion"
+SHARD_META_KEY = b"\xff\xff/shardMeta"   # persisted tag + owned range
+
+
+def encode_shard_meta(tag: int, begin: bytes, end: Optional[bytes]) -> bytes:
+    e = end if end is not None else b""
+    has_end = 1 if end is not None else 0
+    return struct.pack("<HBI", tag, has_end, len(begin)) + begin + \
+        struct.pack("<I", len(e)) + e
+
+
+def decode_shard_meta(buf: bytes):
+    tag, has_end, lb = struct.unpack_from("<HBI", buf, 0)
+    off = 7
+    begin = buf[off:off + lb]
+    off += lb
+    (le,) = struct.unpack_from("<I", buf, off)
+    end = buf[off + 4:off + 4 + le] if has_end else None
+    return tag, bytes(begin), (bytes(end) if end is not None else None)
 
 _ATOMIC_APPLY = {
     ADD_VALUE: atomic.add,
@@ -116,7 +134,11 @@ class VersionedMap:
         return None if cs is not None else self._base_get(key)
 
     def _merged_keys(self, begin: bytes, end: bytes) -> List[bytes]:
-        """Sorted candidate keys in [begin, end): window ∪ base."""
+        """Sorted candidate keys in [begin, end): window ∪ base. The
+        user keyspace ends at \\xff — system keys (engine metadata under
+        \\xff\\xff) never surface in reads (ref: FDBTypes.h
+        normalKeys)."""
+        end = min(end, b"\xff")
         lo = bisect_left(self._keys, begin)
         hi = bisect_left(self._keys, end)
         win = self._keys[lo:hi]
@@ -142,13 +164,22 @@ class VersionedMap:
                     break
         return out
 
-    def resolve_selector(self, sel: KeySelector, version: int) -> bytes:
+    def resolve_selector(self, sel: KeySelector, version: int,
+                         begin: bytes = b"",
+                         end: Optional[bytes] = None):
         """Resolve a KeySelector against the keys present at `version`
-        (ref: storageserver findKey / fdbclient KeySelectorRef semantics:
-        start from the last key < (or <= when or_equal) the reference
-        key, then move `offset` present keys forward). Clamps to b'' on
-        underflow and to \\xff on overflow."""
-        present = [k for k in self._merged_keys(b"", b"\xff" * 65)
+        within [begin, end) (ref: storageserver findKey / KeySelectorRef
+        semantics: start from the last key < (or <= when or_equal) the
+        reference key, then move `offset` present keys forward).
+
+        Returns (key, leftover): leftover 0 means resolved in-shard;
+        a negative leftover means the answer is the |leftover|-th
+        present key LEFT of `begin` (1-based); a positive leftover means
+        the leftover-th present key RIGHT of `end` — the client walks
+        the neighboring shard with a boundary-anchored selector (ref:
+        NativeAPI getKey readThrough iteration across shards)."""
+        hi = end if end is not None else b"\xff"
+        present = [k for k in self._merged_keys(begin, hi)
                    if self.get(k, version) is not None]
         if sel.or_equal:
             base = bisect_right(present, sel.key) - 1
@@ -156,10 +187,10 @@ class VersionedMap:
             base = bisect_left(present, sel.key) - 1
         idx = base + sel.offset
         if idx < 0:
-            return b""
+            return b"", idx
         if idx >= len(present):
-            return b"\xff"
-        return present[idx]
+            return b"\xff", idx - len(present) + 1
+        return present[idx], 0
 
     def forget(self, up_to: int) -> None:
         """Drop window state at or below `up_to` — it lives in the base
@@ -180,17 +211,26 @@ class VersionedMap:
 
 
 class StorageServer:
-    def __init__(self, process: SimProcess, tlog_peek: NetworkRef,
+    def __init__(self, process: SimProcess, tlog_peek: NetworkRef = None,
                  kv: Optional[IKeyValueStore] = None,
                  tlog_pop: Optional[NetworkRef] = None,
                  durability_lag_versions: Optional[int] = None,
-                 tag: int = 0):
+                 tag: int = 0, dbinfo=None,
+                 shard_begin: bytes = b"",
+                 shard_end: Optional[bytes] = None):
         self.process = process
+        # direct log wiring (component tests) or dbinfo-driven discovery
+        # of the current log generation (clusters with recovery)
         self.tlog_peek = tlog_peek
         self.tlog_pop = tlog_pop
+        self.dbinfo = dbinfo            # AsyncVar[ServerDBInfo] or None
         self.kv = kv
         self.tag = tag
+        self.shard_begin = shard_begin
+        self.shard_end = shard_end
         self.known_committed = 0  # replicated log-set-wide (peek piggyback)
+        self._replica_rr = tag    # peek replica rotation, offset by tag
+        self._seen_epoch = 0
         self.data = VersionedMap(base=kv)
         self.version = NotifiedVersion(0)
         self.durable_version = NotifiedVersion(0)
@@ -238,26 +278,111 @@ class StorageServer:
             (v,) = struct.unpack("<Q", raw)
             self.durable_version.set(v)
             self.version.set(v)
+        if self.kv.get(SHARD_META_KEY) is None:
+            # first boot of this store: persist the shard identity NOW so
+            # a crash before the first durability batch still leaves a
+            # self-describing store for the worker's boot scan
+            self.kv.set(SHARD_META_KEY,
+                        encode_shard_meta(self.tag, self.shard_begin,
+                                          self.shard_end))
+            await self.kv.commit()
 
     async def _pull_loop(self):
         """Pull this tag's committed mutations from the log
-        (ref: update :2461, peeking the server's own tag)."""
+        (ref: update :2461, peeking the server's own tag). With a
+        dbinfo, the source is the generation covering the next needed
+        version — old locked generations drain first, then the current
+        one; replicas rotate on failure; an epoch change below our
+        version triggers a rollback (ref: storageserver rollback +
+        peekcursor generation fail-over)."""
         while True:
-            reply = await self.tlog_peek.get_reply(
-                TLogPeekRequest(self.version.get() + 1, self.tag),
-                self.process)
-            if reply.known_committed > self.known_committed:
-                self.known_committed = reply.known_committed
-            for version, mutations in reply.entries:
-                if version <= self.version.get():
-                    continue
-                for m in mutations:
-                    self.data.apply(version, m)
-                self._pending.append((version, mutations))
-                self.version.set(version)
-                self._check_watches(version, mutations)
-            if reply.committed_version > self.version.get():
-                self.version.set(reply.committed_version)
+            if self.dbinfo is None:
+                reply = await self.tlog_peek.get_reply(
+                    TLogPeekRequest(self.version.get() + 1, self.tag),
+                    self.process)
+                self._apply_peek(reply, cap=None)
+                continue
+            self._maybe_rollback()
+            needed = self.version.get() + 1
+            src = self._pick_source(needed)
+            if src is None:
+                await flow.first_of(
+                    self.dbinfo.on_change(),
+                    flow.delay(0.2, TaskPriority.UPDATE_STORAGE))
+                continue
+            gen, refs = src
+            try:
+                reply = await flow.timeout_error(refs.peeks.get_reply(
+                    TLogPeekRequest(needed, self.tag), self.process), 5.0)
+            except flow.FdbError:
+                self._replica_rr += 1  # rotate to another replica
+                await flow.delay(0.05, TaskPriority.UPDATE_STORAGE)
+                continue
+            cap = gen.end_version if gen.end_version >= 0 else None
+            before = self.version.get()
+            self._apply_peek(reply, cap)
+            if cap is not None and self.version.get() >= cap:
+                # old generation drained: let it free our tag
+                refs.pops.send(TLogPopRequest(cap, self.tag), self.process)
+            elif cap is not None and self.version.get() == before:
+                # a locked replica that answered instantly with nothing
+                # lacks the generation's tail (it died behind its peers):
+                # rotate instead of re-peeking it forever
+                self._replica_rr += 1
+                await flow.delay(0.05, TaskPriority.UPDATE_STORAGE)
+
+    def _apply_peek(self, reply, cap: Optional[int]) -> None:
+        if reply.known_committed > self.known_committed:
+            self.known_committed = reply.known_committed
+        for version, mutations in reply.entries:
+            if version <= self.version.get():
+                continue
+            if cap is not None and version > cap:
+                break  # stale data beyond the generation's locked end
+            for m in mutations:
+                self.data.apply(version, m)
+            self._pending.append((version, mutations))
+            self.version.set(version)
+            self._check_watches(version, mutations)
+        adv = reply.committed_version
+        if cap is not None:
+            adv = min(adv, cap)
+        if adv > self.version.get():
+            self.version.set(adv)
+
+    def _pick_source(self, needed: int):
+        """The generation that owns `needed`, and one of its replicas."""
+        info = self.dbinfo.get()
+        gens = sorted(info.old_logs, key=lambda g: g.end_version)
+        for gen in gens:
+            if gen.end_version >= needed and gen.logs:
+                return gen, gen.logs[self._replica_rr % len(gen.logs)]
+        cur = info.logs
+        if cur.logs:
+            return cur, cur.logs[self._replica_rr % len(cur.logs)]
+        return None
+
+    def _maybe_rollback(self) -> None:
+        """A new epoch whose recovery version is below what we pulled
+        means the surplus came from a replica that died un-acked: rebuild
+        the window from the durable base plus the surviving prefix
+        (ref: storageserver.actor.cpp rollback)."""
+        info = self.dbinfo.get()
+        if info.epoch == self._seen_epoch:
+            return
+        self._seen_epoch = info.epoch
+        rv = info.recovery_version
+        if rv <= 0 or self.version.get() <= rv:
+            return
+        keep = [(v, ms) for v, ms in self._pending if v <= rv]
+        self.data = VersionedMap(base=self.kv)
+        for v, ms in keep:
+            for m in ms:
+                self.data.apply(v, m)
+        self._pending = keep
+        self.version.rollback(rv)
+        flow.TraceEvent("StorageRollback", self.process.name).detail(
+            To=rv).log()
 
     async def _durability_loop(self):
         """Apply old window versions to the engine, persist the durable
@@ -294,6 +419,10 @@ class StorageServer:
             if self.tlog_pop is not None:
                 self.tlog_pop.send(TLogPopRequest(made, self.tag),
                                    self.process)
+            elif self.dbinfo is not None:
+                for lr in self.dbinfo.get().logs.logs:
+                    lr.pops.send(TLogPopRequest(made, self.tag),
+                                 self.process)
 
     def _apply_to_kv(self, m: MutationRef) -> None:
         if m.type == SET_VALUE:
@@ -377,7 +506,8 @@ class StorageServer:
     async def _serve_get_key(self, req: StorageGetKeyRequest, reply):
         try:
             await self._wait_version(req.version)
-            reply.send(self.data.resolve_selector(req.selector, req.version))
+            reply.send(self.data.resolve_selector(
+                req.selector, req.version, self.shard_begin, self.shard_end))
         except flow.FdbError as e:
             reply.send_error(e)
 

@@ -1,0 +1,165 @@
+"""Worker: hosts role actors on a process, recovers disk stores on
+reboot, registers with the ClusterController.
+
+Reference: fdbserver/worker.actor.cpp — `workerServer` (:613) scans the
+data folder on boot and re-creates roles from surviving disk stores
+(tlog queues come back *stopped*, ready to be locked and drained by the
+next recovery; storage servers rejoin and resume pulling), then
+registers with the CC (registrationClient :347) and serves recruitment
+requests. Role construction here is a direct method call guarded by a
+liveness check — the simulated stand-in for the recruitment RPC; the
+registration itself travels over the simulated network so a rebooted
+worker re-appears the same way a real one would.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+from .. import flow
+from ..flow import TaskPriority, error
+from ..rpc import RequestStream, SimProcess
+from .dbinfo import LogRefs, ProxyRefs, StorageRefs
+from .kvstore import EphemeralKeyValueStore, KeyValueStoreMemory
+from .master import Master
+from .proxy import Proxy
+from .resolver_role import Resolver
+from .storage import (SHARD_META_KEY, StorageServer,
+                      decode_shard_meta)
+from .tlog import TLog
+
+class RegisterWorkerRequest(NamedTuple):
+    name: str
+    machine: str
+    worker: object                      # the Worker (sim recruitment seam)
+    recovered_logs: Tuple[LogRefs, ...]
+    recovered_storages: Tuple[StorageRefs, ...] = ()
+
+
+class Worker:
+    def __init__(self, process: SimProcess, net, durable: bool = False,
+                 dbinfo=None, conflict_backend: str = "python",
+                 storage_lag_versions: Optional[int] = None):
+        self.process = process
+        self.net = net
+        self.durable = durable
+        self.dbinfo = dbinfo            # AsyncVar[ServerDBInfo]
+        self.conflict_backend = conflict_backend
+        self.storage_lag_versions = storage_lag_versions
+        self.roles: dict = {}           # name -> role object
+        self.pings = RequestStream(process)
+        self._actors = flow.ActorCollection()
+
+    # -- liveness --------------------------------------------------------
+    def _check_alive(self) -> None:
+        if not self.process.alive:
+            raise error("broken_promise")
+
+    def start(self) -> None:
+        self._actors.add(flow.spawn(self._ping_loop(), TaskPriority.CLUSTER_CONTROLLER,
+                                    name=f"{self.process.name}.ping"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    async def _ping_loop(self):
+        while True:
+            _req, reply = await self.pings.pop()
+            reply.send(None)
+
+    # -- boot-time disk-store recovery ----------------------------------
+    async def recover_stores(self):
+        """Re-create roles from surviving disk stores (ref: worker boot
+        store scan). TLogs come back stopped; storage servers rejoin
+        live. Returns (recovered_logs, recovered_storages)."""
+        recovered_logs = []
+        recovered_storages = []
+        if self.durable:
+            disk = self.net.disk(self.process.machine)
+            for store in sorted(disk.files):
+                if store.startswith("tlog-") and store.endswith(".dq0"):
+                    name = store[:-4]
+                    tlog = self._make_tlog(name)
+                    tlog.stopped = True      # old-generation data only
+                    tlog.start()
+                    await tlog.recovered()
+                    recovered_logs.append(self._log_refs(name, tlog))
+                elif store.startswith("storage-") and store.endswith(".dq0"):
+                    name = store[:-4]
+                    refs = await self._recover_storage(name)
+                    if refs is not None:
+                        recovered_storages.append(refs)
+        return tuple(recovered_logs), tuple(recovered_storages)
+
+    async def _recover_storage(self, name: str):
+        kv = KeyValueStoreMemory(self.net.disk(self.process.machine), name,
+                                 owner=self.process)
+        await kv.recover()
+        meta = kv.get(SHARD_META_KEY)
+        if meta is None:
+            return None
+        tag, begin, end = decode_shard_meta(meta)
+        return self.recruit_storage(name, tag, begin, end, kv=kv)
+
+    # -- recruitment (CC-driven) ----------------------------------------
+    def _make_tlog(self, store: str, recovery_version: int = 0) -> TLog:
+        disk = self.net.disk(self.process.machine) if self.durable else None
+        return TLog(self.process, disk=disk, name=store,
+                    recovery_version=recovery_version)
+
+    def _log_refs(self, store: str, tlog: TLog) -> LogRefs:
+        return LogRefs(store, self.process.machine, tlog.commits.ref(),
+                       tlog.peeks.ref(), tlog.pops.ref(), tlog.locks.ref())
+
+    def recruit_tlog(self, store: str, recovery_version: int = 0) -> LogRefs:
+        """(ref: InitializeTLogRequest handling in workerServer)"""
+        self._check_alive()
+        tlog = self._make_tlog(store, recovery_version)
+        tlog.start()
+        self.roles[store] = tlog
+        return self._log_refs(store, tlog)
+
+    def recruit_resolver(self, name: str, recovery_version: int):
+        self._check_alive()
+        r = Resolver(self.process, backend=self.conflict_backend,
+                     recovery_version=recovery_version)
+        r.start()
+        self.roles[name] = r
+        return r.resolves.ref()
+
+    def recruit_proxy(self, name: str, master_ref, resolver_refs, tlog_refs,
+                      resolver_splits, storage_splits,
+                      recovery_version: int) -> ProxyRefs:
+        self._check_alive()
+        p = Proxy(self.process, master_ref, resolver_refs, tlog_refs,
+                  resolver_splits=resolver_splits,
+                  storage_splits=storage_splits,
+                  recovery_version=recovery_version)
+        p.start()
+        self.roles[name] = p
+        return ProxyRefs(name, p.grvs.ref(), p.commits.ref(),
+                         p.raw_committed.ref())
+
+    def recruit_master(self, name: str, recovery_version: int) -> Master:
+        self._check_alive()
+        m = Master(self.process, recovery_version=recovery_version)
+        m.start()
+        self.roles[name] = m
+        return m
+
+    def recruit_storage(self, name: str, tag: int, begin: bytes,
+                        end: Optional[bytes], kv=None) -> StorageRefs:
+        self._check_alive()
+        if kv is None:
+            if self.durable:
+                kv = KeyValueStoreMemory(self.net.disk(self.process.machine),
+                                         name, owner=self.process)
+            else:
+                kv = EphemeralKeyValueStore()
+        s = StorageServer(self.process, None, kv=kv, tag=tag,
+                          durability_lag_versions=self.storage_lag_versions,
+                          dbinfo=self.dbinfo, shard_begin=begin,
+                          shard_end=end)
+        s.start()
+        self.roles[name] = s
+        refs = StorageRefs(name, tag, begin, end, s.gets.ref(),
+                           s.ranges.ref(), s.get_keys.ref(), s.watches.ref())
+        return refs

@@ -230,9 +230,9 @@ def test_clogged_network_still_correct():
             tr = db.create_transaction()
             tr.set(b"x", b"1")
             await tr.commit()
-            # clog the proxy<->resolver and tlog links mid-run
-            c.net.clog_pair("m1", "m2", 2.0)
-            c.net.clog_pair("m1", "m3", 1.0)
+            # clog links between worker machines mid-run
+            c.net.clog_pair("w0", "w1", 2.0)
+            c.net.clog_pair("w0", "w2", 1.0)
             tr2 = db.create_transaction()
             tr2.set(b"x", b"2")
             await tr2.commit()

@@ -1,0 +1,187 @@
+"""Epoch recovery under faults: kill transaction roles mid-workload on
+durable clusters; the recovery state machine — not test scaffolding —
+heals the cluster and no acknowledged commit is lost.
+
+Ref: fdbserver/masterserver.actor.cpp masterCore (:1212),
+TagPartitionedLogSystem.actor.cpp epochEnd (:1265), and the simulation
+test strategy of workloads running *while* processes die
+(fdbserver/workloads/MachineAttrition.actor.cpp, tests/fast/CycleTest.txt).
+"""
+
+import pytest
+
+from foundationdb_tpu import flow
+from foundationdb_tpu.client import run_transaction
+from foundationdb_tpu.server import SimCluster
+
+
+def _durable_cluster(seed, **kw):
+    kw.setdefault("durable", True)
+    return SimCluster(seed=seed, **kw)
+
+
+@pytest.mark.parametrize("role", ["tlog", "proxy", "resolver"])
+def test_kill_role_cluster_heals(role):
+    """Killing any transaction-subsystem role mid-stream triggers an
+    epoch recovery; acknowledged writes survive, later writes work."""
+    c = _durable_cluster(seed=101 + hash(role) % 50)
+    try:
+        db = c.client()
+
+        async def main():
+            acked = []
+            for i in range(5):
+                async def body(tr, i=i):
+                    tr.set(b"k%02d" % i, b"v%d" % i)
+                await run_transaction(db, body)
+                acked.append(i)
+            c.kill_role(role)
+            # commits must keep working through the recovery
+            for i in range(5, 10):
+                async def body(tr, i=i):
+                    tr.set(b"k%02d" % i, b"v%d" % i)
+                await run_transaction(db, body)
+                acked.append(i)
+            tr = db.create_transaction()
+            got = await tr.get_range(b"k", b"l")
+            assert got == [(b"k%02d" % i, b"v%d" % i) for i in acked]
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
+
+
+def test_kill_tlog_during_cycle_workload():
+    """The Cycle invariant holds across a TLog kill mid-workload
+    (ref: Cycle.actor.cpp stacked with Attrition)."""
+    n = 6
+    c = _durable_cluster(seed=7)
+    try:
+        db = c.client()
+        dbs = [c.client(f"c{i}") for i in range(3)]
+
+        async def setup():
+            tr = db.create_transaction()
+            for i in range(n):
+                tr.set(b"cyc%02d" % i, b"%02d" % ((i + 1) % n))
+            await tr.commit()
+
+        async def swap_loop(db, iters):
+            for _ in range(iters):
+                async def body(tr):
+                    a = flow.g_random.random_int(0, n)
+                    b = int(await tr.get(b"cyc%02d" % a))
+                    cc_ = int(await tr.get(b"cyc%02d" % b))
+                    d = int(await tr.get(b"cyc%02d" % cc_))
+                    tr.set(b"cyc%02d" % a, b"%02d" % cc_)
+                    tr.set(b"cyc%02d" % cc_, b"%02d" % b)
+                    tr.set(b"cyc%02d" % b, b"%02d" % d)
+                await run_transaction(db, body, max_retries=200)
+
+        async def killer():
+            await flow.delay(0.05)
+            c.kill_role("tlog")
+
+        async def main():
+            await setup()
+            tasks = [flow.spawn(swap_loop(d, 6)) for d in dbs]
+            tasks.append(flow.spawn(killer()))
+            await flow.wait_for_all(tasks)
+
+            async def check(tr):
+                kvs = await tr.get_range(b"cyc", b"cyd")
+                assert len(kvs) == n
+                nxt = {int(k[3:]): int(v) for k, v in kvs}
+                seen, cur = set(), 0
+                while cur not in seen:
+                    seen.add(cur)
+                    cur = nxt[cur]
+                assert len(seen) == n, f"cycle broken: {nxt}"
+            await run_transaction(db, check, max_retries=50)
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
+
+
+def test_storage_worker_reboot_rejoins():
+    """A killed storage worker auto-reboots, recovers its engine from
+    disk, re-registers, and serves reads again — no epoch change
+    needed."""
+    c = _durable_cluster(seed=23)
+    try:
+        db = c.client()
+
+        async def main():
+            async def body(tr):
+                tr.set(b"a", b"1")
+                tr.set(b"b", b"2")
+            await run_transaction(db, body)
+            c.kill_role("storage")
+
+            async def body2(tr):
+                assert await tr.get(b"a") == b"1"
+                tr.set(b"c", b"3")
+            await run_transaction(db, body2, max_retries=200)
+            tr = db.create_transaction()
+            assert await tr.get(b"c") == b"3"
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
+
+
+def test_master_epoch_advances_on_kill():
+    """Recovery bumps the epoch in the coordinated state and the
+    broadcast dbinfo."""
+    c = _durable_cluster(seed=41)
+    try:
+        db = c.client()
+
+        async def main():
+            async def body(tr):
+                tr.set(b"x", b"1")
+            await run_transaction(db, body)
+            e0 = c.cc.dbinfo.get().epoch
+            assert e0 >= 1
+            c.kill_role("proxy")
+
+            async def body2(tr):
+                tr.set(b"y", b"2")
+            await run_transaction(db, body2, max_retries=200)
+            assert c.cc.dbinfo.get().epoch > e0
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
+
+
+def test_acked_commits_survive_power_loss_of_tlog():
+    """Every acknowledged commit is readable after the TLog machine
+    power-loses its unsynced writes and the cluster recovers (the
+    durability contract end-to-end)."""
+    c = _durable_cluster(seed=59)
+    try:
+        db = c.client()
+
+        async def main():
+            acked = {}
+            for i in range(8):
+                async def body(tr, i=i):
+                    tr.set(b"p%02d" % i, b"v%d" % i)
+                await run_transaction(db, body)
+                acked[b"p%02d" % i] = b"v%d" % i
+                if i == 4:
+                    c.kill_role("tlog")
+            tr = db.create_transaction()
+            got = dict(await tr.get_range(b"p", b"q"))
+            assert got == acked, (got, acked)
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
