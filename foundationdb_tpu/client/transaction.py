@@ -38,7 +38,7 @@ _ATOMIC_APPLY = {
 
 RETRYABLE = {"not_committed", "transaction_too_old", "future_version",
              "broken_promise", "commit_unknown_result", "timed_out",
-             "tlog_stopped", "coordinators_changed"}
+             "tlog_stopped", "coordinators_changed", "wrong_shard_server"}
 
 # errors that mean our picture of the cluster may be stale: re-fetch the
 # ServerDBInfo before retrying (ref: the client reconnecting through

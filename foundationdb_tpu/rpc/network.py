@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from ..flow import error
 from ..flow.actors import PromiseStream
 from ..flow.future import Future, Promise
+from ..flow.rng import buggify
 from ..flow.scheduler import Scheduler, TaskPriority
 
 
@@ -197,6 +198,11 @@ class SimNetwork:
     def _delivery_delay(self, src: SimProcess, dst: SimProcess) -> float:
         lat = self.min_latency + self.rng.random01() * (
             self.max_latency - self.min_latency)
+        if buggify("net/extra_latency"):
+            # occasional pathological latency: reorders far more
+            # aggressively than the uniform draw (ref: sim2's BUGGIFY'd
+            # connection delays)
+            lat += self.rng.random01() * 0.05
         key = (src.machine, dst.machine)
         unclog = self._clogged.get(key, 0.0)
         now = self.sched.now()
@@ -214,6 +220,10 @@ class SimNetwork:
 
     def send_oneway(self, src: SimProcess, dst: Endpoint, request) -> None:
         self._deliver(src, dst, (request, None), None)
+        if buggify("net/duplicate_oneway"):
+            # best-effort datagrams may be delivered twice (receivers
+            # must be idempotent, e.g. TLog pops)
+            self._deliver(src, dst, (request, None), None)
 
     def _deliver(self, src: SimProcess, dst: Endpoint, item,
                  reply: Optional[Promise]) -> None:

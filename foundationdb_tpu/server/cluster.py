@@ -32,8 +32,12 @@ class SimCluster:
                  storage_lag_versions: Optional[int] = None,
                  n_proxies: int = 1, n_logs: int = 1, n_storage: int = 1,
                  n_workers: Optional[int] = None, n_coordinators: int = 1,
-                 auto_reboot: bool = True):
-        flow.set_seed(seed)
+                 auto_reboot: bool = True, buggify: bool = False):
+        flow.set_seed(seed, buggify_enabled=buggify)
+        # knob distortion rides the same switch as BUGGIFY (ref:
+        # `if (randomize && BUGGIFY)` in Knobs.cpp); always re-init so a
+        # prior run's distorted knobs never leak into this one
+        flow.reset_server_knobs(randomize=buggify)
         self.sched = flow.Scheduler(start_time=start_time, virtual=True)
         flow.set_scheduler(self.sched)
         self.net = SimNetwork(self.sched, flow.g_random)

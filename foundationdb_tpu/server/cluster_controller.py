@@ -222,14 +222,17 @@ class ClusterController:
         self.publish(info._replace(storages=tuple(storages)))
 
     def min_storage_version(self) -> int:
-        """Smallest pulled version across shards (drain progress for old
-        log cleanup)."""
+        """Smallest DURABLE version across shards — the floor for
+        retiring old log generations. A dead or unregistered shard
+        pins the floor at 0: it may come back needing everything the
+        old generation still holds (code review r3)."""
         info = self.dbinfo.get()
         vs = []
         for s in info.storages:
             obj = self._storage_objs.get(s.name)
-            if obj is not None and obj.process.alive:
-                vs.append(obj.version.get())
+            if obj is None or not obj.process.alive:
+                return 0
+            vs.append(obj.durable_version.get())
         return min(vs) if vs else 0
 
     # -- client handshake -----------------------------------------------

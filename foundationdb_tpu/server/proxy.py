@@ -73,6 +73,10 @@ class Proxy:
         # keyServers boundaries: storage tag i owns [sbounds[i], sbounds[i+1])
         self._sbounds = [b""] + list(storage_splits) + [None]
         self.tlog_refs = list(tlog_refs)
+        if flow.buggify("proxy/small_batch_window"):
+            # shrink the batcher to one-or-two txn batches: stresses the
+            # pipeline interlocks and resolver ordering under load
+            batch_window, max_batch = 1e-5, 2
         self.batch_window = batch_window
         self.max_batch = max_batch
         self.committed_version = NotifiedVersion(recovery_version)

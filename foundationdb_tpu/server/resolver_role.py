@@ -37,6 +37,9 @@ class Resolver:
         # (ref: outstandingBatches, Resolver.actor.cpp:159,:241-257)
         self._reply_cache: dict[int, list[int]] = {}
         self._reply_order: deque[int] = deque()
+        # a tiny cache stresses the duplicate-delivery fallback path
+        self._cache_cap = 2 if flow.buggify("resolver/small_reply_cache") \
+            else 256
 
     def start(self) -> None:
         self._actors.add(flow.spawn(self._resolve_loop(),
@@ -84,7 +87,7 @@ class Resolver:
             self.conflict_set.resolve([], req.version, new_oldest)
         self._reply_cache[req.version] = verdicts
         self._reply_order.append(req.version)
-        while len(self._reply_order) > 256:
+        while len(self._reply_order) > self._cache_cap:
             self._reply_cache.pop(self._reply_order.popleft(), None)
         self.version.set(req.version)
         reply.send(verdicts)

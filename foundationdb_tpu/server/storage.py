@@ -238,6 +238,10 @@ class StorageServer:
                      is not None else
                      int(SERVER_KNOBS.storage_durability_lag *
                          SERVER_KNOBS.versions_per_second))
+        if durability_lag_versions is None and \
+                flow.buggify("storage/short_durability_lag"):
+            # near-zero MVCC window: every read races the window floor
+            self._lag = 1000
         # raw pulled entries not yet durable: [(version, mutations)]
         self._pending: List[Tuple[int, tuple]] = []
         self.gets = RequestStream(process)
@@ -321,10 +325,12 @@ class StorageServer:
             cap = gen.end_version if gen.end_version >= 0 else None
             before = self.version.get()
             self._apply_peek(reply, cap)
-            if cap is not None and self.version.get() >= cap:
-                # old generation drained: let it free our tag
-                refs.pops.send(TLogPopRequest(cap, self.tag), self.process)
-            elif cap is not None and self.version.get() == before:
+            # NOTE: pops happen only from the durability loop at the
+            # DURABLE version — popping a drained generation at the
+            # pulled version would free log data this server still
+            # needs if it crashes before persisting (code review r3)
+            if cap is not None and self.version.get() == before and \
+                    self.version.get() < cap:
                 # a locked replica that answered instantly with nothing
                 # lacks the generation's tail (it died behind its peers):
                 # rotate instead of re-peeking it forever
@@ -420,9 +426,15 @@ class StorageServer:
                 self.tlog_pop.send(TLogPopRequest(made, self.tag),
                                    self.process)
             elif self.dbinfo is not None:
-                for lr in self.dbinfo.get().logs.logs:
+                info = self.dbinfo.get()
+                for lr in info.logs.logs:
                     lr.pops.send(TLogPopRequest(made, self.tag),
                                  self.process)
+                for gen in info.old_logs:
+                    for lr in gen.logs:
+                        lr.pops.send(TLogPopRequest(
+                            min(made, gen.end_version), self.tag),
+                            self.process)
 
     def _apply_to_kv(self, m: MutationRef) -> None:
         if m.type == SET_VALUE:

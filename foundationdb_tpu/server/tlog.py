@@ -151,6 +151,11 @@ class TLog:
         durable actors are spawned in version order, so log records land
         on disk in version order."""
         version = req.version
+        if flow.buggify("tlog/slow_fsync"):
+            # a straggling disk: widens the window where commits are
+            # accepted but not durable (stresses lock + recovery races)
+            await flow.delay(flow.g_random.random01() * 0.01,
+                             TaskPriority.TLOG_COMMIT_REPLY)
         if self._dq is None:
             await flow.delay(self.fsync_delay, TaskPriority.TLOG_COMMIT_REPLY)
         else:

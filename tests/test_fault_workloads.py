@@ -1,0 +1,175 @@
+"""Stacked fault workloads across many seeds: correctness workloads run
+WHILE roles die, links clog, and BUGGIFY distorts timings — the
+reference's core test strategy (ref: tests/fast/CycleTest.txt stacking
+Cycle + Attrition + RandomClogging; fdbrpc/sim2.actor.cpp:1222-1406;
+flow/Knobs.cpp BUGGIFY randomization).
+"""
+
+import pytest
+
+from foundationdb_tpu import flow
+from foundationdb_tpu.client import run_transaction
+from foundationdb_tpu.server import SimCluster
+
+N = 6  # cycle length
+
+
+async def _cycle_setup(db):
+    tr = db.create_transaction()
+    for i in range(N):
+        tr.set(b"cyc%02d" % i, b"%02d" % ((i + 1) % N))
+    await tr.commit()
+
+
+async def _cycle_swaps(db, iters):
+    for _ in range(iters):
+        async def body(tr):
+            a = flow.g_random.random_int(0, N)
+            b = int(await tr.get(b"cyc%02d" % a))
+            c = int(await tr.get(b"cyc%02d" % b))
+            d = int(await tr.get(b"cyc%02d" % c))
+            tr.set(b"cyc%02d" % a, b"%02d" % c)
+            tr.set(b"cyc%02d" % c, b"%02d" % b)
+            tr.set(b"cyc%02d" % b, b"%02d" % d)
+        await run_transaction(db, body, max_retries=500)
+
+
+async def _cycle_check(db):
+    async def check(tr):
+        kvs = await tr.get_range(b"cyc", b"cyd")
+        assert len(kvs) == N, kvs
+        nxt = {int(k[3:]): int(v) for k, v in kvs}
+        seen, cur = set(), 0
+        while cur not in seen:
+            seen.add(cur)
+            cur = nxt[cur]
+        assert len(seen) == N, f"cycle broken: {nxt}"
+    await run_transaction(db, check, max_retries=200)
+
+
+async def _attrition(c, kills, machines):
+    """Random role kills + link clogs, spaced so recovery can make
+    progress between faults (ref: MachineAttrition + RandomClogging)."""
+    rng = flow.g_random
+    for _ in range(kills):
+        await flow.delay(0.2 + rng.random01() * 0.4)
+        op = rng.random_int(0, 6)
+        try:
+            if op == 0:
+                c.kill_role("tlog")
+            elif op == 1:
+                c.kill_role("proxy")
+            elif op == 2:
+                c.kill_role("resolver")
+            elif op == 3:
+                c.kill_role("storage")
+            else:
+                a = machines[rng.random_int(0, len(machines))]
+                b = machines[rng.random_int(0, len(machines))]
+                c.net.clog_pair(a, b, rng.random01() * 0.5)
+        except KeyError:
+            pass  # nothing of that kind alive right now
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_cycle_survives_attrition(seed):
+    """20 seeds of Cycle + attrition + BUGGIFY on a durable cluster."""
+    c = SimCluster(seed=1000 + seed, durable=True, buggify=True,
+                   n_workers=5)
+    try:
+        db = c.client()
+        dbs = [c.client(f"c{i}") for i in range(2)]
+        machines = [f"w{i}" for i in range(c.n_workers)]
+
+        async def main():
+            await _cycle_setup(db)
+            tasks = [flow.spawn(_cycle_swaps(d, 5)) for d in dbs]
+            tasks.append(flow.spawn(_attrition(c, 2, machines)))
+            await flow.wait_for_all(tasks)
+            await _cycle_check(db)
+            return True
+
+        assert c.run(main(), timeout_time=900)
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_replicated_sharded_cycle_attrition(seed):
+    """The full shape (2 logs, 2 shards, 2 resolvers) under attrition."""
+    c = SimCluster(seed=2000 + seed, durable=True, buggify=True,
+                   n_logs=2, n_storage=2, n_resolvers=2, n_workers=6)
+    try:
+        db = c.client()
+        machines = [f"w{i}" for i in range(c.n_workers)]
+
+        async def main():
+            await _cycle_setup(db)
+            tasks = [flow.spawn(_cycle_swaps(db, 6))]
+            tasks.append(flow.spawn(_attrition(c, 3, machines)))
+            await flow.wait_for_all(tasks)
+            await _cycle_check(db)
+            return True
+
+        assert c.run(main(), timeout_time=900)
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_marker_exactness_under_kills(seed):
+    """Atomic all-or-nothing commits under faults: each transaction
+    writes a unique marker + increments a counter; on
+    commit_unknown_result the client re-reads the marker to learn the
+    outcome (the reference's idempotency pattern). The final counter
+    must equal the number of markers present."""
+    c = SimCluster(seed=3000 + seed, durable=True, buggify=True,
+                   n_workers=5)
+    try:
+        db = c.client()
+
+        async def main():
+            applied = 0
+            for i in range(15):
+                marker = b"mark%04d" % i
+                tr = db.create_transaction()
+                committed = None
+                for _attempt in range(100):
+                    try:
+                        cur = int(await tr.get(b"counter") or b"0")
+                        tr.set(b"counter", b"%d" % (cur + 1))
+                        tr.set(marker, b"1")
+                        await tr.commit()
+                        committed = True
+                        break
+                    except flow.FdbError as e:
+                        if e.name == "commit_unknown_result":
+                            # did it actually apply?
+                            async def probe(tr2, marker=marker):
+                                return await tr2.get(marker)
+                            got = await run_transaction(db, probe,
+                                                        max_retries=200)
+                            if got is not None:
+                                committed = True
+                                break
+                            await tr.on_error(e)
+                        else:
+                            await tr.on_error(e)
+                assert committed is not None, "txn never decided"
+                applied += 1
+                if i in (4, 9):
+                    try:
+                        c.kill_role("tlog" if i == 4 else "proxy")
+                    except KeyError:
+                        pass
+
+            async def check(tr):
+                n = int(await tr.get(b"counter") or b"0")
+                marks = await tr.get_range(b"mark", b"marl")
+                assert n == len(marks) == applied, (n, len(marks), applied)
+            await run_transaction(db, check, max_retries=200)
+            return True
+
+        assert c.run(main(), timeout_time=900)
+    finally:
+        c.shutdown()
