@@ -43,15 +43,20 @@ WINDOW_BATCHES = MWTLV // VERSION_STEP
 
 
 def make_batch(rng, n_txns, keyspace, version):
-    """Pre-encoded arrays for one batch: 8-byte big-endian point keys."""
+    """Pre-encoded arrays for one batch: 16-byte big-endian point keys
+    (the reference microbench's key width, SkipList.cpp:1429-1502 —
+    round-2 VERDICT asked for the matching shape)."""
     rk = rng.integers(0, keyspace, size=n_txns * READS_PER_TXN, dtype=np.int64)
     wk = rng.integers(0, keyspace, size=n_txns, dtype=np.int64)
 
     def enc(idx, end):
         k = np.zeros((idx.shape[0], N_WORDS + 1), np.uint32)
-        k[:, 0] = (idx >> 32).astype(np.uint32)
-        k[:, 1] = (idx & 0xFFFFFFFF).astype(np.uint32)
-        k[:, N_WORDS] = 9 if end else 8  # end key = key + b"\x00"
+        # low words carry the id -> full-width 16-byte keys; the end key
+        # is key + b"\x00", encoded as the same words + length 17 (the
+        # row compare is lexicographic over (words, length))
+        k[:, N_WORDS - 2] = (idx >> 32).astype(np.uint32)
+        k[:, N_WORDS - 1] = (idx & 0xFFFFFFFF).astype(np.uint32)
+        k[:, N_WORDS] = KEY_BYTES + 1 if end else KEY_BYTES
         return k
 
     snapshots = np.full(n_txns, version - VERSION_STEP, np.int64)
@@ -106,9 +111,9 @@ def _measure_device_run(run, probe_count, init_state, n_batches, cap, slack):
 
 def bench_tpu_point(n_txns, n_batches, keyspace):
     """Device-driven point-mode bench: batches generated on-device, all
-    n_batches resolve steps chained in one fori_loop dispatch. 8-byte
-    point keys (value < keyspace in the low word), READS_PER_TXN point
-    reads + 1 point write per txn."""
+    n_batches resolve steps chained in one fori_loop dispatch. 16-byte
+    point keys (id in the low words), READS_PER_TXN point reads + 1
+    point write per txn."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -119,7 +124,7 @@ def bench_tpu_point(n_txns, n_batches, keyspace):
     n_txns = next_pow2(n_txns)
     if (n_batches + 4) * VERSION_STEP >= (1 << 30):
         raise ValueError("FDBTPU_BENCH_BATCHES too large for int32 offsets")
-    n_words = 2  # 8-byte point keys
+    n_words = N_WORDS  # 16-byte point keys (reference microbench width)
     nr = next_pow2(n_txns * READS_PER_TXN)
     nw = n_txns
     # steady state: one write row per txn per batch, live for
@@ -130,8 +135,8 @@ def bench_tpu_point(n_txns, n_batches, keyspace):
     def gen_keys(key, slots):
         idx = jax.random.randint(key, (slots,), 0, keyspace, dtype=jnp.int32)
         k = jnp.zeros((slots, n_words + 1), jnp.uint32)
-        k = k.at[:, 1].set(idx.astype(jnp.uint32))
-        return k.at[:, n_words].set(8)
+        k = k.at[:, n_words - 1].set(idx.astype(jnp.uint32))
+        return k.at[:, n_words].set(KEY_BYTES)
 
     rt = jnp.asarray(np.minimum(
         np.arange(nr) // READS_PER_TXN, n_txns).astype(np.int32))
@@ -208,8 +213,8 @@ def bench_tpu(n_txns, n_batches, keyspace):
     def gen_keys(key, slots):
         idx = jax.random.randint(key, (slots,), 0, keyspace, dtype=jnp.int32)
         k = jnp.zeros((slots, n_words + 1), jnp.uint32)
-        k = k.at[:, 1].set(idx.astype(jnp.uint32))
-        return k.at[:, n_words].set(8)
+        k = k.at[:, n_words - 1].set(idx.astype(jnp.uint32))
+        return k.at[:, n_words].set(KEY_BYTES)
 
     rt = jnp.asarray(np.minimum(
         np.arange(nr) // READS_PER_TXN, n_txns).astype(np.int32))
@@ -221,9 +226,9 @@ def bench_tpu(n_txns, n_batches, keyspace):
     def one_step(i, hk, hv, key):
         key, kr, kw = jax.random.split(key, 3)
         rb = gen_keys(kr, nr)
-        re = rb.at[:, n_words].set(9)
+        re = rb.at[:, n_words].set(KEY_BYTES + 1)  # end = key + b"\x00"
         wb = gen_keys(kw, nw)
-        we = wb.at[:, n_words].set(9)
+        we = wb.at[:, n_words].set(KEY_BYTES + 1)
         commit = (jnp.int32(i) + 2) * VERSION_STEP
         snap = jnp.full((n_txns,), 1, jnp.int32) * (commit - VERSION_STEP)
         oldest = jnp.maximum(commit - MWTLV, 0)
@@ -257,14 +262,24 @@ def bench_tpu(n_txns, n_batches, keyspace):
     return n_batches * n_txns / elapsed, n_conflicts
 
 
-def bench_tpu_streamed(n_txns, n_batches, keyspace):
-    """Host-fed path: per-batch H2D + dispatch through resolve_arrays.
-    Measures the full host->device pipeline (bounded by link bandwidth
-    on tunneled setups, not by the kernel)."""
+def bench_tpu_streamed(n_txns, n_batches, keyspace, backend="point"):
+    """Host-fed path: per-batch H2D + dispatch through resolve_arrays —
+    what a real resolver role pays per batch, marshalling and transfer
+    included. JAX's async dispatch double-buffers naturally: batch i+1's
+    host prep and H2D overlap batch i's device compute because nothing
+    blocks on a result until the very end (verdict readbacks are
+    deferred device arrays). `backend` picks the point kernel (the FDB
+    hot-path shape, default) or the general interval kernel."""
+    from foundationdb_tpu.models.point_resolver import PointConflictSet
     from foundationdb_tpu.models.tpu_resolver import TpuConflictSet
+    from foundationdb_tpu.ops.keys import next_pow2
 
     rng = np.random.default_rng(20260729)
-    cs = TpuConflictSet(key_bytes=KEY_BYTES, capacity=1 << 17)
+    cap = next_pow2((WINDOW_BATCHES + 2) * n_txns + 2)
+    if backend == "point":
+        cs = PointConflictSet(key_bytes=KEY_BYTES, capacity=cap)
+    else:
+        cs = TpuConflictSet(key_bytes=KEY_BYTES, capacity=next_pow2(2 * cap))
     version = VERSION_STEP
     warmup = 3
 
@@ -301,10 +316,10 @@ def bench_cpu(backend, n_txns, n_batches, keyspace):
             reads = []
             for _ in range(READS_PER_TXN):
                 k = int(rng.integers(0, keyspace))
-                kb = k.to_bytes(8, "big")
+                kb = k.to_bytes(KEY_BYTES, "big")
                 reads.append((kb, kb + b"\x00"))
             k = int(rng.integers(0, keyspace))
-            kb = k.to_bytes(8, "big")
+            kb = k.to_bytes(KEY_BYTES, "big")
             txns.append(ResolverTransaction(v - VERSION_STEP, tuple(reads),
                                             ((kb, kb + b"\x00"),)))
         return txns
@@ -318,20 +333,50 @@ def bench_cpu(backend, n_txns, n_batches, keyspace):
     return n_batches * n_txns / (time.perf_counter() - t0), n_conflicts
 
 
+def _run_backend(backend, n_txns, n_batches, keyspace):
+    if backend == "tpu-point":
+        return bench_tpu_point(n_txns, n_batches, keyspace)
+    if backend == "tpu":
+        return bench_tpu(n_txns, n_batches, keyspace)
+    if backend == "tpu-streamed":
+        return bench_tpu_streamed(n_txns, n_batches, keyspace)
+    if backend == "tpu-streamed-interval":
+        return bench_tpu_streamed(n_txns, n_batches, keyspace, "interval")
+    return bench_cpu(backend, n_txns, n_batches, keyspace)
+
+
 def main():
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env-only JAX_PLATFORMS=cpu wedges device init when the axon
+        # TPU plugin was registered at interpreter start; the explicit
+        # config update (what tests/conftest.py does) actually sticks
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 16384))
     n_batches = int(os.environ.get("FDBTPU_BENCH_BATCHES", 100))
     keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 4_000_000))
-    backend = os.environ.get("FDBTPU_BENCH_BACKEND", "tpu-point")
+    backend = os.environ.get("FDBTPU_BENCH_BACKEND", "all")
 
-    if backend == "tpu-point":
-        txn_per_s, n_conflicts = bench_tpu_point(n_txns, n_batches, keyspace)
-    elif backend == "tpu":
-        txn_per_s, n_conflicts = bench_tpu(n_txns, n_batches, keyspace)
-    elif backend == "tpu-streamed":
-        txn_per_s, n_conflicts = bench_tpu_streamed(n_txns, n_batches, keyspace)
+    sub = {}
+    if backend == "all":
+        # the honest triple (round-2 VERDICT task 5): peak device-driven
+        # point + interval kernels, and the host-streamed pipeline —
+        # all with 16-byte keys. The STREAMED number is the headline:
+        # it is what a resolver role actually pays per batch.
+        for name, fn in (("tpu-point", bench_tpu_point),
+                         ("tpu", bench_tpu),
+                         ("tpu-streamed", bench_tpu_streamed)):
+            tps, nc = fn(n_txns, n_batches, keyspace)
+            sub[name] = {"txn_per_s": round(tps, 1),
+                         "vs_baseline": round(tps / TARGET_TXN_PER_S, 4),
+                         "conflicts": nc}
+        txn_per_s = sub["tpu-streamed"]["txn_per_s"]
+        n_conflicts = sub["tpu-streamed"]["conflicts"]
+        backend_name = "tpu-streamed"
     else:
-        txn_per_s, n_conflicts = bench_cpu(backend, n_txns, n_batches, keyspace)
+        txn_per_s, n_conflicts = _run_backend(backend, n_txns, n_batches,
+                                              keyspace)
+        backend_name = backend
 
     print(json.dumps({
         "metric": "resolver_throughput",
@@ -339,11 +384,13 @@ def main():
         "unit": "txn/s",
         "vs_baseline": round(txn_per_s / TARGET_TXN_PER_S, 4),
         "config": {
-            "backend": backend, "batch_txns": n_txns, "batches": n_batches,
-            "reads_per_txn": READS_PER_TXN, "writes_per_txn": 1,
-            "keyspace": keyspace, "window_batches": WINDOW_BATCHES,
+            "backend": backend_name, "batch_txns": n_txns,
+            "batches": n_batches, "reads_per_txn": READS_PER_TXN,
+            "writes_per_txn": 1, "keyspace": keyspace,
+            "window_batches": WINDOW_BATCHES, "key_bytes": KEY_BYTES,
             "conflicts": n_conflicts,
         },
+        "sub_metrics": sub,
     }))
 
 

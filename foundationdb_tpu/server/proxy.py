@@ -22,7 +22,7 @@ from __future__ import annotations
 from typing import List
 
 from .. import flow
-from ..flow import NotifiedVersion, TaskPriority, error
+from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
 from .types import (CLEAR_RANGE, SET_VALUE, SET_VERSIONSTAMPED_KEY,
@@ -73,6 +73,10 @@ class Proxy:
         # keyServers boundaries: storage tag i owns [sbounds[i], sbounds[i+1])
         self._sbounds = [b""] + list(storage_splits) + [None]
         self.tlog_refs = list(tlog_refs)
+        batch_window = max(batch_window,
+                           SERVER_KNOBS.commit_transaction_batch_interval_min)
+        max_batch = min(max_batch,
+                        SERVER_KNOBS.commit_transaction_batch_count_max)
         if flow.buggify("proxy/small_batch_window"):
             # shrink the batcher to one-or-two txn batches: stresses the
             # pipeline interlocks and resolver ordering under load

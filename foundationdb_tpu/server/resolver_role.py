@@ -15,12 +15,10 @@ from __future__ import annotations
 from collections import deque
 
 from .. import flow
-from ..flow import NotifiedVersion, TaskPriority
+from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority
 from ..models import ResolverTransaction, create_conflict_set
 from ..rpc import RequestStream, SimProcess
 from .types import ResolveRequest
-
-MAX_WRITE_TRANSACTION_LIFE_VERSIONS = 5_000_000  # ref: Knobs.cpp:35
 
 
 class Resolver:
@@ -28,6 +26,8 @@ class Resolver:
                  recovery_version: int = 0):
         self.process = process
         self.conflict_set = create_conflict_set(backend, recovery_version)
+        # the MVCC window width (ref: Knobs.cpp:35; BUGGIFY shrinks it)
+        self._mwtlv = SERVER_KNOBS.max_write_transaction_life_versions
         self.version = NotifiedVersion(recovery_version)
         self.resolves = RequestStream(process)
         self._actors = flow.ActorCollection()
@@ -72,7 +72,7 @@ class Resolver:
         txns = [ResolverTransaction(t.read_snapshot, t.read_conflict_ranges,
                                     t.write_conflict_ranges)
                 for t in req.transactions]
-        new_oldest = max(0, req.version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+        new_oldest = max(0, req.version - self._mwtlv)
         try:
             verdicts = self.conflict_set.resolve(txns, req.version, new_oldest)
         except (ValueError, OverflowError) as e:

@@ -151,16 +151,23 @@ class TLog:
         durable actors are spawned in version order, so log records land
         on disk in version order."""
         version = req.version
-        if flow.buggify("tlog/slow_fsync"):
-            # a straggling disk: widens the window where commits are
-            # accepted but not durable (stresses lock + recovery races)
-            await flow.delay(flow.g_random.random01() * 0.01,
-                             TaskPriority.TLOG_COMMIT_REPLY)
         if self._dq is None:
+            if flow.buggify("tlog/slow_fsync"):
+                await flow.delay(flow.g_random.random01() * 0.01,
+                                 TaskPriority.TLOG_COMMIT_REPLY)
             await flow.delay(self.fsync_delay, TaskPriority.TLOG_COMMIT_REPLY)
+            # variable delays must not reorder durability acks
+            await self.version.when_at_least(req.prev_version)
         else:
             await self._dq_lock.take()
             try:
+                if flow.buggify("tlog/slow_fsync"):
+                    # a straggling disk: widens the accepted-but-not-
+                    # durable window (stresses lock + recovery races).
+                    # INSIDE the FIFO lock: records must still land on
+                    # disk in version order (code review r3)
+                    await flow.delay(flow.g_random.random01() * 0.01,
+                                     TaskPriority.TLOG_COMMIT_REPLY)
                 seq = await self._dq.push(
                     encode_log_entry(version, req.mutations))
                 await self._dq.commit()
