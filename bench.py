@@ -363,10 +363,8 @@ def main():
         # point + interval kernels, and the host-streamed pipeline —
         # all with 16-byte keys. The STREAMED number is the headline:
         # it is what a resolver role actually pays per batch.
-        for name, fn in (("tpu-point", bench_tpu_point),
-                         ("tpu", bench_tpu),
-                         ("tpu-streamed", bench_tpu_streamed)):
-            tps, nc = fn(n_txns, n_batches, keyspace)
+        for name in ("tpu-point", "tpu", "tpu-streamed"):
+            tps, nc = _run_backend(name, n_txns, n_batches, keyspace)
             sub[name] = {"txn_per_s": round(tps, 1),
                          "vs_baseline": round(tps / TARGET_TXN_PER_S, 4),
                          "conflicts": nc}

@@ -76,12 +76,17 @@ class Database:
                 _OpenDatabaseRequest(-1), self.process)
         return self._info
 
-    async def refresh(self) -> None:
-        """Long-poll the CC for a newer picture (after a failure, this
-        resolves once recovery has produced one)."""
-        known = self._info.seq if self._info is not None else -1
+    async def refresh_past(self, used_seq: int) -> None:
+        """Ensure the cached picture is newer than `used_seq` — the
+        broadcast sequence the FAILED attempt actually used. Long-polls
+        the CC only when the cache hasn't already moved past it: another
+        transaction's retry may have refreshed first, and waiting for
+        something newer than an already-current picture would deadlock
+        a healthy cluster (round-3 fix)."""
+        if self._info is not None and self._info.seq > used_seq:
+            return
         self._info = await self.cluster_ref.get_reply(
-            _OpenDatabaseRequest(known), self.process)
+            _OpenDatabaseRequest(used_seq), self.process)
 
     async def proxy(self):
         info = await self.info()
@@ -119,6 +124,7 @@ class Transaction:
         self.reset()
 
     def reset(self) -> None:
+        self._used_seq: int = 0       # newest dbinfo seq this attempt saw
         self._read_version: Optional[int] = None
         self._writes: Dict[bytes, Optional[bytes]] = {}  # RYW write map
         self._write_order: List[bytes] = []              # sorted keys
@@ -131,10 +137,27 @@ class Transaction:
         self.committed_version: Optional[int] = None
         self.committed_batch_index: Optional[int] = None
 
+    async def _get_info(self):
+        """Cluster picture for this attempt, recording the seq so
+        on_error knows which picture actually failed."""
+        info = await self.db.info()
+        if info.seq > self._used_seq:
+            self._used_seq = info.seq
+        return info
+
+    async def _proxy(self):
+        info = await self._get_info()
+        return info.proxies[flow.g_random.random_int(
+            0, len(info.proxies))]
+
+    async def _shard(self, key: bytes):
+        info = await self._get_info()
+        return info.storages[_shard_index(info.storages, key)]
+
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            proxy = await self.db.proxy()
+            proxy = await self._proxy()
             reply = await _rpc(proxy.grvs.get_reply(None, self.db.process))
             self._read_version = reply.version
         return self._read_version
@@ -155,7 +178,7 @@ class Transaction:
         if found:
             return val
         version = await self.get_read_version()
-        shard = await self.db.shard_for(key)
+        shard = await self._shard(key)
         return await _rpc(shard.gets.get_reply(
             StorageGetRequest(key, version), self.db.process))
 
@@ -175,7 +198,7 @@ class Transaction:
         the offset leaves the anchor shard (ref: Transaction::getKey /
         NativeAPI getKey readThrough iteration)."""
         version = await self.get_read_version()
-        info = await self.db.info()
+        info = await self._get_info()
         storages = info.storages
         i = _shard_index(storages, selector.key)
         sel = selector
@@ -243,7 +266,7 @@ class Transaction:
                 val = merged.get(k)
                 if val is None and k not in self._writes and \
                         not any(b <= k < e for b, e in self._cleared):
-                    shard = await self.db.shard_for(k)
+                    shard = await self._shard(k)
                     val = await _rpc(shard.gets.get_reply(
                         StorageGetRequest(k, version), self.db.process))
                 for op, param in ops:
@@ -273,7 +296,7 @@ class Transaction:
         """Fan a range read across the shards it overlaps, honoring the
         limit shard by shard (ref: NativeAPI getRange iterating the
         location cache)."""
-        info = await self.db.info()
+        info = await self._get_info()
         shards = _overlapping_shards(info.storages, begin, end)
         if reverse:
             shards = shards[::-1]
@@ -365,7 +388,7 @@ class Transaction:
                             tuple(self._write_conflicts),
                             tuple(self._mutations))
         try:
-            proxy = await self.db.proxy()
+            proxy = await self._proxy()
             reply = await _rpc(proxy.commits.get_reply(req, self.db.process))
         except flow.FdbError as e:
             for _k, f in self._watches:
@@ -413,7 +436,7 @@ class Transaction:
         if not (isinstance(e, flow.FdbError) and e.name in RETRYABLE):
             raise e
         if e.name in REFRESH_ERRORS:
-            await self.db.refresh()
+            await self.db.refresh_past(self._used_seq)
         await flow.delay(0.001 + flow.g_random.random01() * 0.01,
                          TaskPriority.DEFAULT_ENDPOINT)
         self.reset()

@@ -146,7 +146,8 @@ class ClusterController:
         for wi in self.workers.values():
             w = wi.worker
             for name, role in list(w.roles.items()):
-                if name.startswith((f"proxy-e{epoch}", f"resolver-e{epoch}")):
+                if name.startswith((f"proxy-e{epoch}", f"resolver-e{epoch}",
+                                    f"ratekeeper-e{epoch}")):
                     stop = getattr(role, "stop", None)
                     if stop is not None:
                         stop()
@@ -220,6 +221,18 @@ class ClusterController:
             storages.append(refs)
             self._storage_objs[refs.name] = w.roles[refs.name]
         self.publish(info._replace(storages=tuple(storages)))
+
+    def tlog_objs(self):
+        """Live TLog role objects of the current generation (stats feed
+        for the ratekeeper; sim stand-in for TLogQueuingMetrics)."""
+        out = []
+        info = self.dbinfo.get()
+        for lr in info.logs.logs:
+            for wi in self.workers.values():
+                obj = wi.worker.roles.get(lr.store)
+                if obj is not None and wi.worker.process.alive:
+                    out.append(obj)
+        return out
 
     def min_storage_version(self) -> int:
         """Smallest DURABLE version across shards — the floor for

@@ -156,6 +156,9 @@ class MasterRecovery:
                                 for i in range(1, cfg.n_resolvers))
         self.cc.recruit_initial_storages()
         storage_splits = self.cc.storage_splits()
+        rk_worker = self.cc.pick_workers(1, role="ratekeeper")[0]
+        rk_ref = rk_worker.recruit_ratekeeper(
+            f"ratekeeper-e{self.epoch}", self.cc)
         proxy_workers = self.cc.pick_workers(cfg.n_proxies, role="proxy")
         proxies = []
         for i, w in enumerate(proxy_workers):
@@ -164,7 +167,7 @@ class MasterRecovery:
                 self.master.version_requests.ref(),
                 resolver_refs, [r.commits for r in new_logs],
                 resolver_splits, storage_splits,
-                recovery_version))
+                recovery_version, ratekeeper_ref=rk_ref))
             self.critical_procs.add(w.process)
         proxies = tuple(proxies)
         # each proxy confirms GRVs with every other proxy (ref:

@@ -59,7 +59,8 @@ class Proxy:
                  resolver_refs, tlog_refs,
                  resolver_splits=(), storage_splits=(),
                  recovery_version: int = 0,
-                 batch_window: float = 0.001, max_batch: int = 512):
+                 batch_window: float = 0.001, max_batch: int = 512,
+                 ratekeeper_ref: NetworkRef = None):
         if not isinstance(resolver_refs, (list, tuple)):
             resolver_refs = [resolver_refs]
         if not isinstance(tlog_refs, (list, tuple)):
@@ -94,6 +95,10 @@ class Proxy:
         self.batch_logging = NotifiedVersion(0)
         self._local_batch = 0
         self._peers = []               # other proxies' raw-committed refs
+        self._ratekeeper_ref = ratekeeper_ref
+        self._rate = 1e9               # tps budget (ratekeeper-fed)
+        self._grv_queue = []           # waiting GRV replies
+        self._grv_inflight = []        # batch being confirmed right now
         self.commits = RequestStream(process)
         self.grvs = RequestStream(process)
         self.raw_committed = RequestStream(process)
@@ -111,9 +116,16 @@ class Proxy:
         self._actors.add(flow.spawn(self._grv_loop(),
                                     TaskPriority.PROXY_GET_CONSISTENT_READ_VERSION,
                                     name=f"{self.process.name}.grv"))
+        self._actors.add(flow.spawn(self._grv_batcher(),
+                                    TaskPriority.PROXY_GRV_TIMER,
+                                    name=f"{self.process.name}.grvBatcher"))
         self._actors.add(flow.spawn(self._raw_committed_loop(),
                                     TaskPriority.PROXY_GET_RAW_COMMITTED_VERSION,
                                     name=f"{self.process.name}.rawCommitted"))
+        if self._ratekeeper_ref is not None:
+            self._actors.add(flow.spawn(self._rate_loop(),
+                                        TaskPriority.PROXY_GRV_TIMER,
+                                        name=f"{self.process.name}.rate"))
         self.process.on_kill(self._actors.cancel_all)
 
     def stop(self) -> None:
@@ -124,33 +136,81 @@ class Proxy:
         self.commits.close()
         self.grvs.close()
         self.raw_committed.close()
+        # a stop mid-confirmation must fail the popped batch too, or
+        # those clients wait out the full request timeout (code review)
+        for reply in self._grv_queue + self._grv_inflight:
+            reply.send_error(error("broken_promise"))
+        self._grv_queue = []
+        self._grv_inflight = []
 
     # -- GRV ------------------------------------------------------------
     async def _grv_loop(self):
+        """Queue GRV requests for the batcher (ref: transactionStarter
+        :1102 — requests are batched on a timer and released at the
+        ratekeeper's rate)."""
         while True:
             _req, reply = await self.grvs.pop()
-            if not self._peers:
-                reply.send(GetReadVersionReply(self.committed_version.get()))
-            else:
-                flow.spawn(self._serve_grv(reply),
-                           TaskPriority.PROXY_GET_CONSISTENT_READ_VERSION)
+            self._grv_queue.append(reply)
 
-    async def _serve_grv(self, reply):
+    async def _grv_batcher(self):
+        """Release queued GRVs in rate-gated batches; one causal
+        confirmation round-trip serves the whole batch (ref:
+        GRV batching in transactionStarter + getLiveCommittedVersion)."""
+        interval = SERVER_KNOBS.grv_batch_interval
+        tokens = 0.0
+        last = flow.now()
+        while True:
+            await flow.delay(interval, TaskPriority.PROXY_GRV_TIMER)
+            now = flow.now()
+            # token bucket with a one-interval burst allowance
+            tokens = min(tokens + self._rate * (now - last),
+                         max(1.0, self._rate * 10 * interval))
+            last = now
+            if not self._grv_queue:
+                continue
+            n = min(len(self._grv_queue), int(tokens))
+            if n <= 0:
+                continue
+            tokens -= n
+            self._grv_inflight, self._grv_queue = (self._grv_queue[:n],
+                                                   self._grv_queue[n:])
+            try:
+                await self._serve_grv_batch(self._grv_inflight)
+            finally:
+                self._grv_inflight = []
+
+    async def _serve_grv_batch(self, batch):
         """Causally-correct GRV with multiple proxies: the read version
         is the max committed version across ALL of them, so a client
         never reads below its own acknowledged commit through a
         different proxy (ref: getLiveCommittedVersion,
         MasterProxyServer.actor.cpp:1019 — asks all other proxies; a
-        dead peer fails the request and the client retries after
+        dead peer fails the batch and the clients retry after
         recovery)."""
         try:
-            futs = [flow.timeout_error(p.get_reply(None, self.process), 2.0)
-                    for p in self._peers]
-            others = await flow.all_of(futs)
-            reply.send(GetReadVersionReply(
-                max([self.committed_version.get()] + list(others))))
+            version = self.committed_version.get()
+            if self._peers:
+                futs = [flow.timeout_error(p.get_reply(None, self.process),
+                                           2.0)
+                        for p in self._peers]
+                others = await flow.all_of(futs)
+                version = max([version] + list(others))
+            for reply in batch:
+                reply.send(GetReadVersionReply(version))
         except flow.FdbError as e:
-            reply.send_error(e)
+            for reply in batch:
+                reply.send_error(e)
+
+    async def _rate_loop(self):
+        """(ref: proxies polling GetRateInfo from the ratekeeper)"""
+        while True:
+            try:
+                r = await flow.timeout_error(
+                    self._ratekeeper_ref.get_reply(None, self.process), 1.0)
+                self._rate = r.tps
+            except flow.FdbError:
+                pass  # keep the last known rate
+            await flow.delay(0.1, TaskPriority.PROXY_GRV_TIMER)
 
     async def _raw_committed_loop(self):
         while True:

@@ -32,7 +32,6 @@ from .types import (ADD_VALUE, AND, APPEND_IF_FITS, BYTE_MAX, BYTE_MIN,
                     StorageGetRangeRequest, StorageGetRequest,
                     StorageWatchRequest, TLogPeekRequest, TLogPopRequest, XOR)
 
-MAX_READ_AHEAD_VERSIONS = 5_000_000  # ref: MAX_READ_TRANSACTION_LIFE_VERSIONS
 DURABLE_VERSION_KEY = b"\xff\xff/storageDurableVersion"
 SHARD_META_KEY = b"\xff\xff/shardMeta"   # persisted tag + owned range
 _NO_HINT = object()  # sentinel: _get_hinted must consult the base engine

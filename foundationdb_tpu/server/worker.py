@@ -127,16 +127,27 @@ class Worker:
 
     def recruit_proxy(self, name: str, master_ref, resolver_refs, tlog_refs,
                       resolver_splits, storage_splits,
-                      recovery_version: int) -> ProxyRefs:
+                      recovery_version: int,
+                      ratekeeper_ref=None) -> ProxyRefs:
         self._check_alive()
         p = Proxy(self.process, master_ref, resolver_refs, tlog_refs,
                   resolver_splits=resolver_splits,
                   storage_splits=storage_splits,
-                  recovery_version=recovery_version)
+                  recovery_version=recovery_version,
+                  ratekeeper_ref=ratekeeper_ref)
         p.start()
         self.roles[name] = p
         return ProxyRefs(name, p.grvs.ref(), p.commits.ref(),
                          p.raw_committed.ref())
+
+    def recruit_ratekeeper(self, name: str, cc):
+        """(ref: the CC recruiting the ratekeeper singleton)"""
+        self._check_alive()
+        from .ratekeeper import Ratekeeper
+        rk = Ratekeeper(self.process, cc)
+        rk.start()
+        self.roles[name] = rk
+        return rk.get_rate.ref()
 
     def recruit_master(self, name: str, recovery_version: int) -> Master:
         self._check_alive()

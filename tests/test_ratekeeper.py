@@ -1,0 +1,76 @@
+"""Ratekeeper admission control: GRVs are batched and rate-gated; an
+unhealthy cluster throttles instead of growing queues without bound.
+
+Ref: fdbserver/Ratekeeper.actor.cpp (updateRate :150-635),
+MasterProxyServer.actor.cpp transactionStarter (:1102, GRV batching).
+"""
+
+from foundationdb_tpu import flow
+from foundationdb_tpu.client import run_transaction
+from foundationdb_tpu.server import SimCluster
+
+
+def test_healthy_cluster_grvs_flow_freely():
+    c = SimCluster(seed=401)
+    try:
+        db = c.client()
+
+        async def main():
+            async def body(tr):
+                tr.set(b"x", b"1")
+            await run_transaction(db, body)
+            served = 0
+            end = flow.now() + 0.5
+            while flow.now() < end:
+                tr = db.create_transaction()
+                await tr.get_read_version()
+                served += 1
+            assert served > 50, served
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
+
+
+def test_dead_storage_throttles_admission():
+    """With a shard dead (auto-reboot off), the ratekeeper drops the
+    budget to a trickle: GRV admission — and therefore the TLog's
+    unpopped backlog — stays bounded instead of growing with demand
+    (round-2 VERDICT task 10)."""
+    c = SimCluster(seed=409, durable=True, auto_reboot=False)
+    try:
+        db = c.client()
+
+        async def main():
+            async def body(tr):
+                tr.set(b"x", b"1")
+            await run_transaction(db, body)
+            c.kill_role("storage")
+            await flow.delay(0.3)   # let the ratekeeper notice
+
+            served = [0]
+
+            async def flood(cl):
+                end = flow.now() + 2.0
+                while flow.now() < end:
+                    tr = cl.create_transaction()
+                    try:
+                        await flow.timeout_error(
+                            flow.spawn(tr.get_read_version()), 3.0)
+                        served[0] += 1
+                    except flow.FdbError:
+                        return
+            clients = [c.client(f"fl{i}") for i in range(10)]
+            await flow.wait_for_all([flow.spawn(flood(cl))
+                                     for cl in clients])
+            # trickle: ~10 tps * 2s, plus scheduling slack — nowhere
+            # near the hundreds/second a healthy cluster serves
+            assert served[0] <= 60, served[0]
+            logs = c.cc.tlog_objs()
+            assert logs and all(len(t.entries) < 1000 for t in logs)
+            return True
+
+        assert c.run(main(), timeout_time=300)
+    finally:
+        c.shutdown()
