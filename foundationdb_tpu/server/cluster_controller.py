@@ -105,6 +105,7 @@ class ClusterController:
             flow.TraceEvent("MasterEpochFailed", self.process.name).detail(
                 Reason=failed).log()
             self._recovery_task.cancel()
+            self._recovery.aux.cancel_all()
             if self._recovery.master is not None:
                 self._recovery.master.stop()
             self._cancel_old_roles()

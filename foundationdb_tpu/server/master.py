@@ -98,6 +98,7 @@ class MasterRecovery:
         # processes whose death ends this epoch (ref: the master's
         # waitFailure clients on proxies/resolvers/tlogs)
         self.critical_procs: set = set()
+        self.aux = flow.ActorCollection()  # epoch-lifetime helper actors
 
     def _trace(self, event: str, **details) -> None:
         flow.TraceEvent(event, self.process.name).detail(**details).log()
@@ -148,9 +149,12 @@ class MasterRecovery:
             self.critical_procs.add(w.process)
         res_workers = self.cc.pick_workers(cfg.n_resolvers, role="resolver")
         resolver_refs = []
+        resolver_metrics = []
         for i, w in enumerate(res_workers):
-            resolver_refs.append(w.recruit_resolver(
-                f"resolver-e{self.epoch}-{i}", recovery_version))
+            rref, mref = w.recruit_resolver(
+                f"resolver-e{self.epoch}-{i}", recovery_version)
+            resolver_refs.append(rref)
+            resolver_metrics.append(mref)
             self.critical_procs.add(w.process)
         resolver_splits = tuple(bytes([(i * 256) // cfg.n_resolvers])
                                 for i in range(1, cfg.n_resolvers))
@@ -208,8 +212,17 @@ class MasterRecovery:
         self.cc.publish(cur._replace(recovery_state=dbi.FULLY_RECOVERED))
         self._trace("MasterRecoveredFully", Epoch=self.epoch)
 
-        # Lifetime: drop drained old generations; serve until cancelled
-        await self._cleanup_old_logs()
+        # Lifetime: retire drained old generations + rebalance resolver
+        # load; both die with this epoch (CC cancels aux on teardown)
+        self.aux.add(flow.spawn(self._cleanup_old_logs(),
+                                TaskPriority.CLUSTER_CONTROLLER,
+                                name=f"master-e{self.epoch}.oldLogCleanup"))
+        if cfg.n_resolvers > 1:
+            self.aux.add(flow.spawn(
+                self._resolution_balancing(resolver_metrics, proxies),
+                TaskPriority.RESOLUTION_METRICS,
+                name=f"master-e{self.epoch}.resolutionBalancing"))
+        await self.aux.get_result()
 
     def _set_state(self, state: str) -> None:
         cur = self.cc.dbinfo.get()
@@ -242,6 +255,61 @@ class MasterRecovery:
             self._trace("MasterRecoveryWaitingForLogs",
                         Stores=",".join(s for s, _m in prev.logs))
             await flow.delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
+
+    async def _resolution_balancing(self, metric_refs, proxies) -> None:
+        """Shift key-range ownership from the most- to the least-loaded
+        resolver (ref: resolutionBalancing, masterserver.actor.cpp:1008
+        + ResolutionSplitRequest). Per round: poll each resolver's
+        cumulative work + first-byte key histogram, diff against the
+        last round, and — when the spread is material — move the loaded
+        resolver's hottest byte bucket, but only when the move reduces
+        the maximum (a single-bucket hotspot never bounces)."""
+        from .types import ResolverMoveRequest
+        n = len(metric_refs)
+        last_work = [0] * n
+        last_hist = [[0] * 256 for _ in range(n)]
+        while True:
+            await flow.delay(2.0, TaskPriority.RESOLUTION_METRICS)
+            settled = await flow.all_of([flow.catch_errors(
+                flow.timeout_error(ref.get_reply(None, self.process), 2.0))
+                for ref in metric_refs])
+            if any(f.is_error for f in settled):
+                continue
+            replies = [f.get() for f in settled]
+            dwork = [r.work_units - last_work[i]
+                     for i, r in enumerate(replies)]
+            dhist = [[r.key_hist[b] - last_hist[i][b] for b in range(256)]
+                     for i, r in enumerate(replies)]
+            last_work = [r.work_units for r in replies]
+            last_hist = [list(r.key_hist) for r in replies]
+            hi = max(range(n), key=lambda i: dwork[i])
+            lo = min(range(n), key=lambda i: dwork[i])
+            if dwork[hi] < 100 or dwork[hi] <= 2 * (dwork[lo] + 1):
+                continue
+            bucket = max(range(256), key=lambda b: dhist[hi][b])
+            moved = dhist[hi][bucket]
+            # only when it actually reduces the max load
+            if moved <= 0 or dwork[lo] + moved >= dwork[hi]:
+                continue
+            begin = bytes([bucket])
+            end = bytes([bucket + 1]) if bucket < 255 else None
+            self._trace("ResolutionBalancingMove", Bucket=bucket,
+                        From=hi, To=lo)
+            # every proxy MUST apply the move: a proxy that never
+            # applies would keep routing writes to the old owner only,
+            # re-opening the missed-conflict hole once others prune.
+            # Retry failures; a truly dead proxy ends the epoch anyway.
+            pending = list(proxies)
+            while pending:
+                settled2 = await flow.all_of([flow.catch_errors(
+                    flow.timeout_error(p.resolver_map.get_reply(
+                        ResolverMoveRequest(begin, end, lo),
+                        self.process), 2.0))
+                    for p in pending])
+                pending = [p for p, f in zip(pending, settled2)
+                           if f.is_error]
+                if pending:
+                    await flow.delay(0.2, TaskPriority.RESOLUTION_METRICS)
 
     async def _cleanup_old_logs(self) -> None:
         """Drop a drained old generation from the broadcast picture once

@@ -19,6 +19,7 @@ pipeline's interlock, exactly the reference's structure.
 
 from __future__ import annotations
 
+from bisect import bisect_right
 from typing import List
 
 from .. import flow
@@ -54,6 +55,85 @@ def _apply_versionstamp(m: MutationRef, stamp: bytes) -> MutationRef:
                        val[:off] + stamp + val[off + 10:])
 
 
+MWTLV = 5_000_000  # fallback window (ref: MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
+
+# Proxies apply a move at their own committed version, so two proxies'
+# apply points can differ by the move's delivery spread; former owners
+# are retained one extra second of versions beyond the window so a
+# write routed by the slowest proxy is still double-delivered when the
+# fastest proxy's clients check against it. (The reference versions
+# keyResolvers updates through the commit stream, eliminating skew
+# structurally — future work.)
+MOVE_SKEW_SLACK = 1_000_000
+
+
+class KeyResolverMap:
+    """keyResolvers: key ranges -> resolver owner HISTORY (newest
+    first). After a move, ranges keep routing to the former owner too
+    until a full MVCC window has passed — both resolvers then hold
+    complete write history for the range, so no conflict can be missed
+    across the transition (ref: the keyResolvers
+    KeyRangeMap<vector<pair<Version,int>>> in
+    MasterProxyServer.actor.cpp:204 and its double-delivery window)."""
+
+    def __init__(self, splits, n_resolvers: int, window: int = None):
+        self.bounds = [b""] + list(splits)   # range i = [bounds[i], next)
+        self.owners = [[(0, i)] for i in range(n_resolvers)]
+        # retention window must track the resolvers' knob-configured
+        # MVCC window or a move could drop a former owner while stale
+        # snapshots are still resolvable (code review r3)
+        self.window = (window if window is not None
+                       else SERVER_KNOBS.max_write_transaction_life_versions)
+
+    def _split_at(self, key: bytes) -> int:
+        i = bisect_right(self.bounds, key) - 1
+        if self.bounds[i] == key:
+            return i
+        self.bounds.insert(i + 1, key)
+        self.owners.insert(i + 1, list(self.owners[i]))
+        return i + 1
+
+    def move(self, begin: bytes, end, to_idx: int, at_version: int) -> None:
+        """Reassign [begin, end) to `to_idx` from `at_version` on; the
+        former owners stay live for one MVCC window."""
+        i = self._split_at(begin)
+        j = self._split_at(end) if end is not None else len(self.bounds)
+        for k in range(i, j):
+            if self.owners[k][0][1] != to_idx:
+                self.owners[k] = [(at_version, to_idx)] + self.owners[k]
+
+    def prune(self, commit_version: int) -> None:
+        """Drop former owners once the window (plus cross-proxy apply
+        skew slack) has passed the move."""
+        horizon = self.window + MOVE_SKEW_SLACK
+        for ow in self.owners:
+            while len(ow) > 1 and ow[-2][0] + horizon < commit_version:
+                ow.pop()
+
+    def live_owners(self, k: int):
+        return [idx for _v, idx in self.owners[k]]
+
+    def clip_per_resolver(self, txn_ranges, n_resolvers: int):
+        """For each resolver, the pieces of `txn_ranges` it must see
+        (current + windowed former owners). Bisects to the overlapped
+        span — the map can grow toward 257 entries as balancing splits
+        buckets, and this sits on the hot commit path."""
+        out = [[] for _ in range(n_resolvers)]
+        nb = len(self.bounds)
+        for b, e in txn_ranges:
+            k = max(0, bisect_right(self.bounds, b) - 1)
+            while k < nb and self.bounds[k] < e:
+                lo = self.bounds[k]
+                hi = self.bounds[k + 1] if k + 1 < nb else None
+                b2 = max(b, lo)
+                e2 = e if hi is None else min(e, hi)
+                if b2 < e2:
+                    for idx in self.live_owners(k):
+                        out[idx].append((b2, e2))
+                k += 1
+        return out
+
+
 class Proxy:
     def __init__(self, process: SimProcess, master_ref: NetworkRef,
                  resolver_refs, tlog_refs,
@@ -69,8 +149,10 @@ class Proxy:
         self.process = process
         self.master_ref = master_ref
         self.resolver_refs = list(resolver_refs)
-        # keyResolvers boundaries: resolver i owns [bounds[i], bounds[i+1})
-        self._bounds = [b""] + list(resolver_splits) + [None]
+        # keyResolvers: versioned range -> owner-history map (rebalanced
+        # at runtime by the master's resolutionBalancing)
+        self.key_resolvers = KeyResolverMap(resolver_splits,
+                                            len(resolver_refs))
         # keyServers boundaries: storage tag i owns [sbounds[i], sbounds[i+1])
         self._sbounds = [b""] + list(storage_splits) + [None]
         self.tlog_refs = list(tlog_refs)
@@ -102,6 +184,7 @@ class Proxy:
         self.commits = RequestStream(process)
         self.grvs = RequestStream(process)
         self.raw_committed = RequestStream(process)
+        self.resolver_map_updates = RequestStream(process)
         self._actors = flow.ActorCollection()
 
     def set_peers(self, raw_refs) -> None:
@@ -126,7 +209,21 @@ class Proxy:
             self._actors.add(flow.spawn(self._rate_loop(),
                                         TaskPriority.PROXY_GRV_TIMER,
                                         name=f"{self.process.name}.rate"))
+        self._actors.add(flow.spawn(self._map_update_loop(),
+                                    TaskPriority.PROXY_COMMIT,
+                                    name=f"{self.process.name}.keyResolvers"))
         self.process.on_kill(self._actors.cancel_all)
+
+    async def _map_update_loop(self):
+        """Apply keyResolvers moves from the master's balancing actor;
+        the move takes effect at this proxy's current committed version
+        and former owners stay live for a window (ref: the keyResolvers
+        updates flowing to proxies via resolutionBalancing)."""
+        while True:
+            req, reply = await self.resolver_map_updates.pop()
+            self.key_resolvers.move(req.begin, req.end, req.to_idx,
+                                    self.committed_version.get())
+            reply.send(None)
 
     def stop(self) -> None:
         """Epoch over: stop serving and break queued/future requests so
@@ -136,6 +233,7 @@ class Proxy:
         self.commits.close()
         self.grvs.close()
         self.raw_committed.close()
+        self.resolver_map_updates.close()
         # a stop mid-confirmation must fail the popped batch too, or
         # those clients wait out the full request timeout (code review)
         for reply in self._grv_queue + self._grv_inflight:
@@ -352,21 +450,26 @@ class Proxy:
             nv.set(to)
 
     async def _resolve_split(self, ver, reqs):
-        """Send each transaction's ranges clipped per resolver shard; every
-        resolver sees every batch version (possibly with no transactions)
-        so its NotifiedVersion ordering advances; a transaction's verdict
-        is the min over the resolvers that saw it."""
+        """Send each transaction's ranges clipped per resolver via the
+        keyResolvers map (current + windowed former owners after a
+        move); every resolver sees every batch version (possibly with
+        no transactions) so its NotifiedVersion ordering advances; a
+        transaction's verdict is the min over the resolvers that saw it
+        (ref: ResolutionRequestBuilder :265-341, combine :585-592)."""
         n_res = len(self.resolver_refs)
+        self.key_resolvers.prune(ver.version)
         per = [[] for _ in range(n_res)]   # [(orig_idx, clipped_req)]
         for idx, req in enumerate(reqs):
+            rr_per = self.key_resolvers.clip_per_resolver(
+                req.read_conflict_ranges, n_res)
+            wr_per = self.key_resolvers.clip_per_resolver(
+                req.write_conflict_ranges, n_res)
             placed = False
             for i in range(n_res):
-                lo, hi = self._bounds[i], self._bounds[i + 1]
-                rr = _clip_ranges(req.read_conflict_ranges, lo, hi)
-                wr = _clip_ranges(req.write_conflict_ranges, lo, hi)
-                if rr or wr:
+                if rr_per[i] or wr_per[i]:
                     per[i].append((idx, req._replace(
-                        read_conflict_ranges=rr, write_conflict_ranges=wr,
+                        read_conflict_ranges=tuple(rr_per[i]),
+                        write_conflict_ranges=tuple(wr_per[i]),
                         mutations=())))
                     placed = True
             if not placed:  # no conflict ranges at all -> resolver 0
@@ -382,15 +485,3 @@ class Proxy:
                 combined[idx] = min(combined[idx], v)
         return combined
 
-
-def _clip_ranges(ranges, lo, hi):
-    out = []
-    for b, e in ranges:
-        b2 = max(b, lo)
-        e2 = e if hi is None else min(e, hi)
-        if hi is None:
-            if b2 < e:
-                out.append((b2, e))
-        elif b2 < e2:
-            out.append((b2, e2))
-    return tuple(out)

@@ -76,6 +76,10 @@ class Ratekeeper:
             if obj is None or not obj.process.alive:
                 # a dead shard: lag is unbounded until it rejoins
                 return MIN_RATE
+            if obj.kv is None:
+                continue  # no engine: the durability loop is inert and
+                # lag is meaningless (defensive; cluster-recruited
+                # storages always have at least an ephemeral engine)
             excess = (obj.version.get() - obj.durable_version.get()
                       - obj._lag)
             worst_excess = max(worst_excess, excess)

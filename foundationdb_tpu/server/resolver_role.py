@@ -18,7 +18,7 @@ from .. import flow
 from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority
 from ..models import ResolverTransaction, create_conflict_set
 from ..rpc import RequestStream, SimProcess
-from .types import ResolveRequest
+from .types import ResolutionMetricsReply, ResolveRequest
 
 
 class Resolver:
@@ -30,6 +30,11 @@ class Resolver:
         self._mwtlv = SERVER_KNOBS.max_write_transaction_life_versions
         self.version = NotifiedVersion(recovery_version)
         self.resolves = RequestStream(process)
+        # load accounting for resolutionBalancing (ref: the resolver's
+        # iopsSample, Resolver.actor.cpp:277-283)
+        self.work_units = 0
+        self.key_hist = [0] * 256
+        self.metrics = RequestStream(process)
         self._actors = flow.ActorCollection()
         # reply cache for duplicate delivery (proxy retry after a broken
         # reply): version -> verdicts, evicted incrementally once a
@@ -45,11 +50,21 @@ class Resolver:
         self._actors.add(flow.spawn(self._resolve_loop(),
                                     TaskPriority.PROXY_RESOLVER_REPLY,
                                     name=f"{self.process.name}.resolve"))
+        self._actors.add(flow.spawn(self._metrics_loop(),
+                                    TaskPriority.RESOLUTION_METRICS,
+                                    name=f"{self.process.name}.metrics"))
         self.process.on_kill(self._actors.cancel_all)
 
     def stop(self) -> None:
         self._actors.cancel_all()
         self.resolves.close()
+        self.metrics.close()
+
+    async def _metrics_loop(self):
+        while True:
+            _req, reply = await self.metrics.pop()
+            reply.send(ResolutionMetricsReply(self.work_units,
+                                              tuple(self.key_hist)))
 
     async def _resolve_loop(self):
         while True:
@@ -72,6 +87,12 @@ class Resolver:
         txns = [ResolverTransaction(t.read_snapshot, t.read_conflict_ranges,
                                     t.write_conflict_ranges)
                 for t in req.transactions]
+        for t in txns:
+            for b, _e in t.read_ranges:
+                self.key_hist[b[0] if b else 0] += 1
+            for b, _e in t.write_ranges:
+                self.key_hist[b[0] if b else 0] += 1
+            self.work_units += len(t.read_ranges) + len(t.write_ranges)
         new_oldest = max(0, req.version - self._mwtlv)
         try:
             verdicts = self.conflict_set.resolve(txns, req.version, new_oldest)

@@ -118,12 +118,13 @@ class Worker:
         return self._log_refs(store, tlog)
 
     def recruit_resolver(self, name: str, recovery_version: int):
+        """Returns (resolves_ref, metrics_ref)."""
         self._check_alive()
         r = Resolver(self.process, backend=self.conflict_backend,
                      recovery_version=recovery_version)
         r.start()
         self.roles[name] = r
-        return r.resolves.ref()
+        return r.resolves.ref(), r.metrics.ref()
 
     def recruit_proxy(self, name: str, master_ref, resolver_refs, tlog_refs,
                       resolver_splits, storage_splits,
@@ -138,7 +139,8 @@ class Worker:
         p.start()
         self.roles[name] = p
         return ProxyRefs(name, p.grvs.ref(), p.commits.ref(),
-                         p.raw_committed.ref())
+                         p.raw_committed.ref(),
+                         p.resolver_map_updates.ref())
 
     def recruit_ratekeeper(self, name: str, cc):
         """(ref: the CC recruiting the ratekeeper singleton)"""
