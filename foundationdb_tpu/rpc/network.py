@@ -129,12 +129,18 @@ class SimNetwork:
     """The simulated transport + fault API (ref: sim2.actor.cpp)."""
 
     def __init__(self, sched: Scheduler, rng, min_latency: float = 0.0002,
-                 max_latency: float = 0.002):
+                 max_latency: float = 0.002, serialize: bool = True):
         self.sched = sched
         self.rng = rng
         self.min_latency = min_latency
         self.max_latency = max_latency
+        # every delivered message round-trips through the wire format,
+        # so serialization bugs surface in ordinary sim runs exactly as
+        # the reference's real-FlowTransport-over-sim-connections does
+        # (flow/serialize.h; SURVEY §4 "no mock-RPC layer")
+        self.serialize = serialize
         self.processes: Dict[str, SimProcess] = {}
+        self._tombstones: Dict[str, SimProcess] = {}
         self._token = 0
         # (src_machine, dst_machine) -> unclog time
         self._clogged: Dict[Tuple[str, str], float] = {}
@@ -159,6 +165,28 @@ class SimNetwork:
     def _next_token(self) -> int:
         self._token += 1
         return self._token
+
+    def resolve_ref(self, process_name: str, token: int) -> "NetworkRef":
+        """Rebuild a NetworkRef from its wire form (process name +
+        token — ref: FlowTransport's (address, token) endpoints). A
+        name that no longer exists resolves to a dead tombstone so
+        sends break the same way a closed connection would."""
+        p = self.processes.get(process_name)
+        if p is None:
+            p = self._tombstones.get(process_name)
+            if p is None:
+                p = SimProcess(self, process_name, process_name)
+                p.alive = False
+                self._tombstones[process_name] = p
+        return NetworkRef(Endpoint(p, token))
+
+    def _wire(self, obj):
+        if not self.serialize:
+            return obj
+        from . import wire
+        if not wire.wire_safe(obj):
+            return obj
+        return wire.roundtrip(obj, self)
 
     # -- faults ---------------------------------------------------------
     def kill(self, process: SimProcess) -> None:
@@ -214,11 +242,13 @@ class SimNetwork:
     def send_request(self, src: SimProcess, dst: Endpoint, request) -> Future:
         reply = Promise()
         dst.process._track_reply(reply)
-        self._deliver(src, dst, (request, _NetReply(self, dst.process, src,
-                                                    reply)), reply)
+        self._deliver(src, dst, (self._wire(request),
+                                 _NetReply(self, dst.process, src, reply)),
+                      reply)
         return reply.future
 
     def send_oneway(self, src: SimProcess, dst: Endpoint, request) -> None:
+        request = self._wire(request)
         self._deliver(src, dst, (request, None), None)
         if buggify("net/duplicate_oneway"):
             # best-effort datagrams may be delivered twice (receivers
@@ -271,6 +301,7 @@ class _NetReply:
             return
         if not self.owner.alive:
             return  # the kill path already broke the promise
+        value = self.net._wire(value)
         delay = self.net._delivery_delay(self.owner, self.dst)
         timer = self.net.sched.delay(delay, TaskPriority.DEFAULT_PROMISE_ENDPOINT)
         p = self.promise

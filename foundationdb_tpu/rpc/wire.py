@@ -1,0 +1,218 @@
+"""RPC wire format: every message that crosses the (simulated or real)
+network serializes to bytes and back.
+
+Reference: flow/serialize.h — the `serializer(ar, ...)` templates give
+every RPC struct a byte encoding, and because the real FlowTransport
+runs over simulated connections in sim, serialization bugs are caught
+by ordinary simulation runs (SURVEY §4: "There is no mock-RPC layer").
+This module plays both parts: a compact tagged encoding for the
+framework's message vocabulary (NamedTuples over primitives), with
+endpoints serialized as (process name, token) the way the reference
+ships (address, token) pairs, and a round-trip hook the simulated
+network applies to every delivery so nothing unserializable can sneak
+into an interface.
+
+Messages that are deliberately NOT wire-safe (the worker registration
+carrying the recruitment seam object) opt out via ``__no_wire__``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Type
+
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+# type tags
+_NONE, _FALSE, _TRUE, _INT, _BIGINT, _FLOAT, _BYTES, _STR, _TUPLE, \
+    _LIST, _NT, _REF, _DICT = range(13)
+
+_REGISTRY: Dict[str, Type] = {}
+
+
+def register_message(cls: Type) -> Type:
+    """Register a NamedTuple message type for the wire (decorator)."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def register_all(module) -> None:
+    """Register every NamedTuple class defined in a module."""
+    for name in dir(module):
+        obj = getattr(module, name)
+        if isinstance(obj, type) and issubclass(obj, tuple) and \
+                hasattr(obj, "_fields") and obj.__module__ == module.__name__:
+            _REGISTRY[obj.__name__] = obj
+
+
+def register_module(module_name: str) -> None:
+    """One-line footer for RPC-vocabulary modules:
+    ``wire.register_module(__name__)``."""
+    import sys
+    register_all(sys.modules[module_name])
+
+
+class WireError(TypeError):
+    pass
+
+
+def encode(obj, out: list) -> None:
+    if obj is None:
+        out.append(bytes([_NONE]))
+    elif obj is False:
+        out.append(bytes([_FALSE]))
+    elif obj is True:
+        out.append(bytes([_TRUE]))
+    elif isinstance(obj, int):
+        if -(1 << 63) <= obj < (1 << 63):
+            out.append(bytes([_INT]))
+            out.append(_I64.pack(obj))
+        else:
+            b = obj.to_bytes((obj.bit_length() + 15) // 8, "big",
+                             signed=True)
+            out.append(bytes([_BIGINT]))
+            out.append(_U32.pack(len(b)))
+            out.append(b)
+    elif isinstance(obj, float):
+        out.append(bytes([_FLOAT]))
+        out.append(_F64.pack(obj))
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(bytes([_BYTES]))
+        out.append(_U32.pack(len(obj)))
+        out.append(bytes(obj))
+    elif isinstance(obj, str):
+        b = obj.encode()
+        out.append(bytes([_STR]))
+        out.append(_U32.pack(len(b)))
+        out.append(b)
+    elif isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        name = type(obj).__name__
+        if name not in _REGISTRY:
+            raise WireError(f"unregistered message type {name}")
+        nb = name.encode()
+        out.append(bytes([_NT]))
+        out.append(_U32.pack(len(nb)))
+        out.append(nb)
+        out.append(_U32.pack(len(obj)))
+        for f in obj:
+            encode(f, out)
+    elif isinstance(obj, tuple):
+        out.append(bytes([_TUPLE]))
+        out.append(_U32.pack(len(obj)))
+        for f in obj:
+            encode(f, out)
+    elif isinstance(obj, list):
+        out.append(bytes([_LIST]))
+        out.append(_U32.pack(len(obj)))
+        for f in obj:
+            encode(f, out)
+    elif isinstance(obj, dict):
+        out.append(bytes([_DICT]))
+        out.append(_U32.pack(len(obj)))
+        for k, v in obj.items():
+            encode(k, out)
+            encode(v, out)
+    elif type(obj).__name__ == "NetworkRef":
+        ep = obj.endpoint
+        nb = ep.process.name.encode()
+        out.append(bytes([_REF]))
+        out.append(_U32.pack(len(nb)))
+        out.append(nb)
+        out.append(_I64.pack(ep.token))
+    else:
+        raise WireError(
+            f"type {type(obj).__name__} has no wire encoding — register "
+            f"the message or mark the request __no_wire__")
+
+
+def decode(buf: bytes, off: int, net):
+    tag = buf[off]
+    off += 1
+    if tag == _NONE:
+        return None, off
+    if tag == _FALSE:
+        return False, off
+    if tag == _TRUE:
+        return True, off
+    if tag == _INT:
+        (v,) = _I64.unpack_from(buf, off)
+        return v, off + 8
+    if tag == _BIGINT:
+        (ln,) = _U32.unpack_from(buf, off)
+        off += 4
+        return int.from_bytes(buf[off:off + ln], "big", signed=True), \
+            off + ln
+    if tag == _FLOAT:
+        (v,) = _F64.unpack_from(buf, off)
+        return v, off + 8
+    if tag == _BYTES:
+        (ln,) = _U32.unpack_from(buf, off)
+        off += 4
+        return bytes(buf[off:off + ln]), off + ln
+    if tag == _STR:
+        (ln,) = _U32.unpack_from(buf, off)
+        off += 4
+        return buf[off:off + ln].decode(), off + ln
+    if tag in (_TUPLE, _LIST):
+        (n,) = _U32.unpack_from(buf, off)
+        off += 4
+        items = []
+        for _ in range(n):
+            v, off = decode(buf, off, net)
+            items.append(v)
+        return (tuple(items) if tag == _TUPLE else items), off
+    if tag == _DICT:
+        (n,) = _U32.unpack_from(buf, off)
+        off += 4
+        d = {}
+        for _ in range(n):
+            k, off = decode(buf, off, net)
+            v, off = decode(buf, off, net)
+            d[k] = v
+        return d, off
+    if tag == _NT:
+        (ln,) = _U32.unpack_from(buf, off)
+        off += 4
+        name = buf[off:off + ln].decode()
+        off += ln
+        (n,) = _U32.unpack_from(buf, off)
+        off += 4
+        fields = []
+        for _ in range(n):
+            v, off = decode(buf, off, net)
+            fields.append(v)
+        cls = _REGISTRY.get(name)
+        if cls is None:
+            raise WireError(f"unregistered message type {name} in decode")
+        return cls(*fields), off
+    if tag == _REF:
+        (ln,) = _U32.unpack_from(buf, off)
+        off += 4
+        name = buf[off:off + ln].decode()
+        off += ln
+        (token,) = _I64.unpack_from(buf, off)
+        off += 8
+        return net.resolve_ref(name, token), off + 0
+    raise WireError(f"bad wire tag {tag}")
+
+
+def to_bytes(obj) -> bytes:
+    out: list = []
+    encode(obj, out)
+    return b"".join(out)
+
+
+def from_bytes(buf: bytes, net):
+    v, _off = decode(buf, 0, net)
+    return v
+
+
+def roundtrip(obj, net):
+    """encode+decode — the simulated delivery hook."""
+    return from_bytes(to_bytes(obj), net)
+
+
+def wire_safe(obj) -> bool:
+    return not getattr(obj, "__no_wire__", False)

@@ -264,3 +264,7 @@ class ClusterController:
                 reply.send(info)
                 return
             await self.dbinfo.on_change()
+
+from ..rpc import wire as _wire
+
+_wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary

@@ -203,3 +203,7 @@ async def elect_leader(candidacy_refs, key: bytes, candidate,
             if other != candidate and n >= need:
                 raise error("operation_failed")
         await flow.delay(0.05, TaskPriority.COORDINATION)
+
+from ..rpc import wire as _wire
+
+_wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary

@@ -80,3 +80,7 @@ class ServerDBInfo(NamedTuple):
 
 EMPTY_DBINFO = ServerDBInfo(0, UNINITIALIZED, 0, (), LogSetInfo(0, 0, -1, ()),
                             (), (), 0)
+
+from ..rpc import wire as _wire
+
+_wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary

@@ -325,3 +325,7 @@ class MasterRecovery:
                          if ls.end_version > floor)
             if len(keep) != len(info.old_logs):
                 self.cc.publish(info._replace(old_logs=keep))
+
+from ..rpc import wire as _wire
+
+_wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary

@@ -94,3 +94,7 @@ class Ratekeeper:
             return MIN_RATE
         frac = 1.0 - (worst_excess - target) / max(1, window - target)
         return max(MIN_RATE, MAX_RATE * frac * frac)
+
+from ..rpc import wire as _wire
+
+_wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary

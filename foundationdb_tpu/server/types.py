@@ -189,3 +189,7 @@ class ResolutionMetricsReply(NamedTuple):
 class TLogLockReply(NamedTuple):
     end_version: int        # highest durable version in this log
     known_committed: int    # highest version known replicated log-set-wide
+
+from ..rpc import wire as _wire
+
+_wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary

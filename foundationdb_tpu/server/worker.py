@@ -176,3 +176,8 @@ class Worker:
         refs = StorageRefs(name, tag, begin, end, s.gets.ref(),
                            s.ranges.ref(), s.get_keys.ref(), s.watches.ref())
         return refs
+
+from ..rpc import wire as _wire
+
+_wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary
+RegisterWorkerRequest.__no_wire__ = True  # carries the recruitment seam
