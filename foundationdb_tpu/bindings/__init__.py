@@ -1,0 +1,47 @@
+"""`fdb`-style Python binding surface.
+
+Reference: bindings/python/fdb — the API programmers actually use:
+``fdb.open()``, ``@fdb.transactional``, ``db[key]`` sugar, and the
+tuple/subspace layers under ``fdb.tuple`` / ``fdb.Subspace``. The
+reference binding is blocking over the C ABI's network thread; this
+framework's client is cooperative, so the surface is async — a
+``@transactional`` function is an async function whose first argument
+is bound to a retried Transaction, and the item sugar lives on the
+async Transaction itself.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..client import Database, Transaction, run_transaction
+from ..layers import Subspace
+from ..layers import tuple_layer as tuple  # noqa: A001 — mirrors fdb.tuple
+from ..server.types import KeySelector
+
+__all__ = ["open", "transactional", "Database", "Transaction",
+           "Subspace", "tuple", "KeySelector"]
+
+
+def open(cluster, name: str = "fdb-client"):  # noqa: A001 — mirrors fdb.open
+    """A Database handle onto a running cluster (ref: fdb.open — the
+    cluster-file argument becomes the SimCluster here)."""
+    return cluster.client(name)
+
+
+def transactional(func):
+    """(ref: @fdb.transactional — the wrapped function receives a
+    transaction as its first argument and is retried on retryable
+    errors; passing a Database starts the retry loop, passing a
+    Transaction composes without a nested loop)"""
+
+    @functools.wraps(func)
+    async def wrapper(db_or_tr, *args, **kwargs):
+        if isinstance(db_or_tr, Transaction):
+            return await func(db_or_tr, *args, **kwargs)
+
+        async def body(tr):
+            return await func(tr, *args, **kwargs)
+        return await run_transaction(db_or_tr, body)
+
+    return wrapper

@@ -1,5 +1,6 @@
 """Client API (ref: fdbclient/ — NativeAPI + ReadYourWrites)."""
 
-from .transaction import Database, Transaction, run_transaction
+from .transaction import (RETRYABLE, Database, Transaction,
+                          run_transaction)
 
-__all__ = ["Database", "Transaction", "run_transaction"]
+__all__ = ["RETRYABLE", "Database", "Transaction", "run_transaction"]

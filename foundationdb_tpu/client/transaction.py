@@ -65,10 +65,19 @@ class Database:
     openDatabase endpoint (ref: MonitorLeader + openDatabase handshake);
     reads route through the shard map, commits through the proxies."""
 
-    def __init__(self, process: SimProcess, cluster_ref: NetworkRef):
+    def __init__(self, process: SimProcess, cluster_ref: NetworkRef,
+                 status_ref: NetworkRef = None):
         self.process = process
         self.cluster_ref = cluster_ref
+        self.status_ref = status_ref
         self._info = None
+
+    async def get_status(self) -> dict:
+        """The cluster status document (ref: StatusClient fetching the
+        CC-assembled JSON, fdbclient/StatusClient.actor.cpp)."""
+        if self.status_ref is None:
+            raise error("client_invalid_operation")
+        return await _rpc(self.status_ref.get_reply(None, self.process))
 
     async def info(self):
         if self._info is None:

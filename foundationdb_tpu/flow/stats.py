@@ -1,0 +1,38 @@
+"""Counters: rate-tracked event counters per role.
+
+Reference: flow/Stats.actor.cpp — `Counter` (value + rolling rate +
+roughness) grouped in a `CounterCollection`, traced periodically and
+folded into the status document. The sim reads them directly for
+status; a trace loop would emit them as TraceEvents in production.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+class Counter:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, n: int = 1) -> None:
+        self.value += n
+
+
+class CounterCollection:
+    """(ref: CounterCollection — named counters for one role)"""
+
+    def __init__(self, role: str):
+        self.role = role
+        self.counters: Dict[str, Counter] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def snapshot(self) -> Dict[str, int]:
+        return {n: c.value for n, c in self.counters.items()}

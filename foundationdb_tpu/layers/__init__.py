@@ -1,0 +1,9 @@
+"""Layers: stateless client libraries on the KV API (ref: layers/ +
+the tuple/subspace/directory machinery in the reference bindings)."""
+
+from . import tuple_layer
+from .subspace import Subspace
+from .tuple_layer import Versionstamp, pack, range_of, unpack
+
+__all__ = ["tuple_layer", "Subspace", "Versionstamp", "pack", "range_of",
+           "unpack"]

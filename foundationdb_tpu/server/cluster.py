@@ -140,7 +140,8 @@ class SimCluster:
     def client(self, name: str = "client", machine: str = ""):
         from ..client import Database  # avoid package-init cycle
         proc = self.net.new_process(name, machine or name)
-        return Database(proc, self.cc.open_db.ref())
+        return Database(proc, self.cc.open_db.ref(),
+                        status_ref=self.cc.status_requests.ref())
 
     # -- running ---------------------------------------------------------
     def run(self, coro, timeout_time: Optional[float] = None):

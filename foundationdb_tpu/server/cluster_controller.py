@@ -63,6 +63,7 @@ class ClusterController:
         self.log_stores: dict = {}         # store name -> LogRefs (live)
         self.registrations = RequestStream(process)
         self.open_db = RequestStream(process)
+        self.status_requests = RequestStream(process)
         self._recovery: Optional[MasterRecovery] = None
         self._recovery_task = None
         self._storage_objs: dict = {}      # name -> StorageServer (registry)
@@ -81,7 +82,8 @@ class ClusterController:
     def start(self) -> None:
         for coro, name in ((self._run(), "run"),
                            (self._registration_loop(), "register"),
-                           (self._open_db_loop(), "openDatabase")):
+                           (self._open_db_loop(), "openDatabase"),
+                           (self._status_loop(), "status")):
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
         self.process.on_kill(self._actors.cancel_all)
@@ -248,6 +250,86 @@ class ClusterController:
                 return 0
             vs.append(obj.durable_version.get())
         return min(vs) if vs else 0
+
+    # -- status ----------------------------------------------------------
+    async def _status_loop(self):
+        while True:
+            _req, reply = await self.status_requests.pop()
+            try:
+                reply.send(self.get_status())
+            except Exception:  # noqa: BLE001 — status must never wedge
+                reply.send({"cluster": {"error": "status_incomplete"}})
+
+    def get_status(self) -> dict:
+        """Assemble the cluster status document (ref: clusterGetStatus,
+        fdbserver/Status.actor.cpp:1802 — the JSON consumed by fdbcli
+        `status` and StatusClient). Role stats are read from the
+        registry; a real deployment would gather them via RPC."""
+        info = self.dbinfo.get()
+        cfg = self.config
+        workers = {
+            name: {"machine": wi.machine,
+                   "alive": wi.worker.process.alive,
+                   "roles": sorted(wi.worker.roles)}
+            for name, wi in self.workers.items()}
+        logs = []
+        for lr in info.logs.logs:
+            entry = {"store": lr.store, "machine": lr.machine}
+            for wi in self.workers.values():
+                obj = wi.worker.roles.get(lr.store)
+                if obj is not None:
+                    entry.update(
+                        durable_version=obj.version.get(),
+                        queue_length=len(obj.entries),
+                        counters=obj.stats.snapshot())
+            logs.append(entry)
+        storages = []
+        for s in info.storages:
+            entry = {"name": s.name, "tag": s.tag,
+                     "begin": s.begin.hex(),
+                     "end": s.end.hex() if s.end is not None else None}
+            obj = self._storage_objs.get(s.name)
+            if obj is not None:
+                entry.update(alive=obj.process.alive,
+                             version=obj.version.get(),
+                             durable_version=obj.durable_version.get(),
+                             counters=obj.stats.snapshot())
+            storages.append(entry)
+        from .proxy import Proxy
+        from .ratekeeper import Ratekeeper
+        proxies = []
+        rate = None
+        for wi in self.workers.values():
+            for rn, role in wi.worker.roles.items():
+                if isinstance(role, Proxy) and f"-e{info.epoch}-" in rn:
+                    proxies.append({
+                        "name": rn,
+                        "committed_version": role.committed_version.get(),
+                        "counters": role.stats.snapshot()})
+                elif isinstance(role, Ratekeeper) and \
+                        rn.endswith(f"-e{info.epoch}"):
+                    rate = role.rate
+        return {
+            "cluster": {
+                "epoch": info.epoch,
+                "recovery_state": info.recovery_state,
+                "recovery_version": info.recovery_version,
+                "coordinators": len(self.coordinators),
+                "workers": workers,
+                "logs": logs,
+                "storages": storages,
+                "proxies": proxies,
+                "qos": {"transactions_per_second_limit": rate},
+                "configuration": {
+                    "proxies": cfg.n_proxies,
+                    "resolvers": cfg.n_resolvers,
+                    "logs": cfg.n_logs,
+                    "storage_shards": cfg.n_storage,
+                    "conflict_backend": cfg.conflict_backend,
+                    "durable": cfg.durable,
+                },
+            },
+        }
 
     # -- client handshake -----------------------------------------------
     async def _open_db_loop(self):

@@ -181,6 +181,8 @@ class Proxy:
         self._rate = 1e9               # tps budget (ratekeeper-fed)
         self._grv_queue = []           # waiting GRV replies
         self._grv_inflight = []        # batch being confirmed right now
+        # (ref: ProxyStats — txn admission/commit counters for status)
+        self.stats = flow.CounterCollection("proxy")
         self.commits = RequestStream(process)
         self.grvs = RequestStream(process)
         self.raw_committed = RequestStream(process)
@@ -293,6 +295,7 @@ class Proxy:
                         for p in self._peers]
                 others = await flow.all_of(futs)
                 version = max([version] + list(others))
+            self.stats.counter("transactions_started").add(len(batch))
             for reply in batch:
                 reply.send(GetReadVersionReply(version))
         except flow.FdbError as e:
@@ -422,12 +425,17 @@ class Proxy:
                 self.committed_version.set(ver.version)
 
             # phase 5: per-transaction replies
+            st = self.stats
+            st.counter("commit_batches").add(1)
             for idx, (verdict, reply) in enumerate(zip(verdicts, replies)):
                 if verdict == COMMITTED:
+                    st.counter("transactions_committed").add(1)
                     reply.send(CommitReply(ver.version, idx))
                 elif verdict == TOO_OLD:
+                    st.counter("transactions_too_old").add(1)
                     reply.send_error(error("transaction_too_old"))
                 else:
+                    st.counter("transactions_conflicted").add(1)
                     reply.send_error(error("not_committed"))
         except flow.FdbError as e:
             # a dead or locked downstream role means this proxy's epoch

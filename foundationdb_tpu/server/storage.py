@@ -342,6 +342,8 @@ class StorageServer:
         self.watches = RequestStream(process)
         # key -> list of (value_at_registration, reply)
         self._watch_map: Dict[bytes, list] = {}
+        # (ref: StorageServer::counters — query/mutation accounting)
+        self.stats = flow.CounterCollection("storage")
         self._actors = flow.ActorCollection()
 
     def start(self) -> None:
@@ -439,6 +441,7 @@ class StorageServer:
                 break  # stale data beyond the generation's locked end
             for m in mutations:
                 self.data.apply(version, m)
+            self.stats.counter("mutations").add(len(mutations))
             self._pending.append((version, mutations))
             self.version.set(version)
             self._check_watches(version, mutations)
@@ -584,6 +587,7 @@ class StorageServer:
 
     async def _serve_get(self, req: StorageGetRequest, reply):
         try:
+            self.stats.counter("get_queries").add(1)
             await self._wait_version(req.version)
             reply.send(self.data.get(req.key, req.version))
         except flow.FdbError as e:
@@ -596,6 +600,7 @@ class StorageServer:
 
     async def _serve_range(self, req: StorageGetRangeRequest, reply):
         try:
+            self.stats.counter("range_queries").add(1)
             await self._wait_version(req.version)
             reply.send(self.data.get_range(req.begin, req.end, req.version,
                                            req.limit, req.reverse))

@@ -60,6 +60,8 @@ class TLog:
         self.pops = RequestStream(process)
         self.locks = RequestStream(process)
         self._dq_lock = FlowLock()
+        # (ref: TLogData counters: commits/bytes for status + ratekeeper)
+        self.stats = flow.CounterCollection("tlog")
         self._recovered = flow.Future()
         self._actors = flow.ActorCollection()
 
@@ -139,6 +141,8 @@ class TLog:
             reply.send_error(error("tlog_stopped"))
             return
         self.queue_version.set(req.version)
+        self.stats.counter("commits").add(1)
+        self.stats.counter("mutations").add(len(req.mutations))
         self.entries.append((req.version, req.mutations, -1))
         self._versions.append(req.version)
         self._entry_tags.append(_tag_set(req.mutations))

@@ -1,0 +1,1 @@
+"""Tools: operator-facing surfaces (ref: fdbcli/, fdbbackup/)."""

@@ -1,0 +1,224 @@
+"""fdbcli analogue: an interactive shell over the client API.
+
+Reference: fdbcli/fdbcli.actor.cpp — the command table (:435-475) with
+get/set/clear/clearrange/getrange/status/writemode, byte-string
+arguments with \\xNN escapes, and transactional semantics per command
+(each command runs its own retried transaction). The shell drives a
+SimCluster's deterministic loop per command; `python -m
+foundationdb_tpu.tools.cli --exec "set a b; get a"` scripts it.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import sys
+from typing import List, Optional
+
+from ..client import run_transaction
+from ..server import SimCluster
+
+HELP = """\
+Commands (ref: fdbcli):
+  get <key>                  read a key
+  set <key> <value>          write a key
+  clear <key>                remove a key
+  clearrange <begin> <end>   remove a key range
+  getrange <begin> <end> [limit]   read a range
+  getkey <sel> <key> [offset]      resolve a key selector
+                             (sel: lt | le | gt | ge)
+  status [json]              cluster status
+  writemode <on|off>         allow mutations (default on)
+  help                       this text
+  exit                       leave
+Keys/values support \\xNN escapes and quoting."""
+
+
+def _unescape(tok: str) -> bytes:
+    out = bytearray()
+    i = 0
+    while i < len(tok):
+        ch = tok[i]
+        if ch == "\\" and i + 3 < len(tok) and tok[i + 1] == "x":
+            out.append(int(tok[i + 2:i + 4], 16))
+            i += 4
+        else:
+            out.extend(ch.encode())
+            i += 1
+    return bytes(out)
+
+
+def _printable(b: bytes) -> str:
+    return "".join(chr(c) if 32 <= c < 127 and c != 92 else f"\\x{c:02x}"
+                   for c in b)
+
+
+class Cli:
+    def __init__(self, cluster: SimCluster):
+        self.cluster = cluster
+        self.db = cluster.client("fdbcli")
+        self.writemode = True
+
+    def execute(self, line: str) -> str:
+        """Run one command line; returns the printed output."""
+        try:
+            lex = shlex.shlex(line, posix=True)
+            lex.whitespace_split = True
+            lex.escape = ""          # backslashes belong to \xNN escapes
+            lex.commenters = ""      # '#' is key/value data, not comments
+            toks = list(lex)
+        except ValueError as e:
+            return f"ERROR: {e}"
+        if not toks:
+            return ""
+        cmd, args = toks[0].lower(), [_unescape(t) for t in toks[1:]]
+        try:
+            return self._dispatch(cmd, args, toks[1:])
+        except Exception as e:  # noqa: BLE001 — shell surfaces, not dies
+            return f"ERROR: {getattr(e, 'name', None) or e}"
+
+    def _run(self, coro):
+        return self.cluster.run(coro, timeout_time=600)
+
+    def _dispatch(self, cmd: str, args: List[bytes],
+                  raw: List[str]) -> str:
+        if cmd == "help":
+            return HELP
+        if cmd == "exit":
+            raise SystemExit(0)
+        if cmd == "writemode":
+            if not raw or raw[0] not in ("on", "off"):
+                return "ERROR: writemode requires `on' or `off'"
+            self.writemode = raw[0] == "on"
+            return ""
+        if cmd == "status":
+            async def st():
+                return await self.db.get_status()
+            doc = self._run(st())
+            if raw and raw[0] == "json":
+                return json.dumps(doc, indent=2, sort_keys=True)
+            cl = doc["cluster"]
+            lines = [
+                f"Epoch {cl['epoch']} — {cl['recovery_state']}",
+                f"  coordinators: {cl['coordinators']}"
+                f"  workers: {len(cl['workers'])}",
+                f"  logs: {len(cl['logs'])}"
+                f"  storage shards: {len(cl['storages'])}"
+                f"  proxies: {len(cl['proxies'])}",
+            ]
+            px = cl["proxies"][0]["counters"] if cl["proxies"] else {}
+            lines.append(
+                f"  transactions committed: "
+                f"{px.get('transactions_committed', 0)}"
+                f"  conflicts: {px.get('transactions_conflicted', 0)}")
+            return "\n".join(lines)
+        if cmd == "get":
+            async def body(tr):
+                return await tr.get(args[0])
+            v = self._run(run_transaction(self.db, body))
+            return (f"`{_printable(args[0])}' is "
+                    f"`{_printable(v)}'" if v is not None else
+                    f"`{_printable(args[0])}': not found")
+        if cmd == "getrange":
+            limit = int(raw[2]) if len(raw) > 2 else 25
+
+            async def body(tr):
+                return await tr.get_range(args[0], args[1], limit=limit)
+            rows = self._run(run_transaction(self.db, body))
+            out = [f"`{_printable(k)}' is `{_printable(v)}'"
+                   for k, v in rows]
+            return "\n".join(out) if out else "(empty range)"
+        if cmd == "getkey":
+            from ..server.types import KeySelector
+            sel_kind, key = raw[0], args[1]
+            offset = int(raw[2]) if len(raw) > 2 else 0
+            base = {"lt": KeySelector.last_less_than,
+                    "le": KeySelector.last_less_or_equal,
+                    "gt": KeySelector.first_greater_than,
+                    "ge": KeySelector.first_greater_or_equal}[sel_kind](key)
+            sel = base._replace(offset=base.offset + offset)
+
+            async def body(tr):
+                return await tr.get_key(sel)
+            k = self._run(run_transaction(self.db, body))
+            return f"`{_printable(k)}'"
+        if cmd not in ("set", "clear", "clearrange"):
+            return f"ERROR: unknown command `{cmd}' (try help)"
+        if not self.writemode:
+            return "ERROR: writemode is off"
+        if cmd == "set":
+            async def body(tr):
+                tr.set(args[0], args[1])
+            self._run(run_transaction(self.db, body))
+            return "Committed"
+        if cmd == "clear":
+            async def body(tr):
+                tr.clear(args[0])
+            self._run(run_transaction(self.db, body))
+            return "Committed"
+        if cmd == "clearrange":
+            async def body(tr):
+                tr.clear_range(args[0], args[1])
+            self._run(run_transaction(self.db, body))
+            return "Committed"
+        return f"ERROR: unknown command `{cmd}' (try help)"
+
+
+def _split_script(script: str) -> List[str]:
+    """Split on ';' outside quotes (the shell's own quoting applies
+    under --exec too)."""
+    parts, cur, quote = [], [], None
+    for ch in script:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "'\"":
+            quote = ch
+            cur.append(ch)
+        elif ch == ";":
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return [p for p in parts if p.strip()]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    script = None
+    seed = 0
+    while argv:
+        a = argv.pop(0)
+        if a == "--exec":
+            script = argv.pop(0)
+        elif a == "--seed":
+            seed = int(argv.pop(0))
+    cluster = SimCluster(seed=seed, durable=True)
+    cli = Cli(cluster)
+    try:
+        if script is not None:
+            for line in _split_script(script):
+                out = cli.execute(line.strip())
+                if out:
+                    print(out)
+            return 0
+        print("fdbtpu-cli (type `help' for commands)")
+        while True:
+            try:
+                line = input("fdb> ")
+            except EOFError:
+                return 0
+            try:
+                out = cli.execute(line)
+            except SystemExit:
+                return 0
+            if out:
+                print(out)
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

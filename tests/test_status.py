@@ -1,0 +1,67 @@
+"""Status document + counters (ref: fdbserver/Status.actor.cpp
+clusterGetStatus :1802, flow/Stats.actor.cpp CounterCollection)."""
+
+from foundationdb_tpu.client import run_transaction
+from foundationdb_tpu.server import SimCluster
+
+
+def test_status_reflects_cluster_and_workload():
+    c = SimCluster(seed=701, durable=True, n_storage=2)
+    try:
+        db = c.client()
+
+        async def main():
+            for i in range(6):
+                async def body(tr, i=i):
+                    tr.set(b"s%d" % i, b"v")
+                await run_transaction(db, body)
+            tr = db.create_transaction()
+            await tr.get(b"s0")
+            status = await db.get_status()
+            cl = status["cluster"]
+            assert cl["epoch"] == 1
+            assert cl["recovery_state"] == "fully_recovered"
+            assert cl["configuration"]["storage_shards"] == 2
+            assert len(cl["storages"]) == 2
+            assert len(cl["logs"]) == 1
+            assert cl["logs"][0]["counters"]["commits"] >= 6
+            px = cl["proxies"][0]["counters"]
+            assert px["transactions_committed"] >= 6
+            assert px["transactions_started"] >= 6
+            total_gets = sum(s["counters"].get("get_queries", 0)
+                             for s in cl["storages"] if "counters" in s)
+            assert total_gets >= 1
+            assert cl["qos"]["transactions_per_second_limit"] is not None
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
+
+
+def test_status_shows_failure_and_recovery():
+    c = SimCluster(seed=703, durable=True)
+    try:
+        db = c.client()
+
+        async def main():
+            async def body(tr):
+                tr.set(b"x", b"1")
+            await run_transaction(db, body)
+            c.kill_role("tlog")
+
+            async def body2(tr):
+                tr.set(b"y", b"2")
+            await run_transaction(db, body2, max_retries=300)
+            status = await db.get_status()
+            cl = status["cluster"]
+            assert cl["epoch"] >= 2
+            assert cl["recovery_state"] == "fully_recovered"
+            # the new generation's log is the one reported
+            assert cl["logs"][0]["store"].startswith(
+                f"tlog-e{cl['epoch']}")
+            return True
+
+        assert c.run(main(), timeout_time=300)
+    finally:
+        c.shutdown()
