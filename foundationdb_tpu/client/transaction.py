@@ -66,10 +66,12 @@ class Database:
     reads route through the shard map, commits through the proxies."""
 
     def __init__(self, process: SimProcess, cluster_ref: NetworkRef,
-                 status_ref: NetworkRef = None):
+                 status_ref: NetworkRef = None,
+                 management_ref: NetworkRef = None):
         self.process = process
         self.cluster_ref = cluster_ref
         self.status_ref = status_ref
+        self.management_ref = management_ref
         self._info = None
 
     async def get_status(self) -> dict:
@@ -78,6 +80,26 @@ class Database:
         if self.status_ref is None:
             raise error("client_invalid_operation")
         return await _rpc(self.status_ref.get_reply(None, self.process))
+
+    async def configure(self, **kwargs) -> None:
+        """Change the transaction-subsystem shape (n_proxies,
+        n_resolvers, n_logs, conflict_backend); triggers an epoch
+        recovery with the new configuration (ref: ManagementAPI
+        changeConfig)."""
+        from ..server.cluster_controller import ConfigureRequest
+        if self.management_ref is None:
+            raise error("client_invalid_operation")
+        await _rpc(self.management_ref.get_reply(
+            ConfigureRequest(**kwargs), self.process))
+
+    async def exclude(self, worker: str, exclude: bool = True) -> None:
+        """Bar a worker from hosting roles (ref: ManagementAPI
+        excludeServers; include again with exclude=False)."""
+        from ..server.cluster_controller import ExcludeRequest
+        if self.management_ref is None:
+            raise error("client_invalid_operation")
+        await _rpc(self.management_ref.get_reply(
+            ExcludeRequest(worker, exclude), self.process))
 
     async def info(self):
         if self._info is None:

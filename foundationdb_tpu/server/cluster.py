@@ -141,7 +141,8 @@ class SimCluster:
         from ..client import Database  # avoid package-init cycle
         proc = self.net.new_process(name, machine or name)
         return Database(proc, self.cc.open_db.ref(),
-                        status_ref=self.cc.status_requests.ref())
+                        status_ref=self.cc.status_requests.ref(),
+                        management_ref=self.cc.management.ref())
 
     # -- running ---------------------------------------------------------
     def run(self, coro, timeout_time: Optional[float] = None):

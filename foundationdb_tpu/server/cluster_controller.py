@@ -45,6 +45,27 @@ class OpenDatabaseRequest(NamedTuple):
     known_seq: int
 
 
+class ConfigureRequest(NamedTuple):
+    """Change the transaction-subsystem configuration; a changed config
+    ends the current epoch so recovery rebuilds with the new shape
+    (ref: ManagementAPI changeConfig — the reference stores it in
+    system keys and the CC reacts; storage shard count is fixed after
+    creation until data distribution arrives)."""
+
+    n_proxies: Optional[int] = None
+    n_resolvers: Optional[int] = None
+    n_logs: Optional[int] = None
+    conflict_backend: Optional[str] = None
+
+
+class ExcludeRequest(NamedTuple):
+    """Exclude (or re-include) a worker from recruitment (ref:
+    ManagementAPI excludeServers / includeServers)."""
+
+    worker: str
+    exclude: bool = True
+
+
 class _WorkerInfo(NamedTuple):
     name: str
     machine: str
@@ -64,6 +85,11 @@ class ClusterController:
         self.registrations = RequestStream(process)
         self.open_db = RequestStream(process)
         self.status_requests = RequestStream(process)
+        self.management = RequestStream(process)
+        self.excluded: set = set()         # worker names barred from roles
+        # level-triggered: a change that lands mid-recovery is noticed
+        # when the monitor next looks, never lost (code review r3)
+        self._config_dirty = False
         self._recovery: Optional[MasterRecovery] = None
         self._recovery_task = None
         self._storage_objs: dict = {}      # name -> StorageServer (registry)
@@ -83,7 +109,8 @@ class ClusterController:
         for coro, name in ((self._run(), "run"),
                            (self._registration_loop(), "register"),
                            (self._open_db_loop(), "openDatabase"),
-                           (self._status_loop(), "status")):
+                           (self._status_loop(), "status"),
+                           (self._management_loop(), "management")):
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
         self.process.on_kill(self._actors.cancel_all)
@@ -114,7 +141,7 @@ class ClusterController:
 
     async def _wait_for_workers(self) -> None:
         need = max(self.config.n_logs, 1)
-        while len(self.workers) < need:
+        while self._live_included_workers() < need:
             await flow.delay(0.05, TaskPriority.CLUSTER_CONTROLLER)
 
     async def _watch_epoch(self, recovery_task) -> str:
@@ -134,8 +161,13 @@ class ClusterController:
                 return "recovery_returned"
         # phase 2: monitor the recruited processes (ref: waitFailure
         # heartbeats; the sim checks liveness directly — a ping RPC to a
-        # dead process would report the same thing a beat later)
+        # dead process would report the same thing a beat later) and
+        # management-driven config changes (level-triggered so a change
+        # that raced the recovery is still honored)
         while True:
+            if self._config_dirty:
+                self._config_dirty = False
+                return "configuration_changed"
             for proc in self._recovery.critical_procs:
                 if not proc.alive:
                     return f"process_failed:{proc.name}"
@@ -187,11 +219,12 @@ class ClusterController:
 
     # -- recruitment helpers (used by MasterRecovery) -------------------
     def pick_workers(self, n: int, role: str):
-        """Round-robin over live workers (ref: fitness-ranked selection
-        in clusterRecruitFromConfiguration — the sim has one process
-        class, so rotation stands in for fitness)."""
-        live = [wi.worker for wi in self.workers.values()
-                if wi.worker.process.alive]
+        """Round-robin over live, non-excluded workers (ref:
+        fitness-ranked selection in clusterRecruitFromConfiguration —
+        the sim has one process class, so rotation stands in for
+        fitness)."""
+        live = [wi.worker for name, wi in self.workers.items()
+                if wi.worker.process.alive and name not in self.excluded]
         if not live:
             raise error("no_more_servers")
         out = []
@@ -250,6 +283,66 @@ class ClusterController:
                 return 0
             vs.append(obj.durable_version.get())
         return min(vs) if vs else 0
+
+    # -- management -------------------------------------------------------
+    async def _management_loop(self):
+        """(ref: ManagementAPI — configuration changes and exclusions
+        arrive as requests; a config change ends the epoch so recovery
+        rebuilds the transaction subsystem with the new shape)"""
+        while True:
+            req, reply = await self.management.pop()
+            if isinstance(req, ConfigureRequest):
+                updates = {k: v for k, v in req._asdict().items()
+                           if v is not None}
+                cand = self.config._replace(**updates)
+                live = self._live_included_workers()
+                if (cand.n_proxies < 1 or cand.n_resolvers < 1
+                        or cand.n_logs < 1 or cand.n_logs > live
+                        or cand.n_resolvers > live
+                        or cand.n_proxies > live):
+                    # an unrecruitable shape would brick the cluster
+                    # (ref: changeConfig validating against the topology)
+                    reply.send_error(error("invalid_option_value"))
+                    continue
+                if updates:
+                    self.config = cand
+                    self._config_dirty = True
+                reply.send(None)
+            elif isinstance(req, ExcludeRequest):
+                if req.exclude:
+                    if self._live_included_workers(
+                            without=req.worker) == 0:
+                        # refuse to exclude the last recruitable worker
+                        # (ref: excludeServers safety check)
+                        reply.send_error(error("invalid_option_value"))
+                        continue
+                    self.excluded.add(req.worker)
+                    if self._hosts_current_txn_role(req.worker):
+                        # current-epoch transaction roles on the worker
+                        # end the epoch; the next recruitment avoids it
+                        self._config_dirty = True
+                else:
+                    self.excluded.discard(req.worker)
+                reply.send(None)
+            else:
+                reply.send_error(error("client_invalid_operation"))
+
+    def _live_included_workers(self, without: str = None) -> int:
+        return sum(1 for name, wi in self.workers.items()
+                   if wi.worker.process.alive and name not in self.excluded
+                   and name != without)
+
+    def _hosts_current_txn_role(self, worker_name: str) -> bool:
+        """Does the worker host a CURRENT-epoch transaction role?
+        Storage shards and retained old-generation logs don't count —
+        exclusion can't vacate them without data distribution."""
+        wi = self.workers.get(worker_name)
+        if wi is None:
+            return False
+        ep = self.dbinfo.get().epoch
+        prefixes = (f"tlog-e{ep}-", f"proxy-e{ep}-", f"resolver-e{ep}-",
+                    f"ratekeeper-e{ep}")
+        return any(rn.startswith(prefixes) for rn in wi.worker.roles)
 
     # -- status ----------------------------------------------------------
     async def _status_loop(self):

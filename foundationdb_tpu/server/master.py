@@ -152,7 +152,8 @@ class MasterRecovery:
         resolver_metrics = []
         for i, w in enumerate(res_workers):
             rref, mref = w.recruit_resolver(
-                f"resolver-e{self.epoch}-{i}", recovery_version)
+                f"resolver-e{self.epoch}-{i}", recovery_version,
+                backend=cfg.conflict_backend)
             resolver_refs.append(rref)
             resolver_metrics.append(mref)
             self.critical_procs.add(w.process)

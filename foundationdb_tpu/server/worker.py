@@ -117,10 +117,11 @@ class Worker:
         self.roles[store] = tlog
         return self._log_refs(store, tlog)
 
-    def recruit_resolver(self, name: str, recovery_version: int):
+    def recruit_resolver(self, name: str, recovery_version: int,
+                         backend: Optional[str] = None):
         """Returns (resolves_ref, metrics_ref)."""
         self._check_alive()
-        r = Resolver(self.process, backend=self.conflict_backend,
+        r = Resolver(self.process, backend=backend or self.conflict_backend,
                      recovery_version=recovery_version)
         r.start()
         self.roles[name] = r

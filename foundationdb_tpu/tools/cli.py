@@ -28,6 +28,10 @@ Commands (ref: fdbcli):
   getkey <sel> <key> [offset]      resolve a key selector
                              (sel: lt | le | gt | ge)
   status [json]              cluster status
+  configure <k>=<v> ...      change the cluster shape (proxies,
+                             resolvers, logs, conflict_backend)
+  exclude <worker>           bar a worker from hosting roles
+  include <worker>           re-admit an excluded worker
   writemode <on|off>         allow mutations (default on)
   help                       this text
   exit                       leave
@@ -112,6 +116,26 @@ class Cli:
                 f"{px.get('transactions_committed', 0)}"
                 f"  conflicts: {px.get('transactions_conflicted', 0)}")
             return "\n".join(lines)
+        if cmd == "configure":
+            mapping = {"proxies": "n_proxies", "resolvers": "n_resolvers",
+                       "logs": "n_logs",
+                       "conflict_backend": "conflict_backend"}
+            kwargs = {}
+            for tok in raw:
+                k, _eq, v = tok.partition("=")
+                if k not in mapping:
+                    return f"ERROR: unknown configuration key `{k}'"
+                kwargs[mapping[k]] = v if k == "conflict_backend" else int(v)
+
+            async def body():
+                await self.db.configure(**kwargs)
+            self._run(body())
+            return "Configuration changed"
+        if cmd in ("exclude", "include"):
+            async def body():
+                await self.db.exclude(raw[0], exclude=cmd == "exclude")
+            self._run(body())
+            return ("Excluded" if cmd == "exclude" else "Included")
         if cmd == "get":
             async def body(tr):
                 return await tr.get(args[0])
