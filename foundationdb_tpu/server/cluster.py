@@ -32,7 +32,8 @@ class SimCluster:
                  storage_lag_versions: Optional[int] = None,
                  n_proxies: int = 1, n_logs: int = 1, n_storage: int = 1,
                  n_workers: Optional[int] = None, n_coordinators: int = 1,
-                 auto_reboot: bool = True, buggify: bool = False):
+                 auto_reboot: bool = True, buggify: bool = False,
+                 storage_engine: str = "memory"):
         flow.set_seed(seed, buggify_enabled=buggify)
         # knob distortion rides the same switch as BUGGIFY (ref:
         # `if (randomize && BUGGIFY)` in Knobs.cpp); always re-init so a
@@ -49,7 +50,8 @@ class SimCluster:
                                     n_resolvers=n_resolvers,
                                     n_logs=n_logs, n_storage=n_storage,
                                     conflict_backend=conflict_backend,
-                                    durable=durable)
+                                    durable=durable,
+                                    storage_engine=storage_engine)
 
         # coordinators (ref: coordinationServer)
         self.coordinators = []
@@ -82,7 +84,8 @@ class SimCluster:
         w = Worker(proc, self.net, durable=self.durable,
                    dbinfo=self.cc.dbinfo,
                    conflict_backend=self.conflict_backend,
-                   storage_lag_versions=self.storage_lag_versions)
+                   storage_lag_versions=self.storage_lag_versions,
+                   storage_engine=self.config.storage_engine)
         w.start()
         self.workers[name] = w
         flow.spawn(self._register_worker(w), name=f"{name}.register")

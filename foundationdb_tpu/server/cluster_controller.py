@@ -35,6 +35,7 @@ class ClusterConfig(NamedTuple):
     n_storage: int = 1         # storage shards
     conflict_backend: str = "python"
     durable: bool = False
+    storage_engine: str = "memory"   # memory | btree (ref: ssd engine)
 
 
 class OpenDatabaseRequest(NamedTuple):
@@ -299,9 +300,12 @@ class ClusterController:
                 if (cand.n_proxies < 1 or cand.n_resolvers < 1
                         or cand.n_logs < 1 or cand.n_logs > live
                         or cand.n_resolvers > live
-                        or cand.n_proxies > live):
-                    # an unrecruitable shape would brick the cluster
-                    # (ref: changeConfig validating against the topology)
+                        or cand.n_proxies > live
+                        or cand.conflict_backend not in (
+                            "python", "native", "tpu", "tpu-point")):
+                    # an unrecruitable shape (or unknown backend) would
+                    # brick the cluster in a recovery-retry loop (ref:
+                    # changeConfig validating against the topology)
                     reply.send_error(error("invalid_option_value"))
                     continue
                 if updates:
@@ -310,10 +314,12 @@ class ClusterController:
                 reply.send(None)
             elif isinstance(req, ExcludeRequest):
                 if req.exclude:
+                    need = max(self.config.n_logs, self.config.n_proxies,
+                               self.config.n_resolvers, 1)
                     if self._live_included_workers(
-                            without=req.worker) == 0:
-                        # refuse to exclude the last recruitable worker
-                        # (ref: excludeServers safety check)
+                            without=req.worker) < need:
+                        # refuse an exclusion that leaves recovery
+                        # unrecruitable (ref: excludeServers safety check)
                         reply.send_error(error("invalid_option_value"))
                         continue
                     self.excluded.add(req.worker)

@@ -39,13 +39,15 @@ class RegisterWorkerRequest(NamedTuple):
 class Worker:
     def __init__(self, process: SimProcess, net, durable: bool = False,
                  dbinfo=None, conflict_backend: str = "python",
-                 storage_lag_versions: Optional[int] = None):
+                 storage_lag_versions: Optional[int] = None,
+                 storage_engine: str = "memory"):
         self.process = process
         self.net = net
         self.durable = durable
         self.dbinfo = dbinfo            # AsyncVar[ServerDBInfo]
         self.conflict_backend = conflict_backend
         self.storage_lag_versions = storage_lag_versions
+        self.storage_engine = storage_engine
         self.roles: dict = {}           # name -> role object
         self.pings = RequestStream(process)
         self._actors = flow.ActorCollection()
@@ -83,15 +85,18 @@ class Worker:
                     await tlog.recovered()
                     recovered_logs.append(self._log_refs(name, tlog))
                 elif store.startswith("storage-") and store.endswith(".dq0"):
-                    name = store[:-4]
-                    refs = await self._recover_storage(name)
+                    refs = await self._recover_storage(store[:-4], "memory")
+                    if refs is not None:
+                        recovered_storages.append(refs)
+                elif store.startswith("storage-") and \
+                        store.endswith(".btree"):
+                    refs = await self._recover_storage(store[:-6], "btree")
                     if refs is not None:
                         recovered_storages.append(refs)
         return tuple(recovered_logs), tuple(recovered_storages)
 
-    async def _recover_storage(self, name: str):
-        kv = KeyValueStoreMemory(self.net.disk(self.process.machine), name,
-                                 owner=self.process)
+    async def _recover_storage(self, name: str, engine: str):
+        kv = self._make_engine(name, engine)
         await kv.recover()
         meta = kv.get(SHARD_META_KEY)
         if meta is None:
@@ -159,13 +164,21 @@ class Worker:
         self.roles[name] = m
         return m
 
+    def _make_engine(self, name: str, engine: Optional[str] = None):
+        """(ref: the KeyValueStoreType choice in IKeyValueStore.h)"""
+        engine = engine or self.storage_engine
+        disk = self.net.disk(self.process.machine)
+        if engine == "btree":
+            from .btree import KeyValueStoreBTree
+            return KeyValueStoreBTree(disk, name, owner=self.process)
+        return KeyValueStoreMemory(disk, name, owner=self.process)
+
     def recruit_storage(self, name: str, tag: int, begin: bytes,
                         end: Optional[bytes], kv=None) -> StorageRefs:
         self._check_alive()
         if kv is None:
             if self.durable:
-                kv = KeyValueStoreMemory(self.net.disk(self.process.machine),
-                                         name, owner=self.process)
+                kv = self._make_engine(name)
             else:
                 kv = EphemeralKeyValueStore()
         s = StorageServer(self.process, None, kv=kv, tag=tag,
