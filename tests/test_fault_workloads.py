@@ -173,3 +173,41 @@ def test_marker_exactness_under_kills(seed):
         assert c.run(main(), timeout_time=900)
     finally:
         c.shutdown()
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_random_cluster_shapes_survive_attrition(seed):
+    """Per-seed random cluster shape — replication, shard count,
+    resolvers, proxies, engine, buggify — running Cycle + attrition
+    (ref: SimulationConfig::generateNormalConfig,
+    SimulatedCluster.actor.cpp:782: random cluster shapes per seed are
+    the reference's way of covering the configuration space)."""
+    import random as _random
+
+    shape_rng = _random.Random(9000 + seed)
+    kw = {
+        "durable": True,
+        "buggify": shape_rng.random() < 0.5,
+        "n_logs": shape_rng.choice([1, 2, 3]),
+        "n_storage": shape_rng.choice([1, 2, 3]),
+        "n_resolvers": shape_rng.choice([1, 2]),
+        "n_proxies": shape_rng.choice([1, 2]),
+        "storage_engine": shape_rng.choice(["memory", "btree"]),
+    }
+    kw["n_workers"] = max(5, kw["n_logs"] + 2, kw["n_storage"] + 1)
+    c = SimCluster(seed=9000 + seed, **kw)
+    try:
+        db = c.client()
+        machines = [f"w{i}" for i in range(c.n_workers)]
+
+        async def main():
+            await _cycle_setup(db)
+            tasks = [flow.spawn(_cycle_swaps(db, 5))]
+            tasks.append(flow.spawn(_attrition(c, 2, machines)))
+            await flow.wait_for_all(tasks)
+            await _cycle_check(db)
+            return True
+
+        assert c.run(main(), timeout_time=900), kw
+    finally:
+        c.shutdown()
