@@ -119,6 +119,7 @@ class KeyValueStoreBTree(IKeyValueStore):
         self._commit_seq = 0
         self._staged: List[Tuple[int, bytes, bytes]] = []  # (op, a, b)
         self._dirty: Dict[int, _Node] = {}    # pages to write at commit
+        self._rows = 0                        # committed row count
 
     # -- recovery --------------------------------------------------------
     async def recover(self) -> None:
@@ -150,6 +151,7 @@ class KeyValueStoreBTree(IKeyValueStore):
         off = _SUPER.size
         self._free = list(struct.unpack_from(f"<{nfree}Q", raw, off))
         # load the reachable tree into the resident cache
+        self._rows = 0
         if root:
             await self._load(root)
 
@@ -160,6 +162,8 @@ class KeyValueStoreBTree(IKeyValueStore):
         if node.kind == _INNER:
             for c in node.children:
                 await self._load(c)
+        else:
+            self._rows += len(node.keys)
 
     # -- staged mutations -------------------------------------------------
     def set(self, key: bytes, value: bytes) -> None:
@@ -238,6 +242,10 @@ class KeyValueStoreBTree(IKeyValueStore):
             if len(out) >= limit:
                 return
 
+    def row_count(self) -> int:
+        return self._rows + sum(1 for op, _a, _b in self._staged
+                                if op == 0)
+
     def get_range(self, begin: bytes, end: bytes, limit: int = 1 << 30,
                   reverse: bool = False) -> List[Tuple[bytes, bytes]]:
         rows: List[Tuple[bytes, bytes]] = []
@@ -295,6 +303,7 @@ class KeyValueStoreBTree(IKeyValueStore):
             else:
                 keys.insert(i, key)
                 vals.insert(i, value)
+                self._rows += 1
             self._free_page(pid)
             return self._maybe_split(_Node(_LEAF, keys, vals))
         ci = bisect_right(node.keys, key)
@@ -356,6 +365,7 @@ class KeyValueStoreBTree(IKeyValueStore):
             if i < len(keys) and keys[i] == key:
                 del keys[i]
                 del vals[i]
+                self._rows -= 1
             self._free_page(pid)
             return self._write_node(_Node(_LEAF, keys, vals))
         ci = bisect_right(node.keys, key)

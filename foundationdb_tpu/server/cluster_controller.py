@@ -91,6 +91,11 @@ class ClusterController:
         # level-triggered: a change that lands mid-recovery is noticed
         # when the monitor next looks, never lost (code review r3)
         self._config_dirty = False
+        self._move_inflight = False        # one shard move at a time
+        # authoritative shard boundaries (ref: the keyServers system
+        # keyspace as ground truth); rebooted servers whose persisted
+        # meta disagrees — e.g. crashed mid-move — are clamped to this
+        self.shard_map: dict = {}          # name -> (tag, begin, end)
         self._recovery: Optional[MasterRecovery] = None
         self._recovery_task = None
         self._storage_objs: dict = {}      # name -> StorageServer (registry)
@@ -111,7 +116,8 @@ class ClusterController:
                            (self._registration_loop(), "register"),
                            (self._open_db_loop(), "openDatabase"),
                            (self._status_loop(), "status"),
-                           (self._management_loop(), "management")):
+                           (self._management_loop(), "management"),
+                           (self._dd_loop(), "dataDistribution")):
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
         self.process.on_kill(self._actors.cancel_all)
@@ -210,10 +216,23 @@ class ClusterController:
 
     def _merge_storages(self, refs: Tuple[StorageRefs, ...]) -> None:
         """A rebooted worker re-registered storage shards: swap the new
-        endpoints into the shard map and re-broadcast."""
+        endpoints into the broadcast map, clamping each server's bounds
+        to the authoritative shard map (its persisted meta may be stale
+        if it crashed mid-move; the clamp also makes it shed data it no
+        longer owns)."""
         info = self.dbinfo.get()
         by_name = {s.name: s for s in info.storages}
         for r in refs:
+            auth = self.shard_map.get(r.name)
+            if auth is not None:
+                _tag, b, e = auth
+                if (r.begin, r.end) != (b, e):
+                    obj = self._storage_objs.get(r.name)
+                    if obj is not None:
+                        flow.spawn(obj.set_bounds(b, e),
+                                   TaskPriority.DATA_DISTRIBUTION,
+                                   name=f"{r.name}.clampBounds")
+                    r = r._replace(begin=b, end=e)
             by_name[r.name] = r
         storages = tuple(sorted(by_name.values(), key=lambda s: s.begin))
         self.publish(info._replace(storages=storages))
@@ -257,6 +276,7 @@ class ClusterController:
                                      bounds[i + 1])
             storages.append(refs)
             self._storage_objs[refs.name] = w.roles[refs.name]
+            self.shard_map[refs.name] = (i, bounds[i], bounds[i + 1])
         self.publish(info._replace(storages=tuple(storages)))
 
     def tlog_objs(self):
@@ -429,6 +449,171 @@ class ClusterController:
                 },
             },
         }
+
+    # -- data distribution (ref: DataDistribution + MoveKeys) ------------
+    async def _dd_loop(self):
+        """Shift shard boundaries toward balanced row counts (ref:
+        dataDistributionTracker splitting on size +
+        dataDistributionQueue scheduling moveKeys). One move at a time;
+        only when the cluster is healthy."""
+        while True:
+            await flow.delay(2.0, TaskPriority.DATA_DISTRIBUTION)
+            info = self.dbinfo.get()
+            if info.recovery_state != FULLY_RECOVERED or \
+                    self._move_inflight or len(info.storages) < 2:
+                continue
+            objs = [self._storage_objs.get(s.name) for s in info.storages]
+            if any(o is None or not o.process.alive or o._adding
+                   for o in objs):
+                continue
+            counts = [o.approx_rows() for o in objs]
+            for i in range(len(objs) - 1):
+                big, small = counts[i], counts[i + 1]
+                src, direction = (i, "right") if big > small else (i + 1,
+                                                                   "left")
+                hi, lo = max(big, small), min(big, small)
+                if hi < 200 or hi <= 2 * lo:
+                    continue
+                split = objs[src].split_key_estimate()
+                if split is None:
+                    continue
+                # moving [split, src.end) right, or [src.begin, split)
+                # left — only when the split lands strictly inside src
+                s_begin = objs[src].shard_begin
+                s_end = objs[src].shard_end
+                if not (split > s_begin
+                        and (s_end is None or split < s_end)):
+                    continue
+                try:
+                    await self._move_boundary(i, direction, split)
+                except Exception as e:  # noqa: BLE001 — DD must survive
+                    flow.TraceEvent(
+                        "MoveKeysError", self.process.name,
+                        severity=flow.trace.SevWarnAlways).detail(
+                        Error=repr(e)).log()
+                break
+
+    async def _move_boundary(self, left_idx: int, direction: str,
+                             split: bytes) -> None:
+        """Move the boundary between adjacent shards left_idx and
+        left_idx+1 to `split` (ref: moveKeys start/finish + fetchKeys).
+        Sequence: destination buffers; proxies dual-tag; destination
+        backfills a snapshot the source serves at its own version;
+        ownership flips durably on the destination, is published, and
+        only then do proxies drop the dual tag and the source shrink."""
+        info = self.dbinfo.get()
+        storages = info.storages
+        if direction == "right":
+            src_i, dst_i = left_idx, left_idx + 1
+            r_begin, r_end = split, storages[dst_i].begin
+        else:
+            src_i, dst_i = left_idx + 1, left_idx
+            r_begin, r_end = storages[src_i].begin, split
+        src = self._storage_objs[storages[src_i].name]
+        dst = self._storage_objs[storages[dst_i].name]
+        dst_old_bounds = (dst.shard_begin, dst.shard_end)
+        proxies = self._current_proxies()
+        if not proxies:
+            return
+        epoch0 = info.epoch
+        self._move_inflight = True
+        flow.TraceEvent("MoveKeysStart", self.process.name).detail(
+            Begin=r_begin.hex(), End=r_end.hex(), Src=storages[src_i].name,
+            Dst=storages[dst_i].name).log()
+        published = False
+        try:
+            dst.begin_adding(r_begin, r_end)
+            for p in proxies:
+                p.start_move(r_begin, r_end, dst.tag)
+            # v0 must cover batches whose tags were computed BEFORE the
+            # dual-tag landed: every such batch's version was issued by
+            # the master already, so the master's issued max (not the
+            # proxies' committed) is the safe horizon (code review r3)
+            v0 = max(p.committed_version.get() for p in proxies)
+            if self._recovery is not None and \
+                    self._recovery.master is not None:
+                v0 = max(v0, self._recovery.master.version)
+            # snapshot only at a version known replicated on the whole
+            # log set — an epoch rollback can never rewind below it, so
+            # the durable install can't capture a phantom timeline
+            deadline = flow.now() + 30.0
+            while (src.known_committed < v0 or src.version.get() < v0):
+                if flow.now() > deadline:
+                    raise error("timed_out")
+                if self.dbinfo.get().epoch != epoch0:
+                    raise error("operation_failed")
+                # idle clusters advance known_committed only with fresh
+                # commits: nudge one through (ref: the recovery txn)
+                from .types import CommitRequest
+                await flow.catch_errors(flow.timeout_error(
+                    self.dbinfo.get().proxies[0].commits.get_reply(
+                        CommitRequest(0, (), (), ()), self.process), 1.0))
+                await flow.delay(0.1, TaskPriority.DATA_DISTRIBUTION)
+            v_s = min(src.known_committed, src.version.get())
+            rows = src.snapshot_range(r_begin, r_end, v_s)
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")   # abort pre-install
+            await dst.install_snapshot(rows, v_s)
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")   # abort pre-publish
+            # publish: THE commit point — from here the move only rolls
+            # forward (a revert after publish would diverge routing from
+            # the advertised map; code review r3)
+            new_storages = []
+            for j, s in enumerate(storages):
+                if j == dst_i:
+                    new_storages.append(
+                        s._replace(begin=split) if direction == "right"
+                        else s._replace(end=split))
+                elif j == src_i:
+                    new_storages.append(
+                        s._replace(end=split) if direction == "right"
+                        else s._replace(begin=split))
+                else:
+                    new_storages.append(s)
+            for s in new_storages:
+                self.shard_map[s.name] = (s.tag, s.begin, s.end)
+            self.publish(self.dbinfo.get()._replace(
+                storages=tuple(new_storages)))
+            published = True
+            for p in self._current_proxies():
+                p.finish_move(r_begin, r_end, dst.tag,
+                              [s.begin for s in new_storages[1:]])
+            try:
+                if direction == "right":
+                    await src.shrink_to(src.shard_begin, split)
+                else:
+                    await src.shrink_to(split, src.shard_end)
+            except flow.FdbError:
+                pass  # a dead src is clamped to the map on re-register
+            flow.TraceEvent("MoveKeysFinish", self.process.name).detail(
+                Split=split.hex()).log()
+        except BaseException:
+            if not published:
+                dst.abort_adding()
+                if (dst.shard_begin, dst.shard_end) != dst_old_bounds:
+                    # the durable install already extended dst's claim:
+                    # retract it (the floor + fetched rows stay, unreachable)
+                    await flow.catch_errors(flow.spawn(
+                        dst.set_bounds(*dst_old_bounds)))
+                for p in self._current_proxies():
+                    p.finish_move(r_begin, r_end, dst.tag,
+                                  [s.begin for s in storages[1:]])
+            raise
+        finally:
+            self._move_inflight = False
+
+    def _current_proxies(self):
+        from .proxy import Proxy
+        ep = self.dbinfo.get().epoch
+        out = []
+        for wi in self.workers.values():
+            if not wi.worker.process.alive:
+                continue
+            for rn, role in wi.worker.roles.items():
+                if isinstance(role, Proxy) and f"-e{ep}-" in rn:
+                    out.append(role)
+        return out
 
     # -- client handshake -----------------------------------------------
     async def _open_db_loop(self):

@@ -65,6 +65,10 @@ class IKeyValueStore:
         """Make all staged mutations durable."""
         raise NotImplementedError
 
+    def row_count(self) -> int:
+        """Approximate stored row count (data-distribution signal)."""
+        return len(self.get_range(b"", b"\xff", limit=1 << 20))
+
 
 class EphemeralKeyValueStore(IKeyValueStore):
     """RAM-only engine for non-durable clusters: the storage server's
@@ -102,6 +106,9 @@ class EphemeralKeyValueStore(IKeyValueStore):
         if reverse:
             keys = keys[::-1]
         return [(k, self._data[k]) for k in keys[:limit]]
+
+    def row_count(self) -> int:
+        return len(self._keys)
 
     async def commit(self) -> None:
         return
@@ -172,6 +179,9 @@ class KeyValueStoreMemory(IKeyValueStore):
         if reverse:
             ks = ks[::-1]
         return [(k, self._data[k]) for k in ks[:limit]]
+
+    def row_count(self) -> int:
+        return len(self._keys)
 
     # -- durability -----------------------------------------------------
     async def commit(self) -> None:

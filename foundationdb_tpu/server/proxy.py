@@ -155,6 +155,7 @@ class Proxy:
                                             len(resolver_refs))
         # keyServers boundaries: storage tag i owns [sbounds[i], sbounds[i+1])
         self._sbounds = [b""] + list(storage_splits) + [None]
+        self._moving: list = []   # (begin, end, extra_tag) dual-tag ranges
         self.tlog_refs = list(tlog_refs)
         batch_window = max(batch_window,
                            SERVER_KNOBS.commit_transaction_batch_interval_min)
@@ -321,18 +322,28 @@ class Proxy:
     def _tags_for(self, m: MutationRef):
         """Destination storage tags for a mutation (ref: LogPushData tag
         routing via the keyServers map). A point mutation goes to its
-        shard's tag; a clear goes to every shard it overlaps."""
+        shard's tag(s); a clear goes to every shard it overlaps. A range
+        being moved is DUAL-TAGGED so both source and destination logs
+        see its mutations throughout the transition (ref: keyServers
+        holding both teams during moveKeys)."""
         n = len(self._sbounds) - 1
-        if n == 1:
+        if n == 1 and not self._moving:
             return (0,)
         if m.type == CLEAR_RANGE:
-            tags = []
+            tags = set()
             for i in range(n):
                 lo, hi = self._sbounds[i], self._sbounds[i + 1]
                 if (hi is None or m.param1 < hi) and lo < m.param2:
-                    tags.append(i)
-            return tuple(tags)
-        return (self._shard_of(m.param1),)
+                    tags.add(i)
+            for mb, me, extra in self._moving:
+                if (me is None or m.param1 < me) and mb < m.param2:
+                    tags.add(extra)
+            return tuple(sorted(tags))
+        tags = {self._shard_of(m.param1)}
+        for mb, me, extra in self._moving:
+            if mb <= m.param1 and (me is None or m.param1 < me):
+                tags.add(extra)
+        return tuple(sorted(tags))
 
     def _shard_of(self, key: bytes) -> int:
         n = len(self._sbounds) - 1
@@ -340,6 +351,19 @@ class Proxy:
             if key >= self._sbounds[i]:
                 return i
         return 0
+
+    def start_move(self, begin: bytes, end, extra_tag: int) -> None:
+        """Dual-tag [begin, end) with `extra_tag` while a shard move is
+        in flight (ref: moveKeys startMoveKeys)."""
+        self._moving.append((begin, end, extra_tag))
+
+    def finish_move(self, begin: bytes, end, extra_tag: int,
+                    new_splits) -> None:
+        """Adopt the new shard boundaries and drop the dual tag
+        (ref: finishMoveKeys)."""
+        self._moving = [mv for mv in self._moving
+                        if mv != (begin, end, extra_tag)]
+        self._sbounds = [b""] + list(new_splits) + [None]
 
     # -- commit pipeline ------------------------------------------------
     async def _batcher(self):

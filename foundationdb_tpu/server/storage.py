@@ -37,11 +37,25 @@ SHARD_META_KEY = b"\xff\xff/shardMeta"   # persisted tag + owned range
 _NO_HINT = object()  # sentinel: _get_hinted must consult the base engine
 
 
-def encode_shard_meta(tag: int, begin: bytes, end: Optional[bytes]) -> bytes:
+def encode_shard_meta(tag: int, begin: bytes, end: Optional[bytes],
+                      floors=()) -> bytes:
+    """Shard identity + fetched-range floors: a floor records that
+    [b, e) was installed from a snapshot at `floor` — on re-pull after
+    a crash, that range's log mutations at or below the floor are
+    already folded into the base and must not re-apply (the atomic-op
+    double-apply hazard of fetchKeys; ref: persistent shard assignment
+    + fetchedVersion bookkeeping in storageserver)."""
     e = end if end is not None else b""
     has_end = 1 if end is not None else 0
-    return struct.pack("<HBI", tag, has_end, len(begin)) + begin + \
-        struct.pack("<I", len(e)) + e
+    out = [struct.pack("<HBI", tag, has_end, len(begin)), begin,
+           struct.pack("<I", len(e)), e, struct.pack("<I", len(floors))]
+    for fb, fe, fv in floors:
+        out.append(struct.pack("<I", len(fb)))
+        out.append(fb)
+        out.append(struct.pack("<I", len(fe)))
+        out.append(fe)
+        out.append(struct.pack("<q", fv))
+    return b"".join(out)
 
 
 def decode_shard_meta(buf: bytes):
@@ -51,7 +65,43 @@ def decode_shard_meta(buf: bytes):
     off += lb
     (le,) = struct.unpack_from("<I", buf, off)
     end = buf[off + 4:off + 4 + le] if has_end else None
-    return tag, bytes(begin), (bytes(end) if end is not None else None)
+    off += 4 + le
+    floors = []
+    if off < len(buf):
+        (nf,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        for _ in range(nf):
+            (l1,) = struct.unpack_from("<I", buf, off)
+            fb = bytes(buf[off + 4:off + 4 + l1])
+            off += 4 + l1
+            (l2,) = struct.unpack_from("<I", buf, off)
+            fe = bytes(buf[off + 4:off + 4 + l2])
+            off += 4 + l2
+            (fv,) = struct.unpack_from("<q", buf, off)
+            off += 8
+            floors.append((fb, fe, fv))
+    return tag, bytes(begin), (bytes(end) if end is not None else None), \
+        floors
+
+def _split_mutation(m: MutationRef, begin: bytes, end: Optional[bytes]):
+    """Split a mutation into (inside, outside) parts relative to
+    [begin, end): point mutations go whole to one side; clears clip."""
+    hi = end  # None = +inf
+    if m.type != CLEAR_RANGE:
+        k = m.param1
+        if begin <= k and (hi is None or k < hi):
+            return [m], []
+        return [], [m]
+    b, e = m.param1, m.param2
+    ib, ie = max(b, begin), (e if hi is None else min(e, hi))
+    inside = [MutationRef(CLEAR_RANGE, ib, ie)] if ib < ie else []
+    outside = []
+    if b < min(begin, e):
+        outside.append(MutationRef(CLEAR_RANGE, b, min(begin, e)))
+    if hi is not None and max(b, hi) < e:
+        outside.append(MutationRef(CLEAR_RANGE, max(b, hi), e))
+    return inside, outside
+
 
 _ATOMIC_APPLY = {
     ADD_VALUE: atomic.add,
@@ -306,7 +356,7 @@ class StorageServer:
                  durability_lag_versions: Optional[int] = None,
                  tag: int = 0, dbinfo=None,
                  shard_begin: bytes = b"",
-                 shard_end: Optional[bytes] = None):
+                 shard_end: Optional[bytes] = None, floors=()):
         self.process = process
         # direct log wiring (component tests) or dbinfo-driven discovery
         # of the current log generation (clusters with recovery)
@@ -317,6 +367,12 @@ class StorageServer:
         self.tag = tag
         self.shard_begin = shard_begin
         self.shard_end = shard_end
+        # fetched-range floors (see encode_shard_meta) + the in-flight
+        # incoming range, whose mutations buffer until the snapshot
+        # lands (ref: AddingShard, storageserver.actor.cpp:149)
+        self._floors: List[Tuple[bytes, bytes, int]] = list(floors)
+        self._adding: Optional[Tuple[bytes, bytes]] = None
+        self._adding_buf: List[Tuple[int, MutationRef]] = []
         self.known_committed = 0  # replicated log-set-wide (peek piggyback)
         self._replica_rr = tag    # peek replica rotation, offset by tag
         self._seen_epoch = 0
@@ -439,17 +495,48 @@ class StorageServer:
                 continue
             if cap is not None and version > cap:
                 break  # stale data beyond the generation's locked end
-            for m in mutations:
+            apply_now = self._partition(version, mutations)
+            for m in apply_now:
                 self.data.apply(version, m)
             self.stats.counter("mutations").add(len(mutations))
-            self._pending.append((version, mutations))
+            if apply_now:
+                self._pending.append((version, apply_now))
             self.version.set(version)
-            self._check_watches(version, mutations)
+            self._check_watches(version, apply_now)
         adv = reply.committed_version
         if cap is not None:
             adv = min(adv, cap)
         if adv > self.version.get():
             self.version.set(adv)
+
+    def _partition(self, version: int, mutations):
+        """Route each mutation part: the in-flight incoming range
+        buffers until its snapshot lands; floored ranges drop parts the
+        installed snapshot already contains (post-crash replay); the
+        rest applies now. Clears are clipped at the range edges."""
+        if self._adding is None and not self._floors:
+            return tuple(mutations)
+        out = []
+        for m in mutations:
+            if self._adding is not None:
+                ab, ae = self._adding
+                inside, outside = _split_mutation(m, ab, ae)
+                for part in inside:
+                    self._adding_buf.append((version, part))
+            else:
+                outside = [m]
+            for part in outside:
+                rest = [part]
+                for fb, fe, fv in self._floors:
+                    if version > fv:
+                        continue
+                    nxt = []
+                    for p in rest:
+                        _in, out_parts = _split_mutation(p, fb, fe)
+                        nxt.extend(out_parts)   # in-floor parts drop
+                    rest = nxt
+                out.extend(rest)
+        return tuple(out)
 
     def _pick_source(self, needed: int):
         """The generation that owns `needed`, and one of its replicas."""
@@ -508,11 +595,20 @@ class StorageServer:
                 version, mutations = self._pending[i]
                 for m in mutations:
                     self._apply_to_kv(m)
-                made = version
+                # replayed install entries can sit below the marker:
+                # never let it regress
+                made = max(made, version)
                 i += 1
             if i == 0:
                 continue
             del self._pending[:i]
+            live_floors = [f for f in self._floors if f[2] > made]
+            if len(live_floors) != len(self._floors):
+                # a floor only filters crash-replay of versions at or
+                # below it; once the durable marker passes it, re-pulls
+                # start above it and it is dead weight (code review r3)
+                self._floors = live_floors
+                self._persist_meta()
             self.kv.set(DURABLE_VERSION_KEY, struct.pack("<Q", made))
             await self.kv.commit()
             self.durable_version.set(made)
@@ -542,6 +638,134 @@ class StorageServer:
                         or b"")
         else:
             raise error("client_invalid_operation")
+
+    # -- shard movement (ref: fetchKeys/AddingShard + moveKeys) ---------
+    def begin_adding(self, begin: bytes, end: Optional[bytes]) -> None:
+        """Start buffering mutations for an incoming range; the dual-tag
+        must begin AFTER this so nothing slips through un-buffered."""
+        self._adding = (begin, end)
+        self._adding_buf = []
+
+    def abort_adding(self) -> None:
+        self._adding = None
+        self._adding_buf = []
+
+    def snapshot_range(self, begin: bytes, end: Optional[bytes],
+                       at_version: int):
+        """This shard's view of the range at `at_version` — the
+        fetchKeys source side. The caller picks a version at or below
+        known_committed so an epoch rollback can never invalidate the
+        snapshot after it lands durably on the destination."""
+        hi = end if end is not None else b"\xff"
+        return self.data.get_range(begin, hi, at_version, 1 << 30)
+
+    async def install_snapshot(self, rows, at_version: int) -> None:
+        """Fold the fetched snapshot into the DURABLE base (with its
+        floor persisted in the shard meta) before ownership flips, then
+        replay buffered mutations above the snapshot version. Making
+        the install durable first keeps a crash from resurrecting the
+        old ownership after the source has shrunk."""
+        begin, end = self._adding
+        for k, v in rows:
+            self.kv.set(k, v)
+        self._floors.append((begin, end if end is not None else b"\xff",
+                             at_version))
+        new_begin = min(self.shard_begin, begin)
+        new_end = self.shard_end
+        if end is None or (self.shard_end is not None
+                           and end > self.shard_end):
+            new_end = end
+        self.shard_begin, self.shard_end = new_begin, new_end
+        self._persist_meta()
+        await self.kv.commit()
+        buf, self._adding_buf = self._adding_buf, []
+        self._adding = None
+        replay = [(v, m) for v, m in buf if v > at_version]
+        for v, m in replay:
+            self.data.apply(v, m)
+        if replay:
+            self._merge_pending(replay)
+
+    async def set_bounds(self, begin: bytes, end: Optional[bytes]) -> None:
+        """Adopt authoritative bounds (the CC's shard map is ground
+        truth; a rebooted server whose persisted meta disagrees — e.g.
+        it crashed mid-move — is clamped back on registration). Shrinks
+        clear the vacated range versioned and fail its watches so
+        stale-map clients refresh."""
+        if begin > self.shard_begin or (
+                self.shard_end is None and end is not None) or (
+                end is not None and self.shard_end is not None
+                and end < self.shard_end):
+            await self.shrink_to(max(begin, self.shard_begin),
+                                 end if end is not None else self.shard_end)
+        self.shard_begin, self.shard_end = begin, end
+        self._persist_meta()
+        if self.kv is not None:
+            await self.kv.commit()
+
+    async def shrink_to(self, begin: bytes, end: Optional[bytes]) -> None:
+        """Give up ownership outside [begin, end): the vacated range is
+        cleared VERSIONED at the current version so stale-map readers at
+        older versions still see consistent data (ref: the old team
+        keeping data through the move grace)."""
+        v = self.version.get()
+        clears = []
+        if begin > self.shard_begin:
+            clears.append(MutationRef(CLEAR_RANGE, self.shard_begin, begin))
+        if end is not None and (self.shard_end is None
+                                or end < (self.shard_end or b"\xff")):
+            clears.append(MutationRef(
+                CLEAR_RANGE, end,
+                self.shard_end if self.shard_end is not None else b"\xff"))
+        for m in clears:
+            self.data.apply(v, m)
+        if clears:
+            self._merge_pending([(v, m) for m in clears])
+        # watches on vacated keys will never fire here again: fail them
+        # so their clients refresh the location map (code review r3)
+        for k in [k for k in self._watch_map
+                  if k < begin or (end is not None and k >= end)]:
+            for _expected, reply in self._watch_map.pop(k):
+                reply.send_error(error("wrong_shard_server"))
+        self.shard_begin, self.shard_end = begin, end
+        self._persist_meta()
+        if self.kv is not None:
+            await self.kv.commit()
+
+    def _persist_meta(self) -> None:
+        if self.kv is not None:
+            self.kv.set(SHARD_META_KEY,
+                        encode_shard_meta(self.tag, self.shard_begin,
+                                          self.shard_end, self._floors))
+
+    def _merge_pending(self, entries) -> None:
+        """Insert (version, mutation) singletons into the durability
+        queue, keeping it version-sorted (installs replay versions that
+        may be older than the queue tail)."""
+        for v, m in entries:
+            i = bisect_right([p[0] for p in self._pending], v)
+            self._pending.insert(i, (v, (m,)))
+
+    def approx_rows(self, cap: int = 50_000) -> int:
+        """Row-count estimate for data-distribution decisions. Counts
+        the versioned view (window + base — the base engine alone lags
+        behind the durability horizon and also holds system metadata
+        keys). Saturates at `cap`: beyond it the balancer compares
+        equal-looking giants, which only defers splitting (a byte
+        sample would lift this, as in the reference)."""
+        hi = self.shard_end if self.shard_end is not None else b"\xff"
+        return len(self.data.get_range(self.shard_begin, hi,
+                                       self.version.get(), cap))
+
+    def split_key_estimate(self) -> Optional[bytes]:
+        """A key near the middle of this shard's data (ref: the
+        byte-sample-driven split point in DataDistributionTracker)."""
+        hi = self.shard_end if self.shard_end is not None else b"\xff"
+        rows = self.data.get_range(self.shard_begin, hi,
+                                   self.version.get(), 5000)
+        if len(rows) < 2:
+            return None
+        return rows[len(rows) // 2][0]
 
     # -- watches --------------------------------------------------------
     def _check_watches(self, version: int, mutations) -> None:
@@ -585,9 +809,22 @@ class StorageServer:
             req, reply = await self.gets.pop()
             flow.spawn(self._serve_get(req, reply), TaskPriority.STORAGE)
 
+    def _check_owned(self, begin: bytes, end: Optional[bytes]) -> None:
+        """Reject requests outside the owned range so stale-map clients
+        refresh their location picture instead of silently reading a
+        vacated range (ref: storageserver wrong_shard_server on
+        shard-miss, the location-cache invalidation signal)."""
+        if begin < self.shard_begin:
+            raise error("wrong_shard_server")
+        if self.shard_end is not None:
+            probe = end if end is not None else begin + b"\x00"
+            if probe > self.shard_end:
+                raise error("wrong_shard_server")
+
     async def _serve_get(self, req: StorageGetRequest, reply):
         try:
             self.stats.counter("get_queries").add(1)
+            self._check_owned(req.key, None)
             await self._wait_version(req.version)
             reply.send(self.data.get(req.key, req.version))
         except flow.FdbError as e:
@@ -601,6 +838,7 @@ class StorageServer:
     async def _serve_range(self, req: StorageGetRangeRequest, reply):
         try:
             self.stats.counter("range_queries").add(1)
+            self._check_owned(req.begin, req.end)
             await self._wait_version(req.version)
             reply.send(self.data.get_range(req.begin, req.end, req.version,
                                            req.limit, req.reverse))
@@ -627,6 +865,7 @@ class StorageServer:
 
     async def _serve_watch(self, req: StorageWatchRequest, reply):
         try:
+            self._check_owned(req.key, None)
             await self._wait_version(req.version)
             expected = self.data.get(req.key, req.version)
             current = self.data.get(req.key, self.version.get())

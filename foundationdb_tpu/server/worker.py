@@ -101,8 +101,9 @@ class Worker:
         meta = kv.get(SHARD_META_KEY)
         if meta is None:
             return None
-        tag, begin, end = decode_shard_meta(meta)
-        return self.recruit_storage(name, tag, begin, end, kv=kv)
+        tag, begin, end, floors = decode_shard_meta(meta)
+        return self.recruit_storage(name, tag, begin, end, kv=kv,
+                                    floors=floors)
 
     # -- recruitment (CC-driven) ----------------------------------------
     def _make_tlog(self, store: str, recovery_version: int = 0) -> TLog:
@@ -174,7 +175,8 @@ class Worker:
         return KeyValueStoreMemory(disk, name, owner=self.process)
 
     def recruit_storage(self, name: str, tag: int, begin: bytes,
-                        end: Optional[bytes], kv=None) -> StorageRefs:
+                        end: Optional[bytes], kv=None,
+                        floors=()) -> StorageRefs:
         self._check_alive()
         if kv is None:
             if self.durable:
@@ -184,7 +186,7 @@ class Worker:
         s = StorageServer(self.process, None, kv=kv, tag=tag,
                           durability_lag_versions=self.storage_lag_versions,
                           dbinfo=self.dbinfo, shard_begin=begin,
-                          shard_end=end)
+                          shard_end=end, floors=floors)
         s.start()
         self.roles[name] = s
         refs = StorageRefs(name, tag, begin, end, s.gets.ref(),
