@@ -185,6 +185,24 @@ class Transaction:
         info = await self._get_info()
         return info.storages[_shard_index(info.storages, key)]
 
+    async def _storage_rpc(self, shard, fn):
+        """Replica-parallel reads: try the shard's replicas in rotated
+        order, failing over on connection-class errors (ref:
+        loadBalance, fdbrpc/LoadBalance.actor.h — replica selection +
+        failover; latency modeling is future work)."""
+        n = len(shard.replicas)
+        start = flow.g_random.random_int(0, n)
+        last = None
+        for j in range(n):
+            rep = shard.replicas[(start + j) % n]
+            try:
+                return await _rpc(fn(rep))
+            except flow.FdbError as e:
+                if e.name not in ("broken_promise", "timed_out"):
+                    raise
+                last = e
+        raise last
+
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:
         if self._read_version is None:
@@ -210,7 +228,7 @@ class Transaction:
             return val
         version = await self.get_read_version()
         shard = await self._shard(key)
-        return await _rpc(shard.gets.get_reply(
+        return await self._storage_rpc(shard, lambda rep: rep.gets.get_reply(
             StorageGetRequest(key, version), self.db.process))
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
@@ -234,8 +252,9 @@ class Transaction:
         i = _shard_index(storages, selector.key)
         sel = selector
         while True:
-            key, leftover = await _rpc(storages[i].get_keys.get_reply(
-                StorageGetKeyRequest(sel, version), self.db.process))
+            key, leftover = await self._storage_rpc(
+                storages[i], lambda rep, sel=sel: rep.get_keys.get_reply(
+                    StorageGetKeyRequest(sel, version), self.db.process))
             if leftover == 0:
                 resolved = key
                 break
@@ -298,8 +317,9 @@ class Transaction:
                 if val is None and k not in self._writes and \
                         not any(b <= k < e for b, e in self._cleared):
                     shard = await self._shard(k)
-                    val = await _rpc(shard.gets.get_reply(
-                        StorageGetRequest(k, version), self.db.process))
+                    val = await self._storage_rpc(
+                        shard, lambda rep, k=k: rep.gets.get_reply(
+                            StorageGetRequest(k, version), self.db.process))
                 for op, param in ops:
                     val = _ATOMIC_APPLY[op](val, param)
                 if val is None:
@@ -335,9 +355,10 @@ class Transaction:
         for s in shards:
             b = max(begin, s.begin)
             e = end if s.end is None else min(end, s.end)
-            part = await _rpc(s.ranges.get_reply(
-                StorageGetRangeRequest(b, e, version, limit - len(out),
-                                       reverse), self.db.process))
+            part = await self._storage_rpc(
+                s, lambda rep, b=b, e=e: rep.ranges.get_reply(
+                    StorageGetRangeRequest(b, e, version, limit - len(out),
+                                           reverse), self.db.process))
             out.extend(part)
             if len(out) >= limit:
                 break
@@ -451,7 +472,9 @@ class Transaction:
             if f.is_ready:
                 continue
             shard = await self.db.shard_for(key)
-            storage_fut = shard.watches.get_reply(
+            rep = shard.replicas[flow.g_random.random_int(
+                0, len(shard.replicas))]
+            storage_fut = rep.watches.get_reply(
                 StorageWatchRequest(key, version), self.db.process)
             storage_fut.on_ready(
                 lambda sf, f=f: (f.send(sf.get()) if not sf.is_error

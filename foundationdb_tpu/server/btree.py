@@ -243,8 +243,7 @@ class KeyValueStoreBTree(IKeyValueStore):
                 return
 
     def row_count(self) -> int:
-        return self._rows + sum(1 for op, _a, _b in self._staged
-                                if op == 0)
+        return self._rows
 
     def get_range(self, begin: bytes, end: bytes, limit: int = 1 << 30,
                   reverse: bool = False) -> List[Tuple[bytes, bytes]]:

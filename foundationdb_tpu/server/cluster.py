@@ -33,7 +33,8 @@ class SimCluster:
                  n_proxies: int = 1, n_logs: int = 1, n_storage: int = 1,
                  n_workers: Optional[int] = None, n_coordinators: int = 1,
                  auto_reboot: bool = True, buggify: bool = False,
-                 storage_engine: str = "memory"):
+                 storage_engine: str = "memory",
+                 storage_replicas: int = 1):
         flow.set_seed(seed, buggify_enabled=buggify)
         # knob distortion rides the same switch as BUGGIFY (ref:
         # `if (randomize && BUGGIFY)` in Knobs.cpp); always re-init so a
@@ -51,7 +52,8 @@ class SimCluster:
                                     n_logs=n_logs, n_storage=n_storage,
                                     conflict_backend=conflict_backend,
                                     durable=durable,
-                                    storage_engine=storage_engine)
+                                    storage_engine=storage_engine,
+                                    storage_replicas=storage_replicas)
 
         # coordinators (ref: coordinationServer)
         self.coordinators = []
@@ -72,7 +74,8 @@ class SimCluster:
 
         # workers, one per simulated machine
         if n_workers is None:
-            n_workers = max(4, n_logs + 1, n_storage, n_resolvers)
+            n_workers = max(4, n_logs + 1, n_storage * storage_replicas,
+                            n_resolvers, storage_replicas + 1)
         self.n_workers = n_workers
         self.workers: dict = {}
         for i in range(n_workers):

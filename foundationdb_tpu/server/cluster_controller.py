@@ -21,7 +21,8 @@ from .. import flow
 from ..flow import AsyncVar, TaskPriority, error
 from ..rpc import RequestStream, SimProcess
 from .coordination import CoordinatedState, elect_leader
-from .dbinfo import EMPTY_DBINFO, FULLY_RECOVERED, ServerDBInfo, StorageRefs
+from .dbinfo import (EMPTY_DBINFO, FULLY_RECOVERED, ServerDBInfo,
+                     StorageRefs, StorageShard)
 from .master import MasterRecovery
 from .worker import RegisterWorkerRequest
 
@@ -33,6 +34,7 @@ class ClusterConfig(NamedTuple):
     n_resolvers: int = 1
     n_logs: int = 1            # log replication factor
     n_storage: int = 1         # storage shards
+    storage_replicas: int = 1  # replicas per shard (same-tag teams)
     conflict_backend: str = "python"
     durable: bool = False
     storage_engine: str = "memory"   # memory | btree (ref: ssd engine)
@@ -221,21 +223,28 @@ class ClusterController:
         if it crashed mid-move; the clamp also makes it shed data it no
         longer owns)."""
         info = self.dbinfo.get()
-        by_name = {s.name: s for s in info.storages}
+        shards = list(info.storages)
+        changed = False
         for r in refs:
             auth = self.shard_map.get(r.name)
-            if auth is not None:
-                _tag, b, e = auth
-                if (r.begin, r.end) != (b, e):
-                    obj = self._storage_objs.get(r.name)
-                    if obj is not None:
-                        flow.spawn(obj.set_bounds(b, e),
-                                   TaskPriority.DATA_DISTRIBUTION,
-                                   name=f"{r.name}.clampBounds")
-                    r = r._replace(begin=b, end=e)
-            by_name[r.name] = r
-        storages = tuple(sorted(by_name.values(), key=lambda s: s.begin))
-        self.publish(info._replace(storages=storages))
+            if auth is None:
+                continue
+            _tag, b, e = auth
+            if (r.begin, r.end) != (b, e):
+                obj = self._storage_objs.get(r.name)
+                if obj is not None:
+                    flow.spawn(obj.set_bounds(b, e),
+                               TaskPriority.DATA_DISTRIBUTION,
+                               name=f"{r.name}.clampBounds")
+                r = r._replace(begin=b, end=e)
+            for si, shard in enumerate(shards):
+                if any(rep.name == r.name for rep in shard.replicas):
+                    shards[si] = shard._replace(replicas=tuple(
+                        r if rep.name == r.name else rep
+                        for rep in shard.replicas))
+                    changed = True
+        if changed:
+            self.publish(info._replace(storages=tuple(shards)))
 
     # -- recruitment helpers (used by MasterRecovery) -------------------
     def pick_workers(self, n: int, role: str):
@@ -269,14 +278,19 @@ class ClusterController:
             return
         splits = list(self.storage_splits())
         bounds = [b""] + splits + [None]
-        workers = self.pick_workers(self.config.n_storage, role="storage")
+        nrep = max(1, self.config.storage_replicas)
         storages = []
-        for i, w in enumerate(workers):
-            refs = w.recruit_storage(f"storage-{i}", i, bounds[i],
-                                     bounds[i + 1])
-            storages.append(refs)
-            self._storage_objs[refs.name] = w.roles[refs.name]
-            self.shard_map[refs.name] = (i, bounds[i], bounds[i + 1])
+        for i in range(self.config.n_storage):
+            team = self.pick_workers(nrep, role="storage")
+            replicas = []
+            for j, w in enumerate(team):
+                refs = w.recruit_storage(f"storage-{i}-r{j}", i, bounds[i],
+                                         bounds[i + 1])
+                replicas.append(refs)
+                self._storage_objs[refs.name] = w.roles[refs.name]
+                self.shard_map[refs.name] = (i, bounds[i], bounds[i + 1])
+            storages.append(StorageShard(i, bounds[i], bounds[i + 1],
+                                         tuple(replicas)))
         self.publish(info._replace(storages=tuple(storages)))
 
     def tlog_objs(self):
@@ -299,10 +313,11 @@ class ClusterController:
         info = self.dbinfo.get()
         vs = []
         for s in info.storages:
-            obj = self._storage_objs.get(s.name)
-            if obj is None or not obj.process.alive:
-                return 0
-            vs.append(obj.durable_version.get())
+            for rep in s.replicas:
+                obj = self._storage_objs.get(rep.name)
+                if obj is None or not obj.process.alive:
+                    return 0
+                vs.append(obj.durable_version.get())
         return min(vs) if vs else 0
 
     # -- management -------------------------------------------------------
@@ -404,15 +419,18 @@ class ClusterController:
             logs.append(entry)
         storages = []
         for s in info.storages:
-            entry = {"name": s.name, "tag": s.tag,
-                     "begin": s.begin.hex(),
-                     "end": s.end.hex() if s.end is not None else None}
-            obj = self._storage_objs.get(s.name)
-            if obj is not None:
-                entry.update(alive=obj.process.alive,
-                             version=obj.version.get(),
-                             durable_version=obj.durable_version.get(),
-                             counters=obj.stats.snapshot())
+            entry = {"tag": s.tag, "begin": s.begin.hex(),
+                     "end": s.end.hex() if s.end is not None else None,
+                     "replicas": []}
+            for rep in s.replicas:
+                rentry = {"name": rep.name}
+                obj = self._storage_objs.get(rep.name)
+                if obj is not None:
+                    rentry.update(alive=obj.process.alive,
+                                  version=obj.version.get(),
+                                  durable_version=obj.durable_version.get(),
+                                  counters=obj.stats.snapshot())
+                entry["replicas"].append(rentry)
             storages.append(entry)
         from .proxy import Proxy
         from .ratekeeper import Ratekeeper
@@ -462,10 +480,12 @@ class ClusterController:
             if info.recovery_state != FULLY_RECOVERED or \
                     self._move_inflight or len(info.storages) < 2:
                 continue
-            objs = [self._storage_objs.get(s.name) for s in info.storages]
+            teams = [[self._storage_objs.get(rep.name)
+                      for rep in s.replicas] for s in info.storages]
             if any(o is None or not o.process.alive or o._adding
-                   for o in objs):
+                   for team in teams for o in team):
                 continue
+            objs = [team[0] for team in teams]   # per-shard spokesman
             counts = [o.approx_rows() for o in objs]
             for i in range(len(objs) - 1):
                 big, small = counts[i], counts[i + 1]
@@ -509,8 +529,12 @@ class ClusterController:
         else:
             src_i, dst_i = left_idx + 1, left_idx
             r_begin, r_end = storages[src_i].begin, split
-        src = self._storage_objs[storages[src_i].name]
-        dst = self._storage_objs[storages[dst_i].name]
+        src_team = [self._storage_objs[rep.name]
+                    for rep in storages[src_i].replicas]
+        dst_team = [self._storage_objs[rep.name]
+                    for rep in storages[dst_i].replicas]
+        src = src_team[0]
+        dst = dst_team[0]
         dst_old_bounds = (dst.shard_begin, dst.shard_end)
         proxies = self._current_proxies()
         if not proxies:
@@ -518,11 +542,13 @@ class ClusterController:
         epoch0 = info.epoch
         self._move_inflight = True
         flow.TraceEvent("MoveKeysStart", self.process.name).detail(
-            Begin=r_begin.hex(), End=r_end.hex(), Src=storages[src_i].name,
-            Dst=storages[dst_i].name).log()
+            Begin=r_begin.hex(), End=r_end.hex(),
+            Src=storages[src_i].replicas[0].name,
+            Dst=storages[dst_i].replicas[0].name).log()
         published = False
         try:
-            dst.begin_adding(r_begin, r_end)
+            for d in dst_team:
+                d.begin_adding(r_begin, r_end)
             for p in proxies:
                 p.start_move(r_begin, r_end, dst.tag)
             # v0 must cover batches whose tags were computed BEFORE the
@@ -553,7 +579,8 @@ class ClusterController:
             rows = src.snapshot_range(r_begin, r_end, v_s)
             if self.dbinfo.get().epoch != epoch0:
                 raise error("operation_failed")   # abort pre-install
-            await dst.install_snapshot(rows, v_s)
+            for d in dst_team:
+                await d.install_snapshot(rows, v_s)
             if self.dbinfo.get().epoch != epoch0:
                 raise error("operation_failed")   # abort pre-publish
             # publish: THE commit point — from here the move only rolls
@@ -562,40 +589,45 @@ class ClusterController:
             new_storages = []
             for j, s in enumerate(storages):
                 if j == dst_i:
-                    new_storages.append(
-                        s._replace(begin=split) if direction == "right"
-                        else s._replace(end=split))
+                    ns = (s._replace(begin=split) if direction == "right"
+                          else s._replace(end=split))
                 elif j == src_i:
-                    new_storages.append(
-                        s._replace(end=split) if direction == "right"
-                        else s._replace(begin=split))
+                    ns = (s._replace(end=split) if direction == "right"
+                          else s._replace(begin=split))
                 else:
-                    new_storages.append(s)
+                    ns = s
+                ns = ns._replace(replicas=tuple(
+                    rep._replace(begin=ns.begin, end=ns.end)
+                    for rep in ns.replicas))
+                new_storages.append(ns)
             for s in new_storages:
-                self.shard_map[s.name] = (s.tag, s.begin, s.end)
+                for rep in s.replicas:
+                    self.shard_map[rep.name] = (s.tag, s.begin, s.end)
             self.publish(self.dbinfo.get()._replace(
                 storages=tuple(new_storages)))
             published = True
             for p in self._current_proxies():
                 p.finish_move(r_begin, r_end, dst.tag,
                               [s.begin for s in new_storages[1:]])
-            try:
-                if direction == "right":
-                    await src.shrink_to(src.shard_begin, split)
-                else:
-                    await src.shrink_to(split, src.shard_end)
-            except flow.FdbError:
-                pass  # a dead src is clamped to the map on re-register
+            for sobj in src_team:
+                try:
+                    if direction == "right":
+                        await sobj.shrink_to(sobj.shard_begin, split)
+                    else:
+                        await sobj.shrink_to(split, sobj.shard_end)
+                except flow.FdbError:
+                    pass  # a dead replica is clamped on re-register
             flow.TraceEvent("MoveKeysFinish", self.process.name).detail(
                 Split=split.hex()).log()
         except BaseException:
             if not published:
-                dst.abort_adding()
-                if (dst.shard_begin, dst.shard_end) != dst_old_bounds:
-                    # the durable install already extended dst's claim:
-                    # retract it (the floor + fetched rows stay, unreachable)
-                    await flow.catch_errors(flow.spawn(
-                        dst.set_bounds(*dst_old_bounds)))
+                for d in dst_team:
+                    d.abort_adding()
+                    if (d.shard_begin, d.shard_end) != dst_old_bounds:
+                        # a durable install already extended the claim:
+                        # retract it (floor + fetched rows stay, unreachable)
+                        await flow.catch_errors(flow.spawn(
+                            d.set_bounds(*dst_old_bounds)))
                 for p in self._current_proxies():
                     p.finish_move(r_begin, r_end, dst.tag,
                                   [s.begin for s in storages[1:]])

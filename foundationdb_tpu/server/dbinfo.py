@@ -54,17 +54,29 @@ class ProxyRefs(NamedTuple):
 
 
 class StorageRefs(NamedTuple):
-    """A storage shard: tag + owned range + endpoints
-    (ref: StorageServerInterface.h + the keyServers map)."""
+    """One storage REPLICA's endpoints
+    (ref: StorageServerInterface.h)."""
 
     name: str
     tag: int
     begin: bytes
-    end: bytes            # b"" sentinel in `end` is not used; None = +inf
+    end: bytes            # None = +inf
     gets: object
     ranges: object
     get_keys: object
     watches: object
+
+
+class StorageShard(NamedTuple):
+    """A key-range shard: the team of replicas serving it (ref: the
+    keyServers map entry — a range and its server team; every replica
+    pulls the SAME tag, so the replicated stream keeps them identical
+    and reads load-balance across them, fdbrpc/LoadBalance.actor.h)."""
+
+    tag: int
+    begin: bytes
+    end: bytes            # None = +inf
+    replicas: Tuple[StorageRefs, ...]
 
 
 class ServerDBInfo(NamedTuple):
@@ -74,7 +86,7 @@ class ServerDBInfo(NamedTuple):
     proxies: Tuple[ProxyRefs, ...]
     logs: LogSetInfo                      # current generation
     old_logs: Tuple[LogSetInfo, ...]      # locked gens still draining
-    storages: Tuple[StorageRefs, ...]     # shard map ordered by begin
+    storages: Tuple[StorageShard, ...]    # shard map ordered by begin
     seq: int = 0                          # broadcast sequence number
 
 

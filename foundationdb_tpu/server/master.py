@@ -160,6 +160,14 @@ class MasterRecovery:
         resolver_splits = tuple(bytes([(i * 256) // cfg.n_resolvers])
                                 for i in range(1, cfg.n_resolvers))
         self.cc.recruit_initial_storages()
+        # every tag's records are held until ALL of its replicas pop
+        expected = {}
+        for name, (tag, _b, _e) in self.cc.shard_map.items():
+            expected.setdefault(tag, []).append(name)
+        expected = {t: tuple(ns) for t, ns in expected.items()}
+        for i, w in enumerate(log_workers):
+            w.roles[f"tlog-e{self.epoch}-{i}"].set_expected_replicas(
+                expected)
         storage_splits = self.cc.storage_splits()
         rk_worker = self.cc.pick_workers(1, role="ratekeeper")[0]
         rk_ref = rk_worker.recruit_ratekeeper(

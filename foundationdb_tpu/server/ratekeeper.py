@@ -72,9 +72,10 @@ class Ratekeeper:
         # intent, throttling healthy clusters — code review r3)
         worst_excess = 0
         for s in info.storages:
-            obj = self.cc._storage_objs.get(s.name)
+          for rep in s.replicas:
+            obj = self.cc._storage_objs.get(rep.name)
             if obj is None or not obj.process.alive:
-                # a dead shard: lag is unbounded until it rejoins
+                # a dead replica: lag is unbounded until it rejoins
                 return MIN_RATE
             if obj.kv is None:
                 continue  # no engine: the durability loop is inert and

@@ -371,6 +371,11 @@ class StorageServer:
         # incoming range, whose mutations buffer until the snapshot
         # lands (ref: AddingShard, storageserver.actor.cpp:149)
         self._floors: List[Tuple[bytes, bytes, int]] = list(floors)
+        # reads below an installed snapshot's version would see future
+        # data through the unversioned base: floor them out (code
+        # review r3 — clients retry with a fresh GRV, which is always
+        # at or above any published install version)
+        self._read_floor = max((f[2] for f in self._floors), default=0)
         self._adding: Optional[Tuple[bytes, bytes]] = None
         self._adding_buf: List[Tuple[int, MutationRef]] = []
         self.known_committed = 0  # replicated log-set-wide (peek piggyback)
@@ -613,18 +618,19 @@ class StorageServer:
             await self.kv.commit()
             self.durable_version.set(made)
             self.data.forget(made)
+            me = self.process.name
             if self.tlog_pop is not None:
-                self.tlog_pop.send(TLogPopRequest(made, self.tag),
+                self.tlog_pop.send(TLogPopRequest(made, self.tag, me),
                                    self.process)
             elif self.dbinfo is not None:
                 info = self.dbinfo.get()
                 for lr in info.logs.logs:
-                    lr.pops.send(TLogPopRequest(made, self.tag),
+                    lr.pops.send(TLogPopRequest(made, self.tag, me),
                                  self.process)
                 for gen in info.old_logs:
                     for lr in gen.logs:
                         lr.pops.send(TLogPopRequest(
-                            min(made, gen.end_version), self.tag),
+                            min(made, gen.end_version), self.tag, me),
                             self.process)
 
     def _apply_to_kv(self, m: MutationRef) -> None:
@@ -670,6 +676,7 @@ class StorageServer:
             self.kv.set(k, v)
         self._floors.append((begin, end if end is not None else b"\xff",
                              at_version))
+        self._read_floor = max(self._read_floor, at_version)
         new_begin = min(self.shard_begin, begin)
         new_end = self.shard_end
         if end is None or (self.shard_end is not None
@@ -746,16 +753,14 @@ class StorageServer:
             i = bisect_right([p[0] for p in self._pending], v)
             self._pending.insert(i, (v, (m,)))
 
-    def approx_rows(self, cap: int = 50_000) -> int:
-        """Row-count estimate for data-distribution decisions. Counts
-        the versioned view (window + base — the base engine alone lags
-        behind the durability horizon and also holds system metadata
-        keys). Saturates at `cap`: beyond it the balancer compares
-        equal-looking giants, which only defers splitting (a byte
-        sample would lift this, as in the reference)."""
-        hi = self.shard_end if self.shard_end is not None else b"\xff"
-        return len(self.data.get_range(self.shard_begin, hi,
-                                       self.version.get(), cap))
+    def approx_rows(self) -> int:
+        """Row-count estimate for data-distribution decisions: the base
+        engine's O(1) count (which lags the durability horizon and
+        includes a couple of metadata keys) plus the window's key-index
+        size — cheap and monotone enough to compare adjacent shards."""
+        base = self.kv.row_count() if self.kv is not None else 0
+        win = len(self.data._keys)
+        return base + win
 
     def split_key_estimate(self) -> Optional[bytes]:
         """A key near the middle of this shard's data (ref: the
@@ -800,7 +805,7 @@ class StorageServer:
         transaction_too_old below the window floor)"""
         if version > self.version.get() + self._max_read_ahead:
             raise error("future_version")
-        if version < self.durable_version.get():
+        if version < max(self.durable_version.get(), self._read_floor):
             raise error("transaction_too_old")
         await self.version.when_at_least(version)
 

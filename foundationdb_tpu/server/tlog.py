@@ -52,7 +52,12 @@ class TLog:
         self.version = NotifiedVersion(recovery_version)  # highest durable
         self.queue_version = NotifiedVersion(recovery_version)  # accepted
         self.known_committed = recovery_version  # replicated log-set-wide
-        self.popped: Dict[int, int] = {}         # per-tag popped version
+        # per-tag, per-replica popped versions; a tag's effective pop
+        # is the min across its EXPECTED replicas — a replica that has
+        # never popped holds the tag's records (code review r3: min over
+        # seen-only would free data a clogged/rebooting replica needs)
+        self.popped: Dict[int, Dict[str, int]] = {}
+        self.expected_replicas: Dict[int, tuple] = {}
         self.stopped = False                     # locked by recovery
         self._stop_future = flow.Future()        # fires when locked
         self.commits = RequestStream(process)
@@ -239,22 +244,39 @@ class TLog:
         while True:
             req, _reply = await self.pops.pop()
             assert isinstance(req, TLogPopRequest)
-            self.pop(req.version, req.tag)
+            self.pop(req.version, req.tag, getattr(req, "replica", ""))
 
-    def pop(self, version: int, tag: int = 0) -> None:
-        """Record that `tag` no longer needs entries at or below
-        `version`; free memory and disk once *every* tag with data in a
-        record has popped past it (ref: tLogPop + popDiskQueue)."""
-        if version <= self.popped.get(tag, -1):
+    def set_expected_replicas(self, mapping: Dict[int, tuple]) -> None:
+        """Tag -> replica names that must pop before records free (ref:
+        the log system knowing each tag's team)."""
+        self.expected_replicas = dict(mapping)
+
+    def _tag_popped(self, tag: int) -> int:
+        reps = self.popped.get(tag, {})
+        expected = self.expected_replicas.get(tag)
+        if expected:
+            return min((reps.get(name, -1) for name in expected),
+                       default=-1)
+        if not reps:
+            return -1
+        return min(reps.values())
+
+    def pop(self, version: int, tag: int = 0, replica: str = "") -> None:
+        """Record that `replica` of `tag` no longer needs entries at or
+        below `version`; free memory and disk once *every* tag with
+        data in a record has popped past it on ALL its replicas
+        (ref: tLogPop + popDiskQueue)."""
+        reps = self.popped.setdefault(tag, {})
+        if version <= reps.get(replica, -1):
             return
-        self.popped[tag] = version
+        reps[replica] = version
         # free the poppable prefix: walk until the first record some tag
         # still needs (per-record tag sets are precomputed at append, so
         # the scan costs O(records freed + 1))
         hi = 0
         for i, v in enumerate(self._versions):
             tags = self._entry_tags[i]
-            if tags and any(self.popped.get(t, -1) < v for t in tags):
+            if tags and any(self._tag_popped(t) < v for t in tags):
                 break
             hi = i + 1
         if hi == 0:

@@ -147,11 +147,13 @@ class TLogPeekRequest(NamedTuple):
 
 class TLogPopRequest(NamedTuple):
     """Discard this tag's log entries at or below version (ref:
-    TLogPopRequest, fdbserver/TLogInterface.h — sent by storage once
-    durable)."""
+    TLogPopRequest, fdbserver/TLogInterface.h — sent by each replica
+    once durable; the tag's effective pop is the MIN across its
+    replicas so a lagging replica never loses unpulled data)."""
 
     version: int
     tag: int = 0
+    replica: str = ""
 
 
 class TLogPeekReply(NamedTuple):

@@ -12,7 +12,7 @@ from foundationdb_tpu.server import SimCluster
 
 def _shard_objs(c):
     info = c.cc.dbinfo.get()
-    return [c.cc._storage_objs[s.name] for s in info.storages]
+    return [c.cc._storage_objs[s.replicas[0].name] for s in info.storages]
 
 
 def test_dd_moves_boundary_to_balance_load():

@@ -28,8 +28,9 @@ def test_status_reflects_cluster_and_workload():
             px = cl["proxies"][0]["counters"]
             assert px["transactions_committed"] >= 6
             assert px["transactions_started"] >= 6
-            total_gets = sum(s["counters"].get("get_queries", 0)
-                             for s in cl["storages"] if "counters" in s)
+            total_gets = sum(r["counters"].get("get_queries", 0)
+                             for s in cl["storages"]
+                             for r in s["replicas"] if "counters" in r)
             assert total_gets >= 1
             assert cl["qos"]["transactions_per_second_limit"] is not None
             return True
