@@ -1,0 +1,48 @@
+"""MetricLogger: persist role counters into the database itself.
+
+Reference: fdbclient/MetricLogger.actor.cpp + flow/TDMetric — counter
+samples written into a system-ish keyspace so the database stores its
+own time series. Here: one snapshot per role per flush under a tuple
+subspace keyed (role, counter, sim_time)."""
+
+from __future__ import annotations
+
+from .. import flow
+from ..client import run_transaction
+from .subspace import Subspace
+
+DEFAULT_SPACE = Subspace(("\x02metrics",))
+
+
+async def log_counters(db, collections, space: Subspace = DEFAULT_SPACE,
+                       max_retries: int = 100) -> int:
+    """Write one timestamped sample per counter; returns rows written."""
+    now = flow.now()
+    rows = []
+    for col in collections:
+        for name, value in col.snapshot().items():
+            rows.append((space.pack((col.role, name, int(now * 1000))),
+                         b"%d" % value))
+
+    async def body(tr):
+        for k, v in rows:
+            tr.set(k, v)
+    await run_transaction(db, body, max_retries=max_retries)
+    return len(rows)
+
+
+async def read_series(db, role: str, counter: str,
+                      space: Subspace = DEFAULT_SPACE):
+    """All samples for one counter: [(ms_timestamp, value)]."""
+    b, e = space.range((role, counter))
+    tr = db.create_transaction()
+    rows = await tr.get_range(b, e)
+    return [(space.unpack(k)[-1], int(v)) for k, v in rows]
+
+
+async def metric_logger(db, collections, interval: float = 1.0,
+                        space: Subspace = DEFAULT_SPACE):
+    """Periodic flush actor (ref: runMetrics)."""
+    while True:
+        await flow.delay(interval)
+        await log_counters(db, collections, space)

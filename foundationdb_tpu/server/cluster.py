@@ -150,6 +150,37 @@ class SimCluster:
                         status_ref=self.cc.status_requests.ref(),
                         management_ref=self.cc.management.ref())
 
+    async def quiet_database(self, max_wait: float = 60.0) -> None:
+        """Wait until the cluster is quiescent: every storage replica
+        has pulled to the log's committed frontier and the TLog backlog
+        is fully popped (ref: fdbserver/QuietDatabase.actor.cpp — the
+        post-workload settling tests rely on)."""
+        deadline = flow.now() + max_wait
+        while flow.now() < deadline:
+            info = self.cc.dbinfo.get()
+            logs = self.cc.tlog_objs()
+            storages = [self.cc._storage_objs.get(rep.name)
+                        for s in info.storages for rep in s.replicas]
+            if (info.recovery_state == "fully_recovered" and logs
+                    and all(o is not None and o.process.alive
+                            for o in storages)):
+                frontier = max(t.version.get() for t in logs)
+                caught_up = all(o.version.get() >= frontier
+                                for o in storages)
+                drained = all(len(t.entries) == 0 for t in logs)
+                if caught_up and drained:
+                    return
+                # the durability horizon (known_committed) only advances
+                # with fresh commits: nudge one through so the tail
+                # drains on an otherwise idle cluster
+                from .types import CommitRequest
+                await flow.catch_errors(flow.timeout_error(
+                    info.proxies[0].commits.get_reply(
+                        CommitRequest(0, (), (), ()),
+                        self.cc.process), 1.0))
+            await flow.delay(0.25)
+        raise flow.error("timed_out")
+
     # -- running ---------------------------------------------------------
     def run(self, coro, timeout_time: Optional[float] = None):
         """Drive the loop until the given actor completes."""

@@ -356,7 +356,8 @@ class StorageServer:
                  durability_lag_versions: Optional[int] = None,
                  tag: int = 0, dbinfo=None,
                  shard_begin: bytes = b"",
-                 shard_end: Optional[bytes] = None, floors=()):
+                 shard_end: Optional[bytes] = None, floors=(),
+                 name: Optional[str] = None):
         self.process = process
         # direct log wiring (component tests) or dbinfo-driven discovery
         # of the current log generation (clusters with recovery)
@@ -365,6 +366,7 @@ class StorageServer:
         self.dbinfo = dbinfo            # AsyncVar[ServerDBInfo] or None
         self.kv = kv
         self.tag = tag
+        self.name = name or process.name   # store name = replica identity
         self.shard_begin = shard_begin
         self.shard_end = shard_end
         # fetched-range floors (see encode_shard_meta) + the in-flight
@@ -592,7 +594,7 @@ class StorageServer:
             target = min(self.version.get() - self._lag,
                          max(self.known_committed,
                              self.durable_version.get()))
-            if target <= self.durable_version.get() or not self._pending:
+            if target <= self.durable_version.get():
                 continue
             made = self.durable_version.get()
             i = 0
@@ -604,9 +606,12 @@ class StorageServer:
                 # never let it regress
                 made = max(made, version)
                 i += 1
-            if i == 0:
-                continue
             del self._pending[:i]
+            # nothing may exist below `target` that we haven't applied:
+            # advance the marker even with an empty queue so pops keep
+            # flowing from idle shards (a stalled marker starved the
+            # tag's log records once pops became per-replica)
+            made = max(made, target)
             live_floors = [f for f in self._floors if f[2] > made]
             if len(live_floors) != len(self._floors):
                 # a floor only filters crash-replay of versions at or
@@ -618,7 +623,7 @@ class StorageServer:
             await self.kv.commit()
             self.durable_version.set(made)
             self.data.forget(made)
-            me = self.process.name
+            me = self.name
             if self.tlog_pop is not None:
                 self.tlog_pop.send(TLogPopRequest(made, self.tag, me),
                                    self.process)

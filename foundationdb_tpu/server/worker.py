@@ -186,7 +186,7 @@ class Worker:
         s = StorageServer(self.process, None, kv=kv, tag=tag,
                           durability_lag_versions=self.storage_lag_versions,
                           dbinfo=self.dbinfo, shard_begin=begin,
-                          shard_end=end, floors=floors)
+                          shard_end=end, floors=floors, name=name)
         s.start()
         self.roles[name] = s
         refs = StorageRefs(name, tag, begin, end, s.gets.ref(),
