@@ -186,3 +186,32 @@ def test_acked_commits_survive_power_loss_of_tlog():
         assert c.run(main(), timeout_time=600)
     finally:
         c.shutdown()
+
+
+def test_coordination_quorum_survives_minority_loss():
+    """With 3 coordinators, killing one leaves the quorum working:
+    recovery (coordinated-state read + exclusive write) still succeeds
+    (ref: CoordinatedState majority quorums,
+    CoordinatedState.actor.cpp:60-197)."""
+    c = _durable_cluster(seed=211, n_coordinators=3)
+    try:
+        db = c.client()
+
+        async def main():
+            async def body(tr):
+                tr.set(b"k", b"1")
+            await run_transaction(db, body)
+            # kill one coordinator (minority), then force a recovery
+            c.net.kill(c.coordinators[0].process)
+            c.kill_role("tlog")
+
+            async def body2(tr):
+                assert await tr.get(b"k") == b"1"
+                tr.set(b"k2", b"2")
+            await run_transaction(db, body2, max_retries=300)
+            assert c.cc.dbinfo.get().epoch >= 2
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
