@@ -16,7 +16,7 @@ from bisect import bisect_left, bisect_right, insort
 from typing import Dict, List, Optional, Tuple
 
 from .. import flow
-from ..flow import Future, TaskPriority, error
+from ..flow import SERVER_KNOBS, Future, TaskPriority, error
 from ..rpc import NetworkRef, SimProcess
 from ..server import atomic as _atomic
 from ..server.cluster_controller import \
@@ -73,6 +73,8 @@ class Database:
         self.status_ref = status_ref
         self.management_ref = management_ref
         self._info = None
+        self._grv_waiters: List[Future] = []
+        self._grv_timer_armed = False
 
     async def get_status(self) -> dict:
         """The cluster status document (ref: StatusClient fetching the
@@ -128,6 +130,50 @@ class Database:
         info = await self.info()
         return info.storages[_shard_index(info.storages, key)]
 
+    def batched_grv(self) -> Future:
+        """Batch concurrent GRV REQUESTS into one proxy round trip (ref:
+        readVersionBatcher, NativeAPI.actor.cpp:2854). Requests are
+        collected for one batch interval and THEN fetched — a request
+        must never join a fetch already in flight, or a client could
+        receive a version predating its own acknowledged commit."""
+        f = Future()
+        self._grv_waiters.append(f)
+        if not self._grv_timer_armed:
+            self._grv_timer_armed = True
+            flow.spawn(self._grv_batch_fire(),
+                       TaskPriority.DEFAULT_ENDPOINT,
+                       name="client.grvBatch")
+        return f
+
+    async def _grv_batch_fire(self) -> None:
+        from ..flow import SERVER_KNOBS
+        await flow.delay(SERVER_KNOBS.grv_batch_interval,
+                         TaskPriority.DEFAULT_ENDPOINT)
+        waiters, self._grv_waiters = self._grv_waiters, []
+        self._grv_timer_armed = False
+        info = None
+        try:
+            info = await self.info()
+            proxy = info.proxies[flow.g_random.random_int(
+                0, len(info.proxies))]
+            reply = await _rpc(proxy.grvs.get_reply(None, self.process))
+            for f in waiters:
+                if not f.is_ready:
+                    f.send((reply.version, info.seq))
+        except flow.FdbError as e:
+            # the batcher owns the seq its fetch used, so IT refreshes
+            # the shared picture before failing the waiters — their
+            # retries then run against the healed cluster (individual
+            # transactions no longer see the seq on this path)
+            if info is not None and e.name in REFRESH_ERRORS:
+                try:
+                    await self.refresh_past(info.seq)
+                except flow.FdbError:
+                    pass
+            for f in waiters:
+                if not f.is_ready:
+                    f.send_error(e)
+
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
 
@@ -165,6 +211,7 @@ class Transaction:
         self._read_conflicts: List[Tuple[bytes, bytes]] = []
         self._write_conflicts: List[Tuple[bytes, bytes]] = []
         self._watches: List[Tuple[bytes, Future]] = []
+        self._txn_bytes = 0
         self.committed_version: Optional[int] = None
         self.committed_batch_index: Optional[int] = None
 
@@ -206,9 +253,10 @@ class Transaction:
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            proxy = await self._proxy()
-            reply = await _rpc(proxy.grvs.get_reply(None, self.db.process))
-            self._read_version = reply.version
+            version, seq = await self.db.batched_grv()
+            if seq > self._used_seq:
+                self._used_seq = seq
+            self._read_version = version
         return self._read_version
 
     # -- RYW overlay ----------------------------------------------------
@@ -365,12 +413,24 @@ class Transaction:
         return out
 
     # -- writes ---------------------------------------------------------
+    def _check_sizes(self, key: bytes, value: bytes = b"") -> None:
+        """(ref: NativeAPI size checks — key_too_large /
+        value_too_large raised client-side before anything ships)"""
+        if len(key) > SERVER_KNOBS.key_size_limit:
+            raise error("key_too_large")
+        if len(value) > SERVER_KNOBS.value_size_limit:
+            raise error("value_too_large")
+        self._txn_bytes += len(key) + len(value)
+        if self._txn_bytes > SERVER_KNOBS.transaction_size_limit:
+            raise error("transaction_too_large")
+
     def _record_write(self, key: bytes, value: Optional[bytes]) -> None:
         if key not in self._writes:
             insort(self._write_order, key)
         self._writes[key] = value
 
     def set(self, key: bytes, value: bytes) -> None:
+        self._check_sizes(key, value)
         self._record_write(key, value)
         self._ops.pop(key, None)  # a set supersedes pending atomics
         self._mutations.append(MutationRef(SET_VALUE, key, value))
@@ -380,6 +440,8 @@ class Transaction:
         self.clear_range(key, _next_key(key))
 
     def clear_range(self, begin: bytes, end: bytes) -> None:
+        self._check_sizes(begin)
+        self._check_sizes(end)
         if begin >= end:
             return
         self._cleared.append((begin, end))

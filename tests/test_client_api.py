@@ -259,3 +259,34 @@ def test_atomic_in_range_read(cluster):
         return True
 
     assert cluster.run(main(), timeout_time=30)
+
+
+def test_size_limits_enforced_client_side():
+    """(ref: NativeAPI key/value/transaction size checks)"""
+    import pytest
+
+    from foundationdb_tpu import flow
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=95)
+    try:
+        db = c.client()
+
+        async def main():
+            tr = db.create_transaction()
+            with pytest.raises(flow.FdbError) as ei:
+                tr.set(b"k" * 10_001, b"v")
+            assert ei.value.name == "key_too_large"
+            with pytest.raises(flow.FdbError) as ei:
+                tr.set(b"k", b"v" * 100_001)
+            assert ei.value.name == "value_too_large"
+            tr2 = db.create_transaction()
+            with pytest.raises(flow.FdbError) as ei:
+                for i in range(200):
+                    tr2.set(b"big%03d" % i, b"x" * 99_000)
+            assert ei.value.name == "transaction_too_large"
+            return True
+
+        assert c.run(main(), timeout_time=60)
+    finally:
+        c.shutdown()
