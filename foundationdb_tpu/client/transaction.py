@@ -146,7 +146,7 @@ class Database:
         return f
 
     async def _grv_batch_fire(self) -> None:
-        from ..flow import SERVER_KNOBS
+        from ..server.types import GetReadVersionRequest
         await flow.delay(SERVER_KNOBS.grv_batch_interval,
                          TaskPriority.DEFAULT_ENDPOINT)
         waiters, self._grv_waiters = self._grv_waiters, []
@@ -154,9 +154,9 @@ class Database:
         info = None
         try:
             info = await self.info()
-            proxy = info.proxies[flow.g_random.random_int(
-                0, len(info.proxies))]
-            reply = await _rpc(proxy.grvs.get_reply(None, self.process))
+            proxy = await self.proxy()
+            reply = await _rpc(proxy.grvs.get_reply(
+                GetReadVersionRequest(len(waiters)), self.process))
             for f in waiters:
                 if not f.is_ready:
                     f.send((reply.version, info.seq))
@@ -173,6 +173,13 @@ class Database:
             for f in waiters:
                 if not f.is_ready:
                     f.send_error(e)
+        except BaseException:
+            # anything else (cancellation, internal error) must not
+            # strand the swapped-out waiters in a silent deadlock
+            for f in waiters:
+                if not f.is_ready:
+                    f.send_error(error("operation_failed"))
+            raise
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
@@ -413,10 +420,13 @@ class Transaction:
         return out
 
     # -- writes ---------------------------------------------------------
-    def _check_sizes(self, key: bytes, value: bytes = b"") -> None:
+    def _check_sizes(self, key: bytes, value: bytes = b"",
+                     slack: int = 0) -> None:
         """(ref: NativeAPI size checks — key_too_large /
-        value_too_large raised client-side before anything ships)"""
-        if len(key) > SERVER_KNOBS.key_size_limit:
+        value_too_large raised client-side before anything ships).
+        `slack` admits synthesized range-end bounds like keyAfter(k),
+        which may run one byte past the user key limit."""
+        if len(key) > SERVER_KNOBS.key_size_limit + slack:
             raise error("key_too_large")
         if len(value) > SERVER_KNOBS.value_size_limit:
             raise error("value_too_large")
@@ -440,10 +450,10 @@ class Transaction:
         self.clear_range(key, _next_key(key))
 
     def clear_range(self, begin: bytes, end: bytes) -> None:
-        self._check_sizes(begin)
-        self._check_sizes(end)
         if begin >= end:
             return
+        self._check_sizes(begin)
+        self._check_sizes(end, slack=1)  # keyAfter(max-size key) is legal
         self._cleared.append((begin, end))
         lo = bisect_left(self._write_order, begin)
         hi = bisect_left(self._write_order, end)
@@ -456,6 +466,7 @@ class Transaction:
 
     def atomic_op(self, key: bytes, param: bytes, op_type: int) -> None:
         """(ref: Transaction::atomicOp / fdbclient/Atomic.h op table)"""
+        self._check_sizes(key, param)
         if op_type in (SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE):
             # transformed at the proxy with the commit version; the
             # operand's trailing 4 bytes are the placeholder offset

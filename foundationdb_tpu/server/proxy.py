@@ -239,7 +239,7 @@ class Proxy:
         self.resolver_map_updates.close()
         # a stop mid-confirmation must fail the popped batch too, or
         # those clients wait out the full request timeout (code review)
-        for reply in self._grv_queue + self._grv_inflight:
+        for reply, _cnt in self._grv_queue + self._grv_inflight:
             reply.send_error(error("broken_promise"))
         self._grv_queue = []
         self._grv_inflight = []
@@ -248,10 +248,12 @@ class Proxy:
     async def _grv_loop(self):
         """Queue GRV requests for the batcher (ref: transactionStarter
         :1102 — requests are batched on a timer and released at the
-        ratekeeper's rate)."""
+        ratekeeper's rate). Client-batched requests carry how many
+        transactions they admit."""
         while True:
-            _req, reply = await self.grvs.pop()
-            self._grv_queue.append(reply)
+            req, reply = await self.grvs.pop()
+            count = getattr(req, "transaction_count", None) or 1
+            self._grv_queue.append((reply, count))
 
     async def _grv_batcher(self):
         """Release queued GRVs in rate-gated batches; one causal
@@ -269,12 +271,24 @@ class Proxy:
             last = now
             if not self._grv_queue:
                 continue
-            n = min(len(self._grv_queue), int(tokens))
-            if n <= 0:
-                continue
-            tokens -= n
-            self._grv_inflight, self._grv_queue = (self._grv_queue[:n],
-                                                   self._grv_queue[n:])
+            take = 0
+            admitted = 0
+            while take < len(self._grv_queue):
+                cnt = self._grv_queue[take][1]
+                if admitted + cnt > tokens:
+                    break
+                admitted += cnt
+                take += 1
+            if take == 0:
+                if tokens < 1:
+                    continue
+                # a batch bigger than the burst cap still admits by
+                # running the bucket into debt, or it would starve
+                admitted = self._grv_queue[0][1]
+                take = 1
+            tokens -= admitted
+            self._grv_inflight, self._grv_queue = (self._grv_queue[:take],
+                                                   self._grv_queue[take:])
             try:
                 await self._serve_grv_batch(self._grv_inflight)
             finally:
@@ -296,11 +310,12 @@ class Proxy:
                         for p in self._peers]
                 others = await flow.all_of(futs)
                 version = max([version] + list(others))
-            self.stats.counter("transactions_started").add(len(batch))
-            for reply in batch:
+            self.stats.counter("transactions_started").add(
+                sum(cnt for _r, cnt in batch))
+            for reply, _cnt in batch:
                 reply.send(GetReadVersionReply(version))
         except flow.FdbError as e:
-            for reply in batch:
+            for reply, _cnt in batch:
                 reply.send_error(e)
 
     async def _rate_loop(self):

@@ -77,6 +77,14 @@ class CommitReply(NamedTuple):
                        # (second half of the versionstamp)
 
 
+class GetReadVersionRequest(NamedTuple):
+    """(ref: GetReadVersionRequest — carries the number of transactions
+    the (client-batched) request admits, so the ratekeeper debit is
+    per-transaction, not per-RPC)"""
+
+    transaction_count: int = 1
+
+
 class GetReadVersionReply(NamedTuple):
     version: int
 

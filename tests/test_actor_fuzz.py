@@ -10,9 +10,8 @@ import foundationdb_tpu.flow as fl
 
 
 def _build_random_actor(rng, depth=0):
-    """Compose a random actor tree out of delay/all_of/first_of/
-    timeout/streams/cancellation; returns (coro_factory, expected_kind)
-    where kind is 'value' or 'error'."""
+    """Compose a random actor-coroutine factory out of delay/all_of/
+    first_of/timeout/streams/locks/cancellation."""
 
     choice = rng.random_int(0, 7 if depth < 3 else 3)
 
@@ -76,8 +75,8 @@ def _build_random_actor(rng, depth=0):
     if choice == 6:
         async def timed():
             got = await fl.timeout(fl.spawn(subs[0]()),
-                                   rng.random01() * 0.02, default=-1)
-            return 1 if got is not None else 0
+                                   rng.random01() * 0.02, default=None)
+            return 1 if got is not None else 0   # 0 = the timeout fired
         return timed
 
     async def cancelled():
