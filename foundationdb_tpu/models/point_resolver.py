@@ -140,5 +140,5 @@ class PointConflictSet(TpuConflictSet):
             jnp.int32(commit_off), jnp.int32(oldest_off),
             jnp.int32(init_off))
         self._apply_fixup(fixup)
-        self._count_dev = count
+        self._note_count(count, nw)
         return conflict
