@@ -1,0 +1,292 @@
+"""Continuous backup: a mutation-log tail + snapshot = point-in-time
+restore.
+
+Reference: fdbclient/FileBackupAgent.actor.cpp + design/backup.md — a
+backup is a range snapshot PLUS a continuous mutation log; restore
+applies the snapshot then replays the log to the target version. The
+log here comes from a dedicated backup tag the proxies add to every
+mutation while a backup is active (ref: the backup mutation-log tags):
+one stream preserves exact intra-version mutation order, and the agent
+is registered in the TLogs' expected-replica sets so records it has
+not yet persisted are never popped away beneath it.
+
+Protocol: enable the tag FIRST, then take the snapshot — every
+mutation after the snapshot version is guaranteed present in the tail,
+and restore discards log records at or below it.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+from .. import flow
+from ..flow import TaskPriority
+from ..server.types import MutationRef, TLogPeekRequest, TLogPopRequest
+from . import backup as snapshot_backup
+
+LOG_MAGIC = b"FDBTPUML"
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+AGENT_NAME = "backup-agent"
+
+
+class BackupAgent:
+    """Drives one continuous backup of a SimCluster (operator-side
+    tool, like the CLI: it holds the cluster handle the way fdbbackup
+    holds a cluster file)."""
+
+    def __init__(self, cluster, db):
+        self.cluster = cluster
+        self.db = db
+        self.base_blob: Optional[bytes] = None
+        self.base_version = 0
+        self.log_records: List[Tuple[int, Tuple[MutationRef, ...]]] = []
+        self._tail_task = None
+        self._tailed_to = 0
+        self._stop = False
+        self._replica_rr = 0
+
+    # -- lifecycle -------------------------------------------------------
+    async def start(self) -> int:
+        """Enable the tag, wait out the tagging horizon, start tailing,
+        then snapshot; returns the snapshot (base) version."""
+        cc = self.cluster.cc
+        cc.backup_active = True
+        cc.backup_agent = self
+        await self._apply_tagging_settled(True)
+        # batches whose tags were computed BEFORE the flag landed carry
+        # versions at or below the master's issued max: wait for commits
+        # to pass that horizon so the snapshot (GRV above it) includes
+        # every untagged transaction (same horizon rule as shard moves)
+        v_enable = 0
+        if cc._recovery is not None and cc._recovery.master is not None:
+            v_enable = cc._recovery.master.version
+        start_v = min((p.committed_version.get()
+                       for p in cc._current_proxies()), default=0)
+        while min((p.committed_version.get()
+                   for p in cc._current_proxies()), default=0) < v_enable:
+            await self._nudge_commit()
+            await flow.delay(0.05, TaskPriority.DEFAULT_ENDPOINT)
+        self._tail_task = flow.spawn(self._tail(start_v),
+                                     TaskPriority.DEFAULT_ENDPOINT,
+                                     name="backupAgent.tail")
+        blob, version, _n = await snapshot_backup.backup(self.db)
+        self.base_blob = blob
+        self.base_version = version
+        return version
+
+    async def stop(self) -> None:
+        self._stop = True
+        cc = self.cluster.cc
+        cc.backup_active = False
+        cc.backup_agent = None
+        await self._apply_tagging_settled(False)
+        if self._tail_task is not None:
+            await flow.catch_errors(self._tail_task)
+
+    async def _apply_tagging_settled(self, active: bool) -> None:
+        """Apply the tag flag and re-apply until a stable epoch carries
+        it — a recovery in flight past its read of cc.backup_active
+        would otherwise publish proxies/tlogs with the stale setting
+        (start: silent log hole; stop: the tag pins log records
+        forever)."""
+        cc = self.cluster.cc
+        while True:
+            ep = cc.dbinfo.get().epoch
+            self._apply_tagging(active)
+            await flow.delay(0.05, TaskPriority.DEFAULT_ENDPOINT)
+            info = cc.dbinfo.get()
+            if info.epoch != ep or \
+                    info.recovery_state != "fully_recovered":
+                continue
+            if all(p.backup_active == active
+                   for p in cc._current_proxies()):
+                return
+
+    def _apply_tagging(self, active: bool) -> None:
+        from ..server.proxy import BACKUP_TAG
+        cc = self.cluster.cc
+        for p in cc._current_proxies():
+            p.backup_active = active
+        for t in cc.tlog_objs():
+            exp = dict(t.expected_replicas)
+            if active:
+                exp[BACKUP_TAG] = (AGENT_NAME,)
+            else:
+                exp.pop(BACKUP_TAG, None)
+            t.set_expected_replicas(exp)
+
+    # -- the tail (modeled on the storage pull loop) ---------------------
+    async def _tail(self, start_version: int) -> None:
+        from ..server.proxy import BACKUP_TAG
+        version = start_version
+        while not self._stop:
+            info = self.cluster.cc.dbinfo.get()
+            src = self._pick_source(info, version + 1)
+            if src is None:
+                await flow.delay(0.2, TaskPriority.DEFAULT_ENDPOINT)
+                continue
+            gen, refs = src
+            try:
+                reply = await flow.timeout_error(refs.peeks.get_reply(
+                    TLogPeekRequest(version + 1, BACKUP_TAG),
+                    self.db.process), 2.0)
+            except flow.FdbError:
+                self._replica_rr += 1   # rotate off a dead replica
+                await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+                continue
+            cap = gen.end_version if gen.end_version >= 0 else None
+            # never record beyond what is known replicated cluster-wide:
+            # a single tlog's durable tail can roll back in a recovery,
+            # and the log must only ever contain versions a consistent
+            # database state actually had (the storage pull applies the
+            # same cap to durability)
+            safe = reply.known_committed
+            if cap is not None:
+                safe = max(safe, cap)   # a locked gen's end IS final
+            before = version
+            for v, mutations in reply.entries:
+                if v <= version:
+                    continue
+                if cap is not None and v > cap:
+                    break
+                if v > safe:
+                    break
+                self.log_records.append((v, mutations))
+                version = v
+            adv = min(reply.committed_version, safe)
+            if cap is not None:
+                adv = min(adv, cap)
+            version = max(version, adv)
+            self._tailed_to = version
+            if version > before:
+                refs.pops.send(TLogPopRequest(version, BACKUP_TAG,
+                                              AGENT_NAME), self.db.process)
+            elif cap is None:
+                # no progress on the open generation: known_committed
+                # only advances with fresh commits — nudge one through
+                await self._nudge_commit()
+                await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+
+    def _pick_source(self, info, needed: int):
+        gens = sorted(info.old_logs, key=lambda g: g.end_version)
+        for gen in gens:
+            if gen.end_version >= needed and gen.logs:
+                return gen, gen.logs[self._replica_rr % len(gen.logs)]
+        if info.logs.logs:
+            return (info.logs,
+                    info.logs.logs[self._replica_rr % len(info.logs.logs)])
+        return None
+
+    async def _nudge_commit(self) -> None:
+        from ..server.types import CommitRequest
+        info = self.cluster.cc.dbinfo.get()
+        if info.proxies:
+            await flow.catch_errors(flow.timeout_error(
+                info.proxies[0].commits.get_reply(
+                    CommitRequest(0, (), (), ()), self.db.process), 1.0))
+
+    async def wait_tailed_to(self, version: int, max_wait: float = 30.0):
+        deadline = flow.now() + max_wait
+        while self._tailed_to < version:
+            if flow.now() > deadline:
+                raise flow.error("timed_out")
+            # the tail only advances through known_committed, which
+            # needs fresh commits on an idle cluster
+            await self._nudge_commit()
+            await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+
+    # -- container -------------------------------------------------------
+    def write_log(self) -> bytes:
+        out = [LOG_MAGIC, _U64.pack(self.base_version),
+               _U64.pack(len(self.log_records))]
+        for v, mutations in self.log_records:
+            out.append(_U64.pack(v))
+            out.append(_U32.pack(len(mutations)))
+            for m in mutations:
+                out.append(bytes([m.type]))
+                out.append(_U32.pack(len(m.param1)))
+                out.append(m.param1)
+                out.append(_U32.pack(len(m.param2)))
+                out.append(m.param2)
+        return b"".join(out)
+
+
+def read_log(blob: bytes):
+    if blob[:8] != LOG_MAGIC:
+        raise ValueError("not a mutation log")
+    (base_version,) = _U64.unpack_from(blob, 8)
+    (n,) = _U64.unpack_from(blob, 16)
+    off = 24
+    records = []
+    for _ in range(n):
+        (v,) = _U64.unpack_from(blob, off)
+        off += 8
+        (nm,) = _U32.unpack_from(blob, off)
+        off += 4
+        ms = []
+        for _ in range(nm):
+            t = blob[off]
+            off += 1
+            (l1,) = _U32.unpack_from(blob, off)
+            p1 = bytes(blob[off + 4:off + 4 + l1])
+            off += 4 + l1
+            (l2,) = _U32.unpack_from(blob, off)
+            p2 = bytes(blob[off + 4:off + 4 + l2])
+            off += 4 + l2
+            ms.append(MutationRef(t, p1, p2))
+        records.append((v, tuple(ms)))
+    return base_version, records
+
+
+async def restore_to_version(db, snapshot_blob: bytes, log_blob: bytes,
+                             target_version: int,
+                             max_retries: int = 300) -> int:
+    """Point-in-time restore: the snapshot state plus every logged
+    mutation in (base_version, target_version], applied in exact
+    commit order (ref: the restore apply loop replaying log files)."""
+    from ..client import run_transaction
+    from ..server.types import (ATOMIC_OPS, CLEAR_RANGE, SET_VALUE)
+
+    base_version, records = read_log(log_blob)
+    if target_version < base_version:
+        raise ValueError("target predates the snapshot")
+    await snapshot_backup.restore(db, snapshot_blob,
+                                  max_retries=max_retries)
+    applied = 0
+    batch: List[MutationRef] = []
+    for v, mutations in records:
+        if v <= base_version or v > target_version:
+            continue
+        batch.extend(mutations)
+    marker_space = b"\x02restore-mark/"
+    for i in range(0, len(batch), 200):
+        chunk = batch[i:i + 200]
+        marker = marker_space + b"%012d" % i
+
+        async def body(tr, chunk=chunk, marker=marker):
+            # chunk marker: atomic ops are NOT idempotent, so a retry
+            # after commit_unknown_result must detect an applied chunk
+            # instead of re-running it (the reference's idempotency
+            # pattern for restore apply)
+            if await tr.get(marker) is not None:
+                return
+            for m in chunk:
+                if m.type == SET_VALUE:
+                    tr.set(m.param1, m.param2)
+                elif m.type == CLEAR_RANGE:
+                    tr.clear_range(m.param1, m.param2)
+                elif m.type in ATOMIC_OPS:
+                    tr.atomic_op(m.param1, m.param2, m.type)
+                else:
+                    raise ValueError(f"unreplayable mutation {m.type}")
+            tr.set(marker, b"1")
+        await run_transaction(db, body, max_retries=max_retries)
+        applied += len(chunk)
+
+    async def clear_markers(tr):
+        tr.clear_range(marker_space, marker_space + b"\xff")
+    await run_transaction(db, clear_markers, max_retries=max_retries)
+    return applied

@@ -94,6 +94,8 @@ class ClusterController:
         # when the monitor next looks, never lost (code review r3)
         self._config_dirty = False
         self._move_inflight = False        # one shard move at a time
+        self.backup_active = False         # continuous-backup tagging
+        self.backup_agent = None           # the live agent, when any
         # authoritative shard boundaries (ref: the keyServers system
         # keyspace as ground truth); rebooted servers whose persisted
         # meta disagrees — e.g. crashed mid-move — are clamped to this

@@ -165,6 +165,10 @@ class MasterRecovery:
         for name, (tag, _b, _e) in self.cc.shard_map.items():
             expected.setdefault(tag, []).append(name)
         expected = {t: tuple(ns) for t, ns in expected.items()}
+        if self.cc.backup_active:
+            from .proxy import BACKUP_TAG
+            from ..layers.backup_agent import AGENT_NAME
+            expected[BACKUP_TAG] = (AGENT_NAME,)
         for i, w in enumerate(log_workers):
             w.roles[f"tlog-e{self.epoch}-{i}"].set_expected_replicas(
                 expected)
@@ -181,6 +185,8 @@ class MasterRecovery:
                 resolver_refs, [r.commits for r in new_logs],
                 resolver_splits, storage_splits,
                 recovery_version, ratekeeper_ref=rk_ref))
+            if self.cc.backup_active:
+                w.roles[f"proxy-e{self.epoch}-{i}"].backup_active = True
             self.critical_procs.add(w.process)
         proxies = tuple(proxies)
         # each proxy confirms GRVs with every other proxy (ref:
@@ -330,6 +336,11 @@ class MasterRecovery:
             if not info.old_logs:
                 continue
             floor = self.cc.min_storage_version()
+            agent = getattr(self.cc, "backup_agent", None)
+            if agent is not None:
+                # an active backup tail must drain a generation before
+                # it retires, or the mutation log gets a silent hole
+                floor = min(floor, agent._tailed_to)
             keep = tuple(ls for ls in info.old_logs
                          if ls.end_version > floor)
             if len(keep) != len(info.old_logs):

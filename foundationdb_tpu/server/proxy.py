@@ -66,6 +66,11 @@ MWTLV = 5_000_000  # fallback window (ref: MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
 # structurally — future work.)
 MOVE_SKEW_SLACK = 1_000_000
 
+# every mutation is ALSO routed here while a continuous backup is
+# active (ref: the backup mutation-log tags — a single stream preserves
+# exact intra-version mutation order for point-in-time restore)
+BACKUP_TAG = 0xFFFF
+
 
 class KeyResolverMap:
     """keyResolvers: key ranges -> resolver owner HISTORY (newest
@@ -156,6 +161,7 @@ class Proxy:
         # keyServers boundaries: storage tag i owns [sbounds[i], sbounds[i+1])
         self._sbounds = [b""] + list(storage_splits) + [None]
         self._moving: list = []   # (begin, end, extra_tag) dual-tag ranges
+        self.backup_active = False
         self.tlog_refs = list(tlog_refs)
         batch_window = max(batch_window,
                            SERVER_KNOBS.commit_transaction_batch_interval_min)
@@ -340,10 +346,11 @@ class Proxy:
         shard's tag(s); a clear goes to every shard it overlaps. A range
         being moved is DUAL-TAGGED so both source and destination logs
         see its mutations throughout the transition (ref: keyServers
-        holding both teams during moveKeys)."""
+        holding both teams during moveKeys); an active backup adds the
+        backup tag to everything."""
         n = len(self._sbounds) - 1
         if n == 1 and not self._moving:
-            return (0,)
+            return (0, BACKUP_TAG) if self.backup_active else (0,)
         if m.type == CLEAR_RANGE:
             tags = set()
             for i in range(n):
@@ -353,11 +360,15 @@ class Proxy:
             for mb, me, extra in self._moving:
                 if (me is None or m.param1 < me) and mb < m.param2:
                     tags.add(extra)
+            if self.backup_active:
+                tags.add(BACKUP_TAG)
             return tuple(sorted(tags))
         tags = {self._shard_of(m.param1)}
         for mb, me, extra in self._moving:
             if mb <= m.param1 and (me is None or m.param1 < me):
                 tags.add(extra)
+        if self.backup_active:
+            tags.add(BACKUP_TAG)
         return tuple(sorted(tags))
 
     def _shard_of(self, key: bytes) -> int:
