@@ -290,3 +290,76 @@ async def restore_to_version(db, snapshot_blob: bytes, log_blob: bytes,
         tr.clear_range(marker_space, marker_space + b"\xff")
     await run_transaction(db, clear_markers, max_retries=max_retries)
     return applied
+
+
+class DrAgent(BackupAgent):
+    """Continuous replication to a DESTINATION database (ref:
+    fdbclient/DatabaseBackupAgent.actor.cpp — DR is the same mutation
+    stream applied to another cluster instead of files). The
+    destination converges to each source version in commit order;
+    chunk markers make the apply exactly-once across retries."""
+
+    def __init__(self, cluster, db, dest_db):
+        super().__init__(cluster, db)
+        self.dest_db = dest_db
+        self.applied_version = 0
+        self._apply_task = None
+        self._applied_idx = 0
+
+    async def start(self) -> int:
+        """Snapshot into the destination, then stream the tail."""
+        base = await super().start()
+        await snapshot_backup.restore(self.dest_db, self.base_blob)
+        self.applied_version = base
+        self._apply_task = flow.spawn(self._apply_loop(),
+                                      TaskPriority.DEFAULT_ENDPOINT,
+                                      name="drAgent.apply")
+        return base
+
+    async def stop(self) -> None:
+        await super().stop()
+        if self._apply_task is not None:
+            await flow.catch_errors(self._apply_task)
+
+    async def wait_applied_to(self, version: int,
+                              max_wait: float = 60.0) -> None:
+        deadline = flow.now() + max_wait
+        while self.applied_version < version:
+            if flow.now() > deadline:
+                raise flow.error("timed_out")
+            await self._nudge_commit()
+            await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+
+    async def _apply_loop(self) -> None:
+        from ..client import run_transaction
+        from ..server.types import ATOMIC_OPS, CLEAR_RANGE, SET_VALUE
+        marker_space = b"\x02dr-mark/"
+        while not (self._stop and
+                   self._applied_idx >= len(self.log_records)):
+            if self._applied_idx >= len(self.log_records):
+                await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+                continue
+            i = self._applied_idx
+            v, mutations = self.log_records[i]
+            self._applied_idx += 1
+            if v <= self.base_version:
+                self.applied_version = max(self.applied_version, v)
+                continue
+            marker = marker_space + b"%012d" % i
+
+            async def body(tr, mutations=mutations, marker=marker):
+                if await tr.get(marker) is not None:
+                    return
+                for m in mutations:
+                    if m.type == SET_VALUE:
+                        tr.set(m.param1, m.param2)
+                    elif m.type == CLEAR_RANGE:
+                        tr.clear_range(m.param1, m.param2)
+                    elif m.type in ATOMIC_OPS:
+                        tr.atomic_op(m.param1, m.param2, m.type)
+                    else:
+                        raise ValueError(
+                            f"unreplayable mutation {m.type}")
+                tr.set(marker, b"1")
+            await run_transaction(self.dest_db, body, max_retries=300)
+            self.applied_version = max(self.applied_version, v)

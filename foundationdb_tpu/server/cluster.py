@@ -34,15 +34,25 @@ class SimCluster:
                  n_workers: Optional[int] = None, n_coordinators: int = 1,
                  auto_reboot: bool = True, buggify: bool = False,
                  storage_engine: str = "memory",
-                 storage_replicas: int = 1):
-        flow.set_seed(seed, buggify_enabled=buggify)
-        # knob distortion rides the same switch as BUGGIFY (ref:
-        # `if (randomize && BUGGIFY)` in Knobs.cpp); always re-init so a
-        # prior run's distorted knobs never leak into this one
-        flow.reset_server_knobs(randomize=buggify)
-        self.sched = flow.Scheduler(start_time=start_time, virtual=True)
-        flow.set_scheduler(self.sched)
-        self.net = SimNetwork(self.sched, flow.g_random)
+                 storage_replicas: int = 1,
+                 share_with: "SimCluster" = None, name_prefix: str = ""):
+        self.prefix = name_prefix
+        if share_with is not None:
+            # a second cluster INSIDE the same deterministic simulation
+            # (multi-cluster tests: DR, cross-cluster tooling) — shares
+            # the scheduler/network/RNG, distinct process namespace
+            self.sched = share_with.sched
+            self.net = share_with.net
+        else:
+            flow.set_seed(seed, buggify_enabled=buggify)
+            # knob distortion rides the same switch as BUGGIFY (ref:
+            # `if (randomize && BUGGIFY)` in Knobs.cpp); always re-init
+            # so a prior run's distorted knobs never leak into this one
+            flow.reset_server_knobs(randomize=buggify)
+            self.sched = flow.Scheduler(start_time=start_time,
+                                        virtual=True)
+            flow.set_scheduler(self.sched)
+            self.net = SimNetwork(self.sched, flow.g_random)
         self.durable = durable
         self.auto_reboot = auto_reboot
         self.conflict_backend = conflict_backend
@@ -56,17 +66,18 @@ class SimCluster:
                                     storage_replicas=storage_replicas)
 
         # coordinators (ref: coordinationServer)
+        px = self.prefix
         self.coordinators = []
         for i in range(n_coordinators):
-            c = Coordinator(self.net.new_process(f"coord{i}",
-                                                 machine=f"coord{i}"))
+            c = Coordinator(self.net.new_process(f"{px}coord{i}",
+                                                 machine=f"{px}coord{i}"))
             c.start()
             self.coordinators.append(c)
 
         # the cluster controller (single candidate; contested elections
         # are exercised in the coordination unit tests)
         self.cc = ClusterController(
-            self.net.new_process("cc", machine="cc"),
+            self.net.new_process(f"{px}cc", machine=f"{px}cc"),
             [(c.reads.ref(), c.writes.ref(), c.candidacies.ref())
              for c in self.coordinators],
             self.config)
@@ -79,7 +90,7 @@ class SimCluster:
         self.n_workers = n_workers
         self.workers: dict = {}
         for i in range(n_workers):
-            self._start_worker(f"worker{i}", f"w{i}")
+            self._start_worker(f"{px}worker{i}", f"{px}w{i}")
 
     # -- worker lifecycle ------------------------------------------------
     def _start_worker(self, name: str, machine: str) -> Worker:
@@ -145,6 +156,7 @@ class SimCluster:
     # -- clients ---------------------------------------------------------
     def client(self, name: str = "client", machine: str = ""):
         from ..client import Database  # avoid package-init cycle
+        name = self.prefix + name
         proc = self.net.new_process(name, machine or name)
         return Database(proc, self.cc.open_db.ref(),
                         status_ref=self.cc.status_requests.ref(),
@@ -188,4 +200,5 @@ class SimCluster:
         return self.sched.run(until=task, timeout_time=timeout_time)
 
     def shutdown(self) -> None:
-        flow.set_scheduler(None)
+        if self.prefix == "":
+            flow.set_scheduler(None)

@@ -118,3 +118,52 @@ def test_backup_tail_survives_recovery():
         assert c.run(main(), timeout_time=900)
     finally:
         c.shutdown()
+
+
+def test_dr_replicates_to_second_cluster():
+    """Continuous DR: a destination CLUSTER (second cluster in the
+    same simulation) converges to the source's state across a source
+    TLog kill mid-stream (ref: DatabaseBackupAgent)."""
+    src = SimCluster(seed=1601, durable=True)
+    dest = SimCluster(share_with=src, name_prefix="dr-", durable=True)
+    try:
+        db = src.client()
+        dest_db = dest.client()
+
+        async def main():
+            async def write_k(k, v):
+                async def body(tr):
+                    tr.set(k, v)
+                await run_transaction(db, body, max_retries=300)
+
+            await write_k(b"seed", b"0")
+            agent = ba.DrAgent(src, src.client("agent"), dest_db)
+            await agent.start()
+
+            for i in range(4):
+                await write_k(b"d%d" % i, b"v%d" % i)
+            src.kill_role("tlog")
+            for i in range(4, 8):
+                await write_k(b"d%d" % i, b"v%d" % i)
+
+            tr = db.create_transaction()
+            await tr.get(b"d7")
+            v_end = await tr.get_read_version()
+            await agent.wait_tailed_to(v_end, max_wait=120)
+            await agent.wait_applied_to(v_end, max_wait=120)
+            await agent.stop()
+
+            async def check(tr):
+                got = dict(await tr.get_range(b"", b"\xff"))
+                got = {k: v for k, v in got.items()
+                       if not k.startswith(b"\x02")}
+                assert got.get(b"seed") == b"0"
+                assert all(got.get(b"d%d" % i) == b"v%d" % i
+                           for i in range(8)), got
+            await run_transaction(dest_db, check, max_retries=200)
+            return True
+
+        assert src.run(main(), timeout_time=900)
+    finally:
+        dest.shutdown()
+        src.shutdown()
