@@ -1,0 +1,1313 @@
+/*
+ * fdb_tpu.cpp — native C client for the foundationdb_tpu wire protocol.
+ *
+ * What the reference's NativeAPI + fdb_c pair does in-process
+ * (fdbclient/NativeAPI.actor.cpp, bindings/c/fdb_c.cpp), this file does
+ * over the framework's TCP transport: framed token-addressed
+ * request/reply (rpc/tcp.py: [u32 len][u8 kind][u64 req_id][u64 token],
+ * protocol tag "fdbtpu01"), the tagged value encoding (rpc/wire.py),
+ * the cluster picture from the gateway's describe endpoint (playing
+ * MonitorLeader/openDatabase), shard-routed reads with replica
+ * failover, a read-your-writes overlay with atomic-op folding
+ * (fdbclient/ReadYourWrites.actor.cpp, fdbclient/Atomic.h), and the
+ * on_error retry/refresh protocol.
+ */
+
+#include "fdb_tpu.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+/* ---------------- error table (flow/error.py; codes identical to
+ * flow/error_definitions.h) ---------------- */
+
+struct ErrDef {
+    const char* name;
+    int code;
+};
+
+const ErrDef kErrors[] = {
+    {"success", 0},
+    {"end_of_stream", 1},
+    {"operation_failed", 1000},
+    {"wrong_shard_server", 1001},
+    {"timed_out", 1004},
+    {"all_alternatives_failed", 1006},
+    {"transaction_too_old", 1007},
+    {"future_version", 1009},
+    {"tlog_stopped", 1011},
+    {"server_request_queue_full", 1012},
+    {"not_committed", 1020},
+    {"commit_unknown_result", 1021},
+    {"transaction_cancelled", 1025},
+    {"connection_failed", 1026},
+    {"coordinators_changed", 1027},
+    {"transaction_timed_out", 1031},
+    {"process_behind", 1037},
+    {"database_locked", 1038},
+    {"broken_promise", 1100},
+    {"operation_cancelled", 1101},
+    {"client_invalid_operation", 2000},
+    {"key_outside_legal_range", 2004},
+    {"inverted_range", 2005},
+    {"transaction_too_large", 2101},
+    {"key_too_large", 2102},
+    {"value_too_large", 2103},
+    {"unknown_error", 4000},
+    {"internal_error", 4100},
+};
+
+int err_code(const std::string& name) {
+    for (const auto& e : kErrors)
+        if (name == e.name) return e.code;
+    return 4000;
+}
+
+const char* err_name(int code) {
+    for (const auto& e : kErrors)
+        if (code == e.code) return e.name;
+    return "unknown_error";
+}
+
+/* retry classification mirrors client/transaction.py RETRYABLE /
+ * REFRESH_ERRORS */
+bool is_retryable(int code) {
+    switch (code) {
+        case 1020: case 1007: case 1009: case 1100: case 1021:
+        case 1004: case 1011: case 1027: case 1001:
+            return true;
+        default:
+            return false;
+    }
+}
+
+bool needs_refresh(int code) {
+    switch (code) {
+        case 1100: case 1021: case 1011: case 1027: case 1001:
+            return true;
+        default:
+            return false;
+    }
+}
+
+/* client-side size limits (flow/knobs.py defaults) */
+constexpr size_t kKeySizeLimit = 10000;
+constexpr size_t kValueSizeLimit = 100000;
+constexpr size_t kTxnSizeLimit = 10000000;
+constexpr int kRequestTimeoutMs = 5000;
+
+/* ---------------- wire value model (rpc/wire.py tags) ---------------- */
+
+enum WTag : uint8_t {
+    W_NONE = 0, W_FALSE = 1, W_TRUE = 2, W_INT = 3, W_BIGINT = 4,
+    W_FLOAT = 5, W_BYTES = 6, W_STR = 7, W_TUPLE = 8, W_LIST = 9,
+    W_NT = 10, W_REF = 11, W_DICT = 12,
+};
+
+struct WVal {
+    enum T { NONE, BOOL, INT, FLOAT, BYTES, STR, TUPLE, LIST, DICT, NT } t =
+        NONE;
+    bool b = false;
+    int64_t i = 0;
+    double f = 0;
+    std::string s;            /* BYTES/STR payload; NT: type name */
+    std::vector<WVal> items;  /* TUPLE/LIST/NT fields; DICT: k,v,k,v... */
+
+    static WVal none() { return WVal{}; }
+    static WVal boolean(bool v) {
+        WVal w; w.t = BOOL; w.b = v; return w;
+    }
+    static WVal integer(int64_t v) {
+        WVal w; w.t = INT; w.i = v; return w;
+    }
+    static WVal bytes(const std::string& v) {
+        WVal w; w.t = BYTES; w.s = v; return w;
+    }
+    static WVal tuple(std::vector<WVal> v) {
+        WVal w; w.t = TUPLE; w.items = std::move(v); return w;
+    }
+    static WVal nt(const char* name, std::vector<WVal> fields) {
+        WVal w; w.t = NT; w.s = name; w.items = std::move(fields); return w;
+    }
+};
+
+void put_u32(std::string& out, uint32_t v) {
+    char b[4];
+    b[0] = char(v); b[1] = char(v >> 8); b[2] = char(v >> 16);
+    b[3] = char(v >> 24);
+    out.append(b, 4);
+}
+
+void put_i64(std::string& out, int64_t sv) {
+    uint64_t v = uint64_t(sv);
+    char b[8];
+    for (int k = 0; k < 8; k++) b[k] = char(v >> (8 * k));
+    out.append(b, 8);
+}
+
+void wire_encode(const WVal& v, std::string& out) {
+    switch (v.t) {
+        case WVal::NONE:
+            out.push_back(char(W_NONE));
+            break;
+        case WVal::BOOL:
+            out.push_back(char(v.b ? W_TRUE : W_FALSE));
+            break;
+        case WVal::INT:
+            out.push_back(char(W_INT));
+            put_i64(out, v.i);
+            break;
+        case WVal::FLOAT: {
+            out.push_back(char(W_FLOAT));
+            char b[8];
+            std::memcpy(b, &v.f, 8); /* IEEE754 little-endian host */
+            out.append(b, 8);
+            break;
+        }
+        case WVal::BYTES:
+            out.push_back(char(W_BYTES));
+            put_u32(out, uint32_t(v.s.size()));
+            out.append(v.s);
+            break;
+        case WVal::STR:
+            out.push_back(char(W_STR));
+            put_u32(out, uint32_t(v.s.size()));
+            out.append(v.s);
+            break;
+        case WVal::TUPLE:
+        case WVal::LIST:
+            out.push_back(char(v.t == WVal::TUPLE ? W_TUPLE : W_LIST));
+            put_u32(out, uint32_t(v.items.size()));
+            for (const auto& it : v.items) wire_encode(it, out);
+            break;
+        case WVal::DICT:
+            out.push_back(char(W_DICT));
+            put_u32(out, uint32_t(v.items.size() / 2));
+            for (const auto& it : v.items) wire_encode(it, out);
+            break;
+        case WVal::NT:
+            out.push_back(char(W_NT));
+            put_u32(out, uint32_t(v.s.size()));
+            out.append(v.s);
+            put_u32(out, uint32_t(v.items.size()));
+            for (const auto& it : v.items) wire_encode(it, out);
+            break;
+    }
+}
+
+bool get_u32(const std::string& buf, size_t& off, uint32_t* out) {
+    if (off + 4 > buf.size()) return false;
+    const unsigned char* p = (const unsigned char*)buf.data() + off;
+    *out = uint32_t(p[0]) | uint32_t(p[1]) << 8 | uint32_t(p[2]) << 16 |
+           uint32_t(p[3]) << 24;
+    off += 4;
+    return true;
+}
+
+bool get_i64(const std::string& buf, size_t& off, int64_t* out) {
+    if (off + 8 > buf.size()) return false;
+    const unsigned char* p = (const unsigned char*)buf.data() + off;
+    uint64_t v = 0;
+    for (int k = 0; k < 8; k++) v |= uint64_t(p[k]) << (8 * k);
+    *out = int64_t(v);
+    off += 8;
+    return true;
+}
+
+bool wire_decode(const std::string& buf, size_t& off, WVal* out) {
+    if (off >= buf.size()) return false;
+    uint8_t tag = uint8_t(buf[off++]);
+    switch (tag) {
+        case W_NONE:
+            out->t = WVal::NONE;
+            return true;
+        case W_FALSE:
+        case W_TRUE:
+            out->t = WVal::BOOL;
+            out->b = (tag == W_TRUE);
+            return true;
+        case W_INT:
+            out->t = WVal::INT;
+            return get_i64(buf, off, &out->i);
+        case W_FLOAT: {
+            if (off + 8 > buf.size()) return false;
+            out->t = WVal::FLOAT;
+            std::memcpy(&out->f, buf.data() + off, 8);
+            off += 8;
+            return true;
+        }
+        case W_BYTES:
+        case W_STR: {
+            uint32_t ln;
+            if (!get_u32(buf, off, &ln) || off + ln > buf.size())
+                return false;
+            out->t = (tag == W_BYTES ? WVal::BYTES : WVal::STR);
+            out->s.assign(buf, off, ln);
+            off += ln;
+            return true;
+        }
+        case W_TUPLE:
+        case W_LIST: {
+            uint32_t n;
+            if (!get_u32(buf, off, &n)) return false;
+            out->t = (tag == W_TUPLE ? WVal::TUPLE : WVal::LIST);
+            out->items.resize(n);
+            for (uint32_t k = 0; k < n; k++)
+                if (!wire_decode(buf, off, &out->items[k])) return false;
+            return true;
+        }
+        case W_DICT: {
+            uint32_t n;
+            if (!get_u32(buf, off, &n)) return false;
+            out->t = WVal::DICT;
+            out->items.resize(size_t(n) * 2);
+            for (uint32_t k = 0; k < 2 * n; k++)
+                if (!wire_decode(buf, off, &out->items[k])) return false;
+            return true;
+        }
+        case W_NT: {
+            uint32_t ln, n;
+            if (!get_u32(buf, off, &ln) || off + ln > buf.size())
+                return false;
+            out->t = WVal::NT;
+            out->s.assign(buf, off, ln);
+            off += ln;
+            if (!get_u32(buf, off, &n)) return false;
+            out->items.resize(n);
+            for (uint32_t k = 0; k < n; k++)
+                if (!wire_decode(buf, off, &out->items[k])) return false;
+            return true;
+        }
+        default:
+            /* W_BIGINT/W_REF never appear on the gateway's client
+             * surface; treat as malformed */
+            return false;
+    }
+}
+
+/* dict lookup by string key */
+const WVal* dict_get(const WVal& d, const char* key) {
+    if (d.t != WVal::DICT) return nullptr;
+    for (size_t k = 0; k + 1 < d.items.size(); k += 2)
+        if (d.items[k].t == WVal::STR && d.items[k].s == key)
+            return &d.items[k + 1];
+    return nullptr;
+}
+
+/* ---------------- connection (rpc/tcp.py peer) ---------------- */
+
+constexpr uint8_t K_REQUEST = 0, K_REPLY = 1, K_ERROR = 2;
+constexpr char kProtocol[] = "fdbtpu01"; /* 8 bytes, PROTOCOL_VERSION */
+constexpr size_t kHdrSize = 21;          /* <IBQQ: 4+1+8+8 */
+
+struct Pending {
+    bool done = false;
+    uint8_t kind = K_ERROR;
+    std::string payload;
+};
+
+struct ConnState {
+    int fd = -1;
+    bool dead = false;
+    std::mutex mut; /* guards fd-writes, pending, dead */
+    std::condition_variable cv;
+    std::map<uint64_t, std::shared_ptr<Pending>> pending;
+
+    void die_locked() {
+        if (dead) return;
+        dead = true;
+        if (fd >= 0) {
+            ::shutdown(fd, SHUT_RDWR);
+            ::close(fd);
+            fd = -1;
+        }
+        for (auto& kv : pending) {
+            kv.second->done = true;
+            kv.second->kind = K_ERROR;
+            kv.second->payload.clear(); /* empty payload = broken_promise */
+        }
+        pending.clear();
+    }
+    void die() {
+        std::lock_guard<std::mutex> g(mut);
+        die_locked();
+        cv.notify_all();
+    }
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+    char* p = (char*)buf;
+    while (n > 0) {
+        ssize_t r = ::recv(fd, p, n, 0);
+        if (r <= 0) return false;
+        p += r;
+        n -= size_t(r);
+    }
+    return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+    const char* p = (const char*)buf;
+    while (n > 0) {
+        ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+        if (r <= 0) return false;
+        p += r;
+        n -= size_t(r);
+    }
+    return true;
+}
+
+void reader_thread(std::shared_ptr<ConnState> st) {
+    for (;;) {
+        int fd;
+        {
+            std::lock_guard<std::mutex> g(st->mut);
+            if (st->dead) return;
+            fd = st->fd;
+        }
+        uint8_t hdr[kHdrSize];
+        if (!read_exact(fd, hdr, kHdrSize)) break;
+        uint32_t ln = uint32_t(hdr[0]) | uint32_t(hdr[1]) << 8 |
+                      uint32_t(hdr[2]) << 16 | uint32_t(hdr[3]) << 24;
+        uint8_t kind = hdr[4];
+        uint64_t req_id = 0;
+        for (int k = 0; k < 8; k++) req_id |= uint64_t(hdr[5 + k]) << (8 * k);
+        std::string payload(ln, '\0');
+        if (ln && !read_exact(fd, payload.data(), ln)) break;
+        std::lock_guard<std::mutex> g(st->mut);
+        auto it = st->pending.find(req_id);
+        if (it != st->pending.end()) {
+            it->second->done = true;
+            it->second->kind = kind;
+            it->second->payload = std::move(payload);
+            st->pending.erase(it);
+            st->cv.notify_all();
+        }
+    }
+    st->die();
+}
+
+struct Conn {
+    std::string host;
+    int port = 0;
+    std::shared_ptr<ConnState> st;
+    uint64_t next_req = 1;
+    std::mutex mut; /* guards st swap + next_req */
+
+    fdb_tpu_error_t ensure_connected(std::shared_ptr<ConnState>* out) {
+        std::lock_guard<std::mutex> g(mut);
+        if (st) {
+            std::lock_guard<std::mutex> g2(st->mut);
+            if (!st->dead) {
+                *out = st;
+                return 0;
+            }
+        }
+        int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0) return 1026;
+        struct addrinfo hints;
+        std::memset(&hints, 0, sizeof(hints));
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        struct addrinfo* res = nullptr;
+        std::string portstr = std::to_string(port);
+        if (getaddrinfo(host.c_str(), portstr.c_str(), &hints, &res) != 0 ||
+            res == nullptr) {
+            ::close(fd);
+            return 1026;
+        }
+        int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+        freeaddrinfo(res);
+        if (rc != 0 || !write_all(fd, kProtocol, 8)) {
+            ::close(fd);
+            return 1026;
+        }
+        auto fresh = std::make_shared<ConnState>();
+        fresh->fd = fd;
+        st = fresh;
+        std::thread(reader_thread, fresh).detach();
+        *out = fresh;
+        return 0;
+    }
+
+    /* blocking request; on success *out holds the decoded reply value */
+    fdb_tpu_error_t request(uint64_t token, const WVal& req, WVal* out) {
+        std::string payload;
+        wire_encode(req, payload);
+        std::shared_ptr<ConnState> c;
+        fdb_tpu_error_t err = ensure_connected(&c);
+        if (err) return err;
+        auto p = std::make_shared<Pending>();
+        uint64_t req_id;
+        {
+            std::lock_guard<std::mutex> g(mut);
+            req_id = next_req++;
+        }
+        std::string frame;
+        frame.reserve(kHdrSize + payload.size());
+        put_u32(frame, uint32_t(payload.size()));
+        frame.push_back(char(K_REQUEST));
+        put_i64(frame, int64_t(req_id));
+        put_i64(frame, int64_t(token));
+        frame += payload;
+        {
+            std::unique_lock<std::mutex> g(c->mut);
+            if (c->dead) return 1100;
+            c->pending[req_id] = p;
+            /* write under the conn lock: frames stay whole (the Python
+             * side queues via a writer thread; one lock suffices here) */
+            if (!write_all(c->fd, frame.data(), frame.size())) {
+                c->die_locked();
+                c->cv.notify_all();
+                return 1100;
+            }
+            bool ok = c->cv.wait_for(
+                g, std::chrono::milliseconds(kRequestTimeoutMs),
+                [&] { return p->done; });
+            if (!ok) {
+                c->pending.erase(req_id);
+                return 1004; /* timed_out */
+            }
+        }
+        if (p->kind == K_REPLY) {
+            size_t off = 0;
+            if (!wire_decode(p->payload, off, out)) return 4000;
+            return 0;
+        }
+        if (p->payload.empty()) return 1100; /* connection death */
+        size_t off = 0;
+        WVal nm;
+        if (!wire_decode(p->payload, off, &nm) || nm.t != WVal::STR)
+            return 4000;
+        return err_code(nm.s);
+    }
+};
+
+/* ---------------- cluster picture (gateway describe) ---------------- */
+
+struct Replica {
+    uint64_t gets = 0, ranges = 0, get_keys = 0;
+};
+
+struct Shard {
+    std::string begin;
+    std::string end;
+    bool has_end = false;
+    std::vector<Replica> replicas;
+};
+
+struct ProxyEndpoints {
+    uint64_t grvs = 0, commits = 0;
+};
+
+struct ClusterInfo {
+    int64_t seq = -1;
+    std::vector<ProxyEndpoints> proxies;
+    std::vector<Shard> shards;
+};
+
+bool parse_info(const WVal& d, ClusterInfo* out) {
+    const WVal* seq = dict_get(d, "seq");
+    const WVal* proxies = dict_get(d, "proxies");
+    const WVal* shards = dict_get(d, "shards");
+    if (!seq || seq->t != WVal::INT || !proxies || !shards) return false;
+    out->seq = seq->i;
+    for (const auto& p : proxies->items) {
+        const WVal* g = dict_get(p, "grvs");
+        const WVal* c = dict_get(p, "commits");
+        if (!g || !c || g->t != WVal::INT || c->t != WVal::INT) return false;
+        out->proxies.push_back({uint64_t(g->i), uint64_t(c->i)});
+    }
+    for (const auto& s : shards->items) {
+        const WVal* b = dict_get(s, "begin");
+        const WVal* e = dict_get(s, "end");
+        const WVal* he = dict_get(s, "has_end");
+        const WVal* reps = dict_get(s, "replicas");
+        if (!b || !e || !he || !reps) return false;
+        Shard sh;
+        sh.begin = b->s;
+        sh.end = e->s;
+        sh.has_end = he->b;
+        for (const auto& r : reps->items) {
+            const WVal* g = dict_get(r, "gets");
+            const WVal* rg = dict_get(r, "ranges");
+            const WVal* gk = dict_get(r, "get_keys");
+            if (!g || !rg || !gk) return false;
+            sh.replicas.push_back(
+                {uint64_t(g->i), uint64_t(rg->i), uint64_t(gk->i)});
+        }
+        out->shards.push_back(std::move(sh));
+    }
+    return !out->proxies.empty() && !out->shards.empty();
+}
+
+/* ---------------- atomic ops (server/atomic.py parity) ---------------- */
+
+using OptBytes = std::optional<std::string>;
+
+std::string le_add_like(const std::string& a, const std::string& b,
+                        bool is_add, bool take_max) {
+    /* unsigned little-endian arithmetic over arbitrary widths; result
+     * truncated/zero-padded to the PARAM's length (doLittleEndianAdd) */
+    size_t n = b.size();
+    std::string out(n, '\0');
+    if (is_add) {
+        unsigned carry = 0;
+        for (size_t k = 0; k < n; k++) {
+            unsigned av = k < a.size() ? (unsigned char)a[k] : 0;
+            unsigned sum = av + (unsigned char)b[k] + carry;
+            out[k] = char(sum & 0xFF);
+            carry = sum >> 8;
+        }
+        return out;
+    }
+    /* max/min: compare as little-endian unsigned integers of arbitrary
+     * width, then truncate the winner to param width */
+    auto cmp_le = [](const std::string& x, const std::string& y) {
+        size_t nx = x.size(), ny = y.size();
+        size_t top = std::max(nx, ny);
+        for (size_t k = top; k-- > 0;) {
+            unsigned xv = k < nx ? (unsigned char)x[k] : 0;
+            unsigned yv = k < ny ? (unsigned char)y[k] : 0;
+            if (xv != yv) return xv < yv ? -1 : 1;
+        }
+        return 0;
+    };
+    int c = cmp_le(a, b);
+    const std::string& win = (take_max ? (c >= 0 ? a : b) : (c <= 0 ? a : b));
+    std::string out2(n, '\0');
+    for (size_t k = 0; k < n && k < win.size(); k++) out2[k] = win[k];
+    return out2;
+}
+
+OptBytes apply_atomic(int op, const OptBytes& existing,
+                      const std::string& param) {
+    switch (op) {
+        case FDB_TPU_OP_ADD:
+            if (param.empty()) return std::string();
+            if (!existing || existing->empty()) return param;
+            return le_add_like(*existing, param, true, false);
+        case FDB_TPU_OP_AND: {
+            if (!existing) return param; /* V2 semantics */
+            std::string out(param);
+            for (size_t k = 0; k < out.size(); k++) {
+                char e = k < existing->size() ? (*existing)[k] : 0;
+                out[k] = char(out[k] & e);
+            }
+            return out;
+        }
+        case FDB_TPU_OP_OR:
+        case FDB_TPU_OP_XOR: {
+            std::string ex = existing ? *existing : std::string();
+            std::string out(param);
+            for (size_t k = 0; k < out.size(); k++) {
+                char e = k < ex.size() ? ex[k] : 0;
+                out[k] = char(op == FDB_TPU_OP_OR ? (out[k] | e)
+                                                  : (out[k] ^ e));
+            }
+            return out;
+        }
+        case FDB_TPU_OP_APPEND_IF_FITS: {
+            std::string ex = existing ? *existing : std::string();
+            if (ex.size() + param.size() <= kValueSizeLimit)
+                return ex + param;
+            return ex;
+        }
+        case FDB_TPU_OP_MAX:
+            if (!existing || existing->empty() || param.empty()) return param;
+            return le_add_like(*existing, param, false, true);
+        case FDB_TPU_OP_MIN:
+            if (!existing) return param; /* V2 semantics */
+            if (param.empty()) return param;
+            return le_add_like(*existing, param, false, false);
+        case FDB_TPU_OP_BYTE_MIN:
+            if (!existing) return param;
+            return std::min(*existing, param);
+        case FDB_TPU_OP_BYTE_MAX:
+            if (!existing) return param;
+            return std::max(*existing, param);
+        case FDB_TPU_OP_COMPARE_AND_CLEAR:
+            if (existing && *existing == param) return std::nullopt;
+            return existing;
+        default:
+            return existing;
+    }
+}
+
+bool is_atomic_op(int op) {
+    switch (op) {
+        case FDB_TPU_OP_ADD: case FDB_TPU_OP_AND: case FDB_TPU_OP_OR:
+        case FDB_TPU_OP_XOR: case FDB_TPU_OP_APPEND_IF_FITS:
+        case FDB_TPU_OP_MAX: case FDB_TPU_OP_MIN: case FDB_TPU_OP_BYTE_MIN:
+        case FDB_TPU_OP_BYTE_MAX: case FDB_TPU_OP_COMPARE_AND_CLEAR:
+            return true;
+        default:
+            return false;
+    }
+}
+
+std::string next_key(const std::string& k) { return k + '\0'; }
+
+} /* namespace */
+
+/* ---------------- public handles ---------------- */
+
+struct FDBTpuDatabase {
+    Conn conn;
+    std::mutex mut; /* guards info + rng */
+    std::shared_ptr<const ClusterInfo> info;
+    std::mt19937 rng{0x5eed};
+
+    std::shared_ptr<const ClusterInfo> picture() {
+        std::lock_guard<std::mutex> g(mut);
+        return info;
+    }
+
+    uint32_t rand_below(uint32_t n) {
+        std::lock_guard<std::mutex> g(mut);
+        return n ? rng() % n : 0;
+    }
+
+    fdb_tpu_error_t describe(int64_t min_seq) {
+        WVal reply;
+        fdb_tpu_error_t err =
+            conn.request(1 /* DESCRIBE_TOKEN */, WVal::integer(min_seq),
+                         &reply);
+        if (err) return err;
+        auto fresh = std::make_shared<ClusterInfo>();
+        if (!parse_info(reply, fresh.get())) return 4000;
+        std::lock_guard<std::mutex> g(mut);
+        if (!info || fresh->seq >= info->seq) info = std::move(fresh);
+        return 0;
+    }
+};
+
+struct Mutation {
+    int type;
+    std::string p1, p2;
+};
+
+struct FDBTpuTransaction {
+    FDBTpuDatabase* db;
+    int64_t read_version = -1;
+    int64_t used_seq = -1;
+    /* RYW overlay: key -> (present, value); clears in op order */
+    std::map<std::string, std::pair<bool, std::string>> writes;
+    std::vector<std::pair<std::string, std::string>> clears;
+    std::map<std::string, std::vector<std::pair<int, std::string>>> ops;
+    std::vector<Mutation> mutations;
+    std::vector<std::pair<std::string, std::string>> rc, wc;
+    size_t txn_bytes = 0;
+    int64_t committed_version = -1;
+    int64_t committed_batch_index = -1;
+
+    void reset() {
+        read_version = -1;
+        used_seq = -1;
+        writes.clear();
+        clears.clear();
+        ops.clear();
+        mutations.clear();
+        rc.clear();
+        wc.clear();
+        txn_bytes = 0;
+        committed_version = -1;
+        committed_batch_index = -1;
+    }
+
+    std::shared_ptr<const ClusterInfo> picture() {
+        auto p = db->picture();
+        if (p && p->seq > used_seq) used_seq = p->seq;
+        return p;
+    }
+
+    /* (found, value) against uncommitted writes, newest-first
+     * (client/transaction.py _overlay_get) */
+    bool overlay_get(const std::string& key, OptBytes* out) {
+        auto it = writes.find(key);
+        if (it != writes.end()) {
+            *out = it->second.first ? OptBytes(it->second.second)
+                                    : std::nullopt;
+            return true;
+        }
+        for (auto rit = clears.rbegin(); rit != clears.rend(); ++rit)
+            if (rit->first <= key && key < rit->second) {
+                *out = std::nullopt;
+                return true;
+            }
+        return false;
+    }
+
+    fdb_tpu_error_t grv(int64_t* out) {
+        if (read_version < 0) {
+            auto p = picture();
+            if (!p) return 1100;
+            const ProxyEndpoints& proxy =
+                p->proxies[db->rand_below(uint32_t(p->proxies.size()))];
+            WVal reply;
+            fdb_tpu_error_t err = db->conn.request(
+                proxy.grvs,
+                WVal::nt("GetReadVersionRequest", {WVal::integer(1)}),
+                &reply);
+            if (err) return err;
+            if (reply.t != WVal::NT || reply.items.empty() ||
+                reply.items[0].t != WVal::INT)
+                return 4000;
+            read_version = reply.items[0].i;
+        }
+        *out = read_version;
+        return 0;
+    }
+
+    size_t shard_index(const std::shared_ptr<const ClusterInfo>& p,
+                       const std::string& key) {
+        for (size_t k = p->shards.size(); k-- > 0;)
+            if (key >= p->shards[k].begin) return k;
+        return 0;
+    }
+
+    /* rotated replica failover (client/transaction.py _storage_rpc) */
+    fdb_tpu_error_t storage_rpc(const Shard& shard,
+                                uint64_t Replica::*endpoint, const WVal& req,
+                                WVal* out) {
+        size_t n = shard.replicas.size();
+        size_t start = db->rand_below(uint32_t(n));
+        fdb_tpu_error_t last = 1100;
+        for (size_t j = 0; j < n; j++) {
+            const Replica& rep = shard.replicas[(start + j) % n];
+            fdb_tpu_error_t err =
+                db->conn.request(rep.*endpoint, req, out);
+            if (err == 0) return 0;
+            if (err != 1100 && err != 1004) return err;
+            last = err;
+        }
+        return last;
+    }
+
+    fdb_tpu_error_t base_get(const std::string& key, OptBytes* out) {
+        if (overlay_get(key, out)) return 0;
+        int64_t version;
+        fdb_tpu_error_t err = grv(&version);
+        if (err) return err;
+        auto p = picture();
+        if (!p) return 1100;
+        const Shard& shard = p->shards[shard_index(p, key)];
+        WVal reply;
+        err = storage_rpc(
+            shard, &Replica::gets,
+            WVal::nt("StorageGetRequest",
+                     {WVal::bytes(key), WVal::integer(version)}),
+            &reply);
+        if (err) return err;
+        if (reply.t == WVal::NONE)
+            *out = std::nullopt;
+        else if (reply.t == WVal::BYTES)
+            *out = reply.s;
+        else
+            return 4000;
+        return 0;
+    }
+
+    fdb_tpu_error_t get(const std::string& key, bool snapshot, OptBytes* out) {
+        if (!snapshot) rc.emplace_back(key, next_key(key));
+        fdb_tpu_error_t err = base_get(key, out);
+        if (err) return err;
+        auto it = ops.find(key);
+        if (it != ops.end())
+            for (const auto& op : it->second)
+                *out = apply_atomic(op.first, *out, op.second);
+        return 0;
+    }
+
+    fdb_tpu_error_t check_sizes(const std::string& key,
+                                const std::string& value, size_t slack = 0) {
+        if (key.size() > kKeySizeLimit + slack) return 2102;
+        if (value.size() > kValueSizeLimit) return 2103;
+        txn_bytes += key.size() + value.size();
+        if (txn_bytes > kTxnSizeLimit) return 2101;
+        return 0;
+    }
+
+    void record_write(const std::string& key, const OptBytes& value) {
+        writes[key] = value ? std::make_pair(true, *value)
+                            : std::make_pair(false, std::string());
+    }
+};
+
+/* ---------------- C ABI ---------------- */
+
+extern "C" {
+
+const char* fdb_tpu_get_error(fdb_tpu_error_t code) {
+    return err_name(code);
+}
+
+int fdb_tpu_error_retryable(fdb_tpu_error_t code) {
+    return is_retryable(code) ? 1 : 0;
+}
+
+fdb_tpu_error_t fdb_tpu_create_database(const char* host, int port,
+                                        FDBTpuDatabase** out_db) {
+    auto* db = new FDBTpuDatabase();
+    db->conn.host = host;
+    db->conn.port = port;
+    fdb_tpu_error_t err = db->describe(-1);
+    if (err) {
+        delete db;
+        return err;
+    }
+    *out_db = db;
+    return 0;
+}
+
+void fdb_tpu_database_destroy(FDBTpuDatabase* db) {
+    if (!db) return;
+    if (db->conn.st) db->conn.st->die();
+    delete db;
+}
+
+fdb_tpu_error_t fdb_tpu_database_create_transaction(
+    FDBTpuDatabase* db, FDBTpuTransaction** out_tr) {
+    auto* tr = new FDBTpuTransaction();
+    tr->db = db;
+    *out_tr = tr;
+    return 0;
+}
+
+void fdb_tpu_transaction_destroy(FDBTpuTransaction* tr) { delete tr; }
+
+void fdb_tpu_transaction_reset(FDBTpuTransaction* tr) { tr->reset(); }
+
+fdb_tpu_error_t fdb_tpu_transaction_get_read_version(FDBTpuTransaction* tr,
+                                                     int64_t* out_version) {
+    return tr->grv(out_version);
+}
+
+static uint8_t* dup_bytes(const std::string& s) {
+    auto* p = (uint8_t*)std::malloc(s.size() ? s.size() : 1);
+    if (s.size()) std::memcpy(p, s.data(), s.size());
+    return p;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_get(FDBTpuTransaction* tr,
+                                        const uint8_t* key, int key_length,
+                                        int snapshot, int* out_present,
+                                        uint8_t** out_value,
+                                        int* out_value_length) {
+    std::string k((const char*)key, key_length);
+    OptBytes v;
+    fdb_tpu_error_t err = tr->get(k, snapshot != 0, &v);
+    if (err) return err;
+    if (!v) {
+        *out_present = 0;
+        *out_value = nullptr;
+        *out_value_length = 0;
+    } else {
+        *out_present = 1;
+        *out_value = dup_bytes(*v);
+        *out_value_length = int(v->size());
+    }
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
+                                            const uint8_t* key,
+                                            int key_length, int or_equal,
+                                            int offset, int snapshot,
+                                            uint8_t** out_key,
+                                            int* out_key_length) {
+    /* cross-shard selector walk (client/transaction.py get_key; ref:
+     * NativeAPI getKey readThrough iteration) */
+    std::string anchor((const char*)key, key_length);
+    int64_t version;
+    fdb_tpu_error_t err = tr->grv(&version);
+    if (err) return err;
+    auto p = tr->picture();
+    if (!p) return 1100;
+    size_t i = tr->shard_index(p, anchor);
+    std::string sel_key = anchor;
+    bool sel_eq = or_equal != 0;
+    int64_t sel_off = offset;
+    std::string resolved;
+    for (;;) {
+        WVal reply;
+        err = tr->storage_rpc(
+            p->shards[i], &Replica::get_keys,
+            WVal::nt("StorageGetKeyRequest",
+                     {WVal::nt("KeySelector",
+                               {WVal::bytes(sel_key),
+                                WVal::boolean(sel_eq),
+                                WVal::integer(sel_off)}),
+                      WVal::integer(version)}),
+            &reply);
+        if (err) return err;
+        if (reply.t != WVal::TUPLE || reply.items.size() != 2 ||
+            reply.items[1].t != WVal::INT)
+            return 4000;
+        int64_t leftover = reply.items[1].i;
+        if (leftover == 0) {
+            resolved = reply.items[0].s;
+            break;
+        }
+        if (leftover < 0) {
+            if (i == 0) {
+                resolved.clear();
+                break;
+            }
+            i -= 1;
+            sel_key = p->shards[i + 1].begin;
+            sel_eq = false;
+            sel_off = leftover + 1;
+        } else {
+            if (i == p->shards.size() - 1) {
+                resolved = "\xff";
+                break;
+            }
+            i += 1;
+            sel_key = p->shards[i].begin;
+            sel_eq = false;
+            sel_off = leftover;
+        }
+    }
+    if (!snapshot) {
+        const std::string& lo = std::min(resolved, anchor);
+        const std::string& hi = std::max(resolved, anchor);
+        tr->rc.emplace_back(lo, next_key(hi));
+    }
+    *out_key = dup_bytes(resolved);
+    *out_key_length = int(resolved.size());
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_get_range(
+    FDBTpuTransaction* tr, const uint8_t* begin_p, int begin_length,
+    const uint8_t* end_p, int end_length, int limit, int reverse,
+    int snapshot, FDBTpuKeyValue** out_kv, int* out_count) {
+    std::string begin((const char*)begin_p, begin_length);
+    std::string end((const char*)end_p, end_length);
+    *out_kv = nullptr;
+    *out_count = 0;
+    if (begin >= end) return 0;
+    if (limit <= 0) limit = 1 << 20;
+    int64_t version;
+    fdb_tpu_error_t err = tr->grv(&version);
+    if (err) return err;
+    auto p = tr->picture();
+    if (!p) return 1100;
+
+    /* an overlay can add/remove rows: fetch the full range and merge
+     * (client/transaction.py get_range; ref: RYWIterator) */
+    bool overlay = !tr->clears.empty() || !tr->writes.empty() ||
+                   !tr->ops.empty();
+    int fetch_limit = overlay ? (1 << 20) : limit;
+    bool fetch_rev = overlay ? false : (reverse != 0);
+
+    std::vector<std::pair<std::string, std::string>> base;
+    std::vector<const Shard*> overlapping;
+    for (const auto& s : p->shards) {
+        bool before_end = !s.has_end || begin < s.end;
+        if (before_end && s.begin < end) overlapping.push_back(&s);
+    }
+    if (fetch_rev) std::reverse(overlapping.begin(), overlapping.end());
+    for (const Shard* s : overlapping) {
+        std::string b = std::max(begin, s->begin);
+        std::string e = s->has_end ? std::min(end, s->end) : end;
+        WVal reply;
+        err = tr->storage_rpc(
+            *s, &Replica::ranges,
+            WVal::nt("StorageGetRangeRequest",
+                     {WVal::bytes(b), WVal::bytes(e), WVal::integer(version),
+                      WVal::integer(fetch_limit - int64_t(base.size())),
+                      WVal::boolean(fetch_rev)}),
+            &reply);
+        if (err) return err;
+        if (reply.t != WVal::LIST) return 4000;
+        for (const auto& kv : reply.items) {
+            if (kv.t != WVal::TUPLE || kv.items.size() != 2) return 4000;
+            base.emplace_back(kv.items[0].s, kv.items[1].s);
+        }
+        if (int64_t(base.size()) >= fetch_limit) break;
+    }
+
+    std::map<std::string, std::string> merged(base.begin(), base.end());
+    for (const auto& cl : tr->clears) {
+        auto it = merged.lower_bound(cl.first);
+        while (it != merged.end() && it->first < cl.second)
+            it = merged.erase(it);
+    }
+    for (auto it = tr->writes.lower_bound(begin);
+         it != tr->writes.end() && it->first < end; ++it) {
+        if (it->second.first)
+            merged[it->first] = it->second.second;
+        else
+            merged.erase(it->first);
+    }
+    for (const auto& kv : tr->ops) {
+        const std::string& k = kv.first;
+        if (!(begin <= k && k < end)) continue;
+        OptBytes val;
+        auto mit = merged.find(k);
+        if (mit != merged.end()) val = mit->second;
+        if (!val) {
+            bool written = tr->writes.count(k) != 0;
+            bool cleared = false;
+            for (const auto& cl : tr->clears)
+                if (cl.first <= k && k < cl.second) cleared = true;
+            if (!written && !cleared) {
+                /* base value for a key the fetch may have missed */
+                const Shard& shard = p->shards[tr->shard_index(p, k)];
+                WVal reply;
+                err = tr->storage_rpc(
+                    shard, &Replica::gets,
+                    WVal::nt("StorageGetRequest",
+                             {WVal::bytes(k), WVal::integer(version)}),
+                    &reply);
+                if (err) return err;
+                if (reply.t == WVal::BYTES) val = reply.s;
+            }
+        }
+        for (const auto& op : kv.second)
+            val = apply_atomic(op.first, val, op.second);
+        if (val)
+            merged[k] = *val;
+        else
+            merged.erase(k);
+    }
+
+    std::vector<std::pair<std::string, std::string>> rows(merged.begin(),
+                                                          merged.end());
+    if (reverse) std::reverse(rows.begin(), rows.end());
+    if (int64_t(rows.size()) > limit) rows.resize(limit);
+
+    if (!snapshot) {
+        /* record only the observed portion when the limit truncates */
+        if (int64_t(rows.size()) == limit && !rows.empty()) {
+            if (reverse)
+                tr->rc.emplace_back(rows.back().first, end);
+            else
+                tr->rc.emplace_back(begin, next_key(rows.back().first));
+        } else {
+            tr->rc.emplace_back(begin, end);
+        }
+    }
+
+    auto* arr = (FDBTpuKeyValue*)std::calloc(
+        rows.size() ? rows.size() : 1, sizeof(FDBTpuKeyValue));
+    for (size_t k = 0; k < rows.size(); k++) {
+        arr[k].key = dup_bytes(rows[k].first);
+        arr[k].key_length = int(rows[k].first.size());
+        arr[k].value = dup_bytes(rows[k].second);
+        arr[k].value_length = int(rows[k].second.size());
+    }
+    *out_kv = arr;
+    *out_count = int(rows.size());
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_set(FDBTpuTransaction* tr,
+                                        const uint8_t* key, int key_length,
+                                        const uint8_t* value,
+                                        int value_length) {
+    std::string k((const char*)key, key_length);
+    std::string v((const char*)value, value_length);
+    fdb_tpu_error_t err = tr->check_sizes(k, v);
+    if (err) return err;
+    tr->record_write(k, v);
+    tr->ops.erase(k); /* a set supersedes pending atomics */
+    tr->mutations.push_back({0 /* SET_VALUE */, k, v});
+    tr->wc.emplace_back(k, next_key(k));
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_clear(FDBTpuTransaction* tr,
+                                          const uint8_t* key,
+                                          int key_length) {
+    std::string k((const char*)key, key_length);
+    std::string e = next_key(k);
+    return fdb_tpu_transaction_clear_range(tr, key, key_length,
+                                           (const uint8_t*)e.data(),
+                                           int(e.size()));
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_clear_range(FDBTpuTransaction* tr,
+                                                const uint8_t* begin_p,
+                                                int begin_length,
+                                                const uint8_t* end_p,
+                                                int end_length) {
+    std::string b((const char*)begin_p, begin_length);
+    std::string e((const char*)end_p, end_length);
+    if (b >= e) return 0;
+    fdb_tpu_error_t err = tr->check_sizes(b, "");
+    if (err) return err;
+    err = tr->check_sizes(e, "", 1); /* keyAfter(max-size key) is legal */
+    if (err) return err;
+    tr->clears.emplace_back(b, e);
+    for (auto it = tr->writes.lower_bound(b);
+         it != tr->writes.end() && it->first < e; ++it)
+        it->second = {false, std::string()};
+    for (auto it = tr->ops.lower_bound(b);
+         it != tr->ops.end() && it->first < e;)
+        it = tr->ops.erase(it);
+    tr->mutations.push_back({1 /* CLEAR_RANGE */, b, e});
+    tr->wc.emplace_back(b, e);
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_atomic_op(FDBTpuTransaction* tr,
+                                              const uint8_t* key,
+                                              int key_length,
+                                              const uint8_t* param,
+                                              int param_length,
+                                              int operation_type) {
+    std::string k((const char*)key, key_length);
+    std::string pm((const char*)param, param_length);
+    fdb_tpu_error_t err = tr->check_sizes(k, pm);
+    if (err) return err;
+    if (operation_type == FDB_TPU_OP_SET_VERSIONSTAMPED_KEY ||
+        operation_type == FDB_TPU_OP_SET_VERSIONSTAMPED_VALUE) {
+        /* transformed at the proxy; operand's trailing 4 bytes are the
+         * placeholder offset (client/transaction.py atomic_op) */
+        tr->mutations.push_back({operation_type, k, pm});
+        std::string wkey =
+            operation_type == FDB_TPU_OP_SET_VERSIONSTAMPED_KEY && k.size() >= 4
+                ? k.substr(0, k.size() - 4)
+                : k;
+        tr->wc.emplace_back(wkey, next_key(wkey));
+        return 0;
+    }
+    if (!is_atomic_op(operation_type)) return 2000;
+    OptBytes cur;
+    bool found = tr->overlay_get(k, &cur);
+    if (found && tr->ops.find(k) == tr->ops.end()) {
+        OptBytes result = apply_atomic(operation_type, cur, pm);
+        if (!result) {
+            tr->record_write(k, std::nullopt);
+            tr->mutations.push_back({1 /* CLEAR_RANGE */, k, next_key(k)});
+        } else {
+            tr->record_write(k, result);
+            tr->mutations.push_back({0 /* SET_VALUE */, k, *result});
+        }
+    } else {
+        tr->ops[k].emplace_back(operation_type, pm);
+        tr->mutations.push_back({operation_type, k, pm});
+    }
+    tr->wc.emplace_back(k, next_key(k));
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_add_conflict_range(
+    FDBTpuTransaction* tr, const uint8_t* begin_p, int begin_length,
+    const uint8_t* end_p, int end_length, int write) {
+    std::string b((const char*)begin_p, begin_length);
+    std::string e((const char*)end_p, end_length);
+    if (b >= e) return 2005;
+    (write ? tr->wc : tr->rc).emplace_back(b, e);
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_commit(FDBTpuTransaction* tr,
+                                           int64_t* out_committed_version) {
+    if (tr->mutations.empty()) {
+        /* read-only: succeeds at the read version without a round trip */
+        tr->committed_version = tr->read_version < 0 ? 0 : tr->read_version;
+        *out_committed_version = tr->committed_version;
+        return 0;
+    }
+    int64_t snapshot;
+    fdb_tpu_error_t err = tr->grv(&snapshot);
+    if (err) return err;
+    auto p = tr->picture();
+    if (!p) return 1100;
+
+    auto ranges = [](const std::vector<std::pair<std::string, std::string>>&
+                         rs) {
+        std::vector<WVal> out;
+        out.reserve(rs.size());
+        for (const auto& r : rs)
+            out.push_back(WVal::tuple(
+                {WVal::bytes(r.first), WVal::bytes(r.second)}));
+        return WVal::tuple(std::move(out));
+    };
+    std::vector<WVal> muts;
+    muts.reserve(tr->mutations.size());
+    for (const auto& m : tr->mutations)
+        muts.push_back(WVal::nt(
+            "MutationRef", {WVal::integer(m.type), WVal::bytes(m.p1),
+                            WVal::bytes(m.p2)}));
+    WVal req = WVal::nt("CommitRequest",
+                        {WVal::integer(snapshot), ranges(tr->rc),
+                         ranges(tr->wc), WVal::tuple(std::move(muts))});
+    const ProxyEndpoints& proxy =
+        p->proxies[tr->db->rand_below(uint32_t(p->proxies.size()))];
+    WVal reply;
+    err = tr->db->conn.request(proxy.commits, req, &reply);
+    if (err) return err;
+    if (reply.t != WVal::NT || reply.items.size() < 2 ||
+        reply.items[0].t != WVal::INT)
+        return 4000;
+    tr->committed_version = reply.items[0].i;
+    tr->committed_batch_index = reply.items[1].i;
+    *out_committed_version = tr->committed_version;
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_get_versionstamp(FDBTpuTransaction* tr,
+                                                     uint8_t** out_stamp,
+                                                     int* out_length) {
+    if (tr->committed_version < 0) return 2000;
+    /* server/proxy.py make_versionstamp: 8B BE version + 2B BE batch */
+    std::string stamp(10, '\0');
+    uint64_t v = uint64_t(tr->committed_version);
+    for (int k = 0; k < 8; k++) stamp[k] = char(v >> (8 * (7 - k)));
+    uint64_t bi = uint64_t(
+        tr->committed_batch_index < 0 ? 0 : tr->committed_batch_index);
+    stamp[8] = char(bi >> 8);
+    stamp[9] = char(bi & 0xFF);
+    *out_stamp = dup_bytes(stamp);
+    *out_length = 10;
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_on_error(FDBTpuTransaction* tr,
+                                             fdb_tpu_error_t code) {
+    if (!is_retryable(code)) return code;
+    if (needs_refresh(code)) {
+        /* long-poll past the picture this attempt used (Database.
+         * refresh_past); a refresh failure still allows the retry */
+        tr->db->describe(tr->used_seq < 0 ? 0 : tr->used_seq);
+    }
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(1 + int(tr->db->rand_below(10))));
+    tr->reset();
+    return 0;
+}
+
+void fdb_tpu_free(void* ptr) { std::free(ptr); }
+
+void fdb_tpu_free_keyvalues(FDBTpuKeyValue* kv, int count) {
+    if (!kv) return;
+    for (int k = 0; k < count; k++) {
+        std::free(kv[k].key);
+        std::free(kv[k].value);
+    }
+    std::free(kv);
+}
+
+} /* extern "C" */

@@ -1,0 +1,148 @@
+/*
+ * fdb_tpu.h — C ABI for the foundationdb_tpu client.
+ *
+ * Reference surface: bindings/c/foundationdb/fdb_c.h — database and
+ * transaction handles, byte-string keys/values, numeric error codes
+ * (flow/error_definitions.h; this framework keeps the same numbers),
+ * and the standard on_error retry protocol.
+ *
+ * Unlike the reference's fdb_c (a thin ABI over the linked-in C++
+ * NativeAPI), this library IS a native client: it speaks the
+ * framework's wire protocol (rpc/tcp.py framing + rpc/wire.py tagged
+ * encoding) over a TCP connection to a cluster gateway, and implements
+ * the client logic itself — read-your-writes overlay, atomic-op
+ * folding, shard-routed reads with replica failover, conflict-range
+ * recording, OCC commit, and the retry/refresh loop
+ * (fdbclient/NativeAPI.actor.cpp, fdbclient/ReadYourWrites.actor.cpp).
+ *
+ * Calls are blocking; one connection is shared and the library is
+ * thread-safe per handle (a transaction must not be used from two
+ * threads at once, matching the reference's rule).
+ *
+ * Not yet carried over this ABI: watches, versionstamped operand
+ * reads (set-versionstamp mutations themselves DO commit).
+ */
+
+#ifndef FDB_TPU_C_H
+#define FDB_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int fdb_tpu_error_t; /* 0 = success; codes = error_definitions.h */
+
+typedef struct FDBTpuDatabase FDBTpuDatabase;
+typedef struct FDBTpuTransaction FDBTpuTransaction;
+
+typedef struct {
+    uint8_t* key;
+    int key_length;
+    uint8_t* value;
+    int value_length;
+} FDBTpuKeyValue;
+
+/* mutation type numbers = fdbclient/CommitTransaction.h (server/types.py) */
+enum {
+    FDB_TPU_OP_ADD = 2,
+    FDB_TPU_OP_AND = 6,
+    FDB_TPU_OP_OR = 7,
+    FDB_TPU_OP_XOR = 8,
+    FDB_TPU_OP_APPEND_IF_FITS = 9,
+    FDB_TPU_OP_MAX = 12,
+    FDB_TPU_OP_MIN = 13,
+    FDB_TPU_OP_SET_VERSIONSTAMPED_KEY = 14,
+    FDB_TPU_OP_SET_VERSIONSTAMPED_VALUE = 15,
+    FDB_TPU_OP_BYTE_MIN = 16,
+    FDB_TPU_OP_BYTE_MAX = 17,
+    FDB_TPU_OP_COMPARE_AND_CLEAR = 20,
+};
+
+const char* fdb_tpu_get_error(fdb_tpu_error_t code);
+int fdb_tpu_error_retryable(fdb_tpu_error_t code);
+
+/* Connect to a cluster gateway and fetch the initial cluster picture. */
+fdb_tpu_error_t fdb_tpu_create_database(const char* host, int port,
+                                        FDBTpuDatabase** out_db);
+void fdb_tpu_database_destroy(FDBTpuDatabase* db);
+
+fdb_tpu_error_t fdb_tpu_database_create_transaction(
+    FDBTpuDatabase* db, FDBTpuTransaction** out_tr);
+void fdb_tpu_transaction_destroy(FDBTpuTransaction* tr);
+void fdb_tpu_transaction_reset(FDBTpuTransaction* tr);
+
+fdb_tpu_error_t fdb_tpu_transaction_get_read_version(FDBTpuTransaction* tr,
+                                                     int64_t* out_version);
+
+/* *out_present = 0 and *out_value = NULL for an absent key. The value
+ * buffer is malloc'd; free with fdb_tpu_free. */
+fdb_tpu_error_t fdb_tpu_transaction_get(FDBTpuTransaction* tr,
+                                        const uint8_t* key, int key_length,
+                                        int snapshot, int* out_present,
+                                        uint8_t** out_value,
+                                        int* out_value_length);
+
+/* Resolve a key selector: the `offset`-th key past the first key
+ * >= (or_equal=0) / > (or_equal=1) the anchor. Result malloc'd. */
+fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
+                                            const uint8_t* key,
+                                            int key_length, int or_equal,
+                                            int offset, int snapshot,
+                                            uint8_t** out_key,
+                                            int* out_key_length);
+
+/* Result array + every contained buffer are malloc'd; free with
+ * fdb_tpu_free_keyvalues. */
+fdb_tpu_error_t fdb_tpu_transaction_get_range(
+    FDBTpuTransaction* tr, const uint8_t* begin, int begin_length,
+    const uint8_t* end, int end_length, int limit, int reverse, int snapshot,
+    FDBTpuKeyValue** out_kv, int* out_count);
+
+fdb_tpu_error_t fdb_tpu_transaction_set(FDBTpuTransaction* tr,
+                                        const uint8_t* key, int key_length,
+                                        const uint8_t* value,
+                                        int value_length);
+fdb_tpu_error_t fdb_tpu_transaction_clear(FDBTpuTransaction* tr,
+                                          const uint8_t* key, int key_length);
+fdb_tpu_error_t fdb_tpu_transaction_clear_range(FDBTpuTransaction* tr,
+                                                const uint8_t* begin,
+                                                int begin_length,
+                                                const uint8_t* end,
+                                                int end_length);
+fdb_tpu_error_t fdb_tpu_transaction_atomic_op(FDBTpuTransaction* tr,
+                                              const uint8_t* key,
+                                              int key_length,
+                                              const uint8_t* param,
+                                              int param_length,
+                                              int operation_type);
+
+/* write=0 adds a read conflict range, write=1 a write conflict range */
+fdb_tpu_error_t fdb_tpu_transaction_add_conflict_range(
+    FDBTpuTransaction* tr, const uint8_t* begin, int begin_length,
+    const uint8_t* end, int end_length, int write);
+
+fdb_tpu_error_t fdb_tpu_transaction_commit(FDBTpuTransaction* tr,
+                                           int64_t* out_committed_version);
+
+/* 10-byte versionstamp of the last commit (8B BE version + 2B BE batch
+ * index); buffer malloc'd. Errors if the transaction has not committed. */
+fdb_tpu_error_t fdb_tpu_transaction_get_versionstamp(FDBTpuTransaction* tr,
+                                                     uint8_t** out_stamp,
+                                                     int* out_length);
+
+/* The standard retry protocol: returns 0 after backoff/reset when the
+ * error is retryable (refreshing the cluster picture when it implies a
+ * stale one), else returns the error back (ref: fdb_transaction_on_error). */
+fdb_tpu_error_t fdb_tpu_transaction_on_error(FDBTpuTransaction* tr,
+                                             fdb_tpu_error_t code);
+
+void fdb_tpu_free(void* p);
+void fdb_tpu_free_keyvalues(FDBTpuKeyValue* kv, int count);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* FDB_TPU_C_H */

@@ -1,0 +1,268 @@
+"""ctypes harness for the native C client (bindings/c/fdb_tpu.cpp).
+
+Reference: the reference's Python binding sits on fdb_c via ctypes
+(bindings/python/fdb/impl.py loading libfdb_c); this module is the same
+seam pointed at this framework's C library, used by the cross-binding
+parity tests and available as a C-backed client for out-of-process
+access through a cluster's TcpGateway.
+
+Calls are blocking (the C library is a synchronous native client), so
+use this from a plain thread — NOT from inside the flow scheduler, which
+must stay free to serve the cluster the C client is talking to.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO_ROOT, "bindings", "c")
+_LIB_PATH = os.path.join(_SRC_DIR, "build", "libfdb_tpu_c.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+class CClientError(Exception):
+    def __init__(self, code: int, name: str):
+        super().__init__(f"{name} ({code})")
+        self.code = code
+        self.name = name
+
+
+def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if build_if_missing:
+        try:
+            subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                           capture_output=True)
+        except FileNotFoundError:
+            if not os.path.exists(_LIB_PATH):
+                raise
+    lib = ctypes.CDLL(_LIB_PATH)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.fdb_tpu_get_error.restype = ctypes.c_char_p
+    lib.fdb_tpu_get_error.argtypes = [ctypes.c_int]
+    lib.fdb_tpu_error_retryable.restype = ctypes.c_int
+    lib.fdb_tpu_error_retryable.argtypes = [ctypes.c_int]
+    lib.fdb_tpu_create_database.restype = ctypes.c_int
+    lib.fdb_tpu_create_database.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)]
+    lib.fdb_tpu_database_destroy.argtypes = [ctypes.c_void_p]
+    lib.fdb_tpu_database_create_transaction.restype = ctypes.c_int
+    lib.fdb_tpu_database_create_transaction.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.fdb_tpu_transaction_destroy.argtypes = [ctypes.c_void_p]
+    lib.fdb_tpu_transaction_reset.argtypes = [ctypes.c_void_p]
+    lib.fdb_tpu_transaction_get_read_version.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_get_read_version.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.fdb_tpu_transaction_get.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.fdb_tpu_transaction_get_key.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_get_key.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.fdb_tpu_transaction_get_range.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_get_range.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int)]
+    lib.fdb_tpu_transaction_set.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_set.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int]
+    lib.fdb_tpu_transaction_clear.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_clear.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.fdb_tpu_transaction_clear_range.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_clear_range.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int]
+    lib.fdb_tpu_transaction_atomic_op.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_atomic_op.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int]
+    lib.fdb_tpu_transaction_add_conflict_range.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_add_conflict_range.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int]
+    lib.fdb_tpu_transaction_commit.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_commit.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.fdb_tpu_transaction_get_versionstamp.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_get_versionstamp.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int)]
+    lib.fdb_tpu_transaction_on_error.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_on_error.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_int]
+    lib.fdb_tpu_free.argtypes = [ctypes.c_void_p]
+    lib.fdb_tpu_free_keyvalues.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+class _KeyValue(ctypes.Structure):
+    _fields_ = [("key", ctypes.POINTER(ctypes.c_uint8)),
+                ("key_length", ctypes.c_int),
+                ("value", ctypes.POINTER(ctypes.c_uint8)),
+                ("value_length", ctypes.c_int)]
+
+
+def _check(lib, code: int) -> None:
+    if code != 0:
+        raise CClientError(code, lib.fdb_tpu_get_error(code).decode())
+
+
+def _take_bytes(lib, ptr, length: int) -> bytes:
+    try:
+        return ctypes.string_at(ptr, length) if length else b""
+    finally:
+        lib.fdb_tpu_free(ptr)
+
+
+class CDatabase:
+    """Out-of-process database handle over a TcpGateway."""
+
+    def __init__(self, host: str, port: int):
+        self.lib = load_library()
+        handle = ctypes.c_void_p()
+        _check(self.lib, self.lib.fdb_tpu_create_database(
+            host.encode(), port, ctypes.byref(handle)))
+        self._h = handle
+
+    def close(self) -> None:
+        if self._h:
+            self.lib.fdb_tpu_database_destroy(self._h)
+            self._h = None
+
+    def create_transaction(self) -> "CTransaction":
+        handle = ctypes.c_void_p()
+        _check(self.lib, self.lib.fdb_tpu_database_create_transaction(
+            self._h, ctypes.byref(handle)))
+        return CTransaction(self.lib, handle)
+
+    def run(self, body, max_retries: int = 100):
+        """The standard retry loop over the C on_error protocol."""
+        tr = self.create_transaction()
+        try:
+            for _ in range(max_retries):
+                try:
+                    result = body(tr)
+                    tr.commit()
+                    return result
+                except CClientError as e:
+                    tr.on_error(e.code)
+        finally:
+            tr.destroy()
+        raise CClientError(1031, "transaction_timed_out")
+
+
+class CTransaction:
+    def __init__(self, lib, handle):
+        self.lib = lib
+        self._h = handle
+
+    def destroy(self) -> None:
+        if self._h:
+            self.lib.fdb_tpu_transaction_destroy(self._h)
+            self._h = None
+
+    def reset(self) -> None:
+        self.lib.fdb_tpu_transaction_reset(self._h)
+
+    def get_read_version(self) -> int:
+        out = ctypes.c_int64()
+        _check(self.lib, self.lib.fdb_tpu_transaction_get_read_version(
+            self._h, ctypes.byref(out)))
+        return out.value
+
+    def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        present = ctypes.c_int()
+        val = ctypes.POINTER(ctypes.c_uint8)()
+        vlen = ctypes.c_int()
+        _check(self.lib, self.lib.fdb_tpu_transaction_get(
+            self._h, key, len(key), int(snapshot), ctypes.byref(present),
+            ctypes.byref(val), ctypes.byref(vlen)))
+        if not present.value:
+            return None
+        return _take_bytes(self.lib, val, vlen.value)
+
+    def get_key(self, key: bytes, or_equal: bool, offset: int,
+                snapshot: bool = False) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        olen = ctypes.c_int()
+        _check(self.lib, self.lib.fdb_tpu_transaction_get_key(
+            self._h, key, len(key), int(or_equal), offset, int(snapshot),
+            ctypes.byref(out), ctypes.byref(olen)))
+        return _take_bytes(self.lib, out, olen.value)
+
+    def get_range(self, begin: bytes, end: bytes, limit: int = 0,
+                  reverse: bool = False,
+                  snapshot: bool = False) -> List[Tuple[bytes, bytes]]:
+        arr = ctypes.c_void_p()
+        count = ctypes.c_int()
+        _check(self.lib, self.lib.fdb_tpu_transaction_get_range(
+            self._h, begin, len(begin), end, len(end), limit, int(reverse),
+            int(snapshot), ctypes.byref(arr), ctypes.byref(count)))
+        try:
+            kvs = ctypes.cast(arr, ctypes.POINTER(_KeyValue))
+            out = []
+            for i in range(count.value):
+                kv = kvs[i]
+                out.append((
+                    ctypes.string_at(kv.key, kv.key_length)
+                    if kv.key_length else b"",
+                    ctypes.string_at(kv.value, kv.value_length)
+                    if kv.value_length else b""))
+            return out
+        finally:
+            self.lib.fdb_tpu_free_keyvalues(arr, count.value)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        _check(self.lib, self.lib.fdb_tpu_transaction_set(
+            self._h, key, len(key), value, len(value)))
+
+    def clear(self, key: bytes) -> None:
+        _check(self.lib, self.lib.fdb_tpu_transaction_clear(
+            self._h, key, len(key)))
+
+    def clear_range(self, begin: bytes, end: bytes) -> None:
+        _check(self.lib, self.lib.fdb_tpu_transaction_clear_range(
+            self._h, begin, len(begin), end, len(end)))
+
+    def atomic_op(self, key: bytes, param: bytes, op_type: int) -> None:
+        _check(self.lib, self.lib.fdb_tpu_transaction_atomic_op(
+            self._h, key, len(key), param, len(param), op_type))
+
+    def add_conflict_range(self, begin: bytes, end: bytes,
+                           write: bool) -> None:
+        _check(self.lib, self.lib.fdb_tpu_transaction_add_conflict_range(
+            self._h, begin, len(begin), end, len(end), int(write)))
+
+    def commit(self) -> int:
+        out = ctypes.c_int64()
+        _check(self.lib, self.lib.fdb_tpu_transaction_commit(
+            self._h, ctypes.byref(out)))
+        return out.value
+
+    def get_versionstamp(self) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        olen = ctypes.c_int()
+        _check(self.lib, self.lib.fdb_tpu_transaction_get_versionstamp(
+            self._h, ctypes.byref(out), ctypes.byref(olen)))
+        return _take_bytes(self.lib, out, olen.value)
+
+    def on_error(self, code: int) -> None:
+        err = self.lib.fdb_tpu_transaction_on_error(self._h, code)
+        if err != 0:
+            raise CClientError(err, self.lib.fdb_tpu_get_error(err).decode())

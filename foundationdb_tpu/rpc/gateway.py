@@ -1,0 +1,140 @@
+"""TCP gateway: the cluster's client-facing endpoints on real sockets.
+
+Reference: in the reference every role endpoint is served directly by
+FlowTransport on the process's listen address, and out-of-process
+clients (the C binding linking NativeAPI) reach it by token
+(fdbrpc/FlowTransport.actor.cpp:517 deliver; bindings/c/fdb_c.cpp is a
+thin ABI over that client). Here the cluster's role endpoints live on
+the in-process flow scheduler, so the gateway plays the listen-address
+seam: each client-visible endpoint (proxy GRV/commit, storage
+get/range/get_key) is assigned a real TCP token whose frames are
+forwarded into the role's RequestStream and whose replies travel back
+over the same wire format the simulator round-trips.
+
+The describe endpoint (fixed token 1) plays MonitorLeader +
+openDatabase: it serves a token-translated ServerDBInfo (proxy and
+shard maps), long-polling the ClusterController through the attached
+Database when the client's picture went stale — exactly the client
+recovery path (fdbclient/MonitorLeader.actor.cpp, NativeAPI
+getClientInfo), so an out-of-process client rides epoch recoveries the
+same way in-process ones do.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .. import flow
+from ..flow import error
+from .tcp import TcpRequestStream, TcpTransport
+
+DESCRIBE_TOKEN = 1
+
+
+class TcpGateway:
+    """Serve a cluster (via its client `Database` handle) over TCP."""
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0):
+        self.db = db
+        self.transport = TcpTransport(host, port)
+        self._describe = TcpRequestStream(self.transport)
+        assert self._describe.token == DESCRIBE_TOKEN, \
+            "describe must be the transport's first registered endpoint"
+        #: (process name, sim token) -> tcp token
+        self._exposed: Dict[Tuple[str, int], int] = {}
+        self._actors: List[object] = []
+
+    @property
+    def port(self) -> int:
+        return self.transport.port
+
+    def start(self) -> None:
+        self.transport.start()
+        self._actors.append(flow.spawn(
+            self._describe_loop(), name=f"gateway:{self.port}.describe"))
+
+    def close(self) -> None:
+        self.transport.close()
+        for a in self._actors:
+            a.cancel()
+        self._actors.clear()
+
+    # -- endpoint exposure ----------------------------------------------
+    def _expose(self, ref) -> int:
+        """TCP token for a sim NetworkRef, forwarding frames to it.
+
+        Tokens are cached by (process, sim-token) identity: after a
+        recovery the same describe tokens keep working for surviving
+        roles, while new-epoch roles get fresh tokens in the next
+        describe — dead tokens answer broken_promise, which the client
+        treats as a stale-picture refresh signal.
+        """
+        ep = ref.endpoint
+        key = (ep.process.name, ep.token)
+        token = self._exposed.get(key)
+        if token is None:
+            stream = TcpRequestStream(self.transport)
+            token = stream.token
+            self._exposed[key] = token
+            self._actors.append(flow.spawn(
+                self._forward_loop(stream, ref),
+                name=f"gateway:{self.port}.fwd.{ep.process.name}"))
+        return token
+
+    async def _forward_loop(self, stream: TcpRequestStream, ref) -> None:
+        while True:
+            req, reply = await stream.pop()
+            flow.spawn(self._forward_one(ref, req, reply))
+
+    async def _forward_one(self, ref, req, reply) -> None:
+        try:
+            reply.send(await ref.get_reply(req, self.db.process))
+        except flow.FdbError as e:
+            reply.send_error(e)
+        except Exception:  # noqa: BLE001 — a bad frame fails only itself
+            reply.send_error(error("internal_error"))
+
+    # -- describe --------------------------------------------------------
+    async def _describe_loop(self) -> None:
+        while True:
+            req, reply = await self._describe.pop()
+            flow.spawn(self._describe_one(req, reply))
+
+    async def _describe_one(self, min_seq, reply) -> None:
+        """Request payload: the newest dbinfo seq the client has seen
+        (-1 for "whatever is current"). A non-negative seq long-polls
+        the CC until the broadcast picture moves past it (the client's
+        post-failure refresh), mirroring Database.refresh_past."""
+        try:
+            if isinstance(min_seq, int) and min_seq >= 0:
+                await self.db.refresh_past(min_seq)
+            info = await self.db.info()
+            reply.send(self._translate(info))
+        except flow.FdbError as e:
+            reply.send_error(e)
+        except Exception:  # noqa: BLE001
+            reply.send_error(error("internal_error"))
+
+    def _translate(self, info) -> dict:
+        """ServerDBInfo with every NetworkRef replaced by a TCP token
+        (refs themselves cannot cross this wire: their encoding names a
+        sim process, meaningless to an out-of-process peer)."""
+        return {
+            "seq": info.seq,
+            "epoch": info.epoch,
+            "recovery_state": info.recovery_state,
+            "proxies": [
+                {"grvs": self._expose(p.grvs),
+                 "commits": self._expose(p.commits)}
+                for p in info.proxies],
+            "shards": [
+                {"begin": s.begin,
+                 "end": s.end if s.end is not None else b"",
+                 "has_end": s.end is not None,
+                 "replicas": [
+                     {"gets": self._expose(r.gets),
+                      "ranges": self._expose(r.ranges),
+                      "get_keys": self._expose(r.get_keys)}
+                     for r in s.replicas]}
+                for s in info.storages],
+        }

@@ -35,8 +35,10 @@ class SimCluster:
                  auto_reboot: bool = True, buggify: bool = False,
                  storage_engine: str = "memory",
                  storage_replicas: int = 1,
-                 share_with: "SimCluster" = None, name_prefix: str = ""):
+                 share_with: "SimCluster" = None, name_prefix: str = "",
+                 virtual: bool = True):
         self.prefix = name_prefix
+        self._owns_scheduler = share_with is None
         if share_with is not None:
             # a second cluster INSIDE the same deterministic simulation
             # (multi-cluster tests: DR, cross-cluster tooling) — shares
@@ -49,8 +51,10 @@ class SimCluster:
             # `if (randomize && BUGGIFY)` in Knobs.cpp); always re-init
             # so a prior run's distorted knobs never leak into this one
             flow.reset_server_knobs(randomize=buggify)
+            # virtual=False runs the same cluster on the wall clock so
+            # real-socket peers (the TCP gateway + C binding) can attach
             self.sched = flow.Scheduler(start_time=start_time,
-                                        virtual=True)
+                                        virtual=virtual)
             flow.set_scheduler(self.sched)
             self.net = SimNetwork(self.sched, flow.g_random)
         self.durable = durable
@@ -200,5 +204,7 @@ class SimCluster:
         return self.sched.run(until=task, timeout_time=timeout_time)
 
     def shutdown(self) -> None:
-        if self.prefix == "":
+        # only the cluster that created the scheduler tears it down — a
+        # share_with secondary must not pull it from under the primary
+        if self._owns_scheduler:
             flow.set_scheduler(None)
