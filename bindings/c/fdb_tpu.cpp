@@ -333,11 +333,10 @@ struct ConnState {
     void die_locked() {
         if (dead) return;
         dead = true;
-        if (fd >= 0) {
-            ::shutdown(fd, SHUT_RDWR);
-            ::close(fd);
-            fd = -1;
-        }
+        /* shutdown() only — the reader thread owns the close: closing
+         * here would race its recv() against kernel fd-number reuse by
+         * a reconnect */
+        if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
         for (auto& kv : pending) {
             kv.second->done = true;
             kv.second->kind = K_ERROR;
@@ -402,6 +401,15 @@ void reader_thread(std::shared_ptr<ConnState> st) {
         }
     }
     st->die();
+    {
+        /* sole closer of the fd (see die_locked); writers hold the
+         * mutex for their whole write, so this cannot race a send */
+        std::lock_guard<std::mutex> g(st->mut);
+        if (st->fd >= 0) {
+            ::close(st->fd);
+            st->fd = -1;
+        }
+    }
 }
 
 struct Conn {
@@ -870,7 +878,7 @@ fdb_tpu_error_t fdb_tpu_create_database(const char* host, int port,
     db->conn.port = port;
     fdb_tpu_error_t err = db->describe(-1);
     if (err) {
-        delete db;
+        fdb_tpu_database_destroy(db); /* reaps the reader thread + fd */
         return err;
     }
     *out_db = db;

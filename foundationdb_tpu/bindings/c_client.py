@@ -41,7 +41,9 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
         try:
             subprocess.run(["make", "-C", _SRC_DIR], check=True,
                            capture_output=True)
-        except FileNotFoundError:
+        except (FileNotFoundError, subprocess.CalledProcessError):
+            # missing or failing toolchain: a prebuilt library may still
+            # serve; with none, the build failure is the real error
             if not os.path.exists(_LIB_PATH):
                 raise
     lib = ctypes.CDLL(_LIB_PATH)

@@ -188,15 +188,19 @@ class BackupAgent:
                 info.proxies[0].commits.get_reply(
                     CommitRequest(0, (), (), ()), self.db.process), 1.0))
 
-    async def wait_tailed_to(self, version: int, max_wait: float = 30.0):
+    async def _wait_until(self, pred, max_wait: float) -> None:
+        """Poll with commit nudges: the tail/apply frontiers only
+        advance through known_committed, which needs fresh commits on
+        an idle cluster."""
         deadline = flow.now() + max_wait
-        while self._tailed_to < version:
+        while not pred():
             if flow.now() > deadline:
                 raise flow.error("timed_out")
-            # the tail only advances through known_committed, which
-            # needs fresh commits on an idle cluster
             await self._nudge_commit()
             await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+
+    async def wait_tailed_to(self, version: int, max_wait: float = 30.0):
+        await self._wait_until(lambda: self._tailed_to >= version, max_wait)
 
     # -- container -------------------------------------------------------
     def write_log(self) -> bytes:
@@ -241,6 +245,22 @@ def read_log(blob: bytes):
     return base_version, records
 
 
+def _replay_mutations(tr, mutations) -> None:
+    """Replay one logged mutation batch into a transaction — the single
+    apply switch shared by restore and DR (a replayable type added here
+    serves both paths)."""
+    from ..server.types import ATOMIC_OPS, CLEAR_RANGE, SET_VALUE
+    for m in mutations:
+        if m.type == SET_VALUE:
+            tr.set(m.param1, m.param2)
+        elif m.type == CLEAR_RANGE:
+            tr.clear_range(m.param1, m.param2)
+        elif m.type in ATOMIC_OPS:
+            tr.atomic_op(m.param1, m.param2, m.type)
+        else:
+            raise ValueError(f"unreplayable mutation {m.type}")
+
+
 async def restore_to_version(db, snapshot_blob: bytes, log_blob: bytes,
                              target_version: int,
                              max_retries: int = 300) -> int:
@@ -248,7 +268,6 @@ async def restore_to_version(db, snapshot_blob: bytes, log_blob: bytes,
     mutation in (base_version, target_version], applied in exact
     commit order (ref: the restore apply loop replaying log files)."""
     from ..client import run_transaction
-    from ..server.types import (ATOMIC_OPS, CLEAR_RANGE, SET_VALUE)
 
     base_version, records = read_log(log_blob)
     if target_version < base_version:
@@ -273,15 +292,7 @@ async def restore_to_version(db, snapshot_blob: bytes, log_blob: bytes,
             # pattern for restore apply)
             if await tr.get(marker) is not None:
                 return
-            for m in chunk:
-                if m.type == SET_VALUE:
-                    tr.set(m.param1, m.param2)
-                elif m.type == CLEAR_RANGE:
-                    tr.clear_range(m.param1, m.param2)
-                elif m.type in ATOMIC_OPS:
-                    tr.atomic_op(m.param1, m.param2, m.type)
-                else:
-                    raise ValueError(f"unreplayable mutation {m.type}")
+            _replay_mutations(tr, chunk)
             tr.set(marker, b"1")
         await run_transaction(db, body, max_retries=max_retries)
         applied += len(chunk)
@@ -299,12 +310,15 @@ class DrAgent(BackupAgent):
     destination converges to each source version in commit order;
     chunk markers make the apply exactly-once across retries."""
 
+    MARKER_SPACE = b"\x02dr-mark/"
+
     def __init__(self, cluster, db, dest_db):
         super().__init__(cluster, db)
         self.dest_db = dest_db
         self.applied_version = 0
         self._apply_task = None
         self._applied_idx = 0
+        self._apply_error: Optional[BaseException] = None
 
     async def start(self) -> int:
         """Snapshot into the destination, then stream the tail."""
@@ -320,23 +334,42 @@ class DrAgent(BackupAgent):
         await super().stop()
         if self._apply_task is not None:
             await flow.catch_errors(self._apply_task)
+        if self._apply_error is not None:
+            raise self._apply_error
+        # the idempotency markers served their purpose: leave the
+        # destination byte-identical to the source's replicated range
+        from ..client import run_transaction
+
+        async def clear_markers(tr):
+            tr.clear_range(self.MARKER_SPACE, self.MARKER_SPACE + b"\xff")
+        await run_transaction(self.dest_db, clear_markers, max_retries=300)
 
     async def wait_applied_to(self, version: int,
                               max_wait: float = 60.0) -> None:
-        deadline = flow.now() + max_wait
-        while self.applied_version < version:
-            if flow.now() > deadline:
-                raise flow.error("timed_out")
-            await self._nudge_commit()
-            await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+        def pred():
+            if self._apply_error is not None:
+                raise self._apply_error
+            return self.applied_version >= version
+        await self._wait_until(pred, max_wait)
 
     async def _apply_loop(self) -> None:
+        try:
+            await self._apply_records()
+        except flow.ActorCancelled:
+            raise
+        except BaseException as e:  # noqa: BLE001 — surfaced to waiters
+            self._apply_error = e
+
+    async def _apply_records(self) -> None:
         from ..client import run_transaction
-        from ..server.types import ATOMIC_OPS, CLEAR_RANGE, SET_VALUE
-        marker_space = b"\x02dr-mark/"
         while not (self._stop and
                    self._applied_idx >= len(self.log_records)):
             if self._applied_idx >= len(self.log_records):
+                # drained: everything at or below the tail frontier is
+                # applied — a version with no backup-tagged record
+                # (empty nudge commits) must still become waitable
+                self.applied_version = max(self.applied_version,
+                                           self._tailed_to)
                 await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
                 continue
             i = self._applied_idx
@@ -345,21 +378,12 @@ class DrAgent(BackupAgent):
             if v <= self.base_version:
                 self.applied_version = max(self.applied_version, v)
                 continue
-            marker = marker_space + b"%012d" % i
+            marker = self.MARKER_SPACE + b"%012d" % i
 
             async def body(tr, mutations=mutations, marker=marker):
                 if await tr.get(marker) is not None:
                     return
-                for m in mutations:
-                    if m.type == SET_VALUE:
-                        tr.set(m.param1, m.param2)
-                    elif m.type == CLEAR_RANGE:
-                        tr.clear_range(m.param1, m.param2)
-                    elif m.type in ATOMIC_OPS:
-                        tr.atomic_op(m.param1, m.param2, m.type)
-                    else:
-                        raise ValueError(
-                            f"unreplayable mutation {m.type}")
+                _replay_mutations(tr, mutations)
                 tr.set(marker, b"1")
             await run_transaction(self.dest_db, body, max_retries=300)
             self.applied_version = max(self.applied_version, v)

@@ -46,6 +46,13 @@ class GatewayedCluster:
     def __exit__(self, *exc):
         self.stop.set()
         self.thread.join(timeout=120)
+        # surface any simulation-thread crash that happened after the
+        # port was handed out — otherwise it shows up only as an opaque
+        # C-client timeout
+        while not self.q.empty():
+            item = self.q.get_nowait()
+            if isinstance(item, BaseException) and exc == (None, None, None):
+                raise item
 
     def _main(self):
         import foundationdb_tpu.flow as fl
