@@ -75,6 +75,14 @@ class Database:
         self._info = None
         self._grv_waiters: List[Future] = []
         self._grv_timer_armed = False
+        #: replica name -> latency EMA seconds (ref: LoadBalance's
+        #: per-alternative latency model, fdbrpc/LoadBalance.actor.h)
+        self._latency_ema: Dict[str, float] = {}
+
+    def note_latency(self, replica: str, seconds: float) -> None:
+        prev = self._latency_ema.get(replica)
+        self._latency_ema[replica] = seconds if prev is None else \
+            0.9 * prev + 0.1 * seconds
 
     async def get_status(self) -> dict:
         """The cluster status document (ref: StatusClient fetching the
@@ -240,22 +248,55 @@ class Transaction:
         return info.storages[_shard_index(info.storages, key)]
 
     async def _storage_rpc(self, shard, fn):
-        """Replica-parallel reads: try the shard's replicas in rotated
-        order, failing over on connection-class errors (ref:
-        loadBalance, fdbrpc/LoadBalance.actor.h — replica selection +
-        failover; latency modeling is future work)."""
-        n = len(shard.replicas)
-        start = flow.g_random.random_int(0, n)
-        last = None
-        for j in range(n):
-            rep = shard.replicas[(start + j) % n]
-            try:
-                return await _rpc(fn(rep))
-            except flow.FdbError as e:
-                if e.name not in ("broken_promise", "timed_out"):
-                    raise
-                last = e
-        raise last
+        """Latency-modeled replica selection with backup requests (ref:
+        fdbrpc/LoadBalance.actor.h — alternatives ordered by measured
+        latency; a slow first choice gets a duplicate request to the
+        next alternative and the first reply wins; connection-class
+        failures penalize the replica's model and rotate on)."""
+        db = self.db
+        ema = db._latency_ema
+        reps = list(shard.replicas)
+        start = flow.g_random.random_int(0, len(reps))
+        reps = reps[start:] + reps[:start]     # tie-break rotation
+        reps.sort(key=lambda r: ema.get(r.name, 0.0))  # stable sort
+        inflight = []   # (replica, settled-wrapper, t0)
+        last_err = None
+        idx = 0
+        while True:
+            if not inflight:
+                if idx >= len(reps):
+                    raise last_err or error("all_alternatives_failed")
+                rep = reps[idx]
+                idx += 1
+                inflight.append((rep, flow.catch_errors(_rpc(fn(rep))),
+                                 flow.now()))
+            race = [w for _, w, _ in inflight]
+            if idx < len(reps):
+                race.append(flow.delay(
+                    SERVER_KNOBS.load_balance_backup_delay))
+            i, settled = await flow.first_of(*race)
+            if i >= len(inflight):
+                # backup window elapsed: duplicate to the next replica
+                rep = reps[idx]
+                idx += 1
+                inflight.append((rep, flow.catch_errors(_rpc(fn(rep))),
+                                 flow.now()))
+                continue
+            rep, _w, t0 = inflight.pop(i)
+            if not settled.is_error:
+                db.note_latency(rep.name, flow.now() - t0)
+                # abandoned rivals still pay: elapsed-so-far is a true
+                # lower bound on their latency — without it a slow
+                # replica never enters the model and (defaulting to 0)
+                # would sort FIRST on every later read
+                for lrep, _lw, lt0 in inflight:
+                    db.note_latency(lrep.name, flow.now() - lt0)
+                return settled.get()
+            e = settled.exception()
+            if e.name not in ("broken_promise", "timed_out"):
+                raise e
+            db.note_latency(rep.name, REQUEST_TIMEOUT)  # penalty
+            last_err = e
 
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:

@@ -60,6 +60,7 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("KEY_SIZE_LIMIT", 10_000)
     init("VALUE_SIZE_LIMIT", 100_000)
     init("RESOLVER_COALESCE_TIME", 1.0)
+    init("LOAD_BALANCE_BACKUP_DELAY", 0.005, lambda: 0.0005)
     init("SAMPLE_EXPIRATION_TIME", 1.0)
     return k
 

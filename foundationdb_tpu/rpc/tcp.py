@@ -19,10 +19,11 @@ transport's closed-connection semantics.
 from __future__ import annotations
 
 import socket
+import ssl
 import struct
 import threading
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, NamedTuple, Optional
 
 from .. import flow
 from ..flow import TaskPriority, error
@@ -35,6 +36,35 @@ PROTOCOL_VERSION = b"fdbtpu01"
 K_REQUEST, K_REPLY, K_ERROR = 0, 1, 2
 HANDSHAKE_TIMEOUT = 5.0
 CONNECT_TIMEOUT = 5.0
+
+
+class TlsConfig(NamedTuple):
+    """Mutual-TLS configuration for a transport (ref: FDBLibTLS — both
+    sides present certificates and verify the peer's chain; the
+    reference plugs this in under FlowTransport the same way)."""
+
+    certfile: str
+    keyfile: str
+    cafile: str
+    verify_peer: bool = True
+
+    def server_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.certfile, self.keyfile)
+        if self.verify_peer:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            ctx.load_verify_locations(self.cafile)
+        return ctx
+
+    def client_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        # peers authenticate by certificate chain, not hostname — the
+        # reference's TLS verifies subject/issuer fields, not DNS names
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(self.cafile)
+        ctx.load_cert_chain(self.certfile, self.keyfile)
+        return ctx
 
 
 class TcpReply:
@@ -117,10 +147,20 @@ class _Conn:
             if self.sock is None:
                 self.sock = socket.create_connection(
                     self.addr, timeout=CONNECT_TIMEOUT)
+                ctx = self.transport.tls_client_ctx()
+                if ctx is not None:
+                    # TLS handshake before the protocol tag, exactly
+                    # where the reference's TLS sits: beneath the
+                    # ConnectPacket (FDBLibTLS under FlowTransport)
+                    self.sock = ctx.wrap_socket(self.sock)
                 self.sock.settimeout(None)
                 self.sock.sendall(PROTOCOL_VERSION)
             elif self.handshake_in:
                 self.sock.settimeout(HANDSHAKE_TIMEOUT)
+                ctx = self.transport.tls_server_ctx()
+                if ctx is not None:
+                    self.sock = ctx.wrap_socket(self.sock,
+                                                server_side=True)
                 if _read_exact(self.sock, len(PROTOCOL_VERSION)) != \
                         PROTOCOL_VERSION:
                     raise OSError("bad handshake")
@@ -182,8 +222,14 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 class TcpTransport:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 tls: Optional[TlsConfig] = None):
         self.host = host
+        self.tls = tls
+        # contexts built once and shared by every connection (cert files
+        # are read at transport creation, not per reconnect)
+        self._tls_server_ctx = tls.server_context() if tls else None
+        self._tls_client_ctx = tls.client_context() if tls else None
         self._streams: Dict[int, TcpRequestStream] = {}
         self._next_token = 1
         self._next_req = 1
@@ -197,6 +243,12 @@ class TcpTransport:
         self._srv.bind((host, port))
         self._srv.listen(16)
         self.port = self._srv.getsockname()[1]
+
+    def tls_server_ctx(self):
+        return self._tls_server_ctx
+
+    def tls_client_ctx(self):
+        return self._tls_client_ctx
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:

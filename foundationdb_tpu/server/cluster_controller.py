@@ -94,6 +94,8 @@ class ClusterController:
         # when the monitor next looks, never lost (code review r3)
         self._config_dirty = False
         self._move_inflight = False        # one shard move at a time
+        self._vacate_seq = 0               # unique vacate-replica names
+        self._vacate_retry_at = 0.0        # backoff for stuck vacates
         self.backup_active = False         # continuous-backup tagging
         self.backup_agent = None           # the live agent, when any
         # authoritative shard boundaries (ref: the keyServers system
@@ -250,19 +252,35 @@ class ClusterController:
 
     # -- recruitment helpers (used by MasterRecovery) -------------------
     def pick_workers(self, n: int, role: str):
-        """Round-robin over live, non-excluded workers (ref:
-        fitness-ranked selection in clusterRecruitFromConfiguration —
-        the sim has one process class, so rotation stands in for
-        fitness)."""
-        live = [wi.worker for name, wi in self.workers.items()
+        """Policy-placed selection over live, non-excluded workers:
+        replicas land in distinct zones (machines) when the worker pool
+        allows it — PolicyAcross(n, zoneid, One()) — degrading to
+        round-robin when it cannot (ref: clusterRecruitFromConfiguration
+        applying the configuration's storagePolicy/tLogPolicy;
+        fdbrpc/ReplicationPolicy.h). Candidate order rotates so
+        consecutive recruitments spread roles the way the reference's
+        fitness ranking does."""
+        from .replication_policy import Locality, PolicyAcross, PolicyOne
+        live = [wi for name, wi in self.workers.items()
                 if wi.worker.process.alive and name not in self.excluded]
         if not live:
             raise error("no_more_servers")
-        out = []
-        for _ in range(n):
-            out.append(live[self._rr % len(live)])
-            self._rr += 1
-        return out
+        rot = self._rr % len(live)
+        self._rr += n
+        ordered = live[rot:] + live[:rot]
+        cands = [(wi.worker, Locality(processid=wi.name, zoneid=wi.machine,
+                                      machineid=wi.machine, dcid="dc0"))
+                 for wi in ordered]
+        team = PolicyAcross(n, "zoneid", PolicyOne()).select(cands)
+        if team is not None:
+            return team
+        # not enough failure domains: place anyway, spread round-robin
+        # (the reference recruits in degraded mode rather than stall)
+        flow.TraceEvent("RecruitmentPolicyDegraded", self.process.name,
+                        severity=flow.trace.SevWarn).detail(
+            Role=role, Needed=n, Zones=len({wi.machine for wi in live})
+        ).log()
+        return [ordered[i % len(ordered)].worker for i in range(n)]
 
     def storage_splits(self) -> Tuple[bytes, ...]:
         info = self.dbinfo.get()
@@ -479,8 +497,15 @@ class ClusterController:
         while True:
             await flow.delay(2.0, TaskPriority.DATA_DISTRIBUTION)
             info = self.dbinfo.get()
-            if info.recovery_state != FULLY_RECOVERED or \
-                    self._move_inflight or len(info.storages) < 2:
+            if info.recovery_state != FULLY_RECOVERED or self._move_inflight:
+                continue
+            # exclusion-driven vacates first: data must leave excluded
+            # workers before balance moves matter (ref: the exclusion
+            # check in dataDistribution — removeKeysFromFailedServers /
+            # teams containing excluded servers get rebuilt)
+            if await self._vacate_excluded(info):
+                continue
+            if len(info.storages) < 2:
                 continue
             teams = [[self._storage_objs.get(rep.name)
                       for rep in s.replicas] for s in info.storages]
@@ -514,6 +539,142 @@ class ClusterController:
                         severity=flow.trace.SevWarnAlways).detail(
                         Error=repr(e)).log()
                 break
+
+    def _worker_of_role(self, role_name: str):
+        for name, wi in self.workers.items():
+            if role_name in wi.worker.roles:
+                return name, wi
+        return None, None
+
+    async def _vacate_excluded(self, info) -> bool:
+        """Move one storage replica off an excluded worker (ref:
+        exclusion handling in DataDistribution — a team containing an
+        excluded server is unhealthy; its data is re-replicated onto an
+        included server, then the old server is removed). Returns True
+        when a vacate ran (or was attempted) this tick."""
+        if flow.now() < self._vacate_retry_at:
+            return False
+        for si, shard in enumerate(info.storages):
+            for rep in shard.replicas:
+                wname, _wi = self._worker_of_role(rep.name)
+                if wname is not None and wname in self.excluded:
+                    try:
+                        await self._replace_replica(si, rep.name)
+                        return True
+                    except Exception as e:  # noqa: BLE001 — DD survives
+                        flow.TraceEvent(
+                            "VacateExcludedError", self.process.name,
+                            severity=flow.trace.SevWarnAlways).detail(
+                            Replica=rep.name, Error=repr(e)).log()
+                        # back off a stuck vacate (e.g. no eligible
+                        # destination) so balance moves aren't starved
+                        # by a 2s retry storm
+                        self._vacate_retry_at = flow.now() + 30.0
+                        return False
+        return False
+
+    async def _replace_replica(self, shard_idx: int, old_name: str) -> None:
+        """Re-home one replica of a shard onto an included worker: the
+        whole-shard fetchKeys — recruit (buffering from the log), add
+        the newcomer to every TLog's expected set so its records are
+        pinned, snapshot from a live teammate, install, publish the
+        swapped team, retire the old role (ref: MoveKeys.actor.cpp
+        startMoveKeys/finishMoveKeys over a full server team change)."""
+        info = self.dbinfo.get()
+        shard = info.storages[shard_idx]
+        epoch0 = info.epoch
+        team_workers = {self._worker_of_role(rep.name)[0]
+                        for rep in shard.replicas}
+        # destination: included, live, not already hosting this shard;
+        # prefer a zone the team doesn't cover (the replication policy)
+        cands = [wi for name, wi in self.workers.items()
+                 if wi.worker.process.alive and name not in self.excluded
+                 and name not in team_workers]
+        if not cands:
+            raise error("no_more_servers")
+        fresh_zone = [wi for wi in cands if wi.machine not in
+                      {self.workers[w].machine for w in team_workers
+                       if w in self.workers}]
+        dst_wi = (fresh_zone or cands)[self._rr % len(fresh_zone or cands)]
+        self._rr += 1
+        # source: a LIVE teammate (the excluded server may itself be the
+        # only live copy — exclusion is not death)
+        src = None
+        for rep in shard.replicas:
+            obj = self._storage_objs.get(rep.name)
+            if obj is not None and obj.process.alive and \
+                    rep.name != old_name:
+                src = obj
+                break
+        if src is None:
+            src = self._storage_objs.get(old_name)
+        if src is None or not src.process.alive:
+            raise error("no_more_servers")
+        self._move_inflight = True
+        self._vacate_seq += 1
+        new_name = f"storage-{shard.tag}-v{self._vacate_seq}"
+        try:
+            # pin the tag's records for the newcomer BEFORE it exists:
+            # teammates' pops must not free records it will still need
+            for t in self.tlog_objs():
+                exp = dict(t.expected_replicas)
+                exp[shard.tag] = tuple(exp.get(shard.tag, ())) + (new_name,)
+                t.set_expected_replicas(exp)
+            refs = dst_wi.worker.recruit_storage(
+                new_name, shard.tag, shard.begin, shard.end)
+            new_obj = dst_wi.worker.roles[new_name]
+            # same-turn: nothing can have been applied yet — buffer all
+            # in-range mutations until the snapshot lands
+            new_obj.begin_adding(shard.begin, shard.end)
+            flow.TraceEvent("VacateReplicaStart", self.process.name).detail(
+                Old=old_name, New=new_name, Worker=dst_wi.name).log()
+            # the newcomer's engine must finish recovering before a
+            # durable install can land on it
+            await flow.timeout_error(new_obj.recovered, 30.0)
+            v_s = min(src.known_committed, src.version.get())
+            rows = src.snapshot_range(shard.begin, shard.end, v_s)
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")
+            await new_obj.install_snapshot(rows, v_s)
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")
+            # publish the swapped team — the commit point
+            info2 = self.dbinfo.get()
+            shards = list(info2.storages)
+            shards[shard_idx] = shard._replace(replicas=tuple(
+                refs if rep.name == old_name else rep
+                for rep in shards[shard_idx].replicas))
+            self._storage_objs[new_name] = new_obj
+            self.shard_map[new_name] = (shard.tag, shard.begin, shard.end)
+            self.shard_map.pop(old_name, None)
+            self.publish(info2._replace(storages=tuple(shards)))
+            # release the old replica's pin; keep the newcomer's
+            for t in self.tlog_objs():
+                exp = dict(t.expected_replicas)
+                exp[shard.tag] = tuple(
+                    n for n in exp.get(shard.tag, ()) if n != old_name)
+                t.set_expected_replicas(exp)
+            old_wname, old_wi = self._worker_of_role(old_name)
+            self._storage_objs.pop(old_name, None)
+            if old_wi is not None:
+                old_wi.worker.retire_storage(old_name)
+            flow.TraceEvent("VacateReplicaFinish", self.process.name).detail(
+                Old=old_name, New=new_name).log()
+        except BaseException:
+            # roll back the newcomer: drop ITS pin (prior successful
+            # vacates' replicas keep theirs) and its half-built role
+            for t in self.tlog_objs():
+                exp = dict(t.expected_replicas)
+                exp[shard.tag] = tuple(
+                    n for n in exp.get(shard.tag, ()) if n != new_name)
+                t.set_expected_replicas(exp)
+            if new_name not in self.shard_map:
+                wname, wi = self._worker_of_role(new_name)
+                if wi is not None:
+                    wi.worker.retire_storage(new_name)
+            raise
+        finally:
+            self._move_inflight = False
 
     async def _move_boundary(self, left_idx: int, direction: str,
                              split: bytes) -> None:

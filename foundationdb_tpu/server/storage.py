@@ -22,7 +22,8 @@ from bisect import bisect_left, bisect_right, insort
 from typing import Dict, List, Optional, Tuple
 
 from .. import flow
-from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority, error
+from ..flow import (SERVER_KNOBS, Future, NotifiedVersion, TaskPriority,
+                    error)
 from ..rpc import NetworkRef, RequestStream, SimProcess
 from . import atomic
 from .kvstore import IKeyValueStore
@@ -408,14 +409,27 @@ class StorageServer:
         # (ref: StorageServer::counters — query/mutation accounting)
         self.stats = flow.CounterCollection("storage")
         self._actors = flow.ActorCollection()
+        self.recovered = Future()   # engine recovery complete (fetchKeys
+                                    # sources/destinations wait on this)
 
     def start(self) -> None:
         self._actors.add(flow.spawn(self._run(), TaskPriority.UPDATE_STORAGE,
                                     name=f"{self.process.name}.run"))
         self.process.on_kill(self._actors.cancel_all)
 
+    def retire(self) -> None:
+        """End this replica: actors stop and every endpoint breaks with
+        broken_promise so stale-map clients refresh their picture
+        instead of timing out (ref: storage server removal — endpoint
+        death IS the signal the location cache invalidates on)."""
+        self._actors.cancel_all()
+        for stream in (self.gets, self.ranges, self.get_keys, self.watches):
+            stream.close()
+
     async def _run(self) -> None:
         await self._recover()
+        if not self.recovered.is_ready:
+            self.recovered.send(None)
         for coro, prio, name in (
                 (self._pull_loop(), TaskPriority.UPDATE_STORAGE, "pull"),
                 (self._durability_loop(), TaskPriority.UPDATE_STORAGE,

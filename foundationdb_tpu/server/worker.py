@@ -193,6 +193,20 @@ class Worker:
                            s.ranges.ref(), s.get_keys.ref(), s.watches.ref())
         return refs
 
+    def retire_storage(self, name: str) -> None:
+        """Tear down a storage role whose data has been moved away
+        (ref: the storage server removal path once DD vacates it —
+        actors end and the store files are destroyed, so a reboot
+        cannot resurrect the stale ownership)."""
+        obj = self.roles.pop(name, None)
+        if obj is not None:
+            obj.retire()
+        if self.durable:
+            disk = self.net.disk(self.process.machine)
+            for f in [f for f in disk.files
+                      if f.startswith(name + ".")]:
+                del disk.files[f]
+
 from ..rpc import wire as _wire
 
 _wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary

@@ -149,3 +149,113 @@ def test_writes_during_move_are_not_lost():
         assert c.run(main(), timeout_time=900)
     finally:
         c.shutdown()
+
+
+def _worker_hosting(c, role_name):
+    for name, wi in c.cc.workers.items():
+        if role_name in wi.worker.roles:
+            return name
+    return None
+
+
+def test_exclusion_vacates_storage_replica():
+    """Excluding a worker that hosts a storage replica makes DD
+    re-home the replica on an included worker — whole-shard fetchKeys:
+    snapshot + buffered log replay, pinned TLog records, published team
+    swap, old role retired — with data intact and writes continuing
+    (ref: exclude + DataDistribution re-replication, MoveKeys)."""
+    c = SimCluster(seed=1301, durable=True, n_storage=2, n_workers=6)
+    try:
+        db = c.client()
+
+        async def main():
+            async def seed(tr):
+                for i in range(120):
+                    tr.set(b"k%04d" % i, b"v%d" % i)
+                tr.set(b"\xf0far", b"high")
+            await run_transaction(db, seed)
+
+            info = c.cc.dbinfo.get()
+            victim_role = info.storages[0].replicas[0].name
+            victim_worker = _worker_hosting(c, victim_role)
+            assert victim_worker is not None
+            await db.exclude(victim_worker)
+
+            # DD must vacate EVERY shard replica off the worker (one
+            # re-home per DD tick)
+            for _ in range(120):
+                await flow.delay(0.5)
+                info = c.cc.dbinfo.get()
+                hosts = {_worker_hosting(c, r.name)
+                         for s in info.storages for r in s.replicas}
+                if victim_worker not in hosts and None not in hosts:
+                    break
+            else:
+                raise AssertionError("exclusion never vacated the replica")
+            assert victim_role not in c.cc.workers[
+                victim_worker].worker.roles, "old role not retired"
+
+            # every row survived the re-home, and writes still flow
+            async def check(tr):
+                rows = await tr.get_range(b"k", b"l")
+                assert len(rows) == 120, len(rows)
+                assert await tr.get(b"k0042") == b"v42"
+                assert await tr.get(b"\xf0far") == b"high"
+                tr.set(b"k9999", b"after-vacate")
+            await run_transaction(db, check)
+
+            # the excluded worker can now die with zero data impact
+            c.kill_worker(victim_worker)
+            await flow.delay(1.0)
+
+            async def check2(tr):
+                assert await tr.get(b"k9999") == b"after-vacate"
+                assert await tr.get(b"k0000") == b"v0"
+            await run_transaction(db, check2)
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
+
+
+def test_exclusion_vacates_one_of_replicated_team():
+    """With storage_replicas=2, excluding one team member re-homes only
+    that replica; the surviving teammate serves as the fetch source."""
+    c = SimCluster(seed=1302, durable=True, n_storage=1,
+                   storage_replicas=2, n_workers=6)
+    try:
+        db = c.client()
+
+        async def main():
+            async def seed(tr):
+                for i in range(60):
+                    tr.set(b"r%03d" % i, b"w%d" % i)
+            await run_transaction(db, seed)
+
+            info = c.cc.dbinfo.get()
+            victim_role = info.storages[0].replicas[0].name
+            keep_role = info.storages[0].replicas[1].name
+            victim_worker = _worker_hosting(c, victim_role)
+            await db.exclude(victim_worker)
+
+            for _ in range(120):
+                await flow.delay(0.5)
+                info = c.cc.dbinfo.get()
+                names = [r.name for r in info.storages[0].replicas]
+                if victim_role not in names:
+                    break
+            else:
+                raise AssertionError("replica never vacated")
+            names = [r.name for r in info.storages[0].replicas]
+            assert keep_role in names  # the teammate was untouched
+
+            async def check(tr):
+                rows = await tr.get_range(b"r", b"s")
+                assert len(rows) == 60
+            await run_transaction(db, check)
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()

@@ -112,3 +112,54 @@ def test_replicated_cluster_survives_attrition():
         assert c.run(main(), timeout_time=900)
     finally:
         c.shutdown()
+
+
+def test_backup_requests_mask_a_slow_replica():
+    """Load balance (ref: fdbrpc/LoadBalance.actor.h): when the chosen
+    replica is slow (clogged links), a duplicate request to the other
+    replica answers within the backup window — far sooner than the 5s
+    request timeout — and the latency model steers later reads away
+    from the slow replica."""
+    c = SimCluster(seed=1304, storage_replicas=2)
+    try:
+        db = c.client()
+
+        async def main():
+            async def seed(tr):
+                for i in range(10):
+                    tr.set(b"bk%02d" % i, b"v%d" % i)
+            await run_transaction(db, seed)
+
+            shard = (await db.info()).storages[0]
+            objs = [c.cc._storage_objs[r.name] for r in shard.replicas]
+            slow = objs[0]
+            slow_machine = slow.process.machine
+            client_machine = db.process.machine
+            # only the CLIENT'S link to the slow replica clogs: pulls
+            # and peer traffic stay healthy, so this is purely a read-
+            # latency event, not a failure
+            c.net.clog_pair(client_machine, slow_machine, 30.0)
+
+            t0 = flow.now()
+            async def read_all(tr):
+                for i in range(10):
+                    assert await tr.get(b"bk%02d" % i) == b"v%d" % i
+            await run_transaction(db, read_all)
+            elapsed = flow.now() - t0
+            # without backup requests the first read against the slow
+            # replica eats the full 5s REQUEST_TIMEOUT
+            assert elapsed < 4.0, elapsed
+
+            # the model now prefers the healthy replica outright: the
+            # abandoned slow request recorded a penalty sample, so both
+            # replicas are modeled and the healthy one sorts first
+            ema = db._latency_ema
+            healthy = shard.replicas[1].name
+            slow_name = shard.replicas[0].name
+            assert healthy in ema and slow_name in ema, ema
+            assert ema[healthy] < ema[slow_name], ema
+            return True
+
+        assert c.run(main(), timeout_time=300)
+    finally:
+        c.shutdown()

@@ -197,3 +197,67 @@ def test_tcp_localhost_smoke():
     finally:
         transport.close()
         fl.set_scheduler(None)
+
+
+# -- TLS (ref: FDBLibTLS — mutual certificate verification under the
+# transport's connect handshake) ---------------------------------------
+
+def _make_cert(tmp_path, name):
+    import subprocess
+    key = str(tmp_path / f"{name}-key.pem")
+    cert = str(tmp_path / f"{name}-cert.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "2",
+         "-subj", f"/CN=fdbtpu-{name}"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+def test_tcp_tls_mutual_auth(tmp_path):
+    """Request/reply over mutually-authenticated TLS; a client with an
+    untrusted certificate is rejected at the handshake."""
+    from foundationdb_tpu.rpc.tcp import (TcpRequestStream, TcpTransport,
+                                          TlsConfig)
+
+    cert, key = _make_cert(tmp_path, "cluster")
+    rogue_cert, rogue_key = _make_cert(tmp_path, "rogue")
+    tls = TlsConfig(cert, key, cert)
+
+    fl.set_seed(17)
+    s = fl.Scheduler(virtual=False)
+    fl.set_scheduler(s)
+    server = TcpTransport(tls=tls)
+    client = TcpTransport(tls=tls)
+    # trusts the cluster CA but presents a cert the server won't trust
+    rogue = TcpTransport(tls=TlsConfig(rogue_cert, rogue_key, cert))
+    try:
+        stream = TcpRequestStream(server)
+        server.start()
+        client.start()
+        rogue.start()
+
+        async def serve():
+            while True:
+                req, reply = await stream.pop()
+                reply.send(req * 2)
+
+        async def main():
+            fl.spawn(serve())
+            ref = client.ref("127.0.0.1", server.port, stream.token)
+            assert await ref.get_reply(21) == 42
+            bad = rogue.ref("127.0.0.1", server.port, stream.token)
+            with pytest.raises(fl.FdbError) as ei:
+                await bad.get_reply(1)
+            assert ei.value.name == "broken_promise"
+            # the trusted client is unaffected by the rejected peer
+            assert await ref.get_reply(100) == 200
+            return True
+
+        t = s.spawn(main())
+        assert s.run(until=t, timeout_time=60)
+    finally:
+        server.close()
+        client.close()
+        rogue.close()
+        fl.set_scheduler(None)
