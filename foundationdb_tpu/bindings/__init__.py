@@ -20,7 +20,40 @@ from ..layers import tuple_layer as tuple  # noqa: A001 — mirrors fdb.tuple
 from ..server.types import KeySelector
 
 __all__ = ["open", "transactional", "Database", "Transaction",
-           "Subspace", "tuple", "KeySelector"]
+           "Subspace", "tuple", "KeySelector", "api_version",
+           "threadsafe_database"]
+
+# -- API versioning (ref: fdb.api_version + the MultiVersion client's
+# version selection, fdbclient/MultiVersionTransaction.actor.cpp:
+# the binding locks to one API version per process; a conflicting
+# second selection is an error). Version numbers track the reference's
+# (520+ = versionstamp ops in tuples, 610+ = current surface).
+CURRENT_API_VERSION = 710
+_selected_api_version = None
+
+
+def api_version(version: int) -> None:
+    global _selected_api_version
+    if _selected_api_version is not None:
+        if version != _selected_api_version:
+            raise RuntimeError(
+                f"API version already selected: {_selected_api_version}")
+        return
+    if not 500 <= version <= CURRENT_API_VERSION:
+        raise RuntimeError(
+            f"API version {version} not supported (500..."
+            f"{CURRENT_API_VERSION})")
+    _selected_api_version = version
+
+
+def threadsafe_database(host: str, port: int):
+    """A THREAD-SAFE blocking Database handle — the native C client over
+    a cluster's TcpGateway (ref: ThreadSafeDatabase in
+    fdbclient/ThreadSafeTransaction.cpp — the layer OS-thread callers
+    use; here that layer IS the C binding, whose connection owns its
+    reader thread and whose calls may come from any thread)."""
+    from .c_client import CDatabase
+    return CDatabase(host, port)
 
 
 def open(cluster, name: str = "fdb-client"):  # noqa: A001 — mirrors fdb.open

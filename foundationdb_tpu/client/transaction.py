@@ -50,6 +50,16 @@ REFRESH_ERRORS = {"broken_promise", "commit_unknown_result", "tlog_stopped",
 REQUEST_TIMEOUT = 5.0  # seconds; a hung role surfaces as retryable
                        # timed_out (ref: failure-monitored getReply)
 
+# The \xff system keyspace (ref: fdbclient/SystemData.cpp — keyServers/,
+# conf/, excluded/ prefixes). Here the rows are materialized from the
+# broadcast ServerDBInfo and the CC's status document rather than stored
+# in the database; writes are rejected the way the reference rejects
+# them without ACCESS_SYSTEM_KEYS.
+SYSTEM_PREFIX = b"\xff"
+KEY_SERVERS_PREFIX = b"\xff/keyServers/"
+CONF_PREFIX = b"\xff/conf/"
+EXCLUDED_PREFIX = b"\xff/excluded/"
+
 
 def _rpc(fut: Future) -> Future:
     return flow.timeout_error(fut, REQUEST_TIMEOUT)
@@ -317,6 +327,42 @@ class Transaction:
                 return True, None
         return False, None
 
+    # -- system keyspace -------------------------------------------------
+    async def _system_rows(self) -> List[Tuple[bytes, bytes]]:
+        """All materialized system rows, sorted (ref: SystemData.cpp —
+        the system keyspace a client can enumerate)."""
+        info = await self._get_info()
+        rows = [(KEY_SERVERS_PREFIX + s.begin,
+                 b",".join(r.name.encode() for r in s.replicas))
+                for s in info.storages]
+        if self.db.status_ref is not None:
+            try:
+                status = await self.db.get_status()
+                conf = status.get("cluster", {}).get("configuration", {})
+                for k, v in conf.items():
+                    if k == "excluded":
+                        for w in v:
+                            rows.append((EXCLUDED_PREFIX + w.encode(), b""))
+                    else:
+                        rows.append((CONF_PREFIX + k.encode(),
+                                     str(v).encode()))
+            except flow.FdbError:
+                pass  # status unavailable: serve the shard map alone
+        rows.sort()
+        return rows
+
+    async def _system_get(self, key: bytes) -> Optional[bytes]:
+        if key.startswith(KEY_SERVERS_PREFIX):
+            # the team owning an arbitrary key (ref: keyServers reads)
+            k = key[len(KEY_SERVERS_PREFIX):]
+            info = await self._get_info()
+            s = info.storages[_shard_index(info.storages, k)]
+            return b",".join(r.name.encode() for r in s.replicas)
+        for rk, rv in await self._system_rows():
+            if rk == key:
+                return rv
+        return None
+
     # -- reads ----------------------------------------------------------
     async def _base_get(self, key: bytes) -> Optional[bytes]:
         found, val = self._overlay_get(key)
@@ -328,6 +374,8 @@ class Transaction:
             StorageGetRequest(key, version), self.db.process))
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        if key.startswith(SYSTEM_PREFIX):
+            return await self._system_get(key)
         if not snapshot:
             self._read_conflicts.append((key, _next_key(key)))
         val = await self._base_get(key)
@@ -384,6 +432,10 @@ class Transaction:
             end = await self.get_key(end, snapshot=snapshot)
         if begin >= end:
             return []
+        if begin.startswith(SYSTEM_PREFIX):
+            rows = [(k, v) for k, v in await self._system_rows()
+                    if begin <= k < end]
+            return sorted(rows, reverse=reverse)[:limit]
         version = await self.get_read_version()
         # With no RYW overlay in the range the storage servers honor the
         # caller's limit/reverse directly; an overlay (clears/writes/
@@ -481,6 +533,8 @@ class Transaction:
         self._writes[key] = value
 
     def set(self, key: bytes, value: bytes) -> None:
+        if key.startswith(SYSTEM_PREFIX):
+            raise error("key_outside_legal_range")
         self._check_sizes(key, value)
         self._record_write(key, value)
         self._ops.pop(key, None)  # a set supersedes pending atomics
@@ -493,6 +547,10 @@ class Transaction:
     def clear_range(self, begin: bytes, end: bytes) -> None:
         if begin >= end:
             return
+        if begin.startswith(SYSTEM_PREFIX) or end > SYSTEM_PREFIX:
+            # an end reaching past \xff would clear into the system
+            # space (storage engines keep their metadata there)
+            raise error("key_outside_legal_range")
         self._check_sizes(begin)
         self._check_sizes(end, slack=1)  # keyAfter(max-size key) is legal
         self._cleared.append((begin, end))
@@ -507,6 +565,8 @@ class Transaction:
 
     def atomic_op(self, key: bytes, param: bytes, op_type: int) -> None:
         """(ref: Transaction::atomicOp / fdbclient/Atomic.h op table)"""
+        if key.startswith(SYSTEM_PREFIX):
+            raise error("key_outside_legal_range")
         self._check_sizes(key, param)
         if op_type in (SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE):
             # transformed at the proxy with the commit version; the
@@ -603,7 +663,9 @@ class Transaction:
         recovery)"""
         if not (isinstance(e, flow.FdbError) and e.name in RETRYABLE):
             raise e
+        flow.cover("client.retry.conflict", e.name == "not_committed")
         if e.name in REFRESH_ERRORS:
+            flow.cover("client.refresh_stale_picture")
             await self.db.refresh_past(self._used_seq)
         await flow.delay(0.001 + flow.g_random.random01() * 0.01,
                          TaskPriority.DEFAULT_ENDPOINT)

@@ -22,6 +22,8 @@ from .rng import DeterministicRandom, buggify, g_random, set_seed
 from .knobs import SERVER_KNOBS, Knobs, make_server_knobs, reset_server_knobs
 from .stats import Counter, CounterCollection
 from .trace import TraceEvent, g_trace, reset_trace
+from .coverage import cover, declare
+from . import coverage, trace
 
 __all__ = [
     "ActorCancelled", "FdbError", "error", "internal_error",

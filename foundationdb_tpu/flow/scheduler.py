@@ -82,6 +82,12 @@ class Scheduler:
         self._current_task: Optional[Task] = None
         self._stopped = False
         self.tasks_run = 0
+        # run-loop profiler (ref: flow/Profiler.actor.cpp + Net2's slow-
+        # task sampling): wall seconds spent executing steps, and the
+        # worst offenders over the threshold
+        self.busy_seconds = 0.0
+        self.slow_task_threshold = 0.05
+        self.slow_tasks: list = []     # (task name, seconds), worst kept
 
     # -- time ---------------------------------------------------------------
     def now(self) -> float:
@@ -150,7 +156,24 @@ class Scheduler:
             return False
         _, _, task, value, exc = heapq.heappop(self._ready)
         self.tasks_run += 1
+        t0 = _time.monotonic()
         task._step(value, exc)
+        dt = _time.monotonic() - t0
+        self.busy_seconds += dt
+        if dt >= self.slow_task_threshold:
+            # a step that hogs the loop starves every other actor — the
+            # reference's slow-task profiler samples exactly this
+            name = getattr(task, "name", "") or "?"
+            self.slow_tasks.append((name, dt))
+            if len(self.slow_tasks) > 32:
+                self.slow_tasks = sorted(
+                    self.slow_tasks, key=lambda s: -s[1])[:16]
+            from .trace import SevWarn
+            from . import trace as _trace
+            _trace.g_trace.emit({
+                "Type": "SlowTask", "Severity": SevWarn,
+                "Machine": "runloop", "TaskName": name,
+                "Seconds": round(dt, 4)})
         return True
 
     def run(self, until: Optional[Future] = None, timeout_time: Optional[float] = None) -> Any:

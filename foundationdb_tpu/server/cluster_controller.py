@@ -144,6 +144,7 @@ class ClusterController:
             # wait for recovery to fail, or for any critical role to die
             # after recovery completes (ref: masterFailure handling)
             failed = await self._watch_epoch(self._recovery_task)
+            flow.cover("cc.epoch_failed")
             flow.TraceEvent("MasterEpochFailed", self.process.name).detail(
                 Reason=failed).log()
             self._recovery_task.cancel()
@@ -477,6 +478,16 @@ class ClusterController:
                 "storages": storages,
                 "proxies": proxies,
                 "qos": {"transactions_per_second_limit": rate},
+                # run-loop profiler (ref: Net2 slow-task sampling /
+                # SystemMonitor machine metrics in status)
+                "run_loop": {
+                    "tasks_run": flow.g().tasks_run,
+                    "busy_seconds": round(flow.g().busy_seconds, 3),
+                    "slow_tasks": [
+                        {"task": n, "seconds": round(s, 4)}
+                        for n, s in sorted(flow.g().slow_tasks,
+                                           key=lambda t: -t[1])[:5]],
+                },
                 "configuration": {
                     "proxies": cfg.n_proxies,
                     "resolvers": cfg.n_resolvers,
@@ -484,6 +495,7 @@ class ClusterController:
                     "storage_shards": cfg.n_storage,
                     "conflict_backend": cfg.conflict_backend,
                     "durable": cfg.durable,
+                    "excluded": sorted(self.excluded),
                 },
             },
         }

@@ -26,6 +26,7 @@ import struct
 import zlib
 from typing import List, Optional, Tuple
 
+from .. import flow
 from ..rpc.disk import SimDisk, SimFile
 
 _REC_HDR = struct.Struct("<QII")
@@ -114,6 +115,7 @@ class DiskQueue:
                                 off + _REC_HDR.size + length])
             if (seq != expect or len(payload) != length
                     or zlib.crc32(payload) != crc):
+                flow.cover("diskqueue.torn_tail_dropped")
                 break
             end = off + _REC_HDR.size + length
             recs.append((seq, payload, end))

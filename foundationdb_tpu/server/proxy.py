@@ -482,9 +482,11 @@ class Proxy:
                     st.counter("transactions_committed").add(1)
                     reply.send(CommitReply(ver.version, idx))
                 elif verdict == TOO_OLD:
+                    flow.cover("proxy.commit.too_old")
                     st.counter("transactions_too_old").add(1)
                     reply.send_error(error("transaction_too_old"))
                 else:
+                    flow.cover("proxy.commit.conflict")
                     st.counter("transactions_conflicted").add(1)
                     reply.send_error(error("not_committed"))
         except flow.FdbError as e:

@@ -81,6 +81,8 @@ class Resolver:
             # (ref: Resolver.actor.cpp:241-257). Conflict-everything only
             # if the entry aged out of the window.
             cached = self._reply_cache.get(req.version)
+            flow.cover("resolver.reply_cache.hit", cached is not None)
+            flow.cover("resolver.reply_cache.aged_out", cached is None)
             reply.send(cached if cached is not None
                        else [0] * len(req.transactions))
             return
@@ -101,6 +103,7 @@ class Resolver:
             # bucket) must not wedge the pipeline: conflict the whole
             # batch — clients see not_committed and retry — and still
             # advance the version so later batches proceed.
+            flow.cover("resolver.batch.rejected")
             flow.TraceEvent("ResolverBatchRejected", self.process.name,
                             severity=flow.trace.SevWarnAlways).detail(
                 Version=req.version, Error=str(e)).log()

@@ -590,6 +590,7 @@ class StorageServer:
                 self.data.apply(v, m)
         self._pending = keep
         self.version.rollback(rv)
+        flow.cover("storage.rollback")
         flow.TraceEvent("StorageRollback", self.process.name).detail(
             To=rv).log()
 

@@ -121,6 +121,7 @@ class TLog:
 
     async def _handle_commit(self, req: TLogCommitRequest, reply):
         if self.stopped:
+            flow.cover("tlog.commit.stopped")
             reply.send_error(error("tlog_stopped"))
             return
         # strict version ordering (ref: tLogCommit waits for
@@ -143,6 +144,7 @@ class TLog:
             await self._ack_when_durable(req.version, reply)
             return
         if self.stopped:
+            flow.cover("tlog.commit.stopped")
             reply.send_error(error("tlog_stopped"))
             return
         self.queue_version.set(req.version)

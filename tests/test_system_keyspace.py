@@ -1,0 +1,110 @@
+"""\xff system keyspace + API version gating.
+
+Ref: fdbclient/SystemData.cpp (keyServers/, conf/, excluded/ prefixes),
+system-key write protection (key_outside_legal_range without
+ACCESS_SYSTEM_KEYS), fdb.api_version selection.
+"""
+
+import pytest
+
+import foundationdb_tpu.bindings as fdb
+from foundationdb_tpu import flow
+from foundationdb_tpu.client import run_transaction
+from foundationdb_tpu.server import SimCluster
+
+
+def test_system_keyspace_reads_and_write_protection():
+    c = SimCluster(seed=51, n_storage=2, storage_replicas=2)
+    try:
+        db = c.client()
+
+        async def main():
+            async def seed(tr):
+                tr.set(b"user", b"row")
+            await run_transaction(db, seed)
+
+            tr = db.create_transaction()
+            # keyServers: one row per shard, value = the replica team
+            rows = await tr.get_range(b"\xff/keyServers/",
+                                      b"\xff/keyServers0")
+            assert len(rows) == 2
+            assert rows[0][0] == b"\xff/keyServers/"
+            for _k, team in rows:
+                assert len(team.split(b",")) == 2
+            # point lookup: the team owning an arbitrary user key
+            team = await tr.get(b"\xff/keyServers/user")
+            assert team == rows[0][1] or team == rows[1][1]
+
+            # conf rows mirror the live configuration
+            conf = dict(await tr.get_range(b"\xff/conf/", b"\xff/conf0"))
+            assert conf[b"\xff/conf/storage_shards"] == b"2"
+            assert conf[b"\xff/conf/proxies"] == b"1"
+
+            # exclusion shows up under \xff/excluded/
+            info = c.cc.dbinfo.get()
+            victim = None
+            for name, wi in c.cc.workers.items():
+                if not any(rn.startswith(("storage", "tlog", "proxy",
+                                          "resolver", "ratekeeper"))
+                           for rn in wi.worker.roles):
+                    victim = name
+                    break
+            if victim is not None:
+                await db.exclude(victim)
+                rows = await tr.get_range(b"\xff/excluded/",
+                                          b"\xff/excluded0")
+                assert (b"\xff/excluded/" + victim.encode(), b"") in rows
+
+            # system keys are write-protected
+            with pytest.raises(flow.FdbError) as ei:
+                tr.set(b"\xff/conf/proxies", b"9")
+            assert ei.value.name == "key_outside_legal_range"
+            with pytest.raises(flow.FdbError):
+                tr.clear_range(b"\xff", b"\xff\xff")
+            with pytest.raises(flow.FdbError):
+                tr.atomic_op(b"\xff/x", b"\x01", 2)
+
+            # the user-space scan convention b"" .. b"\xff" is untouched
+            user = await tr.get_range(b"", b"\xff")
+            assert user == [(b"user", b"row")]
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
+
+
+def test_api_version_selection():
+    fdb._selected_api_version = None
+    with pytest.raises(RuntimeError):
+        fdb.api_version(200)     # out of range
+    fdb.api_version(710)
+    fdb.api_version(710)         # idempotent re-selection is fine
+    with pytest.raises(RuntimeError):
+        fdb.api_version(630)     # conflicting re-selection is not
+    fdb._selected_api_version = None
+
+
+def test_clear_range_cannot_reach_system_space():
+    """A clear whose END crosses \xff must be rejected — it would wipe
+    the storage engine's own \xff\xff metadata (review finding)."""
+    c = SimCluster(seed=52, durable=True)
+    try:
+        db = c.client()
+
+        async def main():
+            tr = db.create_transaction()
+            tr.set(b"safe", b"1")
+            await tr.commit()
+            tr.reset()
+            with pytest.raises(flow.FdbError) as ei:
+                tr.clear_range(b"b", b"\xff\xffzz")
+            assert ei.value.name == "key_outside_legal_range"
+            # the legal full-wipe bound is untouched
+            tr.clear_range(b"", b"\xff")
+            await tr.commit()
+            return True
+
+        assert c.run(main(), timeout_time=60)
+    finally:
+        c.shutdown()
