@@ -61,6 +61,10 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("VALUE_SIZE_LIMIT", 100_000)
     init("RESOLVER_COALESCE_TIME", 1.0)
     init("LOAD_BALANCE_BACKUP_DELAY", 0.005, lambda: 0.0005)
+    # DD shard sizing (ref: SHARD_MAX_BYTES_PER_KSEC family — row-count
+    # stand-ins for the byte/bandwidth thresholds)
+    init("DD_SHARD_SPLIT_ROWS", 1000, lambda: 120)
+    init("DD_SHARD_MERGE_ROWS", 40, lambda: 10)
     init("SAMPLE_EXPIRATION_TIME", 1.0)
     return k
 

@@ -96,6 +96,7 @@ class ClusterController:
         self._move_inflight = False        # one shard move at a time
         self._vacate_seq = 0               # unique vacate-replica names
         self._vacate_retry_at = 0.0        # backoff for stuck vacates
+        self._dd_last_committed = -1       # idle detection for DD nudges
         self.backup_active = False         # continuous-backup tagging
         self.backup_agent = None           # the live agent, when any
         # authoritative shard boundaries (ref: the keyServers system
@@ -289,6 +290,14 @@ class ClusterController:
             return tuple(s.begin for s in info.storages[1:])
         return tuple(bytes([(i * 256) // self.config.n_storage])
                      for i in range(1, self.config.n_storage))
+
+    def storage_tags(self) -> Tuple[int, ...]:
+        """Tags in shard (begin) order — explicit because splits mint
+        fresh tags mid-keyspace."""
+        info = self.dbinfo.get()
+        if info.storages:
+            return tuple(s.tag for s in info.storages)
+        return tuple(range(self.config.n_storage))
 
     def recruit_initial_storages(self) -> None:
         """First boot only: create the shard set (ref: the initial
@@ -517,15 +526,52 @@ class ClusterController:
             # teams containing excluded servers get rebuilt)
             if await self._vacate_excluded(info):
                 continue
-            if len(info.storages) < 2:
-                continue
             teams = [[self._storage_objs.get(rep.name)
                       for rep in s.replicas] for s in info.storages]
             if any(o is None or not o.process.alive or o._adding
                    for team in teams for o in team):
                 continue
+            if len(info.storages) > self.config.n_storage and info.proxies:
+                # post-split watch state on an IDLE cluster: durability
+                # (and thus row counts) only advances with commits, so a
+                # cooled shard's counts would never fall to the merge
+                # threshold. A busy cluster advances on its own — skip.
+                committed = max(p.committed_version.get()
+                                for p in self._current_proxies()
+                                ) if self._current_proxies() else 0
+                if committed <= self._dd_last_committed:
+                    await self._nudge_commit()
+                self._dd_last_committed = committed
             objs = [team[0] for team in teams]   # per-shard spokesman
             counts = [o.approx_rows() for o in objs]
+            from ..flow import SERVER_KNOBS as _K
+            # split a hot shard (ref: shardSplitter on size)
+            hot = [i for i, n in enumerate(counts)
+                   if n > _K.dd_shard_split_rows]
+            if hot:
+                try:
+                    await self._split_shard(hot[0])
+                except Exception as e:  # noqa: BLE001 — DD survives
+                    flow.TraceEvent(
+                        "ShardSplitError", self.process.name,
+                        severity=flow.trace.SevWarnAlways).detail(
+                        Error=repr(e)).log()
+                continue
+            # merge adjacent cold shards — never below the configured
+            # baseline count (ref: shardMerger; SHARD_MIN_BYTES floor)
+            cold = [i for i in range(len(counts) - 1)
+                    if counts[i] + counts[i + 1] < _K.dd_shard_merge_rows]
+            if cold and len(info.storages) > self.config.n_storage:
+                try:
+                    await self._merge_shards(cold[0])
+                except Exception as e:  # noqa: BLE001 — DD survives
+                    flow.TraceEvent(
+                        "ShardMergeError", self.process.name,
+                        severity=flow.trace.SevWarnAlways).detail(
+                        Error=repr(e)).log()
+                continue
+            if len(info.storages) < 2:
+                continue
             for i in range(len(objs) - 1):
                 big, small = counts[i], counts[i + 1]
                 src, direction = (i, "right") if big > small else (i + 1,
@@ -688,6 +734,229 @@ class ClusterController:
         finally:
             self._move_inflight = False
 
+    async def _split_shard(self, shard_idx: int) -> None:
+        """Split a hot shard: mint a fresh tag, recruit a policy-spread
+        team for the upper half, dual-tag it through the transition,
+        snapshot + buffered-replay onto the newcomers, publish the
+        extra shard (ref: dataDistributionTracker shardSplitter →
+        executing moveKeys to a new team; the keyServers map gains a
+        boundary)."""
+        info = self.dbinfo.get()
+        shard = info.storages[shard_idx]
+        epoch0 = info.epoch
+        src_team = [self._storage_objs.get(rep.name)
+                    for rep in shard.replicas]
+        if any(o is None or not o.process.alive for o in src_team):
+            raise error("operation_failed")
+        src = src_team[0]
+        split = src.split_key_estimate()
+        if split is None or not (shard.begin < split and (
+                shard.end is None or split < shard.end)):
+            raise error("operation_failed")
+        new_tag = max(s.tag for s in info.storages) + 1
+        nrep = max(1, self.config.storage_replicas)
+        team = self.pick_workers(nrep, role="storage")
+        names = [f"storage-{new_tag}-r{j}" for j in range(nrep)]
+        proxies = self._current_proxies()
+        if not proxies:
+            raise error("operation_failed")
+        self._move_inflight = True
+        flow.TraceEvent("ShardSplitStart", self.process.name).detail(
+            Tag=shard.tag, NewTag=new_tag, Split=split.hex()).log()
+        new_refs = []
+        dual_tagged = False
+        published = False
+        try:
+            # pin the fresh tag before any record can exist for it
+            for t in self.tlog_objs():
+                exp = dict(t.expected_replicas)
+                exp[new_tag] = tuple(names)
+                t.set_expected_replicas(exp)
+            new_objs = []
+            for j, w in enumerate(team):
+                refs = w.recruit_storage(names[j], new_tag, split,
+                                         shard.end)
+                obj = w.roles[names[j]]
+                obj.begin_adding(split, shard.end)  # same-turn: no gap
+                new_refs.append(refs)
+                new_objs.append(obj)
+            for p in proxies:
+                p.start_move(split, shard.end, new_tag)
+            dual_tagged = True
+            for o in new_objs:
+                await flow.timeout_error(o.recovered, 30.0)
+            v_s = await self._wait_replication_horizon(src, epoch0, proxies)
+            rows = src.snapshot_range(split, shard.end, v_s)
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")
+            for o in new_objs:
+                await o.install_snapshot(rows, v_s)
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")
+            # publish: the commit point
+            info2 = self.dbinfo.get()
+            shards = list(info2.storages)
+            left = shard._replace(end=split, replicas=tuple(
+                rep._replace(end=split) for rep in shard.replicas))
+            right = StorageShard(new_tag, split, shard.end, tuple(
+                r._replace(begin=split, end=shard.end) for r in new_refs))
+            shards[shard_idx] = left
+            shards.insert(shard_idx + 1, right)
+            for rep in left.replicas:
+                self.shard_map[rep.name] = (left.tag, left.begin, left.end)
+            for j, rep in enumerate(right.replicas):
+                self.shard_map[rep.name] = (new_tag, split, shard.end)
+                self._storage_objs[rep.name] = new_objs[j]
+            self.publish(info2._replace(storages=tuple(shards)))
+            published = True   # the commit point: only roll forward
+            for p in self._current_proxies():
+                p.finish_move(split, shard.end, new_tag,
+                              [s.begin for s in shards[1:]],
+                              [s.tag for s in shards])
+            for sobj in src_team:
+                try:
+                    await sobj.shrink_to(sobj.shard_begin, split)
+                except flow.FdbError:
+                    pass  # a dead replica is clamped on re-register
+            flow.TraceEvent("ShardSplitFinish", self.process.name).detail(
+                NewTag=new_tag).log()
+        except BaseException:
+            if not published:
+                for t in self.tlog_objs():
+                    exp = dict(t.expected_replicas)
+                    exp.pop(new_tag, None)
+                    t.set_expected_replicas(exp)
+                if dual_tagged:
+                    for p in self._current_proxies():
+                        p.finish_move(split, shard.end, new_tag,
+                                      [s.begin for s in info.storages[1:]],
+                                      [s.tag for s in info.storages])
+                for j, w in enumerate(team[:len(new_refs)]):
+                    w.retire_storage(names[j])
+                    self._storage_objs.pop(names[j], None)
+            raise
+        finally:
+            self._move_inflight = False
+
+    async def _merge_shards(self, left_idx: int) -> None:
+        """Fold shard left_idx+1 into left_idx: the left team absorbs
+        the right range (dual-tagged through the transition), the right
+        team and its tag retire (ref: dataDistributionTracker
+        shardMerger — adjacent cold shards collapse to one)."""
+        info = self.dbinfo.get()
+        left, right = info.storages[left_idx], info.storages[left_idx + 1]
+        epoch0 = info.epoch
+        l_team = [self._storage_objs.get(rep.name) for rep in left.replicas]
+        r_team = [self._storage_objs.get(rep.name) for rep in right.replicas]
+        if any(o is None or not o.process.alive for o in l_team + r_team):
+            raise error("operation_failed")
+        src = r_team[0]
+        proxies = self._current_proxies()
+        if not proxies:
+            raise error("operation_failed")
+        self._move_inflight = True
+        flow.TraceEvent("ShardMergeStart", self.process.name).detail(
+            Left=left.tag, Right=right.tag).log()
+        published = False
+        l_old_bounds = [(o.shard_begin, o.shard_end) for o in l_team]
+        try:
+            for o in l_team:
+                o.begin_adding(right.begin, right.end)
+            for p in proxies:
+                p.start_move(right.begin, right.end, left.tag)
+            v_s = await self._wait_replication_horizon(src, epoch0, proxies)
+            rows = src.snapshot_range(right.begin, right.end, v_s)
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")
+            for o in l_team:
+                await o.install_snapshot(rows, v_s)
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")
+            # publish the collapsed map
+            info2 = self.dbinfo.get()
+            shards = list(info2.storages)
+            merged = left._replace(end=right.end, replicas=tuple(
+                rep._replace(end=right.end) for rep in left.replicas))
+            shards[left_idx] = merged
+            del shards[left_idx + 1]
+            for rep in merged.replicas:
+                self.shard_map[rep.name] = (merged.tag, merged.begin,
+                                            merged.end)
+            for rep in right.replicas:
+                self.shard_map.pop(rep.name, None)
+            self.publish(info2._replace(storages=tuple(shards)))
+            published = True
+            for p in self._current_proxies():
+                p.finish_move(right.begin, right.end, left.tag,
+                              [s.begin for s in shards[1:]],
+                              [s.tag for s in shards])
+            # retire the right team; its tag's residual records are
+            # covered by the left tag's copies, so pop them fully or
+            # the log would pin them forever
+            for rep in right.replicas:
+                wname, wi = self._worker_of_role(rep.name)
+                self._storage_objs.pop(rep.name, None)
+                if wi is not None:
+                    wi.worker.retire_storage(rep.name)
+            for t in self.tlog_objs():
+                exp = dict(t.expected_replicas)
+                expected = exp.pop(right.tag, ())
+                t.set_expected_replicas(exp)
+                for name in expected:
+                    t.pop(1 << 60, right.tag, name)
+            flow.TraceEvent("ShardMergeFinish", self.process.name).detail(
+                Tag=merged.tag).log()
+        except BaseException:
+            if not published:
+                for o, old in zip(l_team, l_old_bounds):
+                    o.abort_adding()
+                    if (o.shard_begin, o.shard_end) != old:
+                        # a durable install already extended the claim:
+                        # retract it (floor + rows stay, unreachable)
+                        await flow.catch_errors(flow.spawn(
+                            o.set_bounds(*old)))
+                for p in self._current_proxies():
+                    p.finish_move(right.begin, right.end, left.tag,
+                                  [s.begin for s in info.storages[1:]],
+                                  [s.tag for s in info.storages])
+            raise
+        finally:
+            self._move_inflight = False
+
+    async def _nudge_commit(self) -> None:
+        """Push one empty commit through — idle clusters advance
+        known_committed (and thus durability) only with fresh commits
+        (ref: the recovery txn idiom)."""
+        from .types import CommitRequest
+        info = self.dbinfo.get()
+        if info.proxies:
+            await flow.catch_errors(flow.timeout_error(
+                info.proxies[0].commits.get_reply(
+                    CommitRequest(0, (), (), ()), self.process), 1.0))
+
+    async def _wait_replication_horizon(self, src, epoch0: int,
+                                        proxies) -> int:
+        """Safe snapshot version for a move source: at least v0 — the
+        master's issued max, covering batches whose tags were computed
+        BEFORE a dual-tag landed — and known replicated on the whole
+        log set, so an epoch rollback can never rewind below it and a
+        durable install can't capture a phantom timeline. `proxies` is
+        the caller's already-validated non-empty list (re-fetching here
+        could observe an epoch transition's empty window)."""
+        v0 = max(p.committed_version.get() for p in proxies)
+        if self._recovery is not None and \
+                self._recovery.master is not None:
+            v0 = max(v0, self._recovery.master.version)
+        deadline = flow.now() + 30.0
+        while src.known_committed < v0 or src.version.get() < v0:
+            if flow.now() > deadline:
+                raise error("timed_out")
+            if self.dbinfo.get().epoch != epoch0:
+                raise error("operation_failed")
+            await self._nudge_commit()
+            await flow.delay(0.1, TaskPriority.DATA_DISTRIBUTION)
+        return min(src.known_committed, src.version.get())
+
     async def _move_boundary(self, left_idx: int, direction: str,
                              split: bytes) -> None:
         """Move the boundary between adjacent shards left_idx and
@@ -726,31 +995,7 @@ class ClusterController:
                 d.begin_adding(r_begin, r_end)
             for p in proxies:
                 p.start_move(r_begin, r_end, dst.tag)
-            # v0 must cover batches whose tags were computed BEFORE the
-            # dual-tag landed: every such batch's version was issued by
-            # the master already, so the master's issued max (not the
-            # proxies' committed) is the safe horizon (code review r3)
-            v0 = max(p.committed_version.get() for p in proxies)
-            if self._recovery is not None and \
-                    self._recovery.master is not None:
-                v0 = max(v0, self._recovery.master.version)
-            # snapshot only at a version known replicated on the whole
-            # log set — an epoch rollback can never rewind below it, so
-            # the durable install can't capture a phantom timeline
-            deadline = flow.now() + 30.0
-            while (src.known_committed < v0 or src.version.get() < v0):
-                if flow.now() > deadline:
-                    raise error("timed_out")
-                if self.dbinfo.get().epoch != epoch0:
-                    raise error("operation_failed")
-                # idle clusters advance known_committed only with fresh
-                # commits: nudge one through (ref: the recovery txn)
-                from .types import CommitRequest
-                await flow.catch_errors(flow.timeout_error(
-                    self.dbinfo.get().proxies[0].commits.get_reply(
-                        CommitRequest(0, (), (), ()), self.process), 1.0))
-                await flow.delay(0.1, TaskPriority.DATA_DISTRIBUTION)
-            v_s = min(src.known_committed, src.version.get())
+            v_s = await self._wait_replication_horizon(src, epoch0, proxies)
             rows = src.snapshot_range(r_begin, r_end, v_s)
             if self.dbinfo.get().epoch != epoch0:
                 raise error("operation_failed")   # abort pre-install
@@ -783,7 +1028,8 @@ class ClusterController:
             published = True
             for p in self._current_proxies():
                 p.finish_move(r_begin, r_end, dst.tag,
-                              [s.begin for s in new_storages[1:]])
+                              [s.begin for s in new_storages[1:]],
+                              [s.tag for s in new_storages])
             for sobj in src_team:
                 try:
                     if direction == "right":
@@ -805,7 +1051,8 @@ class ClusterController:
                             d.set_bounds(*dst_old_bounds)))
                 for p in self._current_proxies():
                     p.finish_move(r_begin, r_end, dst.tag,
-                                  [s.begin for s in storages[1:]])
+                                  [s.begin for s in storages[1:]],
+                                  [s.tag for s in storages])
             raise
         finally:
             self._move_inflight = False

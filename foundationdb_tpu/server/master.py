@@ -184,7 +184,8 @@ class MasterRecovery:
                 self.master.version_requests.ref(),
                 resolver_refs, [r.commits for r in new_logs],
                 resolver_splits, storage_splits,
-                recovery_version, ratekeeper_ref=rk_ref))
+                recovery_version, ratekeeper_ref=rk_ref,
+                storage_tags=self.cc.storage_tags()))
             if self.cc.backup_active:
                 w.roles[f"proxy-e{self.epoch}-{i}"].backup_active = True
             self.critical_procs.add(w.process)

@@ -142,7 +142,7 @@ class KeyResolverMap:
 class Proxy:
     def __init__(self, process: SimProcess, master_ref: NetworkRef,
                  resolver_refs, tlog_refs,
-                 resolver_splits=(), storage_splits=(),
+                 resolver_splits=(), storage_splits=(), storage_tags=None,
                  recovery_version: int = 0,
                  batch_window: float = 0.001, max_batch: int = 512,
                  ratekeeper_ref: NetworkRef = None):
@@ -158,8 +158,17 @@ class Proxy:
         # at runtime by the master's resolutionBalancing)
         self.key_resolvers = KeyResolverMap(resolver_splits,
                                             len(resolver_refs))
-        # keyServers boundaries: storage tag i owns [sbounds[i], sbounds[i+1])
+        # keyServers boundaries: range i = [sbounds[i], sbounds[i+1]),
+        # owned by storage tag _stags[i]. Tags are EXPLICIT, not
+        # positional: shard splits mint fresh tags mid-keyspace (ref:
+        # the keyServers map carrying Tag values, fdbclient/SystemData)
         self._sbounds = [b""] + list(storage_splits) + [None]
+        if storage_tags is None:
+            raise ValueError(
+                "storage_tags is required: tags are not positional once "
+                "splits mint fresh tags mid-keyspace")
+        self._stags = list(storage_tags)
+        assert len(self._stags) == len(self._sbounds) - 1
         self._moving: list = []   # (begin, end, extra_tag) dual-tag ranges
         self.backup_active = False
         self.tlog_refs = list(tlog_refs)
@@ -350,13 +359,14 @@ class Proxy:
         backup tag to everything."""
         n = len(self._sbounds) - 1
         if n == 1 and not self._moving:
-            return (0, BACKUP_TAG) if self.backup_active else (0,)
+            return ((self._stags[0], BACKUP_TAG) if self.backup_active
+                    else (self._stags[0],))
         if m.type == CLEAR_RANGE:
             tags = set()
             for i in range(n):
                 lo, hi = self._sbounds[i], self._sbounds[i + 1]
                 if (hi is None or m.param1 < hi) and lo < m.param2:
-                    tags.add(i)
+                    tags.add(self._stags[i])
             for mb, me, extra in self._moving:
                 if (me is None or m.param1 < me) and mb < m.param2:
                     tags.add(extra)
@@ -375,8 +385,8 @@ class Proxy:
         n = len(self._sbounds) - 1
         for i in range(n - 1, -1, -1):
             if key >= self._sbounds[i]:
-                return i
-        return 0
+                return self._stags[i]
+        return self._stags[0]
 
     def start_move(self, begin: bytes, end, extra_tag: int) -> None:
         """Dual-tag [begin, end) with `extra_tag` while a shard move is
@@ -384,12 +394,15 @@ class Proxy:
         self._moving.append((begin, end, extra_tag))
 
     def finish_move(self, begin: bytes, end, extra_tag: int,
-                    new_splits) -> None:
-        """Adopt the new shard boundaries and drop the dual tag
-        (ref: finishMoveKeys)."""
+                    new_splits, new_tags) -> None:
+        """Adopt the new shard boundaries/tags and drop the dual tag
+        (ref: finishMoveKeys). Tags are explicit — a positional
+        fallback would silently misroute after a split."""
         self._moving = [mv for mv in self._moving
                         if mv != (begin, end, extra_tag)]
         self._sbounds = [b""] + list(new_splits) + [None]
+        self._stags = list(new_tags)
+        assert len(self._stags) == len(self._sbounds) - 1
 
     # -- commit pipeline ------------------------------------------------
     async def _batcher(self):

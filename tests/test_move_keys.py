@@ -259,3 +259,108 @@ def test_exclusion_vacates_one_of_replicated_team():
         assert c.run(main(), timeout_time=600)
     finally:
         c.shutdown()
+
+
+def test_dd_splits_hot_shard_with_fresh_tag():
+    """A shard over the split threshold gets divided: DD mints a fresh
+    tag, recruits a new team, dual-tags the transition, and publishes
+    an extra shard — with reads/writes correct throughout and the new
+    tag live in the proxies' routing (ref: dataDistributionTracker
+    shardSplitter + moveKeys to a new team)."""
+    from foundationdb_tpu.flow import SERVER_KNOBS
+
+    c = SimCluster(seed=1401, durable=True, n_storage=1, n_workers=5)
+    try:
+        db = c.client()
+        SERVER_KNOBS.init("DD_SHARD_SPLIT_ROWS", 150)
+
+        async def main():
+            async def seed(tr):
+                for i in range(300):
+                    tr.set(b"s%04d" % i, b"v%d" % i)
+            await run_transaction(db, seed)
+
+            for _ in range(120):
+                await flow.delay(0.5)
+                info = c.cc.dbinfo.get()
+                if len(info.storages) >= 2:
+                    break
+            else:
+                raise AssertionError("hot shard never split")
+            info = c.cc.dbinfo.get()
+            tags = [s.tag for s in info.storages]
+            assert len(set(tags)) == len(tags)
+            assert max(tags) >= 1          # a fresh tag was minted
+            assert info.storages[0].end == info.storages[1].begin
+
+            # all rows survive, routed across the split
+            async def check(tr):
+                rows = await tr.get_range(b"s", b"t")
+                assert len(rows) == 300, len(rows)
+                # a write on each side of the new boundary
+                tr.set(b"s0000x", b"left")
+                tr.set(b"s0299x", b"right")
+            await run_transaction(db, check)
+
+            async def check2(tr):
+                assert await tr.get(b"s0000x") == b"left"
+                assert await tr.get(b"s0299x") == b"right"
+            await run_transaction(db, check2)
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
+
+
+def test_dd_merges_cold_split_back():
+    """After the data that forced a split is cleared, DD merges the
+    extra shard away (never below the configured count), retiring the
+    right team and its tag (ref: shardMerger)."""
+    from foundationdb_tpu.flow import SERVER_KNOBS
+
+    c = SimCluster(seed=1402, durable=True, n_storage=1, n_workers=5)
+    try:
+        db = c.client()
+        SERVER_KNOBS.init("DD_SHARD_SPLIT_ROWS", 150)
+
+        async def main():
+            async def seed(tr):
+                for i in range(300):
+                    tr.set(b"m%04d" % i, b"v%d" % i)
+            await run_transaction(db, seed)
+            for _ in range(120):
+                await flow.delay(0.5)
+                if len(c.cc.dbinfo.get().storages) >= 2:
+                    break
+            else:
+                raise AssertionError("never split")
+            right_names = [r.name
+                           for r in c.cc.dbinfo.get().storages[1].replicas]
+
+            # empty the keyspace: both shards go cold -> merge
+            async def wipe(tr):
+                tr.clear_range(b"", b"\xff")
+                tr.set(b"survivor", b"1")
+            await run_transaction(db, wipe)
+            for _ in range(120):
+                await flow.delay(0.5)
+                if len(c.cc.dbinfo.get().storages) == 1:
+                    break
+            else:
+                raise AssertionError("cold shards never merged")
+
+            # the right team retired: roles gone from every worker
+            for name in right_names:
+                assert all(name not in wi.worker.roles
+                           for wi in c.cc.workers.values()), name
+
+            async def check(tr):
+                assert await tr.get(b"survivor") == b"1"
+                tr.set(b"post-merge", b"2")
+            await run_transaction(db, check)
+            return True
+
+        assert c.run(main(), timeout_time=600)
+    finally:
+        c.shutdown()
