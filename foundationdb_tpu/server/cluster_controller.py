@@ -97,6 +97,7 @@ class ClusterController:
         self._vacate_seq = 0               # unique vacate-replica names
         self._vacate_retry_at = 0.0        # backoff for stuck vacates
         self._dd_last_committed = -1       # idle detection for DD nudges
+        self._max_tag_ever = max(config.n_storage - 1, 0)  # no tag reuse
         self.backup_active = False         # continuous-backup tagging
         self.backup_agent = None           # the live agent, when any
         # authoritative shard boundaries (ref: the keyServers system
@@ -531,17 +532,17 @@ class ClusterController:
             if any(o is None or not o.process.alive or o._adding
                    for team in teams for o in team):
                 continue
-            if len(info.storages) > self.config.n_storage and info.proxies:
-                # post-split watch state on an IDLE cluster: durability
-                # (and thus row counts) only advances with commits, so a
-                # cooled shard's counts would never fall to the merge
-                # threshold. A busy cluster advances on its own — skip.
-                committed = max(p.committed_version.get()
-                                for p in self._current_proxies()
-                                ) if self._current_proxies() else 0
-                if committed <= self._dd_last_committed:
+            objs0 = [self._storage_objs.get(s.replicas[0].name)
+                     for s in info.storages]
+            if (len(info.storages) > self.config.n_storage and info.proxies
+                    and all(o is not None for o in objs0)):
+                # post-split watch state: row counts only reflect
+                # reality once the storages SETTLE — pending un-durable
+                # mutations folded in and the MVCC window drained — and
+                # both only advance with commits. Nudge until settled,
+                # then the cluster goes fully quiet again.
+                if any(o._pending or o.data._keys for o in objs0):
                     await self._nudge_commit()
-                self._dd_last_committed = committed
             objs = [team[0] for team in teams]   # per-shard spokesman
             counts = [o.approx_rows() for o in objs]
             from ..flow import SERVER_KNOBS as _K
@@ -753,7 +754,13 @@ class ClusterController:
         if split is None or not (shard.begin < split and (
                 shard.end is None or split < shard.end)):
             raise error("operation_failed")
-        new_tag = max(s.tag for s in info.storages) + 1
+        # tags are NEVER reused within a CC lifetime: a merged-away
+        # tag's force-pops (1<<60) persist on the epoch's tlogs and
+        # would instantly free a re-minted tag's records
+        self._max_tag_ever = max(self._max_tag_ever,
+                                 max(s.tag for s in info.storages))
+        self._max_tag_ever += 1
+        new_tag = self._max_tag_ever
         nrep = max(1, self.config.storage_replicas)
         team = self.pick_workers(nrep, role="storage")
         names = [f"storage-{new_tag}-r{j}" for j in range(nrep)]
@@ -822,15 +829,18 @@ class ClusterController:
                 NewTag=new_tag).log()
         except BaseException:
             if not published:
-                for t in self.tlog_objs():
-                    exp = dict(t.expected_replicas)
-                    exp.pop(new_tag, None)
-                    t.set_expected_replicas(exp)
                 if dual_tagged:
                     for p in self._current_proxies():
                         p.finish_move(split, shard.end, new_tag,
                                       [s.begin for s in info.storages[1:]],
                                       [s.tag for s in info.storages])
+                for t in self.tlog_objs():
+                    exp = dict(t.expected_replicas)
+                    exp.pop(new_tag, None)
+                    t.set_expected_replicas(exp)
+                    # commits dual-tagged during the aborted split would
+                    # otherwise pin log records for the rest of the epoch
+                    t.pop(1 << 60, new_tag, "split-aborted")
                 for j, w in enumerate(team[:len(new_refs)]):
                     w.retire_storage(names[j])
                     self._storage_objs.pop(names[j], None)

@@ -44,8 +44,12 @@ class DiskQueue:
         self._limit = file_size_limit
         self._files: List[SimFile] = [
             disk.open(f"{name}.dq0", owner), disk.open(f"{name}.dq1", owner)]
-        # in-memory mirror of the live queue: (seq, payload)
-        self._records: List[Tuple[int, bytes]] = []
+        # in-memory mirror of the live queue: (seq, payload); a SPILLED
+        # record's payload is None — re-read from file via read(seq)
+        # (ref: spill-by-reference, the TLog keeping DiskQueue positions
+        # instead of values once memory exceeds the spill threshold)
+        self._records: List[Tuple[int, Optional[bytes]]] = []
+        self._offsets: dict = {}   # seq -> (file_idx, payload_off, length)
         self._next_seq = 0
         self._popped_seq = -1  # highest seq discarded
         self._cur = 0          # index of the file being appended
@@ -84,10 +88,12 @@ class DiskQueue:
         keep_end = [0, 0]
         self._file_first_seq = [1 << 62, 1 << 62]
         self._file_last_seq = [-1, -1]
-        for seq, _payload, i, end in valid:
+        self._offsets = {}
+        for seq, payload, i, end in valid:
             keep_end[i] = end
             self._file_first_seq[i] = min(self._file_first_seq[i], seq)
             self._file_last_seq[i] = max(self._file_last_seq[i], seq)
+            self._offsets[seq] = (i, end - len(payload), len(payload))
         for i in range(2):
             await self._files[i].truncate(keep_end[i])
             self._append_off[i] = keep_end[i]
@@ -142,6 +148,8 @@ class DiskQueue:
             await self._write_file_header(i, seq)
         rec = _REC_HDR.pack(seq, len(payload), zlib.crc32(payload)) + payload
         await self._files[i].write(self._append_off[i], rec)
+        self._offsets[seq] = (i, self._append_off[i] + _REC_HDR.size,
+                             len(payload))
         self._append_off[i] += len(rec)
         self._file_last_seq[i] = seq
         self._records.append((seq, payload))
@@ -176,8 +184,42 @@ class DiskQueue:
         idx = 0
         recs = self._records
         while idx < len(recs) and recs[idx][0] <= up_to_seq:
+            self._offsets.pop(recs[idx][0], None)
             idx += 1
         del recs[:idx]
+
+    # -- spill ----------------------------------------------------------
+    def spill(self, up_to_seq: int) -> None:
+        """Drop the in-memory payloads of committed records with
+        seq <= up_to_seq; they remain durable on disk and readable via
+        read(seq) (ref: TLog spill-by-reference — updatePersistentData
+        keeping DiskQueue locations instead of values)."""
+        for k, (seq, payload) in enumerate(self._records):
+            if seq > up_to_seq:
+                break
+            if payload is not None:
+                self._records[k] = (seq, None)
+
+    async def read(self, seq: int) -> Optional[bytes]:
+        """A committed record's payload straight from its file (the
+        spilled-peek path). None if the record is gone — popped before
+        the lookup, OR its file truncated by a roll while the read was
+        in flight (the header re-validates seq + crc, so a racing
+        truncation can never surface as garbage)."""
+        loc = self._offsets.get(seq)
+        if loc is None:
+            return None
+        i, off, length = loc
+        raw = await self._files[i].read(off - _REC_HDR.size,
+                                        _REC_HDR.size + length)
+        if len(raw) < _REC_HDR.size + length:
+            return None
+        got_seq, got_len, crc = _REC_HDR.unpack_from(raw, 0)
+        payload = bytes(raw[_REC_HDR.size:])
+        if got_seq != seq or got_len != length or \
+                zlib.crc32(payload) != crc:
+            return None
+        return payload
 
     # -- introspection --------------------------------------------------
     @property
@@ -191,4 +233,5 @@ class DiskQueue:
 
     @property
     def bytes_used(self) -> int:
-        return sum(len(p) for _, p in self._records)
+        """In-MEMORY bytes (spilled payloads don't count)."""
+        return sum(len(p) for _, p in self._records if p is not None)

@@ -37,6 +37,11 @@ def _tag_set(tagged) -> frozenset:
     return frozenset(tags)
 
 
+def _payload_bytes(tagged) -> int:
+    return sum(len(tm.mutation.param1) + len(tm.mutation.param2) + 16
+               for tm in tagged)
+
+
 class TLog:
     def __init__(self, process: SimProcess, disk: Optional[SimDisk] = None,
                  name: str = "tlog", fsync_delay: float = 0.0005,
@@ -45,10 +50,18 @@ class TLog:
         self.fsync_delay = fsync_delay
         self._dq = (DiskQueue(disk, name, owner=process)
                     if disk is not None else None)
-        # [(version, tagged_mutations, seq)] sorted by version
+        # [(version, tagged_mutations, seq)] sorted by version; a
+        # SPILLED entry's tagged_mutations is None — its payload lives
+        # only in the DiskQueue, re-read at peek (ref: TLog spill,
+        # TLogServer.actor.cpp updatePersistentData — memory stays
+        # bounded by TLOG_SPILL_THRESHOLD while a lagging reader can
+        # still drain the log)
         self.entries: list = []
         self._versions: list = []  # parallel sorted version index
         self._entry_tags: list = []  # parallel per-record tag sets
+        self._entry_bytes: list = []  # parallel payload-size estimates
+        self.mem_bytes = 0            # total un-spilled payload bytes
+        self._spill_floor = 0         # first possibly-unspilled index
         self.version = NotifiedVersion(recovery_version)  # highest durable
         self.queue_version = NotifiedVersion(recovery_version)  # accepted
         self.known_committed = recovery_version  # replicated log-set-wide
@@ -97,10 +110,16 @@ class TLog:
                 self.entries.append((version, tagged, seq0 + i))
                 self._versions.append(version)
                 self._entry_tags.append(_tag_set(tagged))
+                nb = _payload_bytes(tagged)
+                self._entry_bytes.append(nb)
+                self.mem_bytes += nb
             if self.entries:
                 last = self.entries[-1][0]
                 self.version.set(last)
                 self.queue_version.set(last)
+        # re-apply the memory bound: recovery decoded the whole durable
+        # queue into memory, which may far exceed the spill threshold
+        self._maybe_spill()
         if not self._recovered.is_ready:
             self._recovered.send(None)
 
@@ -153,6 +172,9 @@ class TLog:
         self.entries.append((req.version, req.mutations, -1))
         self._versions.append(req.version)
         self._entry_tags.append(_tag_set(req.mutations))
+        nb = _payload_bytes(req.mutations)
+        self._entry_bytes.append(nb)
+        self.mem_bytes += nb
         flow.spawn(self._make_durable(req, reply),
                    TaskPriority.TLOG_COMMIT_REPLY)
 
@@ -188,9 +210,39 @@ class TLog:
             if i < len(self._versions) and self._versions[i] == version:
                 e = self.entries[i]
                 self.entries[i] = (e[0], e[1], seq)
+            self._maybe_spill()
         if self.version.get() < version:
             self.version.set(version)
         reply.send(version)
+
+    def _maybe_spill(self) -> None:
+        """Spill the oldest durable entries once in-memory payload bytes
+        exceed TLOG_SPILL_THRESHOLD: memory keeps only the position; a
+        peek re-reads the payload from the DiskQueue (ref:
+        updatePersistentData's spill-by-reference)."""
+        from ..flow import SERVER_KNOBS
+        limit = SERVER_KNOBS.tlog_spill_threshold
+        if self._dq is None or self.mem_bytes <= limit:
+            return
+        spilled_to = -1
+        for i in range(self._spill_floor, len(self.entries)):
+            if self.mem_bytes <= limit:
+                break
+            v, tagged, s = self.entries[i]
+            if tagged is None:
+                self._spill_floor = i + 1
+                continue
+            if s < 0:
+                break   # not yet durable: spill is a strict prefix
+            self.entries[i] = (v, None, s)
+            self.mem_bytes -= self._entry_bytes[i]
+            self._entry_bytes[i] = 0
+            self._spill_floor = i + 1
+            spilled_to = max(spilled_to, s)
+        if spilled_to >= 0:
+            flow.cover("tlog.spilled")
+            self.stats.counter("spills").add(1)
+            self._dq.spill(spilled_to)
 
     async def _ack_when_durable(self, version, reply):
         await self.version.when_at_least(version)
@@ -236,7 +288,18 @@ class TLog:
         durable = self.version.get()
         hi = bisect_right(self._versions, durable)
         out = []
-        for v, tagged, _s in self.entries[lo:hi]:
+        # snapshot: spilled reads await the disk, and a concurrent pop
+        # may shift the live lists under us. The tag index answers
+        # "does this record even carry my tag" without touching disk.
+        snap = list(zip(self.entries[lo:hi], self._entry_tags[lo:hi]))
+        for (v, tagged, s), etags in snap:
+            if req.tag not in etags:
+                continue
+            if tagged is None:
+                payload = await self._dq.read(s)
+                if payload is None:
+                    continue   # popped while we read — reader is stale
+                _v, tagged = decode_log_entry(payload)
             ms = tuple(tm.mutation for tm in tagged if req.tag in tm.tags)
             if ms:
                 out.append((v, ms))
@@ -284,8 +347,11 @@ class TLog:
         if hi == 0:
             return
         max_seq = max((s for _v, _m, s in self.entries[:hi]), default=-1)
+        self.mem_bytes -= sum(self._entry_bytes[:hi])
         del self.entries[:hi]
         del self._versions[:hi]
         del self._entry_tags[:hi]
+        del self._entry_bytes[:hi]
+        self._spill_floor = max(0, self._spill_floor - hi)
         if self._dq is not None and max_seq >= 0:
             self._dq.pop(max_seq)

@@ -144,3 +144,62 @@ def test_lock_stops_commits_keeps_peeks(env):
 
     t = s.spawn(main())
     assert s.run(until=t, timeout_time=30)
+
+
+def test_spill_bounds_memory_and_peeks_from_disk():
+    """Once payload bytes exceed TLOG_SPILL_THRESHOLD the oldest durable
+    entries spill: memory keeps only DiskQueue positions, a lagging
+    reader's peek re-reads payloads from disk bit-exactly, pops still
+    reclaim, and recovery after a crash still sees everything (ref:
+    TLogServer updatePersistentData spill-by-reference)."""
+    fl.set_seed(23)
+    s = fl.Scheduler(virtual=True)
+    fl.set_scheduler(s)
+    try:
+        net = SimNetwork(s, fl.g_random)
+        proc = net.new_process("tlog-spill", machine="ms")
+        client = net.new_process("client", machine="mc")
+        disk = net.disk("ms")
+        fl.SERVER_KNOBS.init("TLOG_SPILL_THRESHOLD", 2000)
+        tlog = TLog(proc, disk=disk, name="tlog-sp")
+        tlog.start()
+
+        async def main():
+            await tlog.recovered()
+            val = b"v" * 100
+            for i in range(1, 41):   # ~4.6KB of payload >> 2KB threshold
+                await tlog.commits.ref().get_reply(
+                    TLogCommitRequest(i - 1, i, (_tm(0, b"k%03d" % i, val),),
+                                      i - 1), client)
+            assert tlog.mem_bytes <= 2000 + 200, tlog.mem_bytes
+            spilled = sum(1 for _v, m, _s in tlog.entries if m is None)
+            assert spilled >= 20, spilled
+
+            # a reader from the beginning sees every record, including
+            # the spilled prefix served from disk
+            reply = await tlog.peeks.ref().get_reply(
+                TLogPeekRequest(1, 0), client)
+            got = [(v, ms[0].param1, ms[0].param2) for v, ms in reply.entries]
+            assert got == [(i, b"k%03d" % i, val) for i in range(1, 41)]
+
+            # pops reclaim spilled records too
+            tlog.set_expected_replicas({0: ("r1",)})
+            tlog.pops.ref().send(TLogPopRequest(20, 0, "r1"), client)
+            await fl.delay(0.1)
+            assert tlog._versions[0] == 21
+
+            # recover from the durable image alone: 21..40 survive
+            tlog2 = TLog(proc, disk=disk, name="tlog-sp")
+            tlog2.start()
+            await tlog2.recovered()
+            reply2 = await tlog2.peeks.ref().get_reply(
+                TLogPeekRequest(1, 0), client)
+            vs = [v for v, _ms in reply2.entries]
+            assert vs[-1] == 40 and 21 in vs
+            return True
+
+        t = s.spawn(main())
+        assert s.run(until=t, timeout_time=120)
+    finally:
+        fl.reset_server_knobs()
+        fl.set_scheduler(None)
