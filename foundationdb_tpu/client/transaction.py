@@ -59,6 +59,12 @@ SYSTEM_PREFIX = b"\xff"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 CONF_PREFIX = b"\xff/conf/"
 EXCLUDED_PREFIX = b"\xff/excluded/"
+# \xff\x02: STORED system rows (latency probe, client status data —
+# ref: the \xff\x02 latencyProbe/client subspaces). Reads hit storage;
+# writes need the ACCESS_SYSTEM_KEYS option. \xff\xff (engine
+# metadata) stays off-limits even with the option.
+STORED_SYSTEM_PREFIX = b"\xff\x02"
+ENGINE_PREFIX = b"\xff\xff"
 
 
 def _rpc(fut: Future) -> Future:
@@ -225,7 +231,27 @@ class Transaction:
         self.db = db
         self.reset()
 
+    def set_option(self, option: str) -> None:
+        """(ref: fdb_transaction_set_option — the subset with behavior
+        here: ACCESS_SYSTEM_KEYS admits \\xff writes)"""
+        if option != "access_system_keys":
+            raise error("invalid_option_value")
+        self._access_system = True
+
+    def _check_writable(self, begin: bytes,
+                        end: Optional[bytes] = None) -> None:
+        sys_ok = getattr(self, "_access_system", False)
+        if end is None:  # point write
+            if begin.startswith(ENGINE_PREFIX) or (
+                    begin.startswith(SYSTEM_PREFIX) and not sys_ok):
+                raise error("key_outside_legal_range")
+        else:            # range [begin, end): end is exclusive
+            if end > ENGINE_PREFIX or (end > SYSTEM_PREFIX and not sys_ok) \
+                    or (begin.startswith(SYSTEM_PREFIX) and not sys_ok):
+                raise error("key_outside_legal_range")
+
     def reset(self) -> None:
+        self._access_system = False   # options reset with the txn
         self._used_seq: int = 0       # newest dbinfo seq this attempt saw
         self._read_version: Optional[int] = None
         self._writes: Dict[bytes, Optional[bytes]] = {}  # RYW write map
@@ -335,19 +361,20 @@ class Transaction:
         rows = [(KEY_SERVERS_PREFIX + s.begin,
                  b",".join(r.name.encode() for r in s.replicas))
                 for s in info.storages]
-        if self.db.status_ref is not None:
-            try:
-                status = await self.db.get_status()
-                conf = status.get("cluster", {}).get("configuration", {})
-                for k, v in conf.items():
-                    if k == "excluded":
-                        for w in v:
-                            rows.append((EXCLUDED_PREFIX + w.encode(), b""))
-                    else:
-                        rows.append((CONF_PREFIX + k.encode(),
-                                     str(v).encode()))
-            except flow.FdbError:
-                pass  # status unavailable: serve the shard map alone
+        try:
+            # capability check, not a ref check: a RemoteDatabase serves
+            # get_status over its own seam (review r3)
+            status = await self.db.get_status()
+            conf = status.get("cluster", {}).get("configuration", {})
+            for k, v in conf.items():
+                if k == "excluded":
+                    for w in v:
+                        rows.append((EXCLUDED_PREFIX + w.encode(), b""))
+                else:
+                    rows.append((CONF_PREFIX + k.encode(),
+                                 str(v).encode()))
+        except flow.FdbError:
+            pass  # status unavailable: serve the shard map alone
         rows.sort()
         return rows
 
@@ -374,7 +401,8 @@ class Transaction:
             StorageGetRequest(key, version), self.db.process))
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
-        if key.startswith(SYSTEM_PREFIX):
+        if key.startswith(SYSTEM_PREFIX) and \
+                not key.startswith(STORED_SYSTEM_PREFIX):
             return await self._system_get(key)
         if not snapshot:
             self._read_conflicts.append((key, _next_key(key)))
@@ -432,7 +460,8 @@ class Transaction:
             end = await self.get_key(end, snapshot=snapshot)
         if begin >= end:
             return []
-        if begin.startswith(SYSTEM_PREFIX):
+        if begin.startswith(SYSTEM_PREFIX) and \
+                not begin.startswith(STORED_SYSTEM_PREFIX):
             rows = [(k, v) for k, v in await self._system_rows()
                     if begin <= k < end]
             return sorted(rows, reverse=reverse)[:limit]
@@ -533,8 +562,7 @@ class Transaction:
         self._writes[key] = value
 
     def set(self, key: bytes, value: bytes) -> None:
-        if key.startswith(SYSTEM_PREFIX):
-            raise error("key_outside_legal_range")
+        self._check_writable(key)
         self._check_sizes(key, value)
         self._record_write(key, value)
         self._ops.pop(key, None)  # a set supersedes pending atomics
@@ -547,10 +575,7 @@ class Transaction:
     def clear_range(self, begin: bytes, end: bytes) -> None:
         if begin >= end:
             return
-        if begin.startswith(SYSTEM_PREFIX) or end > SYSTEM_PREFIX:
-            # an end reaching past \xff would clear into the system
-            # space (storage engines keep their metadata there)
-            raise error("key_outside_legal_range")
+        self._check_writable(begin, end)
         self._check_sizes(begin)
         self._check_sizes(end, slack=1)  # keyAfter(max-size key) is legal
         self._cleared.append((begin, end))
@@ -565,8 +590,7 @@ class Transaction:
 
     def atomic_op(self, key: bytes, param: bytes, op_type: int) -> None:
         """(ref: Transaction::atomicOp / fdbclient/Atomic.h op table)"""
-        if key.startswith(SYSTEM_PREFIX):
-            raise error("key_outside_legal_range")
+        self._check_writable(key)
         self._check_sizes(key, param)
         if op_type in (SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE):
             # transformed at the proxy with the commit version; the
@@ -648,6 +672,11 @@ class Transaction:
             shard = await self.db.shard_for(key)
             rep = shard.replicas[flow.g_random.random_int(
                 0, len(shard.replicas))]
+            if rep.watches is None:
+                # this seam doesn't carry watches (the TCP gateway) —
+                # fail the future cleanly instead of crashing the actor
+                f.send_error(error("client_invalid_operation"))
+                continue
             storage_fut = rep.watches.get_reply(
                 StorageWatchRequest(key, version), self.db.process)
             storage_fut.on_ready(

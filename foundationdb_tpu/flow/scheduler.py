@@ -217,19 +217,28 @@ class _TimerFuture(Future):
 
 
 # --- ambient scheduler -----------------------------------------------------
-# Single-threaded runtime: one active scheduler at a time (like g_network).
-_current: Optional[Scheduler] = None
+# One active scheduler per THREAD (like g_network): the simulator owns
+# its thread's loop, while an out-of-process client (client/remote.py)
+# may host a second wall-clock loop on its own thread in the same
+# process without clobbering the sim's.
+import threading as _threading
+
+
+class _Ambient(_threading.local):
+    current: Optional[Scheduler] = None
+
+
+_tls = _Ambient()
 
 
 def set_scheduler(s: Optional[Scheduler]) -> None:
-    global _current
-    _current = s
+    _tls.current = s
 
 
 def g() -> Scheduler:
-    if _current is None:
+    if _tls.current is None:
         raise error("internal_error")
-    return _current
+    return _tls.current
 
 
 def now() -> float:

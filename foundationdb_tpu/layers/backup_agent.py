@@ -248,8 +248,11 @@ def read_log(blob: bytes):
 def _replay_mutations(tr, mutations) -> None:
     """Replay one logged mutation batch into a transaction — the single
     apply switch shared by restore and DR (a replayable type added here
-    serves both paths)."""
+    serves both paths). System-key mutations (the \\xff\\x02 stored
+    subspace rides the backup tag like everything else) need the
+    option, exactly as the reference's restore does."""
     from ..server.types import ATOMIC_OPS, CLEAR_RANGE, SET_VALUE
+    tr.set_option("access_system_keys")
     for m in mutations:
         if m.type == SET_VALUE:
             tr.set(m.param1, m.param2)

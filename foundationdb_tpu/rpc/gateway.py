@@ -123,6 +123,12 @@ class TcpGateway:
             "seq": info.seq,
             "epoch": info.epoch,
             "recovery_state": info.recovery_state,
+            # control plane (ref: StatusClient / ManagementAPI reach the
+            # CC the same way data ops reach the roles)
+            "status": (self._expose(self.db.status_ref)
+                       if self.db.status_ref is not None else 0),
+            "management": (self._expose(self.db.management_ref)
+                           if self.db.management_ref is not None else 0),
             "proxies": [
                 {"grvs": self._expose(p.grvs),
                  "commits": self._expose(p.commits)}

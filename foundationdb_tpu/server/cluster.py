@@ -171,6 +171,16 @@ class SimCluster:
         has pulled to the log's committed frontier and the TLog backlog
         is fully popped (ref: fdbserver/QuietDatabase.actor.cpp — the
         post-workload settling tests rely on)."""
+        # the latency probe's own writes would keep the log from ever
+        # draining to zero — pause it while quiescing (the reference's
+        # quiet database similarly suppresses background traffic)
+        self.cc.probe_paused = True
+        try:
+            return await self._quiet_inner(max_wait)
+        finally:
+            self.cc.probe_paused = False
+
+    async def _quiet_inner(self, max_wait: float) -> None:
         deadline = flow.now() + max_wait
         while flow.now() < deadline:
             info = self.cc.dbinfo.get()

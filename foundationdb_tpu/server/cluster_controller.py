@@ -98,6 +98,7 @@ class ClusterController:
         self._vacate_retry_at = 0.0        # backoff for stuck vacates
         self._dd_last_committed = -1       # idle detection for DD nudges
         self._max_tag_ever = max(config.n_storage - 1, 0)  # no tag reuse
+        self.probe_paused = False          # quiet_database pauses probes
         self.backup_active = False         # continuous-backup tagging
         self.backup_agent = None           # the live agent, when any
         # authoritative shard boundaries (ref: the keyServers system
@@ -125,7 +126,8 @@ class ClusterController:
                            (self._open_db_loop(), "openDatabase"),
                            (self._status_loop(), "status"),
                            (self._management_loop(), "management"),
-                           (self._dd_loop(), "dataDistribution")):
+                           (self._dd_loop(), "dataDistribution"),
+                           (self._latency_probe_loop(), "latencyProbe")):
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
         self.process.on_kill(self._actors.cancel_all)
@@ -416,6 +418,55 @@ class ClusterController:
                     f"ratekeeper-e{ep}")
         return any(rn.startswith(prefixes) for rn in wi.worker.roles)
 
+    async def _latency_probe_loop(self):
+        """Measure real GRV/read/commit latency through an ordinary
+        client transaction and surface it in status (ref: the latency
+        probe section of clusterGetStatus, Status.actor.cpp:983 —
+        operators read these, and the bands feed alerting)."""
+        from ..client import Database
+        db = Database(self.process, self.open_db.ref())
+        self._latency_probe = {}
+        probe_seen_committed = -1
+        while True:
+            await flow.delay(5.0, TaskPriority.LOW_PRIORITY)
+            if self.dbinfo.get().recovery_state != FULLY_RECOVERED or \
+                    self.probe_paused:
+                continue
+            try:
+                probe_key = b"\xff\x02/status/latency_probe"
+                tr = db.create_transaction()
+                t0 = flow.now()
+                await tr.get_read_version()
+                grv_s = flow.now() - t0
+                t1 = flow.now()
+                await tr.get(probe_key)
+                read_s = flow.now() - t1
+                probe = {
+                    "transaction_start_seconds": round(grv_s, 6),
+                    "read_seconds": round(read_s, 6),
+                    "probed_at": round(flow.now(), 3),
+                }
+                # the COMMIT probe only runs while the cluster is
+                # seeing commits: an idle cluster must be able to go
+                # fully quiet (quiet_database drains the log to zero),
+                # which a 5s probe write would forever prevent
+                committed = max((p.committed_version.get()
+                                 for p in self._current_proxies()),
+                                default=-1)
+                if committed != probe_seen_committed:
+                    tr2 = db.create_transaction()
+                    tr2.set_option("access_system_keys")
+                    tr2.set(probe_key, b"%d" % int(flow.now() * 1000))
+                    t2 = flow.now()
+                    probe_seen_committed = await tr2.commit()
+                    probe["commit_seconds"] = round(flow.now() - t2, 6)
+                elif "commit_seconds" in self._latency_probe:
+                    probe["commit_seconds"] = \
+                        self._latency_probe["commit_seconds"]
+                self._latency_probe = probe
+            except flow.FdbError:
+                pass  # a probe racing a recovery just skips a round
+
     # -- status ----------------------------------------------------------
     async def _status_loop(self):
         while True:
@@ -488,6 +539,7 @@ class ClusterController:
                 "storages": storages,
                 "proxies": proxies,
                 "qos": {"transactions_per_second_limit": rate},
+                "latency_probe": getattr(self, "_latency_probe", {}),
                 # run-loop profiler (ref: Net2 slow-task sampling /
                 # SystemMonitor machine metrics in status)
                 "run_loop": {

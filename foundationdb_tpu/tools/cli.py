@@ -58,10 +58,22 @@ def _printable(b: bytes) -> str:
 
 
 class Cli:
-    def __init__(self, cluster: SimCluster):
-        self.cluster = cluster
-        self.db = cluster.client("fdbcli")
+    def __init__(self, db, runner):
+        """`db` is any Database-shaped handle (in-sim or remote);
+        `runner` executes a client coroutine to completion — the sim
+        loop locally, RemoteCluster.call over TCP."""
+        self.db = db
+        self._runner = runner
         self.writemode = True
+
+    @classmethod
+    def for_cluster(cls, cluster: SimCluster) -> "Cli":
+        return cls(cluster.client("fdbcli"),
+                   lambda coro: cluster.run(coro, timeout_time=600))
+
+    @classmethod
+    def for_remote(cls, remote) -> "Cli":
+        return cls(remote.db, remote.call)
 
     def execute(self, line: str) -> str:
         """Run one command line; returns the printed output."""
@@ -82,7 +94,7 @@ class Cli:
             return f"ERROR: {getattr(e, 'name', None) or e}"
 
     def _run(self, coro):
-        return self.cluster.run(coro, timeout_time=600)
+        return self._runner(coro)
 
     def _dispatch(self, cmd: str, args: List[bytes],
                   raw: List[str]) -> str:
@@ -213,14 +225,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     script = None
     seed = 0
+    connect = None
     while argv:
         a = argv.pop(0)
         if a == "--exec":
             script = argv.pop(0)
         elif a == "--seed":
             seed = int(argv.pop(0))
-    cluster = SimCluster(seed=seed, durable=True)
-    cli = Cli(cluster)
+        elif a == "--connect":
+            connect = argv.pop(0)
+    cluster = None
+    remote = None
+    if connect is not None:
+        # remote mode (ref: fdbcli -C cluster-file): speak the wire
+        # protocol to a tools.server / TcpGateway in another process
+        from ..client.remote import RemoteCluster
+        host, _colon, port = connect.rpartition(":")
+        if not port.isdigit():
+            print(f"--connect expects host:port, got `{connect}'",
+                  file=sys.stderr)
+            return 2
+        remote = RemoteCluster(host or "127.0.0.1", int(port))
+        cli = Cli.for_remote(remote)
+    else:
+        cluster = SimCluster(seed=seed, durable=True)
+        cli = Cli.for_cluster(cluster)
     try:
         if script is not None:
             for line in _split_script(script):
@@ -241,7 +270,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             if out:
                 print(out)
     finally:
-        cluster.shutdown()
+        if remote is not None:
+            remote.close()
+        if cluster is not None:
+            cluster.shutdown()
 
 
 if __name__ == "__main__":

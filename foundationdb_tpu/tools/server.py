@@ -1,0 +1,70 @@
+"""Cluster host process: serve a cluster on a TCP port.
+
+Reference: fdbserver + fdbmonitor — one OS process hosting the
+database, reachable over the network; `python -m
+foundationdb_tpu.tools.server --port 4500` plays that role for this
+framework: a wall-clock cluster (every role, durable disks, recovery,
+DD) whose client surface is served by the TcpGateway, so external
+processes — the CLI's --connect mode, the C binding, any
+RemoteCluster — speak the real wire protocol to it.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from .. import flow
+from ..rpc.gateway import TcpGateway
+from ..server import SimCluster
+
+
+def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
+          storage_replicas: int = 1, n_logs: int = 1, n_proxies: int = 1,
+          announce=print) -> None:
+    """Run until interrupted; announces `LISTENING <port>` once up."""
+    c = SimCluster(seed=seed, virtual=False, durable=True,
+                   n_storage=n_storage, storage_replicas=storage_replicas,
+                   n_logs=n_logs, n_proxies=n_proxies)
+    gw = TcpGateway(c.client("gateway-host"), port=port)
+    try:
+        async def main():
+            gw.start()
+            announce(f"LISTENING {gw.port}", flush=True)
+            while True:
+                await flow.delay(0.5)
+
+        c.run(main())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.close()
+        c.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    kwargs = {}
+    while argv:
+        a = argv.pop(0)
+        if a == "--port":
+            kwargs["port"] = int(argv.pop(0))
+        elif a == "--seed":
+            kwargs["seed"] = int(argv.pop(0))
+        elif a == "--storage":
+            kwargs["n_storage"] = int(argv.pop(0))
+        elif a == "--replicas":
+            kwargs["storage_replicas"] = int(argv.pop(0))
+        elif a == "--logs":
+            kwargs["n_logs"] = int(argv.pop(0))
+        elif a == "--proxies":
+            kwargs["n_proxies"] = int(argv.pop(0))
+        else:
+            print(f"unknown argument {a}", file=sys.stderr)
+            return 2
+    serve(**kwargs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

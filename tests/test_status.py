@@ -66,3 +66,29 @@ def test_status_shows_failure_and_recovery():
         assert c.run(main(), timeout_time=300)
     finally:
         c.shutdown()
+
+
+def test_status_latency_probe():
+    """The CC's periodic probe transaction reports real GRV/read/commit
+    latencies in status (ref: Status.actor.cpp:983 latencyProbe)."""
+    from foundationdb_tpu import flow
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=71)
+    try:
+        db = c.client()
+
+        async def main():
+            for _ in range(40):
+                status = await db.get_status()
+                probe = status["cluster"]["latency_probe"]
+                if probe:
+                    assert probe["transaction_start_seconds"] >= 0
+                    assert probe["commit_seconds"] > 0
+                    return True
+                await flow.delay(1.0)
+            raise AssertionError("latency probe never reported")
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()

@@ -108,3 +108,37 @@ def test_clear_range_cannot_reach_system_space():
         assert c.run(main(), timeout_time=60)
     finally:
         c.shutdown()
+
+
+def test_access_system_keys_option_and_stored_subspace():
+    """ACCESS_SYSTEM_KEYS admits \xff\x02 stored-system writes (the
+    latency-probe subspace); without it they reject; \xff\xff engine
+    space rejects always; user scans never see system rows."""
+    c = SimCluster(seed=53, durable=True)
+    try:
+        db = c.client()
+
+        async def main():
+            tr = db.create_transaction()
+            with pytest.raises(flow.FdbError):
+                tr.set(b"\xff\x02/own", b"x")       # no option
+            tr.set_option("access_system_keys")
+            tr.set(b"\xff\x02/own", b"x")           # option: allowed
+            with pytest.raises(flow.FdbError):
+                tr.set(b"\xff\xff/engine", b"x")    # never
+            tr.set(b"user", b"1")
+            await tr.commit()
+
+            tr2 = db.create_transaction()
+            assert await tr2.get(b"\xff\x02/own") == b"x"  # stored read
+            rows = await tr2.get_range(b"", b"\xff")
+            assert rows == [(b"user", b"1")]        # user scan is clean
+            # option state resets with the transaction
+            tr2.reset()
+            with pytest.raises(flow.FdbError):
+                tr2.set(b"\xff\x02/own", b"y")
+            return True
+
+        assert c.run(main(), timeout_time=60)
+    finally:
+        c.shutdown()

@@ -13,7 +13,7 @@ from foundationdb_tpu.tools.cli import Cli
 
 def test_cli_commands():
     c = SimCluster(seed=801)
-    cli = Cli(c)
+    cli = Cli.for_cluster(c)
     try:
         assert cli.execute("set apple red") == "Committed"
         assert cli.execute("set banana yellow") == "Committed"
