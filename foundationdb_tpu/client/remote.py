@@ -160,6 +160,7 @@ class RemoteCluster:
         except BaseException as e:  # noqa: BLE001 — surface to creator
             self._started.put(e)
         finally:
+            self._stop.set()   # later call()s fail fast, never hang
             transport.close()
             flow.set_scheduler(None)
 
@@ -174,6 +175,8 @@ class RemoteCluster:
 
     def call(self, coro, timeout: float = 600.0):
         """Run a client coroutine on the loop thread; blocking."""
+        if self._stop.is_set() or not self._thread.is_alive():
+            raise flow.error("broken_promise")   # loop gone: fail fast
         box: list = []
         done = threading.Event()
         self._submissions.put((coro, box, done))

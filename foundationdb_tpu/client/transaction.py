@@ -240,15 +240,21 @@ class Transaction:
 
     def _check_writable(self, begin: bytes,
                         end: Optional[bytes] = None) -> None:
+        """ACCESS_SYSTEM_KEYS admits ONLY the stored \\xff\\x02 subspace
+        — writes to the synthetic materialized ranges (keyServers/conf/
+        excluded) would commit into a space reads never consult, a
+        silent black hole (review r3)."""
         sys_ok = getattr(self, "_access_system", False)
         if end is None:  # point write
-            if begin.startswith(ENGINE_PREFIX) or (
-                    begin.startswith(SYSTEM_PREFIX) and not sys_ok):
+            if begin.startswith(SYSTEM_PREFIX) and not (
+                    sys_ok and begin.startswith(STORED_SYSTEM_PREFIX)
+                    and not begin.startswith(ENGINE_PREFIX)):
                 raise error("key_outside_legal_range")
         else:            # range [begin, end): end is exclusive
-            if end > ENGINE_PREFIX or (end > SYSTEM_PREFIX and not sys_ok) \
-                    or (begin.startswith(SYSTEM_PREFIX) and not sys_ok):
-                raise error("key_outside_legal_range")
+            if begin.startswith(SYSTEM_PREFIX) or end > SYSTEM_PREFIX:
+                if not (sys_ok and begin.startswith(STORED_SYSTEM_PREFIX)
+                        and end <= ENGINE_PREFIX):
+                    raise error("key_outside_legal_range")
 
     def reset(self) -> None:
         self._access_system = False   # options reset with the txn
@@ -464,6 +470,12 @@ class Transaction:
                 not begin.startswith(STORED_SYSTEM_PREFIX):
             rows = [(k, v) for k, v in await self._system_rows()
                     if begin <= k < end]
+            if end > STORED_SYSTEM_PREFIX:
+                # the range crosses into the STORED system subspace:
+                # point reads serve those rows, so range scans must too
+                rows += await self.get_range(
+                    STORED_SYSTEM_PREFIX, min(end, ENGINE_PREFIX),
+                    snapshot=snapshot)
             return sorted(rows, reverse=reverse)[:limit]
         version = await self.get_read_version()
         # With no RYW overlay in the range the storage servers honor the

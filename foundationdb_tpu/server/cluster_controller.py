@@ -217,6 +217,8 @@ class ClusterController:
                                                  req.worker, ())
             for lr in req.recovered_logs:
                 self.log_stores[lr.store] = lr
+            if req.recovered_logs:
+                self._merge_recovered_logs(req.recovered_logs)
             if req.recovered_storages:
                 for r in req.recovered_storages:
                     obj = req.worker.roles.get(r.name)
@@ -224,6 +226,26 @@ class ClusterController:
                         self._storage_objs[r.name] = obj
                 self._merge_storages(req.recovered_storages)
             reply.send(None)
+
+    def _merge_recovered_logs(self, refs) -> None:
+        """A rebooted worker re-registered old-generation log stores:
+        swap the fresh endpoints into the broadcast picture by store
+        name, or a behind storage server could never finish draining
+        that generation — its peeks would hit the dead pre-reboot refs
+        until the next full recovery (found by the DD-under-attrition
+        workload). Current-generation refs are recovery's job: a
+        current tlog death already ends the epoch."""
+        info = self.dbinfo.get()
+        by_store = {lr.store: lr for lr in refs}
+        changed = False
+        new_old = []
+        for gen in info.old_logs:
+            logs = tuple(by_store.get(lr.store, lr) for lr in gen.logs)
+            if logs != gen.logs:
+                changed = True
+            new_old.append(gen._replace(logs=logs))
+        if changed:
+            self.publish(info._replace(old_logs=tuple(new_old)))
 
     def _merge_storages(self, refs: Tuple[StorageRefs, ...]) -> None:
         """A rebooted worker re-registered storage shards: swap the new

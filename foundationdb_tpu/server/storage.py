@@ -704,6 +704,20 @@ class StorageServer:
             new_end = end
         self.shard_begin, self.shard_end = new_begin, new_end
         self._persist_meta()
+        # a WHOLE-shard install (vacate/split newcomer) makes at_version
+        # a durable version outright: everything below it is in the
+        # snapshot. Without this, a crash before the first durability
+        # cycle recovers at version 0 and wedges pulling generations
+        # that no longer exist. (Partial installs — boundary moves —
+        # must NOT claim it: the old range still needs its own replay.)
+        if begin <= new_begin and (
+                end is None or (new_end is not None and end >= new_end)):
+            if at_version > self.durable_version.get():
+                self.kv.set(DURABLE_VERSION_KEY,
+                            struct.pack("<Q", at_version))
+                self.durable_version.set(at_version)
+                if self.version.get() < at_version:
+                    self.version.set(at_version)
         await self.kv.commit()
         buf, self._adding_buf = self._adding_buf, []
         self._adding = None

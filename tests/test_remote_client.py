@@ -62,12 +62,16 @@ def test_cli_against_separate_server_process():
     """True multi-process: a tools.server subprocess hosts the cluster;
     the CLI connects over TCP from THIS process and reads back what it
     wrote (ref: fdbcli -C against a running fdbserver)."""
+    import os
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.Popen(
         [sys.executable, "-m", "foundationdb_tpu.tools.server",
          "--port", "0", "--seed", "83"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
-             "PYTHONPATH": "/root/repo", "HOME": "/root"})
+        env=env)
     try:
         line = proc.stdout.readline().strip()
         assert line.startswith("LISTENING "), line
