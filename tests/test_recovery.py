@@ -224,8 +224,7 @@ def test_whole_cluster_blackout_recovers_from_disks(seed):
     the transaction subsystem from the surviving disk stores with every
     acknowledged commit intact (ref: the simulation restart tests —
     recovery from durable state alone)."""
-    c = SimCluster(seed=seed, durable=True, n_logs=2, n_storage=2,
-                   n_workers=6)
+    c = _durable_cluster(seed, n_logs=2, n_storage=2, n_workers=6)
     try:
         db = c.client()
 
@@ -241,10 +240,7 @@ def test_whole_cluster_blackout_recovers_from_disks(seed):
 
             # total blackout: every worker dies in the same instant
             for name in list(c.workers):
-                try:
-                    c.kill_worker(name)
-                except KeyError:
-                    pass
+                c.kill_worker(name)
 
             # auto-reboot + epoch recovery must heal from disks alone
             async def check(tr):
