@@ -89,7 +89,9 @@ class Database:
         self.status_ref = status_ref
         self.management_ref = management_ref
         self._info = None
-        self._grv_waiters: List[Future] = []
+        #: priority class -> waiting futures (batched per class so a
+        #: BATCH rider can never borrow DEFAULT's admission)
+        self._grv_waiters: Dict[int, List[Future]] = {}
         self._grv_timer_armed = False
         #: replica name -> latency EMA seconds (ref: LoadBalance's
         #: per-alternative latency model, fdbrpc/LoadBalance.actor.h)
@@ -154,14 +156,18 @@ class Database:
         info = await self.info()
         return info.storages[_shard_index(info.storages, key)]
 
-    def batched_grv(self) -> Future:
-        """Batch concurrent GRV REQUESTS into one proxy round trip (ref:
-        readVersionBatcher, NativeAPI.actor.cpp:2854). Requests are
-        collected for one batch interval and THEN fetched — a request
-        must never join a fetch already in flight, or a client could
-        receive a version predating its own acknowledged commit."""
+    def batched_grv(self, priority: Optional[int] = None) -> Future:
+        """Batch concurrent GRV REQUESTS into one proxy round trip PER
+        PRIORITY CLASS (ref: readVersionBatcher,
+        NativeAPI.actor.cpp:2854). Requests are collected for one batch
+        interval and THEN fetched — a request must never join a fetch
+        already in flight, or a client could receive a version
+        predating its own acknowledged commit."""
+        from ..server.types import PRIORITY_DEFAULT
+        if priority is None:
+            priority = PRIORITY_DEFAULT
         f = Future()
-        self._grv_waiters.append(f)
+        self._grv_waiters.setdefault(priority, []).append(f)
         if not self._grv_timer_armed:
             self._grv_timer_armed = True
             flow.spawn(self._grv_batch_fire(),
@@ -170,17 +176,27 @@ class Database:
         return f
 
     async def _grv_batch_fire(self) -> None:
-        from ..server.types import GetReadVersionRequest
         await flow.delay(SERVER_KNOBS.grv_batch_interval,
                          TaskPriority.DEFAULT_ENDPOINT)
-        waiters, self._grv_waiters = self._grv_waiters, []
+        by_prio, self._grv_waiters = self._grv_waiters, {}
         self._grv_timer_armed = False
+        # classes fetch CONCURRENTLY: a throttled or dead-proxy fetch in
+        # one class must not head-of-line block (or, on cancellation,
+        # strand) another class's independent round trip
+        for priority, waiters in by_prio.items():
+            flow.spawn(self._grv_fetch_one(priority, waiters),
+                       TaskPriority.DEFAULT_ENDPOINT,
+                       name=f"client.grvFetch.p{priority}")
+
+    async def _grv_fetch_one(self, priority: int, waiters) -> None:
+        from ..server.types import GetReadVersionRequest
         info = None
         try:
             info = await self.info()
             proxy = await self.proxy()
             reply = await _rpc(proxy.grvs.get_reply(
-                GetReadVersionRequest(len(waiters)), self.process))
+                GetReadVersionRequest(len(waiters), priority),
+                self.process))
             for f in waiters:
                 if not f.is_ready:
                     f.send((reply.version, info.seq))
@@ -231,12 +247,31 @@ class Transaction:
         self.db = db
         self.reset()
 
-    def set_option(self, option: str) -> None:
+    def set_option(self, option: str, value=None) -> None:
         """(ref: fdb_transaction_set_option — the subset with behavior
-        here: ACCESS_SYSTEM_KEYS admits \\xff writes)"""
-        if option != "access_system_keys":
+        here: ACCESS_SYSTEM_KEYS admits \\xff\\x02 writes; TIMEOUT
+        bounds the transaction INCLUDING retries in seconds;
+        RETRY_LIMIT caps on_error resets. Timeout/retry state survives
+        reset() the way the reference's options do.)"""
+        from ..server.types import PRIORITY_BATCH, PRIORITY_IMMEDIATE
+        if option == "access_system_keys":
+            self._access_system = True
+        elif option in ("timeout", "retry_limit"):
+            try:
+                value = float(value) if option == "timeout" else int(value)
+            except (TypeError, ValueError):
+                raise error("invalid_option_value") from None
+            if option == "timeout":
+                self._timeout_seconds = value
+                self._timeout_deadline = flow.now() + value
+            else:
+                self._retry_limit = value
+        elif option == "priority_batch":
+            self._grv_priority = PRIORITY_BATCH
+        elif option == "priority_system_immediate":
+            self._grv_priority = PRIORITY_IMMEDIATE
+        else:
             raise error("invalid_option_value")
-        self._access_system = True
 
     def _check_writable(self, begin: bytes,
                         end: Optional[bytes] = None) -> None:
@@ -258,6 +293,12 @@ class Transaction:
 
     def reset(self) -> None:
         self._access_system = False   # options reset with the txn
+        # timeout/retry OPTIONS survive an explicit reset, but their
+        # spent budgets re-arm — a reused object starts a fresh logical
+        # transaction (ref: fdb reset semantics)
+        self._retries_used = 0
+        if getattr(self, "_timeout_seconds", None) is not None:
+            self._timeout_deadline = flow.now() + self._timeout_seconds
         self._used_seq: int = 0       # newest dbinfo seq this attempt saw
         self._read_version: Optional[int] = None
         self._writes: Dict[bytes, Optional[bytes]] = {}  # RYW write map
@@ -343,7 +384,8 @@ class Transaction:
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            version, seq = await self.db.batched_grv()
+            version, seq = await self.db.batched_grv(
+                getattr(self, "_grv_priority", None))
             if seq > self._used_seq:
                 self._used_seq = seq
             self._read_version = version
@@ -701,16 +743,30 @@ class Transaction:
         """(ref: Transaction::onError :2956 — backoff and reset; a
         failure that implies a stale cluster picture re-fetches the
         ServerDBInfo first, which long-polls across an in-flight
-        recovery)"""
+        recovery; TIMEOUT/RETRY_LIMIT options bound the loop)"""
         if not (isinstance(e, flow.FdbError) and e.name in RETRYABLE):
             raise e
+        deadline = getattr(self, "_timeout_deadline", None)
+        if deadline is not None and flow.now() >= deadline:
+            raise error("transaction_timed_out")
+        limit = getattr(self, "_retry_limit", None)
+        if limit is not None:
+            self._retries_used = getattr(self, "_retries_used", 0) + 1
+            if self._retries_used > limit:
+                raise e
         flow.cover("client.retry.conflict", e.name == "not_committed")
         if e.name in REFRESH_ERRORS:
             flow.cover("client.refresh_stale_picture")
             await self.db.refresh_past(self._used_seq)
         await flow.delay(0.001 + flow.g_random.random01() * 0.01,
                          TaskPriority.DEFAULT_ENDPOINT)
+        # a RETRY reset keeps the logical transaction's spent budgets —
+        # only an explicit user reset() re-arms them
+        retries = getattr(self, "_retries_used", 0)
         self.reset()
+        self._retries_used = retries
+        if deadline is not None:
+            self._timeout_deadline = deadline
 
 
 async def run_transaction(db: Database, body, max_retries: int = 100):

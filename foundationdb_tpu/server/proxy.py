@@ -26,7 +26,8 @@ from .. import flow
 from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
-from .types import (CLEAR_RANGE, SET_VALUE, SET_VERSIONSTAMPED_KEY,
+from .types import (CLEAR_RANGE, PRIORITY_DEFAULT, PRIORITY_IMMEDIATE,
+                    SET_VALUE, SET_VERSIONSTAMPED_KEY,
                     SET_VERSIONSTAMPED_VALUE, CommitReply, CommitRequest,
                     GetReadVersionReply, MutationRef, ResolveRequest,
                     TLogCommitRequest, TaggedMutation)
@@ -254,8 +255,8 @@ class Proxy:
         self.resolver_map_updates.close()
         # a stop mid-confirmation must fail the popped batch too, or
         # those clients wait out the full request timeout (code review)
-        for reply, _cnt in self._grv_queue + self._grv_inflight:
-            reply.send_error(error("broken_promise"))
+        for entry in self._grv_queue + self._grv_inflight:
+            entry[0].send_error(error("broken_promise"))
         self._grv_queue = []
         self._grv_inflight = []
 
@@ -268,7 +269,8 @@ class Proxy:
         while True:
             req, reply = await self.grvs.pop()
             count = getattr(req, "transaction_count", None) or 1
-            self._grv_queue.append((reply, count))
+            prio = getattr(req, "priority", PRIORITY_DEFAULT)
+            self._grv_queue.append((reply, count, prio))
 
     async def _grv_batcher(self):
         """Release queued GRVs in rate-gated batches; one causal
@@ -280,28 +282,37 @@ class Proxy:
         while True:
             await flow.delay(interval, TaskPriority.PROXY_GRV_TIMER)
             now = flow.now()
-            # token bucket with a one-interval burst allowance
-            tokens = min(tokens + self._rate * (now - last),
-                         max(1.0, self._rate * 10 * interval))
+            # token bucket with a one-interval burst allowance; a ZERO
+            # rate is a full stop (emergency throttle), not a trickle
+            if self._rate <= 0:
+                tokens = 0.0
+            else:
+                tokens = min(tokens + self._rate * (now - last),
+                             max(1.0, self._rate * 10 * interval))
             last = now
             if not self._grv_queue:
                 continue
+            # priority classes (ref: TransactionPriority): IMMEDIATE
+            # bypasses the gate and pays no tokens; DEFAULT next; BATCH
+            # sorts last so it is throttled first when tokens run out
+            self._grv_queue.sort(key=lambda e: -e[2])
             take = 0
-            admitted = 0
+            charged = 0
             while take < len(self._grv_queue):
-                cnt = self._grv_queue[take][1]
-                if admitted + cnt > tokens:
-                    break
-                admitted += cnt
+                _r, cnt, prio = self._grv_queue[take]
+                if prio < PRIORITY_IMMEDIATE:
+                    if charged + cnt > tokens:
+                        break
+                    charged += cnt
                 take += 1
             if take == 0:
                 if tokens < 1:
                     continue
                 # a batch bigger than the burst cap still admits by
                 # running the bucket into debt, or it would starve
-                admitted = self._grv_queue[0][1]
+                charged = self._grv_queue[0][1]
                 take = 1
-            tokens -= admitted
+            tokens -= charged
             self._grv_inflight, self._grv_queue = (self._grv_queue[:take],
                                                    self._grv_queue[take:])
             try:
@@ -326,12 +337,12 @@ class Proxy:
                 others = await flow.all_of(futs)
                 version = max([version] + list(others))
             self.stats.counter("transactions_started").add(
-                sum(cnt for _r, cnt in batch))
-            for reply, _cnt in batch:
-                reply.send(GetReadVersionReply(version))
+                sum(e[1] for e in batch))
+            for entry in batch:
+                entry[0].send(GetReadVersionReply(version))
         except flow.FdbError as e:
-            for reply, _cnt in batch:
-                reply.send_error(e)
+            for entry in batch:
+                entry[0].send_error(e)
 
     async def _rate_loop(self):
         """(ref: proxies polling GetRateInfo from the ratekeeper)"""

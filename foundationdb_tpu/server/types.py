@@ -77,12 +77,20 @@ class CommitReply(NamedTuple):
                        # (second half of the versionstamp)
 
 
+PRIORITY_BATCH = 0
+PRIORITY_DEFAULT = 1
+PRIORITY_IMMEDIATE = 2
+
+
 class GetReadVersionRequest(NamedTuple):
     """(ref: GetReadVersionRequest — carries the number of transactions
     the (client-batched) request admits, so the ratekeeper debit is
-    per-transaction, not per-RPC)"""
+    per-transaction, not per-RPC, and the priority class:
+    BATCH is throttled first, IMMEDIATE bypasses the rate gate —
+    TransactionPriority in fdbclient/FDBTypes.h)"""
 
     transaction_count: int = 1
+    priority: int = PRIORITY_DEFAULT
 
 
 class GetReadVersionReply(NamedTuple):

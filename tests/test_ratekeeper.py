@@ -74,3 +74,58 @@ def test_dead_storage_throttles_admission():
         assert c.run(main(), timeout_time=300)
     finally:
         c.shutdown()
+
+
+def test_grv_priority_classes():
+    """BATCH priority is throttled first when the rate budget runs dry;
+    IMMEDIATE bypasses the gate entirely (ref: TransactionPriority +
+    the per-class budgets in transactionStarter/Ratekeeper)."""
+    from foundationdb_tpu.server.proxy import Proxy
+
+    c = SimCluster(seed=61)
+    try:
+        db = c.client()
+
+        async def main():
+            await db.info()   # wait for recruitment
+            # choke the admission rate at its SOURCE — the proxies
+            # re-poll the ratekeeper every 100ms, so patching their
+            # cached copy alone would be overwritten
+            from foundationdb_tpu.server.ratekeeper import Ratekeeper
+            proxies = [role for wi in c.cc.workers.values()
+                       for role in wi.worker.roles.values()
+                       if isinstance(role, Proxy)]
+            for wi in c.cc.workers.values():
+                for role in wi.worker.roles.values():
+                    if isinstance(role, Ratekeeper):
+                        role._compute_rate = lambda: 0.0
+            for p in proxies:
+                p._rate = 0.0
+            await flow.delay(0.3)   # let the zero rate propagate
+
+            tr_b = db.create_transaction()
+            tr_b.set_option("priority_batch")
+            tr_i = db.create_transaction()
+            tr_i.set_option("priority_system_immediate")
+
+            # immediate sails through a zero-rate gate
+            fi = flow.spawn(tr_i.get_read_version())
+            fb = flow.spawn(tr_b.get_read_version())
+            await flow.delay(1.0)
+            assert fi.is_ready and not fi.is_error
+            assert not fb.is_ready          # batch is throttled
+
+            # restoring the budget (at the source) releases the batch
+            for wi in c.cc.workers.values():
+                for role in wi.worker.roles.values():
+                    if isinstance(role, Ratekeeper):
+                        role._compute_rate = lambda: 1e9
+            for p in proxies:
+                p._rate = 1e9
+            await flow.delay(1.0)
+            assert fb.is_ready and not fb.is_error
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()

@@ -142,3 +142,65 @@ def test_access_system_keys_option_and_stored_subspace():
         assert c.run(main(), timeout_time=60)
     finally:
         c.shutdown()
+
+
+def test_timeout_and_retry_limit_options():
+    """TIMEOUT bounds the whole retry loop; RETRY_LIMIT caps on_error
+    resets (ref: fdb_transaction_set_option TIMEOUT/RETRY_LIMIT — the
+    options survive resets so the loop actually terminates)."""
+    c = SimCluster(seed=54)
+    try:
+        db = c.client()
+
+        async def main():
+            # retry_limit: a perpetually-conflicting transaction stops
+            # after exactly N retries
+            tr = db.create_transaction()
+            tr.set_option("retry_limit", 3)
+            attempts = [0]
+            for _ in range(50):
+                attempts[0] += 1
+                await tr.get(b"rl")
+                # sabotage: commit something conflicting from the side
+                side = db.create_transaction()
+                side.set(b"rl", b"x%d" % attempts[0])
+                await side.commit()
+                tr.set(b"rl", b"mine")
+                try:
+                    await tr.commit()
+                    raise AssertionError("should have conflicted")
+                except flow.FdbError as e:
+                    assert e.name == "not_committed"
+                    try:
+                        await tr.on_error(e)
+                    except flow.FdbError as e2:
+                        assert e2.name == "not_committed"
+                        break
+            else:
+                raise AssertionError("retry_limit never enforced")
+            assert attempts[0] == 4  # initial + 3 retries
+
+            # timeout: the loop dies with transaction_timed_out once the
+            # deadline passes, regardless of retryable errors
+            tr2 = db.create_transaction()
+            tr2.set_option("timeout", 0.5)
+            for _ in range(100):
+                await tr2.get(b"to")
+                side = db.create_transaction()
+                side.set(b"to", b"y")
+                await side.commit()
+                tr2.set(b"to", b"mine")
+                try:
+                    await tr2.commit()
+                    raise AssertionError("should have conflicted")
+                except flow.FdbError as e:
+                    try:
+                        await tr2.on_error(e)
+                    except flow.FdbError as e2:
+                        assert e2.name == "transaction_timed_out"
+                        return True
+            raise AssertionError("timeout never enforced")
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
