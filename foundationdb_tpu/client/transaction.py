@@ -261,17 +261,35 @@ class Transaction:
                 value = float(value) if option == "timeout" else int(value)
             except (TypeError, ValueError):
                 raise error("invalid_option_value") from None
+            # fdb sentinels: 0 disables the timeout; a negative retry
+            # limit means unlimited
             if option == "timeout":
-                self._timeout_seconds = value
-                self._timeout_deadline = flow.now() + value
+                self._timeout_seconds = value if value > 0 else None
+                self._timeout_deadline = (flow.now() + value
+                                          if value > 0 else None)
             else:
-                self._retry_limit = value
+                self._retry_limit = value if value >= 0 else None
         elif option == "priority_batch":
             self._grv_priority = PRIORITY_BATCH
         elif option == "priority_system_immediate":
             self._grv_priority = PRIORITY_IMMEDIATE
         else:
             raise error("invalid_option_value")
+
+    def _rpc(self, fut: Future) -> Future:
+        """Per-request timeout, clipped to the transaction's TIMEOUT
+        deadline so an in-flight stall can't overshoot the configured
+        bound by a whole request timeout (review r3)."""
+        deadline = getattr(self, "_timeout_deadline", None)
+        if deadline is None:
+            return _rpc(fut)
+        remaining = deadline - flow.now()
+        if remaining <= 0:
+            fut.abandon()
+            return flow.error_future(error("transaction_timed_out"))
+        if remaining >= REQUEST_TIMEOUT:
+            return _rpc(fut)
+        return flow.timeout_error(fut, remaining, "transaction_timed_out")
 
     def _check_writable(self, begin: bytes,
                         end: Optional[bytes] = None) -> None:
@@ -293,6 +311,7 @@ class Transaction:
 
     def reset(self) -> None:
         self._access_system = False   # options reset with the txn
+        self._grv_priority = None     # ...including the priority class
         # timeout/retry OPTIONS survive an explicit reset, but their
         # spent budgets re-arm — a reused object starts a fresh logical
         # transaction (ref: fdb reset semantics)
@@ -351,7 +370,7 @@ class Transaction:
                     raise last_err or error("all_alternatives_failed")
                 rep = reps[idx]
                 idx += 1
-                inflight.append((rep, flow.catch_errors(_rpc(fn(rep))),
+                inflight.append((rep, flow.catch_errors(self._rpc(fn(rep))),
                                  flow.now()))
             race = [w for _, w, _ in inflight]
             if idx < len(reps):
@@ -362,7 +381,7 @@ class Transaction:
                 # backup window elapsed: duplicate to the next replica
                 rep = reps[idx]
                 idx += 1
-                inflight.append((rep, flow.catch_errors(_rpc(fn(rep))),
+                inflight.append((rep, flow.catch_errors(self._rpc(fn(rep))),
                                  flow.now()))
                 continue
             rep, _w, t0 = inflight.pop(i)
@@ -384,8 +403,15 @@ class Transaction:
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:
         if self._read_version is None:
-            version, seq = await self.db.batched_grv(
-                getattr(self, "_grv_priority", None))
+            fut = self.db.batched_grv(getattr(self, "_grv_priority", None))
+            deadline = getattr(self, "_timeout_deadline", None)
+            if deadline is not None:
+                # the shared class fetch continues for other waiters;
+                # only THIS transaction's wait is deadline-bounded
+                fut = flow.timeout_error(
+                    fut, max(deadline - flow.now(), 0.001),
+                    "transaction_timed_out")
+            version, seq = await fut
             if seq > self._used_seq:
                 self._used_seq = seq
             self._read_version = version
@@ -693,7 +719,8 @@ class Transaction:
                             tuple(self._mutations))
         try:
             proxy = await self._proxy()
-            reply = await _rpc(proxy.commits.get_reply(req, self.db.process))
+            reply = await self._rpc(
+                proxy.commits.get_reply(req, self.db.process))
         except flow.FdbError as e:
             for _k, f in self._watches:
                 if not f.is_ready:
@@ -760,11 +787,13 @@ class Transaction:
             await self.db.refresh_past(self._used_seq)
         await flow.delay(0.001 + flow.g_random.random01() * 0.01,
                          TaskPriority.DEFAULT_ENDPOINT)
-        # a RETRY reset keeps the logical transaction's spent budgets —
-        # only an explicit user reset() re-arms them
+        # a RETRY reset keeps the logical transaction's spent budgets
+        # and priority class — only an explicit user reset() re-arms
         retries = getattr(self, "_retries_used", 0)
+        prio = getattr(self, "_grv_priority", None)
         self.reset()
         self._retries_used = retries
+        self._grv_priority = prio
         if deadline is not None:
             self._timeout_deadline = deadline
 

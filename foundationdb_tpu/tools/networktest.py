@@ -1,0 +1,94 @@
+"""Transport microbenchmark: request/reply throughput and latency.
+
+Reference: fdbserver -r networktestserver / networktest
+(fdbserver/networktest.actor.cpp) — a ping server and a client loop
+measuring the RPC path in isolation. Here it exercises the real TCP
+transport (frames, wire encoding, reader/writer threads) over
+loopback: `python -m foundationdb_tpu.tools.networktest [--requests N]
+[--parallel P] [--bytes B]`.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional
+
+from .. import flow
+from ..rpc.tcp import TcpRequestStream, TcpTransport
+
+
+def run_networktest(requests: int = 2000, parallel: int = 16,
+                    payload_bytes: int = 64) -> dict:
+    parallel = max(1, min(parallel, requests))
+    flow.set_seed(0)
+    s = flow.Scheduler(virtual=False)
+    flow.set_scheduler(s)
+    server = TcpTransport()
+    client = TcpTransport()
+    try:
+        stream = TcpRequestStream(server)
+        server.start()
+        client.start()
+        payload = b"x" * payload_bytes
+
+        async def serve():
+            while True:
+                req, reply = await stream.pop()
+                reply.send(req)
+
+        async def worker(ref, n, lat):
+            for _ in range(n):
+                t0 = time.perf_counter()
+                got = await ref.get_reply(payload)
+                lat.append(time.perf_counter() - t0)
+                assert got == payload
+
+        async def main():
+            flow.spawn(serve())
+            ref = client.ref("127.0.0.1", server.port, stream.token)
+            await ref.get_reply(b"warmup")
+            lat: List[float] = []
+            per, extra = divmod(requests, parallel)
+            t0 = time.perf_counter()
+            await flow.wait_for_all([
+                flow.spawn(worker(ref, per + (1 if i < extra else 0), lat))
+                for i in range(parallel)])
+            wall = time.perf_counter() - t0
+            lat.sort()
+            return {
+                "requests": len(lat),
+                "parallel": parallel,
+                "payload_bytes": payload_bytes,
+                "requests_per_second": round(per * parallel / wall, 1),
+                "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
+            }
+
+        t = s.spawn(main())
+        return s.run(until=t, timeout_time=600)
+    finally:
+        server.close()
+        client.close()
+        flow.set_scheduler(None)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    kw = {}
+    while argv:
+        a = argv.pop(0)
+        if a == "--requests":
+            kw["requests"] = int(argv.pop(0))
+        elif a == "--parallel":
+            kw["parallel"] = int(argv.pop(0))
+        elif a == "--bytes":
+            kw["payload_bytes"] = int(argv.pop(0))
+    result = run_networktest(**kw)
+    import json
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

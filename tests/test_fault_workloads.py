@@ -321,3 +321,45 @@ def test_dd_split_merge_vacate_under_attrition(seed):
         assert c.run(main(), timeout_time=1200)
     finally:
         c.shutdown()
+
+
+@pytest.mark.parametrize("seed", (3401, 3402))
+def test_dd_churn_with_buggify(seed):
+    """The DD structural operations under BUGGIFY: randomized knobs
+    (tiny batch windows, distorted thresholds) + injected delays while
+    shards split and roles die (ref: BUGGIFY as the chaos amplifier in
+    every simulation run)."""
+    c = SimCluster(seed=seed, durable=True, n_storage=1, n_workers=6,
+                   buggify=True)
+    flow.SERVER_KNOBS.init("DD_SHARD_SPLIT_ROWS", 100)
+    try:
+        db = c.client()
+        machines = [f"w{i}" for i in range(c.n_workers)]
+
+        async def main():
+            acked = {}
+            at = flow.spawn(_attrition(c, 4, machines))
+            for i in range(200):
+                async def body(tr, i=i):
+                    tr.set(b"bg%05d" % i, b"v%d" % i)
+                await run_transaction(db, body, max_retries=800)
+                acked[b"bg%05d" % i] = b"v%d" % i
+            await at
+            for _ in range(200):
+                await flow.delay(0.5)
+                info = c.cc.dbinfo.get()
+                tags = [s.tag for s in info.storages]
+                assert len(set(tags)) == len(tags)
+                if len(info.storages) >= 2:
+                    break
+
+            async def check(tr):
+                rows = await tr.get_range(b"bg", b"bh")
+                assert rows == sorted(acked.items()), (
+                    len(rows), len(acked))
+            await run_transaction(db, check, max_retries=800)
+            return True
+
+        assert c.run(main(), timeout_time=1800)
+    finally:
+        c.shutdown()
