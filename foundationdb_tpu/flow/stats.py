@@ -20,6 +20,10 @@ class Counter:
     def add(self, n: int = 1) -> None:
         self.value += n
 
+    def set(self, n: int) -> None:
+        """Gauge semantics (ref: TDMetric gauges beside counters)."""
+        self.value = n
+
 
 class CounterCollection:
     """(ref: CounterCollection — named counters for one role)"""

@@ -416,19 +416,35 @@ class Proxy:
         assert len(self._stags) == len(self._sbounds) - 1
 
     # -- commit pipeline ------------------------------------------------
+    @staticmethod
+    def _req_bytes(req) -> int:
+        """Mutations AND conflict ranges: both ship to the resolver/log,
+        so both count toward the batch's byte budget."""
+        from .types import mutation_bytes
+        return (sum(mutation_bytes(m) for m in req.mutations)
+                + sum(len(b) + len(e) + 16
+                      for b, e in (tuple(req.read_conflict_ranges)
+                                   + tuple(req.write_conflict_ranges))))
+
     async def _batcher(self):
-        """(ref: commitBatcher :344 — batch by window/count)"""
+        """(ref: commitBatcher :344 — batch by window / count / BYTES:
+        a batch closes early once its mutation payload reaches
+        COMMIT_TRANSACTION_BATCH_BYTES_MAX, bounding resolver/log
+        request sizes)"""
+        bytes_max = SERVER_KNOBS.commit_transaction_batch_bytes_max
         while True:
             req, reply = await self.commits.pop()
             batch: List = [(req, reply)]
+            nbytes = self._req_bytes(req)
             deadline = flow.delay(self.batch_window,
                                   TaskPriority.PROXY_COMMIT_BATCHER)
-            while len(batch) < self.max_batch:
+            while len(batch) < self.max_batch and nbytes < bytes_max:
                 nxt = self.commits.pop()
                 got = await flow.first_of(nxt, deadline)
                 if got[0] == 1:  # window expired
                     break
                 batch.append(got[1])
+                nbytes += self._req_bytes(got[1][0])
             deadline.cancel()
             self._local_batch += 1
             flow.spawn(self._commit_batch(batch, self._local_batch),

@@ -35,6 +35,8 @@ class Resolver:
         self.work_units = 0
         self.key_hist = [0] * 256
         self.metrics = RequestStream(process)
+        self.stats = flow.CounterCollection("resolver")
+        self._pressure_traced = False
         self._actors = flow.ActorCollection()
         # reply cache for duplicate delivery (proxy retry after a broken
         # reply): version -> verdicts, evicted incrementally once a
@@ -115,3 +117,33 @@ class Resolver:
             self._reply_cache.pop(self._reply_order.popleft(), None)
         self.version.set(req.version)
         reply.send(verdicts)
+        self._check_state_pressure(req.version)
+
+    def state_size(self) -> int:
+        """Conflict-history row estimate across backends (boundary rows
+        for interval backends; a bisect-list length for the Python
+        baseline)."""
+        cs = self.conflict_set
+        ic = getattr(cs, "interval_count", None)
+        if ic is not None:
+            # a method on the native backend, a property on the device
+            # backends (incl. tpu-point) — support both
+            return int(ic() if callable(ic) else ic)
+        return len(getattr(cs, "_keys", ()))
+
+    def _check_state_pressure(self, version: int) -> None:
+        """(ref: the resolver memory back-pressure, Resolver.actor.cpp
+        :91-98 — state beyond RESOLVER_STATE_MEMORY_LIMIT is a red
+        flag: the window GC is not keeping up with the write rate.
+        Interpreted here as a row count; surfaced via trace + counter
+        so ratekeeper/status consumers can see it.)"""
+        size = self.state_size()
+        self.stats.counter("state_rows").set(size)
+        limit = flow.SERVER_KNOBS.resolver_state_memory_limit
+        if size > limit and not self._pressure_traced:
+            self._pressure_traced = True
+            flow.TraceEvent("ResolverStatePressure", self.process.name,
+                            severity=flow.trace.SevWarnAlways).detail(
+                Rows=size, Limit=limit, Version=version).log()
+        elif size <= limit:
+            self._pressure_traced = False

@@ -26,7 +26,8 @@ from ..rpc import RequestStream, SimProcess
 from ..rpc.disk import SimDisk
 from .diskqueue import DiskQueue
 from .types import (TLogCommitRequest, TLogLockReply, TLogLockRequest,
-                    TLogPeekReply, TLogPeekRequest, TLogPopRequest)
+                    TLogPeekReply, TLogPeekRequest, TLogPopRequest,
+                    mutation_bytes)
 from .wire import decode_log_entry, encode_log_entry
 
 
@@ -38,8 +39,7 @@ def _tag_set(tagged) -> frozenset:
 
 
 def _payload_bytes(tagged) -> int:
-    return sum(len(tm.mutation.param1) + len(tm.mutation.param2) + 16
-               for tm in tagged)
+    return sum(mutation_bytes(tm.mutation) for tm in tagged)
 
 
 class TLog:
@@ -291,10 +291,21 @@ class TLog:
         # snapshot: spilled reads await the disk, and a concurrent pop
         # may shift the live lists under us. The tag index answers
         # "does this record even carry my tag" without touching disk.
+        # Replies are SIZE-BOUNDED (ref: DESIRED_TOTAL_BYTES chunking in
+        # tLogPeekMessages) — a far-behind reader drains in chunks; its
+        # next poll continues past the last delivered version, and the
+        # reply's `durable` watermark is clamped to what was actually
+        # delivered so the reader cannot skip the truncated remainder.
         snap = list(zip(self.entries[lo:hi], self._entry_tags[lo:hi]))
+        limit_bytes = flow.SERVER_KNOBS.desired_total_bytes
+        sent_bytes = 0
+        truncated_at = None
         for (v, tagged, s), etags in snap:
             if req.tag not in etags:
                 continue
+            if sent_bytes >= limit_bytes:
+                truncated_at = v
+                break
             if tagged is None:
                 payload = await self._dq.read(s)
                 if payload is None:
@@ -303,6 +314,10 @@ class TLog:
             ms = tuple(tm.mutation for tm in tagged if req.tag in tm.tags)
             if ms:
                 out.append((v, ms))
+                sent_bytes += sum(mutation_bytes(m) for m in ms)
+        if truncated_at is not None:
+            durable = min(durable, max(req.begin_version,
+                                       truncated_at - 1))
         reply.send(TLogPeekReply(tuple(out), durable, self.known_committed))
 
     async def _pop_loop(self):

@@ -62,6 +62,12 @@ class MutationRef(NamedTuple):
     param2: bytes  # value / range end
 
 
+def mutation_bytes(m: "MutationRef") -> int:
+    """Payload-size estimate for batching/spill/chunking decisions (one
+    shared formula so byte limits can't silently diverge)."""
+    return len(m.param1) + len(m.param2) + 16
+
+
 class CommitRequest(NamedTuple):
     """One transaction's commit payload (ref: CommitTransactionRequest)."""
 

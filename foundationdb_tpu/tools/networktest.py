@@ -20,6 +20,9 @@ from ..rpc.tcp import TcpRequestStream, TcpTransport
 
 def run_networktest(requests: int = 2000, parallel: int = 16,
                     payload_bytes: int = 64) -> dict:
+    if requests <= 0:
+        return {"requests": 0, "parallel": 0, "payload_bytes": payload_bytes,
+                "requests_per_second": 0.0, "p50_ms": 0.0, "p99_ms": 0.0}
     parallel = max(1, min(parallel, requests))
     flow.set_seed(0)
     s = flow.Scheduler(virtual=False)
@@ -60,7 +63,7 @@ def run_networktest(requests: int = 2000, parallel: int = 16,
                 "requests": len(lat),
                 "parallel": parallel,
                 "payload_bytes": payload_bytes,
-                "requests_per_second": round(per * parallel / wall, 1),
+                "requests_per_second": round(len(lat) / wall, 1),
                 "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
                 "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3),
             }

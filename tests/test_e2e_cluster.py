@@ -489,3 +489,60 @@ def test_tlog_tolerates_reordered_pushes():
         assert s.run(until=t, timeout_time=10)
     finally:
         fl.set_scheduler(None)
+
+
+def test_commit_batches_close_on_byte_limit():
+    """COMMIT_TRANSACTION_BATCH_BYTES_MAX bounds batch payloads: large
+    transactions still commit correctly when every batch closes early."""
+    c = SimCluster(seed=95)
+    flow.SERVER_KNOBS.init("COMMIT_TRANSACTION_BATCH_BYTES_MAX", 2048)
+    try:
+        db = c.client()
+
+        async def main():
+            big = b"B" * 900
+            async def body(tr):
+                for i in range(8):
+                    tr.set(b"byte%02d" % i, big)
+            await run_transaction(db, body)
+
+            async def check(tr):
+                rows = await tr.get_range(b"byte", b"bytf")
+                assert len(rows) == 8
+                assert all(v == big for _k, v in rows)
+            await run_transaction(db, check)
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
+        flow.reset_server_knobs()
+
+
+def test_resolver_state_pressure_is_surfaced():
+    """A conflict history beyond RESOLVER_STATE_MEMORY_LIMIT (rows,
+    here) raises the ResolverStatePressure trace — the GC-behind red
+    flag (ref: Resolver.actor.cpp memory back-pressure)."""
+    c = SimCluster(seed=96)
+    flow.SERVER_KNOBS.init("RESOLVER_STATE_MEMORY_LIMIT", 50)
+    try:
+        db = c.client()
+
+        async def main():
+            async def body(tr):
+                for i in range(200):
+                    tr.set(b"pr%04d" % i, b"x")
+            await run_transaction(db, body)
+            for _ in range(40):
+                if flow.g_trace.counts.get("ResolverStatePressure", 0):
+                    return True
+                async def more(tr):
+                    tr.set(b"prx", b"y")
+                await run_transaction(db, more)
+                await flow.delay(0.2)
+            raise AssertionError("pressure never traced")
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
+        flow.reset_server_knobs()

@@ -203,3 +203,48 @@ def test_spill_bounds_memory_and_peeks_from_disk():
     finally:
         fl.reset_server_knobs()
         fl.set_scheduler(None)
+
+
+def test_peek_replies_are_size_bounded():
+    """DESIRED_TOTAL_BYTES chunks big peeks: a far-behind reader drains
+    in multiple rounds, the reply watermark is clamped to what was
+    delivered, and no version is ever skipped."""
+    fl.set_seed(29)
+    s = fl.Scheduler(virtual=True)
+    fl.set_scheduler(s)
+    try:
+        net = SimNetwork(s, fl.g_random)
+        proc = net.new_process("tlog-chunk", machine="mc")
+        client = net.new_process("client", machine="cc2")
+        fl.SERVER_KNOBS.init("DESIRED_TOTAL_BYTES", 500)
+        tlog = TLog(proc)
+        tlog.start()
+
+        async def main():
+            val = b"v" * 100
+            for i in range(1, 21):
+                await tlog.commits.ref().get_reply(
+                    TLogCommitRequest(i - 1, i,
+                                      (_tm(0, b"c%03d" % i, val),), i - 1),
+                    client)
+            got = []
+            begin = 1
+            rounds = 0
+            while True:
+                rounds += 1
+                reply = await tlog.peeks.ref().get_reply(
+                    TLogPeekRequest(begin, 0), client)
+                got.extend(v for v, _ms in reply.entries)
+                if reply.committed_version >= 20:
+                    break
+                assert reply.committed_version >= begin - 1
+                begin = reply.committed_version + 1
+            assert got == list(range(1, 21)), got  # nothing skipped
+            assert rounds >= 3, rounds             # actually chunked
+            return True
+
+        t = s.spawn(main())
+        assert s.run(until=t, timeout_time=60)
+    finally:
+        fl.reset_server_knobs()
+        fl.set_scheduler(None)
