@@ -87,6 +87,16 @@ class SimCluster:
             self.config)
         self.cc.start()
 
+        # sim_validation: every simulation continuously re-checks the
+        # published cluster picture's invariants (ref: sim_validation.cpp
+        # debug hooks) — a broken shard map or regressed epoch fails the
+        # test at its source, not where a workload later trips
+        from .sim_validation import validator
+        self.validator_state: dict = {}
+        self._validator = flow.spawn(
+            validator(self.cc.dbinfo, self.validator_state),
+            name=f"{px}simValidator")
+
         # workers, one per simulated machine
         if n_workers is None:
             n_workers = max(4, n_logs + 1, n_storage * storage_replicas,
@@ -209,9 +219,23 @@ class SimCluster:
 
     # -- running ---------------------------------------------------------
     def run(self, coro, timeout_time: Optional[float] = None):
-        """Drive the loop until the given actor completes."""
+        """Drive the loop until the given actor completes. A
+        sim-validation violation outranks the workload's own outcome —
+        a detached validator's error would otherwise die silently in
+        its task future (code review r3)."""
         task = flow.spawn(coro, name="test-main")
-        return self.sched.run(until=task, timeout_time=timeout_time)
+        try:
+            result = self.sched.run(until=task, timeout_time=timeout_time)
+        except BaseException:
+            self._raise_validator_error()
+            raise
+        self._raise_validator_error()
+        return result
+
+    def _raise_validator_error(self) -> None:
+        v = getattr(self, "_validator", None)
+        if v is not None and v.is_ready and v.is_error:
+            raise v.exception()
 
     def shutdown(self) -> None:
         # only the cluster that created the scheduler tears it down — a

@@ -30,7 +30,7 @@ from .types import (CLEAR_RANGE, PRIORITY_DEFAULT, PRIORITY_IMMEDIATE,
                     SET_VALUE, SET_VERSIONSTAMPED_KEY,
                     SET_VERSIONSTAMPED_VALUE, CommitReply, CommitRequest,
                     GetReadVersionReply, MutationRef, ResolveRequest,
-                    TLogCommitRequest, TaggedMutation)
+                    TLogCommitRequest, TaggedMutation, mutation_bytes)
 
 
 def make_versionstamp(version: int, batch_index: int) -> bytes:
@@ -420,7 +420,6 @@ class Proxy:
     def _req_bytes(req) -> int:
         """Mutations AND conflict ranges: both ship to the resolver/log,
         so both count toward the batch's byte budget."""
-        from .types import mutation_bytes
         return (sum(mutation_bytes(m) for m in req.mutations)
                 + sum(len(b) + len(e) + 16
                       for b, e in (tuple(req.read_conflict_ranges)

@@ -546,3 +546,57 @@ def test_resolver_state_pressure_is_surfaced():
     finally:
         c.shutdown()
         flow.reset_server_knobs()
+
+
+def test_sim_validation_catches_broken_maps():
+    """The always-on validator (ref: sim_validation.cpp) fails fast on
+    a gapped shard map, duplicate tags, or a regressed epoch — and its
+    live instance has actually been checking this cluster."""
+    from foundationdb_tpu.server.sim_validation import validate_dbinfo
+
+    c = SimCluster(seed=97, n_storage=2)
+    try:
+        db = c.client()
+
+        async def main():
+            await db.info()
+            info = c.cc.dbinfo.get()
+            validate_dbinfo(info, {})   # the real picture passes
+
+            dup = info._replace(storages=(
+                info.storages[0],
+                info.storages[1]._replace(tag=info.storages[0].tag)))
+            with pytest.raises(AssertionError, match="duplicate"):
+                validate_dbinfo(dup, {})
+
+            with pytest.raises(AssertionError, match="seq"):
+                validate_dbinfo(info, {"seq": info.seq})
+
+            with pytest.raises(AssertionError, match="epoch"):
+                validate_dbinfo(info, {"epoch": info.epoch + 1})
+
+            # THIS cluster's validator is live: it observed the current
+            # broadcast sequence (per-cluster state, not a global)
+            assert c.validator_state.get("seq") == c.cc.dbinfo.get().seq
+            assert c.validator_state.get("checked", 0) > 0
+            return True
+
+        assert c.run(main(), timeout_time=60)
+
+        # e2e: a BROKEN publish mid-run fails the simulation itself —
+        # the live validator's error surfaces through c.run
+        async def poison():
+            info = c.cc.dbinfo.get()
+            gapped = info._replace(storages=(
+                info.storages[0]._replace(end=b"\x40", replicas=tuple(
+                    r._replace(end=b"\x40")
+                    for r in info.storages[0].replicas)),
+                info.storages[1]))
+            c.cc.publish(gapped)
+            await flow.delay(1.0)
+            return True
+
+        with pytest.raises(AssertionError, match="gap"):
+            c.run(poison(), timeout_time=30)
+    finally:
+        c.shutdown()
