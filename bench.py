@@ -345,13 +345,52 @@ def _run_backend(backend, n_txns, n_batches, keyspace):
     return bench_cpu(backend, n_txns, n_batches, keyspace)
 
 
+def _probe_device(timeout_s: float = 120.0) -> bool:
+    """True iff the accelerator answers a trivial computation within
+    the timeout. The axon TPU tunnel can hang indefinitely inside
+    backend init (device listing still works!) — without this probe a
+    dead tunnel turns the bench into an unbounded hang instead of an
+    honest error record."""
+    import threading
+
+    ok = []
+
+    def attempt():
+        try:
+            import jax
+            import jax.numpy as jnp
+            x = jnp.ones((8, 8), jnp.float32)
+            (x @ x).block_until_ready()
+            ok.append(True)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return bool(ok)
+
+
 def main():
+    backend_env = os.environ.get("FDBTPU_BENCH_BACKEND", "all")
+    needs_device = backend_env in ("all", "tpu", "tpu-point",
+                                   "tpu-streamed", "tpu-streamed-interval")
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # env-only JAX_PLATFORMS=cpu wedges device init when the axon
         # TPU plugin was registered at interpreter start; the explicit
         # config update (what tests/conftest.py does) actually sticks
         import jax
         jax.config.update("jax_platforms", "cpu")
+    elif needs_device and not _probe_device():
+        print(json.dumps({
+            "metric": "resolver_throughput", "value": 0, "unit": "txn/s",
+            "vs_baseline": 0.0,
+            "error": "accelerator unreachable: device init hung past the "
+                     "probe timeout (axon tunnel down); prior recorded "
+                     "result is BENCH_r02.json (tpu-point 2.56x)",
+        }))
+        sys.stdout.flush()   # piped stdout is block-buffered; the hung
+        os._exit(2)          # jax thread rules out a clean sys.exit
     n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 16384))
     n_batches = int(os.environ.get("FDBTPU_BENCH_BATCHES", 100))
     keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 4_000_000))

@@ -39,6 +39,13 @@ class SimCluster:
                  virtual: bool = True):
         self.prefix = name_prefix
         self._owns_scheduler = share_with is None
+        # co-scheduled clusters (share_with): any of them may publish a
+        # broken picture, and whichever cluster's run() drives the loop
+        # must surface it — track the sharing group both ways
+        self._share_src = share_with
+        self._peer_clusters: list = []
+        if share_with is not None:
+            share_with._peer_clusters.append(self)
         if share_with is not None:
             # a second cluster INSIDE the same deterministic simulation
             # (multi-cluster tests: DR, cross-cluster tooling) — shares
@@ -233,9 +240,19 @@ class SimCluster:
         return result
 
     def _raise_validator_error(self) -> None:
-        v = getattr(self, "_validator", None)
-        if v is not None and v.is_ready and v.is_error:
-            raise v.exception()
+        # walk to the sharing group's root, then check every member —
+        # a share_with secondary's violation must not die silently just
+        # because the PRIMARY's run() drives the loop
+        root = self
+        while root._share_src is not None:
+            root = root._share_src
+        stack = [root]
+        while stack:
+            c = stack.pop()
+            stack.extend(c._peer_clusters)
+            v = getattr(c, "_validator", None)
+            if v is not None and v.is_ready and v.is_error:
+                raise v.exception()
 
     def shutdown(self) -> None:
         # only the cluster that created the scheduler tears it down — a
