@@ -394,7 +394,7 @@ def main():
     n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 16384))
     n_batches = int(os.environ.get("FDBTPU_BENCH_BATCHES", 100))
     keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 4_000_000))
-    backend = os.environ.get("FDBTPU_BENCH_BACKEND", "all")
+    backend = backend_env
 
     sub = {}
     if backend == "all":

@@ -117,9 +117,11 @@ class RemoteCluster:
     transport and scheduler; `call(coro)` executes client coroutines
     there and returns the result to the calling thread."""
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 30.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 30.0,
+                 tls=None):
         self.host = host
         self.port = port
+        self._tls = tls
         self._submissions: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._started: queue.Queue = queue.Queue()
@@ -133,7 +135,7 @@ class RemoteCluster:
     def _main(self) -> None:
         s = flow.Scheduler(virtual=False)
         flow.set_scheduler(s)
-        transport = TcpTransport()
+        transport = TcpTransport(tls=self._tls)
         try:
             transport.start()
             db = RemoteDatabase(transport, self.host, self.port)

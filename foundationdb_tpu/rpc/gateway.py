@@ -34,9 +34,10 @@ DESCRIBE_TOKEN = 1
 class TcpGateway:
     """Serve a cluster (via its client `Database` handle) over TCP."""
 
-    def __init__(self, db, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
+                 tls=None):
         self.db = db
-        self.transport = TcpTransport(host, port)
+        self.transport = TcpTransport(host, port, tls=tls)
         self._describe = TcpRequestStream(self.transport)
         assert self._describe.token == DESCRIBE_TOKEN, \
             "describe must be the transport's first registered endpoint"

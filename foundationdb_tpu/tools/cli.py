@@ -223,9 +223,11 @@ def _split_script(script: str) -> List[str]:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    from ._tlsargs import TLS_FLAGS, tls_from_args
     script = None
     seed = 0
     connect = None
+    tls_args = {}
     while argv:
         a = argv.pop(0)
         if a == "--exec":
@@ -234,6 +236,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             seed = int(argv.pop(0))
         elif a == "--connect":
             connect = argv.pop(0)
+        elif a in TLS_FLAGS:
+            tls_args[TLS_FLAGS[a]] = argv.pop(0)
+    try:
+        tls = tls_from_args(tls_args)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if tls is not None and connect is None:
+        print("--tls-* flags require --connect (local mode has no "
+              "network)", file=sys.stderr)
+        return 2
     cluster = None
     remote = None
     if connect is not None:
@@ -245,7 +258,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"--connect expects host:port, got `{connect}'",
                   file=sys.stderr)
             return 2
-        remote = RemoteCluster(host or "127.0.0.1", int(port))
+        remote = RemoteCluster(host or "127.0.0.1", int(port), tls=tls)
         cli = Cli.for_remote(remote)
     else:
         cluster = SimCluster(seed=seed, durable=True)

@@ -21,12 +21,12 @@ from ..server import SimCluster
 
 def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
           storage_replicas: int = 1, n_logs: int = 1, n_proxies: int = 1,
-          announce=print) -> None:
+          tls=None, announce=print) -> None:
     """Run until interrupted; announces `LISTENING <port>` once up."""
     c = SimCluster(seed=seed, virtual=False, durable=True,
                    n_storage=n_storage, storage_replicas=storage_replicas,
                    n_logs=n_logs, n_proxies=n_proxies)
-    gw = TcpGateway(c.client("gateway-host"), port=port)
+    gw = TcpGateway(c.client("gateway-host"), port=port, tls=tls)
     try:
         async def main():
             gw.start()
@@ -44,10 +44,14 @@ def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    from ._tlsargs import TLS_FLAGS, tls_from_args
     kwargs = {}
+    tls_args = {}
     while argv:
         a = argv.pop(0)
-        if a == "--port":
+        if a in TLS_FLAGS:
+            tls_args[TLS_FLAGS[a]] = argv.pop(0)
+        elif a == "--port":
             kwargs["port"] = int(argv.pop(0))
         elif a == "--seed":
             kwargs["seed"] = int(argv.pop(0))
@@ -62,6 +66,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(f"unknown argument {a}", file=sys.stderr)
             return 2
+    try:
+        tls = tls_from_args(tls_args)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if tls is not None:
+        kwargs["tls"] = tls
     serve(**kwargs)
     return 0
 

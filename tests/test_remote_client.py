@@ -94,3 +94,75 @@ def test_cli_against_separate_server_process():
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+def test_remote_client_over_mutual_tls(tmp_path):
+    """The full remote client stack over mutually-authenticated TLS —
+    a rogue client with an untrusted certificate cannot connect (ref:
+    FDBLibTLS protecting every external connection)."""
+    import subprocess
+
+    from foundationdb_tpu.rpc.tcp import TlsConfig
+
+    def make_cert(name):
+        key = str(tmp_path / f"{name}-key.pem")
+        cert = str(tmp_path / f"{name}-cert.pem")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "2",
+             "-subj", f"/CN=fdbtpu-{name}"],
+            check=True, capture_output=True)
+        return cert, key
+
+    cert, key = make_cert("cluster")
+    rogue_cert, rogue_key = make_cert("rogue")
+    tls = TlsConfig(cert, key, cert)
+
+    # GatewayedCluster with a TLS transport
+    import foundationdb_tpu.rpc.gateway as gwmod
+
+    class TlsGatewayedCluster(GatewayedCluster):
+        def _main(self):
+            import foundationdb_tpu.flow as fl
+            from foundationdb_tpu.server.cluster import SimCluster
+            gw = None
+            c = None
+            try:
+                c = SimCluster(virtual=False, **self.kw)
+                gw = gwmod.TcpGateway(c.client("gateway-host"), tls=tls)
+
+                async def main():
+                    gw.start()
+                    self.q.put(gw.port)
+                    while not self.stop.is_set():
+                        await fl.delay(0.02)
+
+                c.run(main())
+            except BaseException as e:  # noqa: BLE001
+                self.q.put(e)
+            finally:
+                if gw is not None:
+                    gw.close()
+                if c is not None:
+                    c.shutdown()
+
+    with TlsGatewayedCluster(seed=87) as gc:
+        rc = RemoteCluster("127.0.0.1", gc.port, tls=tls)
+        try:
+            async def write(tr):
+                tr.set(b"secure", b"channel")
+            rc.call(run_transaction(rc.db, write))
+
+            async def read(tr):
+                return await tr.get(b"secure")
+            assert rc.call(run_transaction(rc.db, read)) == b"channel"
+        finally:
+            rc.close()
+
+        # untrusted certificate: the connection dies AT THE HANDSHAKE —
+        # a specific transport error, fast, not a connect-timeout
+        from foundationdb_tpu import flow as fl
+        with pytest.raises(fl.FdbError) as ei:
+            RemoteCluster("127.0.0.1", gc.port, connect_timeout=60,
+                          tls=TlsConfig(rogue_cert, rogue_key, cert))
+        assert ei.value.name in ("broken_promise", "timed_out")
