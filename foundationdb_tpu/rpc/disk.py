@@ -137,6 +137,12 @@ class SimDisk:
     def exists(self, name: str) -> bool:
         return name in self.files
 
+    def remove(self, name: str) -> None:
+        """Destroy a file (store retirement)."""
+        f = self.files.pop(name, None)
+        if f is not None:
+            f._close()
+
     async def _io_latency(self, sync: bool = False):
         from .. import flow
         base = 0.0001 if not sync else 0.0005
@@ -150,3 +156,120 @@ class SimDisk:
         for f in self.files.values():
             if f._open and (owner is None or f.owner is owner):
                 f._power_loss(rng)
+
+
+class RealFile:
+    """One ON-DISK file behind the SimFile async interface (ref:
+    AsyncFileKAIO/AsyncFileCached — the production IAsyncFile). Writes
+    go to the OS immediately; sync() is a real fsync, so acknowledged
+    durability survives an actual process restart."""
+
+    __slots__ = ("path", "name", "owner", "_fh", "_open")
+
+    def __init__(self, path: str, name: str, owner=None):
+        import os
+        self.path = path
+        self.name = name
+        self.owner = owner
+        mode = "r+b" if os.path.exists(path) else "w+b"
+        # unbuffered: writes reach the OS immediately, so a finalizer
+        # flush can never resurrect stale bytes after a successor
+        # process has recovered from the same file
+        self._fh = open(path, mode, buffering=0)
+        self._open = True
+
+    async def write(self, offset: int, data: bytes) -> None:
+        self._check_open()
+        self._fh.seek(offset)
+        self._fh.write(data)
+
+    async def sync(self) -> None:
+        import os
+        self._check_open()
+        os.fsync(self._fh.fileno())
+
+    async def read(self, offset: int, length: int) -> bytes:
+        self._check_open()
+        self._fh.seek(offset)
+        return self._fh.read(length)
+
+    async def truncate(self, size: int) -> None:
+        self._check_open()
+        self._fh.truncate(size)
+
+    async def size(self) -> int:
+        import os
+        self._check_open()
+        return os.fstat(self._fh.fileno()).st_size
+
+    def _check_open(self) -> None:
+        if not self._open:
+            raise error("io_error")
+
+    def _power_loss(self, rng) -> None:
+        # a real process crash: the OS keeps whatever it has; only the
+        # handle dies (unsynced page-cache fate is the kernel's call)
+        self._close()
+
+    def _close(self) -> None:
+        if self._open:
+            self._open = False
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+
+
+class RealDisk:
+    """A directory as a machine's file namespace — the production disk
+    behind the same seam the simulator serves (ref: the platform layer
+    under IAsyncFile). `tools/server --data-dir` uses this so a host
+    process's durable state survives ACTUAL restarts."""
+
+    def __init__(self, root: str, machine: str = ""):
+        import os
+        self.root = root
+        self.machine = machine
+        os.makedirs(root, exist_ok=True)
+        self.files: Dict[str, RealFile] = {}
+        for name in sorted(os.listdir(root)):
+            self.files[name] = RealFile(os.path.join(root, name), name)
+
+    def _path(self, name: str) -> str:
+        import os
+        assert "/" not in name and name not in (".", ".."), name
+        return os.path.join(self.root, name)
+
+    def open(self, name: str, owner=None) -> RealFile:
+        f = self.files.get(name)
+        if f is None or not f._open:
+            f = RealFile(self._path(name), name, owner)
+            self.files[name] = f
+        elif owner is not None:
+            f.owner = owner
+        return f
+
+    def exists(self, name: str) -> bool:
+        return name in self.files
+
+    def power_loss(self, rng, owner=None) -> None:
+        for f in self.files.values():
+            if f._open and (owner is None or f.owner is owner):
+                f._power_loss(rng)
+
+    def remove(self, name: str) -> None:
+        """Destroy a file ON DISK (store retirement must not resurrect
+        on the next boot scan)."""
+        import os
+        f = self.files.pop(name, None)
+        if f is not None:
+            f._close()
+            try:
+                os.unlink(f.path)
+            except OSError:
+                pass
+
+    def close_all(self) -> None:
+        """Release every handle (cluster shutdown)."""
+        for f in self.files.values():
+            f._close()

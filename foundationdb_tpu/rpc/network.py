@@ -142,6 +142,9 @@ class SimNetwork:
         self.processes: Dict[str, SimProcess] = {}
         self._tombstones: Dict[str, SimProcess] = {}
         self._token = 0
+        #: machine -> disk namespace factory; None = in-memory SimDisk.
+        #: A cluster on REAL storage installs RealDisk here.
+        self.disk_factory = None
         # (src_machine, dst_machine) -> unclog time
         self._clogged: Dict[Tuple[str, str], float] = {}
         self.messages_sent = 0
@@ -155,11 +158,17 @@ class SimNetwork:
         return p
 
     def disk(self, machine: str) -> "SimDisk":
-        """The machine's persistent file namespace (survives kills)."""
-        from .disk import SimDisk
+        """The machine's persistent file namespace (survives kills).
+        `disk_factory` (set by a cluster running on REAL storage)
+        swaps in on-disk namespaces behind the same seam."""
         d = self.disks.get(machine)
         if d is None:
-            d = self.disks[machine] = SimDisk(self, machine)
+            if self.disk_factory is not None:
+                d = self.disk_factory(machine)
+            else:
+                from .disk import SimDisk
+                d = SimDisk(self, machine)
+            self.disks[machine] = d
         return d
 
     def _next_token(self) -> int:

@@ -36,7 +36,7 @@ class SimCluster:
                  storage_engine: str = "memory",
                  storage_replicas: int = 1,
                  share_with: "SimCluster" = None, name_prefix: str = "",
-                 virtual: bool = True):
+                 virtual: bool = True, data_dir: Optional[str] = None):
         self.prefix = name_prefix
         self._owns_scheduler = share_with is None
         # co-scheduled clusters (share_with): any of them may publish a
@@ -45,6 +45,10 @@ class SimCluster:
         self._share_src = share_with
         self._peer_clusters: list = []
         if share_with is not None:
+            if data_dir is not None:
+                raise ValueError(
+                    "data_dir on a share_with secondary is not supported: "
+                    "it would silently run on the primary's sim disks")
             share_with._peer_clusters.append(self)
         if share_with is not None:
             # a second cluster INSIDE the same deterministic simulation
@@ -64,6 +68,14 @@ class SimCluster:
                                         virtual=virtual)
             flow.set_scheduler(self.sched)
             self.net = SimNetwork(self.sched, flow.g_random)
+            if data_dir is not None:
+                # REAL on-disk stores: durable state survives an actual
+                # process restart (tools/server --data-dir)
+                import os
+
+                from ..rpc.disk import RealDisk
+                self.net.disk_factory = lambda m: RealDisk(
+                    os.path.join(data_dir, m), m)
         self.durable = durable
         self.auto_reboot = auto_reboot
         self.conflict_backend = conflict_backend
@@ -80,8 +92,10 @@ class SimCluster:
         px = self.prefix
         self.coordinators = []
         for i in range(n_coordinators):
-            c = Coordinator(self.net.new_process(f"{px}coord{i}",
-                                                 machine=f"{px}coord{i}"))
+            cproc = self.net.new_process(f"{px}coord{i}",
+                                         machine=f"{px}coord{i}")
+            c = Coordinator(cproc, disk=(self.net.disk(f"{px}coord{i}")
+                                         if durable else None))
             c.start()
             self.coordinators.append(c)
 
@@ -258,4 +272,7 @@ class SimCluster:
         # only the cluster that created the scheduler tears it down — a
         # share_with secondary must not pull it from under the primary
         if self._owns_scheduler:
+            for d in self.net.disks.values():
+                if hasattr(d, "close_all"):
+                    d.close_all()   # release real-file handles
             flow.set_scheduler(None)

@@ -22,7 +22,7 @@ from __future__ import annotations
 from typing import NamedTuple, Optional, Tuple
 
 from .. import flow
-from ..flow import TaskPriority, error
+from ..flow import FlowLock, TaskPriority, error
 from ..rpc import RequestStream, SimProcess
 
 
@@ -72,26 +72,63 @@ class CandidacyReply(NamedTuple):
 
 class Coordinator:
     """One coordination server (ref: coordinationServer,
-    Coordination.actor.cpp)."""
+    Coordination.actor.cpp). With a disk, the generation register
+    persists through an OnDemandStore analogue (a DiskQueue holding the
+    latest register image), so the coordinated state — and therefore
+    the whole cluster — survives a full process restart."""
 
-    def __init__(self, process: SimProcess):
+    def __init__(self, process: SimProcess, disk=None):
         self.process = process
         # generation register: key -> (value, write_gen, read_gen)
         self._reg: dict = {}
-        # leader election register: key -> (leader, change_id)
+        # leader election register: key -> (leader, change_id) —
+        # ephemeral by design: elections re-run on boot
         self._leader: dict = {}
         self.reads = RequestStream(process)
         self.writes = RequestStream(process)
         self.candidacies = RequestStream(process)
+        if disk is not None:
+            from .diskqueue import DiskQueue
+            self._dq = DiskQueue(disk, f"{process.name}.reg", owner=process)
+        else:
+            self._dq = None
+        # the DiskQueue is single-writer; reads raising read_gen and
+        # writes both persist, so their pushes must serialize
+        self._persist_lock = flow.FlowLock()
         self._actors = flow.ActorCollection()
 
     def start(self) -> None:
+        self._actors.add(flow.spawn(self._run(), TaskPriority.COORDINATION,
+                                    name=f"{self.process.name}.run"))
+        self.process.on_kill(self._actors.cancel_all)
+
+    async def _run(self) -> None:
+        if self._dq is not None:
+            payloads = await self._dq.recover()
+            if payloads:
+                from ..rpc import wire
+                self._reg = wire.from_bytes(payloads[-1], None)
+                self._dq.pop(self._dq.next_seq - 2)
         for coro, name in ((self._read_loop(), "genReads"),
                            (self._write_loop(), "genWrites"),
                            (self._leader_loop(), "leader")):
             self._actors.add(flow.spawn(coro, TaskPriority.COORDINATION,
                                         name=f"{self.process.name}.{name}"))
-        self.process.on_kill(self._actors.cancel_all)
+
+    async def _persist(self) -> None:
+        """Fsync the register image BEFORE acking (ref: the reference's
+        OnDemandStore commit before GenerationReg replies)."""
+        if self._dq is None:
+            return
+        from ..rpc import wire
+        payload = wire.to_bytes(self._reg)
+        await self._persist_lock.take()
+        try:
+            seq = await self._dq.push(payload)
+            await self._dq.commit()
+            self._dq.pop(seq - 1)   # only the newest image matters
+        finally:
+            self._persist_lock.release()
 
     async def _read_loop(self):
         while True:
@@ -101,6 +138,9 @@ class Coordinator:
             if req.gen > rgen:
                 rgen = req.gen
                 self._reg[req.key] = (value, wgen, rgen)
+                # the raised read generation must survive a crash, or a
+                # pre-crash writer could still commit at an old gen
+                await self._persist()
             reply.send(GenRegReadReply(value, wgen, rgen))
 
     async def _write_loop(self):
@@ -111,6 +151,7 @@ class Coordinator:
             if req.gen >= rgen and req.gen >= wgen:
                 self._reg[req.key] = (req.value, req.gen,
                                       max(rgen, req.gen))
+                await self._persist()
                 reply.send(GenRegWriteReply(req.gen))
             else:
                 # a newer reader/writer got here first

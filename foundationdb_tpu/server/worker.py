@@ -206,7 +206,7 @@ class Worker:
             disk = self.net.disk(self.process.machine)
             for f in [f for f in disk.files
                       if f.startswith(name + ".")]:
-                del disk.files[f]
+                disk.remove(f)
 
 from ..rpc import wire as _wire
 

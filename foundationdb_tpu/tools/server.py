@@ -21,11 +21,13 @@ from ..server import SimCluster
 
 def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
           storage_replicas: int = 1, n_logs: int = 1, n_proxies: int = 1,
-          tls=None, announce=print) -> None:
-    """Run until interrupted; announces `LISTENING <port>` once up."""
+          tls=None, data_dir=None, announce=print) -> None:
+    """Run until interrupted; announces `LISTENING <port>` once up.
+    With --data-dir, durable state lives in REAL files there and
+    survives restarting this process."""
     c = SimCluster(seed=seed, virtual=False, durable=True,
                    n_storage=n_storage, storage_replicas=storage_replicas,
-                   n_logs=n_logs, n_proxies=n_proxies)
+                   n_logs=n_logs, n_proxies=n_proxies, data_dir=data_dir)
     gw = TcpGateway(c.client("gateway-host"), port=port, tls=tls)
     try:
         async def main():
@@ -53,6 +55,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             tls_args[TLS_FLAGS[a]] = argv.pop(0)
         elif a == "--port":
             kwargs["port"] = int(argv.pop(0))
+        elif a == "--data-dir":
+            kwargs["data_dir"] = argv.pop(0)
         elif a == "--seed":
             kwargs["seed"] = int(argv.pop(0))
         elif a == "--storage":

@@ -166,3 +166,54 @@ def test_remote_client_over_mutual_tls(tmp_path):
             RemoteCluster("127.0.0.1", gc.port, connect_timeout=60,
                           tls=TlsConfig(rogue_cert, rogue_key, cert))
         assert ei.value.name in ("broken_promise", "timed_out")
+
+
+def test_server_process_sigkill_restart_keeps_data(tmp_path):
+    """Operator durability: a tools.server process is SIGKILLed and a
+    NEW process restarts on the same --data-dir; committed data
+    survives (ref: restarting fdbserver on its data directory)."""
+    import os
+    import signal
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    data = str(tmp_path / "srvdata")
+
+    def start():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "foundationdb_tpu.tools.server",
+             "--port", "0", "--seed", "84", "--data-dir", data],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env)
+        line = p.stdout.readline().strip()
+        assert line.startswith("LISTENING "), line
+        return p, int(line.split()[1])
+
+    proc, port = start()
+    try:
+        rc = RemoteCluster("127.0.0.1", port)
+        try:
+            async def write(tr):
+                for i in range(30):
+                    tr.set(b"dur%02d" % i, b"v%d" % i)
+            rc.call(run_transaction(rc.db, write))
+        finally:
+            rc.close()
+        proc.send_signal(signal.SIGKILL)   # no clean shutdown
+        proc.wait(timeout=30)
+
+        proc, port = start()               # fresh process, same dir
+        rc = RemoteCluster("127.0.0.1", port)
+        try:
+            async def check(tr):
+                rows = await tr.get_range(b"dur", b"dus")
+                assert len(rows) == 30, len(rows)
+                tr.set(b"post", b"1")
+            rc.call(run_transaction(rc.db, check))
+        finally:
+            rc.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
