@@ -14,8 +14,8 @@ Blocking surface: `call(coro)` runs any client coroutine on the loop
 thread and returns its result, so synchronous tools (the CLI's
 ``--connect`` mode) drive transactions without owning a scheduler.
 
-Not carried over this seam: watches (the gateway does not expose
-storage watch endpoints).
+Watches work over the seam too: the gateway forwards the storage watch
+long-polls like any other endpoint.
 """
 
 from __future__ import annotations
@@ -46,7 +46,7 @@ def _build_info(d: dict, transport: TcpTransport, host: str,
         replicas = tuple(
             StorageRefs(f"rep-{r['gets']}", 0, s["begin"], end,
                         mk(r["gets"]), mk(r["ranges"]), mk(r["get_keys"]),
-                        None)
+                        mk(r["watches"]) if r.get("watches") else None)
             for r in s["replicas"])
         shards.append(StorageShard(0, s["begin"], end, replicas))
     return ServerDBInfo(

@@ -754,8 +754,9 @@ class Transaction:
             rep = shard.replicas[flow.g_random.random_int(
                 0, len(shard.replicas))]
             if rep.watches is None:
-                # this seam doesn't carry watches (the TCP gateway) —
-                # fail the future cleanly instead of crashing the actor
+                # a seam without watch endpoints (older gateways, the C
+                # binding's describe): fail the future cleanly instead
+                # of crashing the actor
                 f.send_error(error("client_invalid_operation"))
                 continue
             storage_fut = rep.watches.get_reply(

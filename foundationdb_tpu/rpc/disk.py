@@ -226,14 +226,27 @@ class RealDisk:
     under IAsyncFile). `tools/server --data-dir` uses this so a host
     process's durable state survives ACTUAL restarts."""
 
+    LOCKFILE = ".fdbtpu-lock"
+
     def __init__(self, root: str, machine: str = ""):
+        import fcntl
         import os
         self.root = root
         self.machine = machine
         os.makedirs(root, exist_ok=True)
+        # exclusive directory lock (ref: fdbserver flocking its data
+        # dir): two processes interleaving writes into the same stores
+        # would corrupt acknowledged durable state
+        self._lock_fh = open(os.path.join(root, self.LOCKFILE), "w")
+        try:
+            fcntl.flock(self._lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self._lock_fh.close()
+            raise error("io_error") from None
         self.files: Dict[str, RealFile] = {}
         for name in sorted(os.listdir(root)):
-            self.files[name] = RealFile(os.path.join(root, name), name)
+            if name != self.LOCKFILE:
+                self.files[name] = RealFile(os.path.join(root, name), name)
 
     def _path(self, name: str) -> str:
         import os
@@ -270,6 +283,10 @@ class RealDisk:
                 pass
 
     def close_all(self) -> None:
-        """Release every handle (cluster shutdown)."""
+        """Release every handle and the directory lock (shutdown)."""
         for f in self.files.values():
             f._close()
+        try:
+            self._lock_fh.close()   # drops the flock
+        except OSError:
+            pass

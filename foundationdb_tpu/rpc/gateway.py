@@ -141,7 +141,8 @@ class TcpGateway:
                  "replicas": [
                      {"gets": self._expose(r.gets),
                       "ranges": self._expose(r.ranges),
-                      "get_keys": self._expose(r.get_keys)}
+                      "get_keys": self._expose(r.get_keys),
+                      "watches": self._expose(r.watches)}
                      for r in s.replicas]}
                 for s in info.storages],
         }

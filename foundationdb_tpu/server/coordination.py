@@ -94,7 +94,7 @@ class Coordinator:
             self._dq = None
         # the DiskQueue is single-writer; reads raising read_gen and
         # writes both persist, so their pushes must serialize
-        self._persist_lock = flow.FlowLock()
+        self._persist_lock = FlowLock()
         self._actors = flow.ActorCollection()
 
     def start(self) -> None:

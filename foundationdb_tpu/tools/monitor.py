@@ -1,0 +1,69 @@
+"""Process supervisor: keep a cluster host process running.
+
+Reference: fdbmonitor/fdbmonitor.cpp:501-790 — a tiny daemon that
+spawns fdbserver, restarts it with backoff when it dies, and logs
+lifecycle events. `python -m foundationdb_tpu.tools.monitor --port N
+--data-dir D [server args...]` does that for tools.server: with a
+data directory the restarted process recovers the database, so a
+crashing server self-heals end to end.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+BACKOFF_INITIAL = 0.5
+BACKOFF_MAX = 30.0
+RESET_AFTER = 10.0   # a run this long resets the backoff
+
+
+def supervise(server_args: List[str], max_restarts: Optional[int] = None,
+              announce=print, python: Optional[str] = None) -> int:
+    """Run tools.server under supervision; returns only when
+    max_restarts is exhausted (None = forever / until SIGINT)."""
+    backoff = BACKOFF_INITIAL
+    restarts = 0
+    while True:
+        cmd = [python or sys.executable, "-m",
+               "foundationdb_tpu.tools.server"] + server_args
+        started = time.monotonic()
+        announce(f"MONITOR starting: {' '.join(cmd)}", flush=True)
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+
+        def relay():
+            # continuously forward + DRAIN child stdout (a full pipe
+            # would block the server; fdbmonitor relays the same way)
+            for line in proc.stdout:
+                announce(f"MONITOR child: {line.rstrip()}", flush=True)
+
+        import threading
+        threading.Thread(target=relay, daemon=True).start()
+        try:
+            rc = proc.wait()
+        except KeyboardInterrupt:
+            proc.terminate()
+            proc.wait(timeout=30)
+            announce("MONITOR stopped", flush=True)
+            return 0
+        ran = time.monotonic() - started
+        announce(f"MONITOR child exited rc={rc} after {ran:.1f}s",
+                 flush=True)
+        restarts += 1
+        if max_restarts is not None and restarts > max_restarts:
+            return 1
+        if ran >= RESET_AFTER:
+            backoff = BACKOFF_INITIAL
+        time.sleep(backoff)
+        backoff = min(backoff * 2, BACKOFF_MAX)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    return supervise(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -217,3 +217,102 @@ def test_server_process_sigkill_restart_keeps_data(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+def test_monitor_restarts_crashed_server(tmp_path):
+    """fdbmonitor analogue: the supervisor restarts a killed server
+    process with backoff, and — thanks to --data-dir — the restarted
+    child serves the same database (ref: fdbmonitor.cpp spawn/restart
+    loop)."""
+    import os
+    import signal
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    data = str(tmp_path / "mondata")
+    port = _free_port()
+
+    mon = subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.tools.monitor",
+         "--port", str(port), "--seed", "89", "--data-dir", data],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        def wait_listening():
+            while True:
+                line = mon.stdout.readline()
+                assert line, "monitor died"
+                if "LISTENING" in line:
+                    return
+
+        wait_listening()
+        rc = RemoteCluster("127.0.0.1", port)
+        try:
+            async def write(tr):
+                tr.set(b"monitored", b"yes")
+            rc.call(run_transaction(rc.db, write))
+        finally:
+            rc.close()
+
+        # find and kill the CHILD server process
+        out = subprocess.run(
+            ["pgrep", "-f", f"tools.server --port {port}"],
+            capture_output=True, text=True)
+        pids = [int(p) for p in out.stdout.split()]
+        assert pids, "no child server found"
+        for pid in pids:
+            os.kill(pid, signal.SIGKILL)
+
+        wait_listening()   # the monitor restarted it
+        rc = RemoteCluster("127.0.0.1", port, connect_timeout=60)
+        try:
+            async def check(tr):
+                assert await tr.get(b"monitored") == b"yes"
+                tr.set(b"post-crash", b"1")
+            rc.call(run_transaction(rc.db, check))
+        finally:
+            rc.close()
+    finally:
+        mon.send_signal(signal.SIGINT)
+        try:
+            mon.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            mon.kill()
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_remote_watch_fires_over_the_gateway():
+    """A watch armed by one remote client fires when another remote
+    transaction changes the key — the long-poll forwarding path (ref:
+    storage watches reaching external clients through FlowTransport)."""
+    import foundationdb_tpu.flow as fl
+
+    with GatewayedCluster(seed=818) as gc:
+        rc = RemoteCluster("127.0.0.1", gc.port)
+        try:
+            async def arm():
+                tr = rc.db.create_transaction()
+                await tr.get(b"watched")
+                w = tr.watch(b"watched")
+                await tr.commit()
+                return w
+            w = rc.call(arm())
+
+            async def write(tr):
+                tr.set(b"watched", b"changed")
+            rc.call(run_transaction(rc.db, write))
+
+            async def await_watch():
+                return await fl.timeout_error(w, 30.0)
+            rc.call(await_watch())   # raises timed_out if never fired
+        finally:
+            rc.close()
