@@ -456,7 +456,8 @@ struct Conn {
     }
 
     /* blocking request; on success *out holds the decoded reply value */
-    fdb_tpu_error_t request(uint64_t token, const WVal& req, WVal* out) {
+    fdb_tpu_error_t request(uint64_t token, const WVal& req, WVal* out,
+                            int timeout_ms = kRequestTimeoutMs) {
         std::string payload;
         wire_encode(req, payload);
         std::shared_ptr<ConnState> c;
@@ -487,7 +488,7 @@ struct Conn {
                 return 1100;
             }
             bool ok = c->cv.wait_for(
-                g, std::chrono::milliseconds(kRequestTimeoutMs),
+                g, std::chrono::milliseconds(timeout_ms),
                 [&] { return p->done; });
             if (!ok) {
                 c->pending.erase(req_id);
@@ -511,7 +512,7 @@ struct Conn {
 /* ---------------- cluster picture (gateway describe) ---------------- */
 
 struct Replica {
-    uint64_t gets = 0, ranges = 0, get_keys = 0;
+    uint64_t gets = 0, ranges = 0, get_keys = 0, watches = 0;
 };
 
 struct Shard {
@@ -557,9 +558,11 @@ bool parse_info(const WVal& d, ClusterInfo* out) {
             const WVal* g = dict_get(r, "gets");
             const WVal* rg = dict_get(r, "ranges");
             const WVal* gk = dict_get(r, "get_keys");
+            const WVal* wa = dict_get(r, "watches");
             if (!g || !rg || !gk) return false;
             sh.replicas.push_back(
-                {uint64_t(g->i), uint64_t(rg->i), uint64_t(gk->i)});
+                {uint64_t(g->i), uint64_t(rg->i), uint64_t(gk->i),
+                 wa ? uint64_t(wa->i) : 0});
         }
         out->shards.push_back(std::move(sh));
     }
@@ -672,6 +675,13 @@ bool is_atomic_op(int op) {
 }
 
 std::string next_key(const std::string& k) { return k + '\0'; }
+
+size_t shard_index_for(const std::shared_ptr<const ClusterInfo>& p,
+                       const std::string& key) {
+    for (size_t k = p->shards.size(); k-- > 0;)
+        if (key >= p->shards[k].begin) return k;
+    return 0;
+}
 
 } /* namespace */
 
@@ -786,9 +796,7 @@ struct FDBTpuTransaction {
 
     size_t shard_index(const std::shared_ptr<const ClusterInfo>& p,
                        const std::string& key) {
-        for (size_t k = p->shards.size(); k-- > 0;)
-            if (key >= p->shards[k].begin) return k;
-        return 0;
+        return shard_index_for(p, key);
     }
 
     /* rotated replica failover (client/transaction.py _storage_rpc) */
@@ -1305,6 +1313,52 @@ fdb_tpu_error_t fdb_tpu_transaction_on_error(FDBTpuTransaction* tr,
         std::chrono::milliseconds(1 + int(tr->db->rand_below(10))));
     tr->reset();
     return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_database_watch(FDBTpuDatabase* db,
+                                       const uint8_t* key, int key_length,
+                                       int timeout_ms) {
+    /* a watch rides the same resilience rules as every read: rotate
+     * replicas on connection-class failures and refresh a stale
+     * picture once before giving up (a recovery swaps the tokens) */
+    std::string k((const char*)key, key_length);
+    fdb_tpu_error_t last = 1100;
+    for (int attempt = 0; attempt < 2; attempt++) {
+        auto p = db->picture();
+        if (!p) return 1100;
+        const ProxyEndpoints& proxy =
+            p->proxies[db->rand_below(uint32_t(p->proxies.size()))];
+        WVal grv;
+        fdb_tpu_error_t err = db->conn.request(
+            proxy.grvs,
+            WVal::nt("GetReadVersionRequest", {WVal::integer(1)}), &grv);
+        if (err == 0) {
+            if (grv.t != WVal::NT || grv.items.empty()) return 4000;
+            int64_t version = grv.items[0].i;
+            const Shard& shard = p->shards[shard_index_for(p, k)];
+            size_t n = shard.replicas.size();
+            size_t start = db->rand_below(uint32_t(n));
+            for (size_t j = 0; j < n; j++) {
+                const Replica& rep = shard.replicas[(start + j) % n];
+                if (rep.watches == 0) return 2000; /* seam lacks watches */
+                WVal reply;
+                err = db->conn.request(
+                    rep.watches,
+                    WVal::nt("StorageWatchRequest",
+                             {WVal::bytes(k), WVal::integer(version)}),
+                    &reply, timeout_ms);
+                if (err == 0) return 0;
+                if (err != 1100) return err; /* incl. the caller's 1004 */
+                last = err;
+            }
+        } else if (err != 1100 && err != 1004) {
+            return err;
+        } else {
+            last = err;
+        }
+        db->describe(p->seq);   /* stale picture: refresh and retry */
+    }
+    return last;
 }
 
 void fdb_tpu_free(void* ptr) { std::free(ptr); }

@@ -19,8 +19,8 @@
  * thread-safe per handle (a transaction must not be used from two
  * threads at once, matching the reference's rule).
  *
- * Not yet carried over this ABI: watches, versionstamped operand
- * reads (set-versionstamp mutations themselves DO commit).
+ * Not yet carried over this ABI: versionstamped operand reads
+ * (set-versionstamp mutations themselves DO commit).
  */
 
 #ifndef FDB_TPU_C_H
@@ -137,6 +137,13 @@ fdb_tpu_error_t fdb_tpu_transaction_get_versionstamp(FDBTpuTransaction* tr,
  * stale one), else returns the error back (ref: fdb_transaction_on_error). */
 fdb_tpu_error_t fdb_tpu_transaction_on_error(FDBTpuTransaction* tr,
                                              fdb_tpu_error_t code);
+
+/* Block until the key's value differs from its value as of now, or
+ * timeout_ms elapses (returns timed_out). A thread-safe blocking watch
+ * (ref: fdb_transaction_watch; the blocking shape suits this ABI). */
+fdb_tpu_error_t fdb_tpu_database_watch(FDBTpuDatabase* db,
+                                       const uint8_t* key, int key_length,
+                                       int timeout_ms);
 
 void fdb_tpu_free(void* p);
 void fdb_tpu_free_keyvalues(FDBTpuKeyValue* kv, int count);

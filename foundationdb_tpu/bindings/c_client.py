@@ -107,6 +107,9 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
     lib.fdb_tpu_transaction_on_error.restype = ctypes.c_int
     lib.fdb_tpu_transaction_on_error.argtypes = [ctypes.c_void_p,
                                                  ctypes.c_int]
+    lib.fdb_tpu_database_watch.restype = ctypes.c_int
+    lib.fdb_tpu_database_watch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.fdb_tpu_free.argtypes = [ctypes.c_void_p]
     lib.fdb_tpu_free_keyvalues.argtypes = [ctypes.c_void_p, ctypes.c_int]
     _lib = lib
@@ -152,6 +155,11 @@ class CDatabase:
         _check(self.lib, self.lib.fdb_tpu_database_create_transaction(
             self._h, ctypes.byref(handle)))
         return CTransaction(self.lib, handle)
+
+    def watch(self, key: bytes, timeout_ms: int = 60000) -> None:
+        """Block until the key's value changes (or timed_out raises)."""
+        _check(self.lib, self.lib.fdb_tpu_database_watch(
+            self._h, key, len(key), timeout_ms))
 
     def run(self, body, max_retries: int = 100):
         """The standard retry loop over the C on_error protocol."""

@@ -125,9 +125,13 @@ class RemoteCluster:
         self._submissions: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._started: queue.Queue = queue.Queue()
+        self._connect_timeout = connect_timeout
         self._thread = threading.Thread(target=self._main, daemon=True)
         self._thread.start()
-        item = self._started.get(timeout=connect_timeout)
+        # the loop thread's own boot timeout governs; the queue wait is
+        # slightly longer so the REAL boot error arrives here instead
+        # of a generic queue.Empty
+        item = self._started.get(timeout=connect_timeout + 10)
         if isinstance(item, BaseException):
             raise item
         self.db: RemoteDatabase = item
@@ -156,7 +160,7 @@ class RemoteCluster:
                     flow.spawn(self._run_one(coro, box, done))
 
             t = s.spawn(boot())
-            s.run(until=t, timeout_time=25)
+            s.run(until=t, timeout_time=self._connect_timeout)
             self._started.put(db)
             s.run(until=s.spawn(pump()))
         except BaseException as e:  # noqa: BLE001 — surface to creator

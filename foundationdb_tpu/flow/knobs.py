@@ -66,6 +66,7 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("DD_SHARD_SPLIT_ROWS", 1000, lambda: 120)
     init("DD_SHARD_MERGE_ROWS", 40, lambda: 10)
     init("SAMPLE_EXPIRATION_TIME", 1.0)
+    init("WATCH_TIMEOUT", 900.0, lambda: 20.0)
     return k
 
 

@@ -437,7 +437,9 @@ class StorageServer:
                 (self._get_loop(), TaskPriority.STORAGE, "get"),
                 (self._range_loop(), TaskPriority.STORAGE, "getrange"),
                 (self._get_key_loop(), TaskPriority.STORAGE, "getkey"),
-                (self._watch_loop(), TaskPriority.STORAGE, "watch")):
+                (self._watch_loop(), TaskPriority.STORAGE, "watch"),
+                (self._watch_expiry_loop(), TaskPriority.LOW_PRIORITY,
+                 "watchExpiry")):
             self._actors.add(flow.spawn(coro, prio,
                                         name=f"{self.process.name}.{name}"))
 
@@ -766,7 +768,7 @@ class StorageServer:
         # so their clients refresh the location map (code review r3)
         for k in [k for k in self._watch_map
                   if k < begin or (end is not None and k >= end)]:
-            for _expected, reply in self._watch_map.pop(k):
+            for _expected, reply, _deadline in self._watch_map.pop(k):
                 reply.send_error(error("wrong_shard_server"))
         self.shard_begin, self.shard_end = begin, end
         self._persist_meta()
@@ -824,11 +826,11 @@ class StorageServer:
             waiters = self._watch_map.get(k, [])
             still = []
             now_val = self.data.get(k, version)
-            for expected, reply in waiters:
+            for expected, reply, deadline in waiters:
                 if now_val != expected:
                     reply.send(version)
                 else:
-                    still.append((expected, reply))
+                    still.append((expected, reply, deadline))
             if still:
                 self._watch_map[k] = still
             else:
@@ -911,6 +913,28 @@ class StorageServer:
             if current != expected:
                 reply.send(self.version.get())
                 return
-            self._watch_map.setdefault(req.key, []).append((expected, reply))
+            deadline = flow.now() + SERVER_KNOBS.watch_timeout
+            self._watch_map.setdefault(req.key, []).append(
+                (expected, reply, deadline))
         except flow.FdbError as e:
             reply.send_error(e)
+
+    async def _watch_expiry_loop(self):
+        """Abandoned registrations (a client that timed out and went
+        away) must not pin _watch_map forever (ref: the database's
+        WATCH timeout, DEFAULT_MAX_WATCHES/timeout handling) — expired
+        waiters get timed_out; a live client just re-arms."""
+        while True:
+            await flow.delay(30.0, TaskPriority.LOW_PRIORITY)
+            now = flow.now()
+            for k in list(self._watch_map):
+                keep = []
+                for expected, reply, deadline in self._watch_map.get(k, ()):
+                    if deadline <= now:
+                        reply.send_error(error("timed_out"))
+                    else:
+                        keep.append((expected, reply, deadline))
+                if keep:
+                    self._watch_map[k] = keep
+                else:
+                    self._watch_map.pop(k, None)

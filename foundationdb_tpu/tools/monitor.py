@@ -33,10 +33,12 @@ def supervise(server_args: List[str], max_restarts: Optional[int] = None,
         announce(f"MONITOR starting: {' '.join(cmd)}", flush=True)
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
 
-        def relay():
+        def relay(p=proc):
             # continuously forward + DRAIN child stdout (a full pipe
-            # would block the server; fdbmonitor relays the same way)
-            for line in proc.stdout:
+            # would block the server; fdbmonitor relays the same way).
+            # Bound to THIS child: a delayed thread must never read a
+            # successor's pipe concurrently with its own relay.
+            for line in p.stdout:
                 announce(f"MONITOR child: {line.rstrip()}", flush=True)
 
         import threading
@@ -45,7 +47,11 @@ def supervise(server_args: List[str], max_restarts: Optional[int] = None,
             rc = proc.wait()
         except KeyboardInterrupt:
             proc.terminate()
-            proc.wait(timeout=30)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()   # a wedged child must not orphan the port
+                proc.wait(timeout=30)
             announce("MONITOR stopped", flush=True)
             return 0
         ran = time.monotonic() - started

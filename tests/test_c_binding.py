@@ -312,3 +312,39 @@ def test_cross_binding_parity(seed):
     assert len(py) == len(cc)
     for i, (a, b) in enumerate(zip(py, cc)):
         assert a == b, f"op result {i} diverged: python={a!r} c={b!r}"
+
+
+def test_c_binding_watch():
+    """The C binding's blocking watch fires when another client writes
+    the key (ref: fdb_transaction_watch; thread-safe blocking shape)."""
+    import threading
+
+    load_library()
+    with GatewayedCluster(seed=22) as gc:
+        db = CDatabase("127.0.0.1", gc.port)
+        try:
+            tr = db.create_transaction()
+            tr.set(b"wkey", b"v0")
+            tr.commit()
+            tr.destroy()
+
+            fired = []
+
+            def watcher():
+                db.watch(b"wkey", timeout_ms=30000)
+                fired.append(True)
+
+            t = threading.Thread(target=watcher)
+            t.start()
+            import time
+            time.sleep(0.3)   # let the long poll arm
+            assert not fired
+
+            t2 = db.create_transaction()
+            t2.set(b"wkey", b"v1")
+            t2.commit()
+            t2.destroy()
+            t.join(timeout=30)
+            assert fired, "watch never fired"
+        finally:
+            db.close()

@@ -600,3 +600,33 @@ def test_sim_validation_catches_broken_maps():
             c.run(poison(), timeout_time=30)
     finally:
         c.shutdown()
+
+
+def test_abandoned_watches_expire():
+    """A watch nobody is waiting on (client gone) expires after
+    WATCH_TIMEOUT instead of pinning the storage watch map forever
+    (ref: the database watch timeout)."""
+    c = SimCluster(seed=98)
+    flow.SERVER_KNOBS.init("WATCH_TIMEOUT", 5.0)
+    try:
+        db = c.client()
+
+        async def main():
+            tr = db.create_transaction()
+            await tr.get(b"wexp")
+            w = tr.watch(b"wexp")
+            await tr.commit()
+            # nothing ever writes the key; the registration must expire
+            with pytest.raises(flow.FdbError) as ei:
+                await flow.timeout_error(w, 120.0)
+            assert ei.value.name == "timed_out"
+            info = c.cc.dbinfo.get()
+            for s in info.storages:
+                for rep in s.replicas:
+                    obj = c.cc._storage_objs[rep.name]
+                    assert not obj._watch_map, obj._watch_map
+            return True
+
+        assert c.run(main(), timeout_time=300)
+    finally:
+        c.shutdown()

@@ -147,7 +147,10 @@ def test_remote_client_over_mutual_tls(tmp_path):
                     c.shutdown()
 
     with TlsGatewayedCluster(seed=87) as gc:
-        rc = RemoteCluster("127.0.0.1", gc.port, tls=tls)
+        # generous boot window: RSA keygen + TLS handshakes under a
+        # loaded machine can stretch startup well past the default
+        rc = RemoteCluster("127.0.0.1", gc.port, tls=tls,
+                           connect_timeout=120)
         try:
             async def write(tr):
                 tr.set(b"secure", b"channel")
