@@ -297,7 +297,7 @@ def _run_script_c(script, seed):
             db.close()
 
 
-@pytest.mark.parametrize("seed", [101, 202])
+@pytest.mark.parametrize("seed", [101, 202, 303])
 def test_cross_binding_parity(seed):
     """bindingtester analogue: an identical randomized instruction
     stream through the Python binding and the native C binding must
