@@ -363,3 +363,60 @@ def test_dd_churn_with_buggify(seed):
         assert c.run(main(), timeout_time=1800)
     finally:
         c.shutdown()
+
+
+@pytest.mark.parametrize("seed", (3501, 3502))
+def test_multikey_atomicity_under_attrition(seed):
+    """Writers update a GROUP of keys to the same stamp in one
+    transaction while readers continuously assert the group is always
+    internally consistent — atomicity is never violated even while
+    roles die and links clog (ref: the Atomic*/WriteDuringRead family
+    of consistency workloads)."""
+    c = SimCluster(seed=seed, durable=True, n_logs=2, n_storage=2,
+                   n_workers=6)
+    try:
+        writer_db = c.client("writer")
+        reader_db = c.client("reader")
+        machines = [f"w{i}" for i in range(c.n_workers)]
+        GROUP = [b"atom/a", b"atom/b", b"atom/c"]
+
+        async def main():
+            async def init(tr):
+                for k in GROUP:
+                    tr.set(k, b"stamp0")
+            await run_transaction(writer_db, init, max_retries=500)
+
+            stop = [False]
+            checked = [0]
+
+            async def writer():
+                i = 1
+                while not stop[0]:
+                    async def body(tr, i=i):
+                        for k in GROUP:
+                            tr.set(k, b"stamp%d" % i)
+                    await run_transaction(writer_db, body, max_retries=800)
+                    i += 1
+                    await flow.delay(0.01)
+
+            async def reader():
+                while not stop[0]:
+                    async def body(tr):
+                        vals = [await tr.get(k) for k in GROUP]
+                        assert len(set(vals)) == 1, vals  # all-or-nothing
+                    await run_transaction(reader_db, body, max_retries=800)
+                    checked[0] += 1
+                    await flow.delay(0.01)
+
+            w = flow.spawn(writer())
+            r = flow.spawn(reader())
+            await _attrition(c, 6, machines)
+            await flow.delay(1.0)
+            stop[0] = True
+            await flow.wait_for_all([w, r])
+            assert checked[0] > 20, checked[0]
+            return True
+
+        assert c.run(main(), timeout_time=1200)
+    finally:
+        c.shutdown()
