@@ -50,6 +50,10 @@ REFRESH_ERRORS = {"broken_promise", "commit_unknown_result", "tlog_stopped",
 REQUEST_TIMEOUT = 5.0  # seconds; a hung role surfaces as retryable
                        # timed_out (ref: failure-monitored getReply)
 
+# "no limit" sentinel for range reads: the default get_range cap, the
+# overlay full-fetch, and the parallel-fan-out threshold must agree
+UNBOUNDED_ROW_LIMIT = 1 << 20
+
 # The \xff system keyspace (ref: fdbclient/SystemData.cpp — keyServers/,
 # conf/, excluded/ prefixes). Here the rows are materialized from the
 # broadcast ServerDBInfo and the CC's status document rather than stored
@@ -525,7 +529,7 @@ class Transaction:
             self._read_conflicts.append((lo, _next_key(hi)))
         return resolved
 
-    async def get_range(self, begin, end, limit: int = 1 << 20,
+    async def get_range(self, begin, end, limit: int = UNBOUNDED_ROW_LIMIT,
                         snapshot: bool = False,
                         reverse: bool = False) -> List[Tuple[bytes, bytes]]:
         if isinstance(begin, KeySelector):
@@ -552,7 +556,8 @@ class Transaction:
         # merge (ref: RYWIterator reads through the WriteMap instead).
         has_overlay = bool(self._cleared or self._write_order or self._ops)
         base = await self._fetch_range(
-            begin, end, version, (1 << 20) if has_overlay else limit,
+            begin, end, version,
+            UNBOUNDED_ROW_LIMIT if has_overlay else limit,
             False if has_overlay else reverse)
         # overlay uncommitted writes (ref: RYWIterator merge)
         merged: Dict[bytes, bytes] = {k: v for k, v in base}
@@ -608,12 +613,46 @@ class Transaction:
         shards = _overlapping_shards(info.storages, begin, end)
         if reverse:
             shards = shards[::-1]
-        out: List[Tuple[bytes, bytes]] = []
-        for s in shards:
-            b = max(begin, s.begin)
-            e = end if s.end is None else min(end, s.end)
-            part = await self._storage_rpc(
+        # the piece of [begin, end) each shard owns
+        clamped = [(s, max(begin, s.begin),
+                    end if s.end is None else min(end, s.end))
+                   for s in shards]
+        if limit >= UNBOUNDED_ROW_LIMIT and len(shards) > 1:
+            # effectively-unbounded scan: fan the shards out in
+            # PARALLEL and concatenate in shard order — the limit can't
+            # truncate, so per-shard requests are independent (ref:
+            # NativeAPI getRange issuing parallel requests when limits
+            # permit). The race settles on the FIRST error (the serial
+            # path's prompt-retry behavior) and cancels the rest.
+            futs = [flow.spawn(self._storage_rpc(
                 s, lambda rep, b=b, e=e: rep.ranges.get_reply(
+                    StorageGetRangeRequest(b, e, version, limit, reverse),
+                    self.db.process))) for s, b, e in clamped]
+            wrappers = [flow.catch_errors(f) for f in futs]
+            results: List = [None] * len(futs)
+            pending = set(range(len(futs)))
+            try:
+                while pending:
+                    order = sorted(pending)
+                    i, settled = await flow.first_of(
+                        *[wrappers[j] for j in order])
+                    idx = order[i]
+                    pending.discard(idx)
+                    if settled.is_error:
+                        raise settled.exception()
+                    results[idx] = settled.get()
+            finally:
+                for f in futs:
+                    if not f.is_ready:
+                        f.cancel()
+            out: List[Tuple[bytes, bytes]] = []
+            for part in results:
+                out.extend(part)
+            return out
+        out = []
+        for _s, b, e in clamped:
+            part = await self._storage_rpc(
+                _s, lambda rep, b=b, e=e: rep.ranges.get_reply(
                     StorageGetRangeRequest(b, e, version, limit - len(out),
                                            reverse), self.db.process))
             out.extend(part)
