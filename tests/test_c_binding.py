@@ -348,3 +348,14 @@ def test_c_binding_watch():
             assert fired, "watch never fired"
         finally:
             db.close()
+
+
+def test_cross_binding_parity_deep():
+    """A longer instruction stream (300 ops) through both bindings —
+    the bindingtester's depth knob (kept to one seed so the suite
+    stays fast; more seeds ran in round-3 sweeps)."""
+    load_library()
+    script = _make_script(911, n_ops=300)
+    py = _run_script_python(script, 911)
+    cc = _run_script_c(script, 911)
+    assert py == cc
