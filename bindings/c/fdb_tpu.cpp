@@ -66,6 +66,7 @@ const ErrDef kErrors[] = {
     {"operation_cancelled", 1101},
     {"client_invalid_operation", 2000},
     {"key_outside_legal_range", 2004},
+    {"invalid_option_value", 2006},
     {"inverted_range", 2005},
     {"transaction_too_large", 2101},
     {"key_too_large", 2102},
@@ -231,7 +232,11 @@ bool get_i64(const std::string& buf, size_t& off, int64_t* out) {
     return true;
 }
 
-bool wire_decode(const std::string& buf, size_t& off, WVal* out) {
+bool wire_decode(const std::string& buf, size_t& off, WVal* out,
+                 int depth = 0) {
+    /* nesting bound: a frame of repeated 1-element list headers must
+     * not be able to overflow the stack */
+    if (depth > 64) return false;
     if (off >= buf.size()) return false;
     uint8_t tag = uint8_t(buf[off++]);
     switch (tag) {
@@ -267,19 +272,25 @@ bool wire_decode(const std::string& buf, size_t& off, WVal* out) {
         case W_LIST: {
             uint32_t n;
             if (!get_u32(buf, off, &n)) return false;
+            /* each element needs >=1 byte: an untrusted count beyond the
+             * remaining buffer is malformed, not a multi-GB resize */
+            if (n > buf.size() - off) return false;
             out->t = (tag == W_TUPLE ? WVal::TUPLE : WVal::LIST);
             out->items.resize(n);
             for (uint32_t k = 0; k < n; k++)
-                if (!wire_decode(buf, off, &out->items[k])) return false;
+                if (!wire_decode(buf, off, &out->items[k], depth + 1))
+                    return false;
             return true;
         }
         case W_DICT: {
             uint32_t n;
             if (!get_u32(buf, off, &n)) return false;
+            if (n > (buf.size() - off) / 2) return false;
             out->t = WVal::DICT;
             out->items.resize(size_t(n) * 2);
             for (uint32_t k = 0; k < 2 * n; k++)
-                if (!wire_decode(buf, off, &out->items[k])) return false;
+                if (!wire_decode(buf, off, &out->items[k], depth + 1))
+                    return false;
             return true;
         }
         case W_NT: {
@@ -290,9 +301,11 @@ bool wire_decode(const std::string& buf, size_t& off, WVal* out) {
             out->s.assign(buf, off, ln);
             off += ln;
             if (!get_u32(buf, off, &n)) return false;
+            if (n > buf.size() - off) return false;
             out->items.resize(n);
             for (uint32_t k = 0; k < n; k++)
-                if (!wire_decode(buf, off, &out->items[k])) return false;
+                if (!wire_decode(buf, off, &out->items[k], depth + 1))
+                    return false;
             return true;
         }
         default:
@@ -388,6 +401,10 @@ void reader_thread(std::shared_ptr<ConnState> st) {
         uint8_t kind = hdr[4];
         uint64_t req_id = 0;
         for (int k = 0; k < 8; k++) req_id |= uint64_t(hdr[5 + k]) << (8 * k);
+        /* a corrupt length must not become a multi-GB allocation; no
+         * legitimate reply approaches this (txn limit is 10MB, range
+         * replies are row-limited) */
+        if (ln > (1u << 30)) break;
         std::string payload(ln, '\0');
         if (ln && !read_exact(fd, payload.data(), ln)) break;
         std::lock_guard<std::mutex> g(st->mut);
@@ -722,8 +739,26 @@ struct Mutation {
     std::string p1, p2;
 };
 
+/* \xff system-keyspace boundaries (client/transaction.py SYSTEM_PREFIX/
+ * STORED_SYSTEM_PREFIX/ENGINE_PREFIX; ref: fdbclient/SystemData.cpp) */
+static bool in_system(const std::string& k) {
+    return !k.empty() && (unsigned char)k[0] == 0xFFu;
+}
+static bool stored_system(const std::string& k) {
+    return k.size() >= 2 && (unsigned char)k[0] == 0xFFu &&
+           (unsigned char)k[1] == 0x02u;
+}
+static bool engine_space(const std::string& k) {
+    return k.size() >= 2 && (unsigned char)k[0] == 0xFFu &&
+           (unsigned char)k[1] == 0xFFu;
+}
+static const std::string kSystemBegin("\xff", 1);
+static const std::string kEngineBegin("\xff\xff", 2);
+
 struct FDBTpuTransaction {
     FDBTpuDatabase* db;
+    bool read_system = false;    /* READ_SYSTEM_KEYS */
+    bool access_system = false;  /* ACCESS_SYSTEM_KEYS (implies read) */
     int64_t read_version = -1;
     int64_t used_seq = -1;
     /* RYW overlay: key -> (present, value); clears in op order */
@@ -737,6 +772,8 @@ struct FDBTpuTransaction {
     int64_t committed_batch_index = -1;
 
     void reset() {
+        read_system = false;
+        access_system = false;
         read_version = -1;
         used_seq = -1;
         writes.clear();
@@ -861,6 +898,24 @@ struct FDBTpuTransaction {
         return 0;
     }
 
+    /* client/transaction.py _check_writable: ACCESS_SYSTEM_KEYS admits
+     * only the stored \xff\x02 subspace; \xff\xff never */
+    fdb_tpu_error_t check_writable(const std::string& b,
+                                   const std::string* e = nullptr) {
+        if (e == nullptr) {
+            if (in_system(b) && !(access_system && stored_system(b) &&
+                                  !engine_space(b)))
+                return 2004;
+        } else {
+            if (in_system(b) || *e > kSystemBegin) {
+                if (!(access_system && stored_system(b) &&
+                      *e <= kEngineBegin))
+                    return 2004;
+            }
+        }
+        return 0;
+    }
+
     void record_write(const std::string& key, const OptBytes& value) {
         writes[key] = value ? std::make_pair(true, *value)
                             : std::make_pair(false, std::string());
@@ -909,6 +964,21 @@ fdb_tpu_error_t fdb_tpu_database_create_transaction(
 
 void fdb_tpu_transaction_destroy(FDBTpuTransaction* tr) { delete tr; }
 
+fdb_tpu_error_t fdb_tpu_transaction_set_option(FDBTpuTransaction* tr,
+                                               const char* option) {
+    std::string o(option ? option : "");
+    if (o == "access_system_keys") {
+        tr->access_system = true;
+        tr->read_system = true;
+        return 0;
+    }
+    if (o == "read_system_keys") {
+        tr->read_system = true;
+        return 0;
+    }
+    return 2006; /* invalid_option_value */
+}
+
 void fdb_tpu_transaction_reset(FDBTpuTransaction* tr) { tr->reset(); }
 
 fdb_tpu_error_t fdb_tpu_transaction_get_read_version(FDBTpuTransaction* tr,
@@ -928,6 +998,8 @@ fdb_tpu_error_t fdb_tpu_transaction_get(FDBTpuTransaction* tr,
                                         uint8_t** out_value,
                                         int* out_value_length) {
     std::string k((const char*)key, key_length);
+    if (in_system(k) && !tr->read_system)
+        return 2004; /* ref: key_outside_legal_range without the option */
     OptBytes v;
     fdb_tpu_error_t err = tr->get(k, snapshot != 0, &v);
     if (err) return err;
@@ -952,6 +1024,7 @@ fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
     /* cross-shard selector walk (client/transaction.py get_key; ref:
      * NativeAPI getKey readThrough iteration) */
     std::string anchor((const char*)key, key_length);
+    if (in_system(anchor) && !tr->read_system) return 2004;
     int64_t version;
     fdb_tpu_error_t err = tr->grv(&version);
     if (err) return err;
@@ -1002,6 +1075,9 @@ fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
             sel_off = leftover;
         }
     }
+    /* a selector walking off user space clamps to maxKey instead of
+     * leaking stored \xff rows (client/transaction.py get_key) */
+    if (resolved > kSystemBegin && !tr->read_system) resolved = kSystemBegin;
     if (!snapshot) {
         const std::string& lo = std::min(resolved, anchor);
         const std::string& hi = std::max(resolved, anchor);
@@ -1021,6 +1097,11 @@ fdb_tpu_error_t fdb_tpu_transaction_get_range(
     *out_kv = nullptr;
     *out_count = 0;
     if (begin >= end) return 0;
+    if (!tr->read_system) {
+        if (in_system(begin) || end > kSystemBegin) return 2004;
+    } else if (end > kEngineBegin) {
+        return 2004;
+    }
     if (limit <= 0) limit = 1 << 20;
     int64_t version;
     fdb_tpu_error_t err = tr->grv(&version);
@@ -1143,7 +1224,9 @@ fdb_tpu_error_t fdb_tpu_transaction_set(FDBTpuTransaction* tr,
                                         int value_length) {
     std::string k((const char*)key, key_length);
     std::string v((const char*)value, value_length);
-    fdb_tpu_error_t err = tr->check_sizes(k, v);
+    fdb_tpu_error_t err = tr->check_writable(k);
+    if (err) return err;
+    err = tr->check_sizes(k, v);
     if (err) return err;
     tr->record_write(k, v);
     tr->ops.erase(k); /* a set supersedes pending atomics */
@@ -1170,7 +1253,9 @@ fdb_tpu_error_t fdb_tpu_transaction_clear_range(FDBTpuTransaction* tr,
     std::string b((const char*)begin_p, begin_length);
     std::string e((const char*)end_p, end_length);
     if (b >= e) return 0;
-    fdb_tpu_error_t err = tr->check_sizes(b, "");
+    fdb_tpu_error_t err = tr->check_writable(b, &e);
+    if (err) return err;
+    err = tr->check_sizes(b, "");
     if (err) return err;
     err = tr->check_sizes(e, "", 1); /* keyAfter(max-size key) is legal */
     if (err) return err;
@@ -1194,7 +1279,9 @@ fdb_tpu_error_t fdb_tpu_transaction_atomic_op(FDBTpuTransaction* tr,
                                               int operation_type) {
     std::string k((const char*)key, key_length);
     std::string pm((const char*)param, param_length);
-    fdb_tpu_error_t err = tr->check_sizes(k, pm);
+    fdb_tpu_error_t err = tr->check_writable(k);
+    if (err) return err;
+    err = tr->check_sizes(k, pm);
     if (err) return err;
     if (operation_type == FDB_TPU_OP_SET_VERSIONSTAMPED_KEY ||
         operation_type == FDB_TPU_OP_SET_VERSIONSTAMPED_VALUE) {
@@ -1322,6 +1409,9 @@ fdb_tpu_error_t fdb_tpu_database_watch(FDBTpuDatabase* db,
      * replicas on connection-class failures and refresh a stale
      * picture once before giving up (a recovery swaps the tokens) */
     std::string k((const char*)key, key_length);
+    /* system keys are unwatchable through this option-less ABI
+     * (client/transaction.py watch gate) */
+    if (in_system(k)) return 2004;
     fdb_tpu_error_t last = 1100;
     for (int attempt = 0; attempt < 2; attempt++) {
         auto p = db->picture();

@@ -73,6 +73,12 @@ fdb_tpu_error_t fdb_tpu_database_create_transaction(
 void fdb_tpu_transaction_destroy(FDBTpuTransaction* tr);
 void fdb_tpu_transaction_reset(FDBTpuTransaction* tr);
 
+/* Named options: "access_system_keys" (admits stored \xff\x02 writes +
+ * \xff reads), "read_system_keys" (reads only). Unknown names return
+ * invalid_option_value (2006). Options reset with the transaction. */
+fdb_tpu_error_t fdb_tpu_transaction_set_option(FDBTpuTransaction* tr,
+                                               const char* option);
+
 fdb_tpu_error_t fdb_tpu_transaction_get_read_version(FDBTpuTransaction* tr,
                                                      int64_t* out_version);
 

@@ -61,6 +61,9 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
     lib.fdb_tpu_transaction_destroy.argtypes = [ctypes.c_void_p]
     lib.fdb_tpu_transaction_reset.argtypes = [ctypes.c_void_p]
+    lib.fdb_tpu_transaction_set_option.restype = ctypes.c_int
+    lib.fdb_tpu_transaction_set_option.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p]
     lib.fdb_tpu_transaction_get_read_version.restype = ctypes.c_int
     lib.fdb_tpu_transaction_get_read_version.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
@@ -189,6 +192,10 @@ class CTransaction:
 
     def reset(self) -> None:
         self.lib.fdb_tpu_transaction_reset(self._h)
+
+    def set_option(self, option: str) -> None:
+        _check(self.lib, self.lib.fdb_tpu_transaction_set_option(
+            self._h, option.encode()))
 
     def get_read_version(self) -> int:
         out = ctypes.c_int64()

@@ -260,6 +260,10 @@ class Transaction:
         from ..server.types import PRIORITY_BATCH, PRIORITY_IMMEDIATE
         if option == "access_system_keys":
             self._access_system = True
+            self._read_system = True
+        elif option == "read_system_keys":
+            # read-only admission to \xff (ref: READ_SYSTEM_KEYS)
+            self._read_system = True
         elif option in ("timeout", "retry_limit"):
             try:
                 value = float(value) if option == "timeout" else int(value)
@@ -315,6 +319,7 @@ class Transaction:
 
     def reset(self) -> None:
         self._access_system = False   # options reset with the txn
+        self._read_system = False
         self._grv_priority = None     # ...including the priority class
         # timeout/retry OPTIONS survive an explicit reset, but their
         # spent budgets re-arm — a reused object starts a fresh logical
@@ -479,9 +484,13 @@ class Transaction:
             StorageGetRequest(key, version), self.db.process))
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
-        if key.startswith(SYSTEM_PREFIX) and \
-                not key.startswith(STORED_SYSTEM_PREFIX):
-            return await self._system_get(key)
+        if key.startswith(SYSTEM_PREFIX):
+            # \xff reads need READ/ACCESS_SYSTEM_KEYS (ref: NativeAPI
+            # validateKey — key_outside_legal_range without the option)
+            if not getattr(self, "_read_system", False):
+                raise error("key_outside_legal_range")
+            if not key.startswith(STORED_SYSTEM_PREFIX):
+                return await self._system_get(key)
         if not snapshot:
             self._read_conflicts.append((key, _next_key(key)))
         val = await self._base_get(key)
@@ -496,6 +505,9 @@ class Transaction:
         """Resolve a key selector, walking across shard boundaries when
         the offset leaves the anchor shard (ref: Transaction::getKey /
         NativeAPI getKey readThrough iteration)."""
+        if selector.key.startswith(SYSTEM_PREFIX) and \
+                not getattr(self, "_read_system", False):
+            raise error("key_outside_legal_range")
         version = await self.get_read_version()
         info = await self._get_info()
         storages = info.storages
@@ -523,6 +535,12 @@ class Transaction:
                 i += 1
                 # the leftover-th present key right of the boundary
                 sel = KeySelector(storages[i].begin, False, leftover)
+        # without READ_SYSTEM_KEYS a selector walking off the end of user
+        # space clamps to maxKey instead of leaking stored \xff rows
+        # (ref: getKey clamps at allKeys.end)
+        if resolved > SYSTEM_PREFIX and \
+                not getattr(self, "_read_system", False):
+            resolved = SYSTEM_PREFIX
         if not snapshot:
             lo = min(resolved, selector.key)
             hi = max(resolved, selector.key)
@@ -538,6 +556,23 @@ class Transaction:
             end = await self.get_key(end, snapshot=snapshot)
         if begin >= end:
             return []
+        if not getattr(self, "_read_system", False):
+            # [begin, end) must stay inside user space (ref: NativeAPI
+            # validateKeyRange — key_outside_legal_range beyond \xff
+            # without READ/ACCESS_SYSTEM_KEYS)
+            if begin.startswith(SYSTEM_PREFIX) or end > SYSTEM_PREFIX:
+                raise error("key_outside_legal_range")
+        elif end > ENGINE_PREFIX:
+            raise error("key_outside_legal_range")
+        elif not begin.startswith(SYSTEM_PREFIX) and end > SYSTEM_PREFIX:
+            # a scan crossing from user space into \xff must see the
+            # SAME system rows an \xff-anchored scan serves (materialized
+            # + stored) — split at the boundary and merge
+            rows = await self.get_range(begin, SYSTEM_PREFIX,
+                                        snapshot=snapshot)
+            rows += await self.get_range(SYSTEM_PREFIX, end,
+                                         snapshot=snapshot)
+            return sorted(rows, reverse=reverse)[:limit]
         if begin.startswith(SYSTEM_PREFIX) and \
                 not begin.startswith(STORED_SYSTEM_PREFIX):
             rows = [(k, v) for k, v in await self._system_rows()
@@ -740,6 +775,14 @@ class Transaction:
         """Future that fires when the key's value changes after this
         transaction commits (ref: Transaction::watch / storage watches).
         Errors with transaction_cancelled if the commit fails."""
+        # same gate as reads: only the stored \xff\x02 subspace is
+        # watchable, and only with the system-keys option (the
+        # materialized \xff ranges have no storage to watch)
+        if key.startswith(SYSTEM_PREFIX) and not (
+                getattr(self, "_read_system", False)
+                and key.startswith(STORED_SYSTEM_PREFIX)
+                and not key.startswith(ENGINE_PREFIX)):
+            raise error("key_outside_legal_range")
         f = Future()
         self._watches.append((key, f))
         return f

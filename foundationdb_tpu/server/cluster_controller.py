@@ -457,6 +457,7 @@ class ClusterController:
             try:
                 probe_key = b"\xff\x02/status/latency_probe"
                 tr = db.create_transaction()
+                tr.set_option("read_system_keys")
                 t0 = flow.now()
                 await tr.get_read_version()
                 grv_s = flow.now() - t0

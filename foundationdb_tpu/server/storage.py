@@ -404,7 +404,7 @@ class StorageServer:
         self.ranges = RequestStream(process)
         self.get_keys = RequestStream(process)
         self.watches = RequestStream(process)
-        # key -> list of (value_at_registration, reply)
+        # key -> list of (value_at_registration, reply, deadline)
         self._watch_map: Dict[bytes, list] = {}
         # (ref: StorageServer::counters — query/mutation accounting)
         self.stats = flow.CounterCollection("storage")
@@ -423,8 +423,19 @@ class StorageServer:
         instead of timing out (ref: storage server removal — endpoint
         death IS the signal the location cache invalidates on)."""
         self._actors.cancel_all()
+        # parked watch waiters would otherwise hang forever once the
+        # expiry actor dies with the role — fail them like set_bounds does
+        # so their clients refresh the location map
+        self._fail_watches(lambda k: True)
         for stream in (self.gets, self.ranges, self.get_keys, self.watches):
             stream.close()
+
+    def _fail_watches(self, pred) -> None:
+        """Fail every parked watch whose key matches `pred` with
+        wrong_shard_server so its client refreshes the location map."""
+        for k in [k for k in self._watch_map if pred(k)]:
+            for _expected, reply, _deadline in self._watch_map.pop(k):
+                reply.send_error(error("wrong_shard_server"))
 
     async def _run(self) -> None:
         await self._recover()
@@ -766,10 +777,8 @@ class StorageServer:
             self._merge_pending([(v, m) for m in clears])
         # watches on vacated keys will never fire here again: fail them
         # so their clients refresh the location map (code review r3)
-        for k in [k for k in self._watch_map
-                  if k < begin or (end is not None and k >= end)]:
-            for _expected, reply, _deadline in self._watch_map.pop(k):
-                reply.send_error(error("wrong_shard_server"))
+        self._fail_watches(
+            lambda k: k < begin or (end is not None and k >= end))
         self.shard_begin, self.shard_end = begin, end
         self._persist_meta()
         if self.kv is not None:

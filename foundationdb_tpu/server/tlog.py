@@ -47,6 +47,7 @@ class TLog:
                  name: str = "tlog", fsync_delay: float = 0.0005,
                  recovery_version: int = 0):
         self.process = process
+        self.name = name
         self.fsync_delay = fsync_delay
         self._dq = (DiskQueue(disk, name, owner=process)
                     if disk is not None else None)
@@ -287,6 +288,23 @@ class TLog:
         lo = bisect_left(self._versions, req.begin_version)
         durable = self.version.get()
         hi = bisect_right(self._versions, durable)
+        # peeking at/below the tag's freed floor means pin bookkeeping
+        # let records this reader still needs be discarded — scream and
+        # stall the reader at the hole instead of silently losing data
+        # (ref: the TLog's popped-version check in tLogPeekMessages)
+        popped_floor = self._tag_popped(req.tag)
+        if popped_floor >= req.begin_version:
+            flow.TraceEvent("TLogPeekBelowPopped", self.name,
+                            severity=flow.SevError).detail(
+                Tag=req.tag, Begin=req.begin_version,
+                Popped=popped_floor).log()
+            # throttle: the reader will re-peek the same version forever
+            # (no progress is possible); don't let that become a hot
+            # RPC loop that floods the scheduler and the trace file
+            await flow.delay(1.0, TaskPriority.LOW_PRIORITY)
+            reply.send(TLogPeekReply((), req.begin_version - 1,
+                                     self.known_committed))
+            return
         out = []
         # snapshot: spilled reads await the disk, and a concurrent pop
         # may shift the live lists under us. The tag index answers
@@ -309,7 +327,18 @@ class TLog:
             if tagged is None:
                 payload = await self._dq.read(s)
                 if payload is None:
-                    continue   # popped while we read — reader is stale
+                    # popped while we read: records this reader still
+                    # needs were freed mid-peek. Scream, and clamp the
+                    # watermark below v UNFLOORED so the reader cannot
+                    # advance past the hole even when v == begin (the
+                    # byte-limit floor would swallow exactly that case).
+                    flow.TraceEvent("TLogPeekRecordFreed", self.name,
+                                    severity=flow.SevError).detail(
+                        Tag=req.tag, Version=v).log()
+                    await flow.delay(1.0, TaskPriority.LOW_PRIORITY)
+                    reply.send(TLogPeekReply(
+                        tuple(out), max(0, v - 1), self.known_committed))
+                    return
                 _v, tagged = decode_log_entry(payload)
             ms = tuple(tm.mutation for tm in tagged if req.tag in tm.tags)
             if ms:

@@ -171,6 +171,34 @@ def test_c_client_end_to_end():
             assert lib.fdb_tpu_error_retryable(1020) == 1
             assert lib.fdb_tpu_error_retryable(2000) == 0
 
+            # system-keyspace gate parity with the Python client: \xff
+            # reads/writes need the option; scans clamp at user space
+            t5 = db.create_transaction()
+            for op in (lambda: t5.get(b"\xff/x"),
+                       lambda: t5.get_range(b"", b"\xff\xf0"),
+                       lambda: t5.set(b"\xff\x02/own", b"x"),
+                       lambda: t5.atomic_op(
+                           b"\xff/x", (1).to_bytes(8, "little"), 2)):
+                with pytest.raises(CClientError) as ei:
+                    op()
+                assert ei.value.code == 2004, ei.value
+            # selectors walking off the end clamp to \xff, not \xff\x02
+            assert t5.get_key(b"\xfe", False, 9) == b"\xff"
+            with pytest.raises(CClientError) as ei:
+                t5.set_option("bogus_option")
+            assert ei.value.code == 2006
+            t5.set_option("access_system_keys")
+            t5.set(b"\xff\x02/own", b"x")       # stored subspace: allowed
+            t5.commit()
+            t5.reset()                           # options reset
+            with pytest.raises(CClientError):
+                t5.get(b"\xff\x02/own")
+            t5.set_option("read_system_keys")
+            assert t5.get(b"\xff\x02/own") == b"x"
+            with pytest.raises(CClientError):
+                t5.set(b"\xff\x02/own", b"y")    # read option: no writes
+            t5.destroy()
+
             tr.destroy()
         finally:
             db.close()

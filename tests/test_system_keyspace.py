@@ -24,6 +24,14 @@ def test_system_keyspace_reads_and_write_protection():
             await run_transaction(db, seed)
 
             tr = db.create_transaction()
+            # \xff reads are gated (ref: key_outside_legal_range
+            # without READ/ACCESS_SYSTEM_KEYS)
+            with pytest.raises(flow.FdbError) as ei:
+                await tr.get(b"\xff/keyServers/user")
+            assert ei.value.name == "key_outside_legal_range"
+            with pytest.raises(flow.FdbError):
+                await tr.get_range(b"\xff/conf/", b"\xff/conf0")
+            tr.set_option("read_system_keys")
             # keyServers: one row per shard, value = the replica team
             rows = await tr.get_range(b"\xff/keyServers/",
                                       b"\xff/keyServers0")
@@ -67,6 +75,14 @@ def test_system_keyspace_reads_and_write_protection():
             # the user-space scan convention b"" .. b"\xff" is untouched
             user = await tr.get_range(b"", b"\xff")
             assert user == [(b"user", b"row")]
+            # with the option, a scan crossing the \xff boundary sees
+            # the same materialized rows an \xff-anchored scan serves
+            crossing = await tr.get_range(b"", b"\xff/keyServers0")
+            assert (b"user", b"row") in crossing
+            anchored = await tr.get_range(b"\xff/keyServers/",
+                                          b"\xff/keyServers0")
+            for row in anchored:
+                assert row in crossing
             return True
 
         assert c.run(main(), timeout_time=120)
@@ -130,9 +146,21 @@ def test_access_system_keys_option_and_stored_subspace():
             await tr.commit()
 
             tr2 = db.create_transaction()
+            tr2.set_option("read_system_keys")
             assert await tr2.get(b"\xff\x02/own") == b"x"  # stored read
             rows = await tr2.get_range(b"", b"\xff")
             assert rows == [(b"user", b"1")]        # user scan is clean
+            # a plain scan whose end crosses \xff is rejected outright
+            # (ref: validateKeyRange — no silent leak of stored rows
+            # through the last shard's open end)
+            tr3 = db.create_transaction()
+            with pytest.raises(flow.FdbError) as ei:
+                await tr3.get_range(b"", b"\xff\xf0")
+            assert ei.value.name == "key_outside_legal_range"
+            # selectors can't walk into stored system space either
+            from foundationdb_tpu.server.types import KeySelector
+            k = await tr3.get_key(KeySelector(b"zzz", False, 5))
+            assert k == b"\xff"
             # option state resets with the transaction
             tr2.reset()
             with pytest.raises(flow.FdbError):
