@@ -324,11 +324,16 @@ def bench_cpu(backend, n_txns, n_batches, keyspace):
                                             ((kb, kb + b"\x00"),)))
         return txns
 
+    # batch construction stays OUTSIDE the timed region (the streamed
+    # device path pre-encodes its batches too) so the baseline measures
+    # resolution, not Python object churn
+    prebuilt = [(version + i * VERSION_STEP,
+                 obj_batch(version + i * VERSION_STEP))
+                for i in range(n_batches)]
     n_conflicts = 0
     t0 = time.perf_counter()
-    for i in range(n_batches):
-        v = version + i * VERSION_STEP
-        verdicts = cs.resolve(obj_batch(v), v, max(0, v - MWTLV))
+    for v, txns in prebuilt:
+        verdicts = cs.resolve(txns, v, max(0, v - MWTLV))
         n_conflicts += sum(1 for x in verdicts if x == 0)
     return n_batches * n_txns / (time.perf_counter() - t0), n_conflicts
 
@@ -350,7 +355,45 @@ def _probe_device(timeout_s: float = 120.0) -> bool:
     the timeout. The axon TPU tunnel can hang indefinitely inside
     backend init (device listing still works!) — without this probe a
     dead tunnel turns the bench into an unbounded hang instead of an
-    honest error record."""
+    honest error record. The probe runs in a SUBPROCESS: a hung
+    attempt inside this process would hold jax's init lock forever and
+    make every retry block on the lock instead of re-trying the
+    tunnel."""
+    import subprocess
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((8, 8), jnp.float32); "
+            "(x @ x).block_until_ready(); print('probe-ok')")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # probe the accelerator path
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=timeout_s, capture_output=True, env=env)
+        return r.returncode == 0 and b"probe-ok" in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _probe_with_retries() -> bool:
+    """Bounded retry (~10 min worst case by default): one transient
+    tunnel hiccup must not zero a whole round's perf evidence
+    (round-3 VERDICT: the watchdog fired once and the round recorded
+    an error instead of a number)."""
+    attempts = int(os.environ.get("FDBTPU_BENCH_PROBE_RETRIES", 3))
+    timeout_s = float(os.environ.get("FDBTPU_BENCH_PROBE_TIMEOUT", 120.0))
+    sleep_s = float(os.environ.get("FDBTPU_BENCH_PROBE_SLEEP", 120.0))
+    for i in range(attempts):
+        if _probe_device(timeout_s):
+            return True
+        if i + 1 < attempts:
+            time.sleep(sleep_s)
+    return False
+
+
+def _init_device_guarded(timeout_s: float = 240.0) -> bool:
+    """Initialize THIS process's jax backend under a watchdog. The
+    subprocess probe only proves the tunnel was alive a moment ago; if
+    it dies between probe and first real jax call, this is the line
+    that would otherwise hang unboundedly."""
     import threading
 
     ok = []
@@ -375,38 +418,70 @@ def main():
     backend_env = os.environ.get("FDBTPU_BENCH_BACKEND", "all")
     needs_device = backend_env in ("all", "tpu", "tpu-point",
                                    "tpu-streamed", "tpu-streamed-interval")
+    n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 16384))
+    n_batches = int(os.environ.get("FDBTPU_BENCH_BATCHES", 100))
+    keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 4_000_000))
+    backend = backend_env
+
+    def cpu_sub_metrics():
+        # the reference's skiplisttest self-comparison (SkipList.cpp:
+        # 1412-1551) measures the CPU conflict set on the same host —
+        # record the native C++ and pure-Python backends next to the
+        # device numbers so "beats the CPU baseline by Nx" is measured,
+        # not asserted (round-3 VERDICT weak item 2)
+        out = {}
+        # batch counts are capped: the prebuilt object batches (kept out
+        # of the timed region for honesty) are ~16k Python txn objects
+        # per batch — uncapped at 100 batches that is multi-GB RSS
+        for name, nb in (("native", min(n_batches, 25)),
+                         ("python", min(n_batches, 10))):
+            try:
+                tps, nc = bench_cpu(name, n_txns, nb, keyspace)
+            except Exception as e:       # e.g. .so missing on this host
+                out[name] = {"error": str(e)}
+                continue
+            out[name] = {"txn_per_s": round(tps, 1),
+                         "vs_baseline": round(tps / TARGET_TXN_PER_S, 4),
+                         "batches": nb, "conflicts": nc}
+        return out
+
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # env-only JAX_PLATFORMS=cpu wedges device init when the axon
         # TPU plugin was registered at interpreter start; the explicit
         # config update (what tests/conftest.py does) actually sticks
         import jax
         jax.config.update("jax_platforms", "cpu")
-    elif needs_device and not _probe_device():
+    elif needs_device and not (_probe_with_retries()
+                               and _init_device_guarded()):
+        # the device is gone (or died between the subprocess probe and
+        # this process's own backend init), but the round's perf
+        # evidence need not be an empty record: measure the CPU
+        # baselines (jax-free imports)
         print(json.dumps({
             "metric": "resolver_throughput", "value": 0, "unit": "txn/s",
             "vs_baseline": 0.0,
             "error": "accelerator unreachable: device init hung past the "
-                     "probe timeout (axon tunnel down); prior recorded "
-                     "result is BENCH_r02.json (tpu-point 2.56x)",
+                     "probe timeout on every retry (axon tunnel down); "
+                     "prior recorded TPU result is BENCH_r02.json "
+                     "(tpu-point 2.56x)",
+            "sub_metrics": cpu_sub_metrics(),
         }))
-        sys.stdout.flush()   # piped stdout is block-buffered; the hung
-        os._exit(2)          # jax thread rules out a clean sys.exit
-    n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 16384))
-    n_batches = int(os.environ.get("FDBTPU_BENCH_BATCHES", 100))
-    keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 4_000_000))
-    backend = backend_env
+        sys.stdout.flush()   # piped stdout is block-buffered; the
+        os._exit(2)          # possibly-hung jax thread rules out sys.exit
 
     sub = {}
     if backend == "all":
         # the honest triple (round-2 VERDICT task 5): peak device-driven
         # point + interval kernels, and the host-streamed pipeline —
-        # all with 16-byte keys. The STREAMED number is the headline:
-        # it is what a resolver role actually pays per batch.
+        # all with 16-byte keys — plus the CPU baselines on the same
+        # host. The STREAMED number is the headline: it is what a
+        # resolver role actually pays per batch.
         for name in ("tpu-point", "tpu", "tpu-streamed"):
             tps, nc = _run_backend(name, n_txns, n_batches, keyspace)
             sub[name] = {"txn_per_s": round(tps, 1),
                          "vs_baseline": round(tps / TARGET_TXN_PER_S, 4),
                          "conflicts": nc}
+        sub.update(cpu_sub_metrics())
         txn_per_s = sub["tpu-streamed"]["txn_per_s"]
         n_conflicts = sub["tpu-streamed"]["conflicts"]
         backend_name = "tpu-streamed"

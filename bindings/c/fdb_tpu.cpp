@@ -1024,7 +1024,10 @@ fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
     /* cross-shard selector walk (client/transaction.py get_key; ref:
      * NativeAPI getKey readThrough iteration) */
     std::string anchor((const char*)key, key_length);
-    if (in_system(anchor) && !tr->read_system) return 2004;
+    /* anchor == "\xff" (allKeys.end) stays legal: the canonical
+     * last-key idiom, same exclusive-end convention as get_range */
+    if (in_system(anchor) && anchor != kSystemBegin && !tr->read_system)
+        return 2004;
     int64_t version;
     fdb_tpu_error_t err = tr->grv(&version);
     if (err) return err;

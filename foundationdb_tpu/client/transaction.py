@@ -505,7 +505,11 @@ class Transaction:
         """Resolve a key selector, walking across shard boundaries when
         the offset leaves the anchor shard (ref: Transaction::getKey /
         NativeAPI getKey readThrough iteration)."""
+        # anchor == b"\xff" (allKeys.end) stays legal without the option
+        # — last_less_than(\xff) is the canonical "last key" idiom, the
+        # same exclusive-end convention the range gate honors
         if selector.key.startswith(SYSTEM_PREFIX) and \
+                selector.key != SYSTEM_PREFIX and \
                 not getattr(self, "_read_system", False):
             raise error("key_outside_legal_range")
         version = await self.get_read_version()
@@ -568,21 +572,23 @@ class Transaction:
             # a scan crossing from user space into \xff must see the
             # SAME system rows an \xff-anchored scan serves (materialized
             # + stored) — split at the boundary and merge
-            rows = await self.get_range(begin, SYSTEM_PREFIX,
-                                        snapshot=snapshot)
-            rows += await self.get_range(SYSTEM_PREFIX, end,
-                                         snapshot=snapshot)
+            rows = await self.get_range(begin, SYSTEM_PREFIX, limit=limit,
+                                        snapshot=snapshot, reverse=reverse)
+            rows += await self.get_range(SYSTEM_PREFIX, end, limit=limit,
+                                         snapshot=snapshot, reverse=reverse)
             return sorted(rows, reverse=reverse)[:limit]
         if begin.startswith(SYSTEM_PREFIX) and \
                 not begin.startswith(STORED_SYSTEM_PREFIX):
             rows = [(k, v) for k, v in await self._system_rows()
                     if begin <= k < end]
-            if end > STORED_SYSTEM_PREFIX:
+            if end > STORED_SYSTEM_PREFIX and begin < b"\xff\x03":
                 # the range crosses into the STORED system subspace:
                 # point reads serve those rows, so range scans must too
+                # — clamped to [begin, end) so a scan anchored above
+                # \xff\x02 doesn't return rows below its begin
                 rows += await self.get_range(
-                    STORED_SYSTEM_PREFIX, min(end, ENGINE_PREFIX),
-                    snapshot=snapshot)
+                    max(begin, STORED_SYSTEM_PREFIX),
+                    min(end, ENGINE_PREFIX), snapshot=snapshot)
             return sorted(rows, reverse=reverse)[:limit]
         version = await self.get_read_version()
         # With no RYW overlay in the range the storage servers honor the

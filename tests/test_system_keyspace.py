@@ -161,6 +161,16 @@ def test_access_system_keys_option_and_stored_subspace():
             from foundationdb_tpu.server.types import KeySelector
             k = await tr3.get_key(KeySelector(b"zzz", False, 5))
             assert k == b"\xff"
+            # ...but the canonical last_less_than(\xff) "last key"
+            # idiom stays legal without any option
+            k = await tr3.get_key(KeySelector(b"\xff", False, 0))
+            assert k == b"user"
+            # a stored-subspace scan anchored ABOVE \xff\x02 must not
+            # return rows below its begin
+            tr4 = db.create_transaction()
+            tr4.set_option("read_system_keys")
+            rows = await tr4.get_range(b"\xff\x03", b"\xff\x10")
+            assert all(k >= b"\xff\x03" for k, _v in rows), rows
             # option state resets with the transaction
             tr2.reset()
             with pytest.raises(flow.FdbError):
