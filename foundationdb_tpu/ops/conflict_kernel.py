@@ -57,7 +57,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .keys import next_pow2, searchsorted_rows
+from .keys import next_pow2, searchsorted_rows, searchsorted_rows_mixed
 from .rmq import VDEAD, build_range_max_table, range_max
 
 SNAP_CLAMP = (1 << 30) + 1  # above any storable version offset
@@ -86,8 +86,14 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
     bit-identical to the single-shard one.
     """
     assert all(x & (x - 1) == 0 for x in (cap, n_txns, n_reads, n_writes))
-    mb = next_pow2(2 * n_reads + 2 * n_writes + 1)  # batch-rank table size
+    # batch-rank table: the union {rb, wb, we} order-embeds every compare
+    # the overlap test needs (re is EXCLUDED — see the proof at its use)
+    mb = next_pow2(n_reads + 2 * n_writes + 1)
     width = n_words + 1
+    # overlap-matrix bit-packing: 32 write slots per uint32 lane — the
+    # fixpoint rounds then move 32x fewer bytes than a bool matrix
+    pack_w = min(32, n_writes)
+    n_lanes = n_writes // pack_w
 
     def _all_shards(flags):
         if axis_name is None:
@@ -100,8 +106,13 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         inf_row = jnp.full((width,), 0xFFFFFFFF, jnp.uint32)
 
         # ---- 1. external check against history --------------------------
-        lo = searchsorted_rows(hk, rb, side="right") - 1
-        hi = searchsorted_rows(hk, re, side="left")
+        # one fused binary search for both bounds (per-query side)
+        ext_q = jnp.concatenate([rb, re], axis=0)
+        ext_side = jnp.concatenate([
+            jnp.ones((rb.shape[0],), bool), jnp.zeros((re.shape[0],), bool)])
+        ext_pos = searchsorted_rows_mixed(hk, ext_q, ext_side)
+        lo = ext_pos[:rb.shape[0]] - 1
+        hi = ext_pos[rb.shape[0]:]
         vmax = range_max(build_range_max_table(hv), lo, hi)
         snap_pad = jnp.concatenate([snap, jnp.full((1,), SNAP_CLAMP, jnp.int32)])
         ext_r = rvalid & (vmax > snap_pad[rtxn])
@@ -110,27 +121,47 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         ext = _all_shards(ext)
 
         # ---- 2. intra-batch fixpoint ------------------------------------
-        endpoints = jnp.concatenate([rb, re, wb, we], axis=0)
-        ep_valid = jnp.concatenate([rvalid, rvalid, wvalid, wvalid])
+        # Rank space: searchsorted(A, x, left) is an order embedding that
+        # is STRICT between x and y exactly when A has an element in
+        # [min(x,y), max(x,y)). The overlap test needs only
+        #   w_lo < r_hi  (<=> wb < re: wb itself is in A)
+        #   r_lo < w_hi  (<=> rb < we: rb itself is in A)
+        # so A = {rb, wb, we} suffices — re ranks against A but need not
+        # be in it, cutting the sort input by n_reads rows.
+        endpoints = jnp.concatenate([rb, wb, we], axis=0)
+        ep_valid = jnp.concatenate([rvalid, wvalid, wvalid])
         endpoints = jnp.where(ep_valid[:, None], endpoints, inf_row[None, :])
         pad = jnp.broadcast_to(inf_row, (mb - endpoints.shape[0], width))
         cols = tuple(jnp.concatenate([endpoints, pad], axis=0)[:, w]
                      for w in range(width))
         ranked = jnp.stack(lax.sort(cols, num_keys=width), axis=1)
 
-        r_lo = searchsorted_rows(ranked, rb)
-        r_hi = searchsorted_rows(ranked, re)
-        w_lo = searchsorted_rows(ranked, wb)
-        w_hi = searchsorted_rows(ranked, we)
+        rank_q = jnp.concatenate([rb, re, wb, we], axis=0)
+        rank_pos = searchsorted_rows(ranked, rank_q)  # all side=left
+        r_lo = rank_pos[:n_reads]
+        r_hi = rank_pos[n_reads:2 * n_reads]
+        w_lo = rank_pos[2 * n_reads:2 * n_reads + n_writes]
+        w_hi = rank_pos[2 * n_reads + n_writes:]
         ov = ((w_lo[None, :] < r_hi[:, None]) & (r_lo[:, None] < w_hi[None, :])
               & rvalid[:, None] & wvalid[None, :]
               & (wtxn[None, :] < rtxn[:, None]))  # [n_reads, n_writes]
+        # pack write columns into uint32 lanes: the compare->shift->sum
+        # chain fuses, so the full bool matrix never hits HBM and each
+        # fixpoint round streams n_writes/32 words per read row
+        bits = jnp.left_shift(jnp.uint32(1),
+                              jnp.arange(pack_w, dtype=jnp.uint32))
+        ovp = jnp.sum(ov.reshape(n_reads, n_lanes, pack_w)
+                      .astype(jnp.uint32) * bits[None, None, :],
+                      axis=2, dtype=jnp.uint32)       # [n_reads, n_lanes]
 
         base_c = jnp.concatenate([ext | too_old, jnp.ones((1,), bool)])
 
         def s_map(c):
             alive_w = ~jnp.take(c, wtxn)
-            hit_r = jnp.any(ov & alive_w[None, :], axis=1)
+            alive_p = jnp.sum(alive_w.reshape(n_lanes, pack_w)
+                              .astype(jnp.uint32) * bits[None, :],
+                              axis=1, dtype=jnp.uint32)
+            hit_r = jnp.any((ovp & alive_p[None, :]) != 0, axis=1)
             hit = (jnp.zeros(n + 1, jnp.int32)
                    .at[rtxn].max(hit_r.astype(jnp.int32)) > 0)
             hit = _all_shards(hit)
@@ -154,10 +185,15 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         ins = jnp.concatenate([wb, we], axis=0)
         ins_valid = jnp.concatenate([surv, surv])
         ins = jnp.where(ins_valid[:, None], ins, inf_row[None, :])
-        cover = jnp.take(hv, searchsorted_rows(hk, ins, side="right") - 1)
+        # one pre-sort search serves both the covering version AND the
+        # merge rank: both are pure functions of the key value, so they
+        # ride the sort as carried columns (equal keys carry equal
+        # values — any permutation among ties is safe)
+        ins_pos = searchsorted_rows(hk, ins, side="right")
+        cover = jnp.take(hv, ins_pos - 1)
         cover = jnp.where(ins_valid, cover, jnp.int32(VDEAD))
         sorted_ops = lax.sort(
-            tuple(ins[:, w] for w in range(width)) + (cover,),
+            tuple(ins[:, w] for w in range(width)) + (cover, ins_pos),
             num_keys=width)
         ins_sorted = jnp.stack(sorted_ops[:width], axis=1)
         ins_cover = sorted_ops[width]
@@ -168,7 +204,7 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         # elementwise instead of cap binary searches.
         mi = ins_sorted.shape[0]
         ins_live = ins_sorted[:, -1] != jnp.uint32(0xFFFFFFFF)
-        ins_ub = searchsorted_rows(hk, ins_sorted, side="right")  # hist<=ins
+        ins_ub = sorted_ops[width + 1]                       # hist<=ins
         u = jnp.where(ins_live, ins_ub, jnp.int32(cap))
         shifts = jnp.cumsum(jnp.zeros(cap, jnp.int32).at[u].add(
             1, mode="drop", indices_are_sorted=True))
@@ -184,8 +220,10 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         merged_v = merged_v.at[pos_i].set(ins_cover, **sorted_unique)
 
         # coverage: +1 at each surviving write begin, -1 at its end
-        o_lo = searchsorted_rows(merged_k, wb, side="left")
-        o_hi = searchsorted_rows(merged_k, we, side="left")
+        o_pos = searchsorted_rows(
+            merged_k, jnp.concatenate([wb, we], axis=0), side="left")
+        o_lo = o_pos[:n_writes]
+        o_hi = o_pos[n_writes:]
         s32 = surv.astype(jnp.int32)
         delta = (jnp.zeros(cap + 1, jnp.int32)
                  .at[o_lo].add(s32).at[o_hi].add(-s32))

@@ -98,6 +98,29 @@ def searchsorted_rows(table: jax.Array, queries: jax.Array,
     return lax.fori_loop(0, logn, body, pos0)
 
 
+def searchsorted_rows_mixed(table: jax.Array, queries: jax.Array,
+                            right_mask: jax.Array) -> jax.Array:
+    """searchsorted_rows with a PER-QUERY side: right where right_mask,
+    left elsewhere. Lets callers fuse every search against one table
+    into a single binary-search loop — the sequential per-level gathers
+    dominate search latency on TPU, so batching queries across call
+    sites divides that latency by the number of sites merged."""
+    cap = table.shape[0]
+    assert cap & (cap - 1) == 0, "table length must be a power of two"
+    logn = cap.bit_length() - 1
+    pos0 = jnp.zeros(queries.shape[0], jnp.int32)
+
+    def body(i, pos):
+        step = jnp.int32(cap) >> (i + 1)
+        probe = jnp.take(table, pos + step - 1, axis=0)
+        lt = lt_rows(probe, queries)          # probe <  q
+        le = ~lt_rows(queries, probe)         # probe <= q
+        go = jnp.where(right_mask, le, lt)
+        return pos + step * go.astype(jnp.int32)
+
+    return lax.fori_loop(0, logn, body, pos0)
+
+
 def searchsorted_i32(table: jax.Array, queries: jax.Array,
                      side: str = "left") -> jax.Array:
     """Branchless binary search over a sorted int32 array.
