@@ -50,7 +50,6 @@ class ProxyRefs(NamedTuple):
     grvs: object
     commits: object
     raw_committed: object = None   # getRawCommittedVersion (peer GRV)
-    resolver_map: object = None    # keyResolvers move endpoint
 
 
 class StorageRefs(NamedTuple):

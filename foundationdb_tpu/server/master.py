@@ -34,6 +34,12 @@ MAX_VERSION_ADVANCE = 5_000_000          # cap per request (ref: :918)
 class GetCommitVersionReply(NamedTuple):
     prev_version: int
     version: int
+    # keyResolvers moves this proxy has not yet applied, each
+    # (effective_version, begin, end_or_None, to_idx) — moves ride the
+    # version chain, so every proxy applies a move at the SAME version
+    # (ref: the reference versioning keyResolvers through the commit
+    # stream, MasterProxyServer.actor.cpp:204 + ApplyMetadataMutation)
+    moves: tuple = ()
 
 
 class CoreState(NamedTuple):
@@ -55,6 +61,9 @@ class Master:
         self.process = process
         self.version = recovery_version
         self._last_time = None
+        # keyResolvers move log for this epoch: every version reply
+        # piggybacks the tail a proxy has not seen yet
+        self.resolver_moves: list = []
         self.version_requests = RequestStream(process)
         self._actors = flow.ActorCollection()
 
@@ -79,10 +88,28 @@ class Master:
         self.version = prev + advance
         return GetCommitVersionReply(prev, self.version)
 
+    def register_move(self, begin: bytes, end, to_idx: int) -> int:
+        """Stamp a keyResolvers move with the version chain: effective
+        from the NEXT version this authority hands out, so every batch
+        either wholly precedes or wholly follows the move on every
+        proxy — no cross-proxy apply skew by construction."""
+        effective = self.version + 1
+        self.resolver_moves.append((effective, begin, end, to_idx))
+        return effective
+
     async def _version_loop(self):
         while True:
-            _req, reply = await self.version_requests.pop()
-            reply.send(self._next_version())
+            req, reply = await self.version_requests.pop()
+            # the request IS the caller's applied-move count; anything
+            # else is protocol misuse and should fail loudly, not
+            # silently re-deliver the whole move log
+            assert isinstance(req, int), req
+            seen = req
+            ver = self._next_version()
+            if len(self.resolver_moves) > seen:
+                ver = ver._replace(
+                    moves=tuple(self.resolver_moves[seen:]))
+            reply.send(ver)
 
 
 class MasterRecovery:
@@ -235,7 +262,7 @@ class MasterRecovery:
                                 name=f"master-e{self.epoch}.oldLogCleanup"))
         if cfg.n_resolvers > 1:
             self.aux.add(flow.spawn(
-                self._resolution_balancing(resolver_metrics, proxies),
+                self._resolution_balancing(resolver_metrics),
                 TaskPriority.RESOLUTION_METRICS,
                 name=f"master-e{self.epoch}.resolutionBalancing"))
         await self.aux.get_result()
@@ -272,7 +299,7 @@ class MasterRecovery:
                         Stores=",".join(s for s, _m in prev.logs))
             await flow.delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
 
-    async def _resolution_balancing(self, metric_refs, proxies) -> None:
+    async def _resolution_balancing(self, metric_refs) -> None:
         """Shift key-range ownership from the most- to the least-loaded
         resolver (ref: resolutionBalancing, masterserver.actor.cpp:1008
         + ResolutionSplitRequest). Per round: poll each resolver's
@@ -280,7 +307,6 @@ class MasterRecovery:
         last round, and — when the spread is material — move the loaded
         resolver's hottest byte bucket, but only when the move reduces
         the maximum (a single-bucket hotspot never bounces)."""
-        from .types import ResolverMoveRequest
         n = len(metric_refs)
         last_work = [0] * n
         last_hist = [[0] * 256 for _ in range(n)]
@@ -309,23 +335,12 @@ class MasterRecovery:
                 continue
             begin = bytes([bucket])
             end = bytes([bucket + 1]) if bucket < 255 else None
+            # the move rides the version chain: every proxy picks it up
+            # with its next assigned batch version and applies it at the
+            # same effective version — no per-proxy delivery, no skew
+            effective = self.master.register_move(begin, end, lo)
             self._trace("ResolutionBalancingMove", Bucket=bucket,
-                        From=hi, To=lo)
-            # every proxy MUST apply the move: a proxy that never
-            # applies would keep routing writes to the old owner only,
-            # re-opening the missed-conflict hole once others prune.
-            # Retry failures; a truly dead proxy ends the epoch anyway.
-            pending = list(proxies)
-            while pending:
-                settled2 = await flow.all_of([flow.catch_errors(
-                    flow.timeout_error(p.resolver_map.get_reply(
-                        ResolverMoveRequest(begin, end, lo),
-                        self.process), 2.0))
-                    for p in pending])
-                pending = [p for p, f in zip(pending, settled2)
-                           if f.is_error]
-                if pending:
-                    await flow.delay(0.2, TaskPriority.RESOLUTION_METRICS)
+                        From=hi, To=lo, EffectiveVersion=effective)
 
     async def _cleanup_old_logs(self) -> None:
         """Drop a drained old generation from the broadcast picture once

@@ -58,15 +58,6 @@ def _apply_versionstamp(m: MutationRef, stamp: bytes) -> MutationRef:
 
 MWTLV = 5_000_000  # fallback window (ref: MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
 
-# Proxies apply a move at their own committed version, so two proxies'
-# apply points can differ by the move's delivery spread; former owners
-# are retained one extra second of versions beyond the window so a
-# write routed by the slowest proxy is still double-delivered when the
-# fastest proxy's clients check against it. (The reference versions
-# keyResolvers updates through the commit stream, eliminating skew
-# structurally — future work.)
-MOVE_SKEW_SLACK = 1_000_000
-
 # every mutation is ALSO routed here while a continuous backup is
 # active (ref: the backup mutation-log tags — a single stream preserves
 # exact intra-version mutation order for point-in-time restore)
@@ -109,11 +100,12 @@ class KeyResolverMap:
                 self.owners[k] = [(at_version, to_idx)] + self.owners[k]
 
     def prune(self, commit_version: int) -> None:
-        """Drop former owners once the window (plus cross-proxy apply
-        skew slack) has passed the move."""
-        horizon = self.window + MOVE_SKEW_SLACK
+        """Drop former owners once one full MVCC window has passed the
+        move. No skew slack is needed: moves are versioned through the
+        commit stream (Master.register_move), so every proxy applies a
+        move at the same effective version."""
         for ow in self.owners:
-            while len(ow) > 1 and ow[-2][0] + horizon < commit_version:
+            while len(ow) > 1 and ow[-2][0] + self.window < commit_version:
                 ow.pop()
 
     def live_owners(self, k: int):
@@ -203,7 +195,9 @@ class Proxy:
         self.commits = RequestStream(process)
         self.grvs = RequestStream(process)
         self.raw_committed = RequestStream(process)
-        self.resolver_map_updates = RequestStream(process)
+        # count of keyResolvers moves already applied; sent with every
+        # version request so the master's reply carries only the tail
+        self._moves_seen = 0
         self._actors = flow.ActorCollection()
 
     def set_peers(self, raw_refs) -> None:
@@ -228,21 +222,7 @@ class Proxy:
             self._actors.add(flow.spawn(self._rate_loop(),
                                         TaskPriority.PROXY_GRV_TIMER,
                                         name=f"{self.process.name}.rate"))
-        self._actors.add(flow.spawn(self._map_update_loop(),
-                                    TaskPriority.PROXY_COMMIT,
-                                    name=f"{self.process.name}.keyResolvers"))
         self.process.on_kill(self._actors.cancel_all)
-
-    async def _map_update_loop(self):
-        """Apply keyResolvers moves from the master's balancing actor;
-        the move takes effect at this proxy's current committed version
-        and former owners stay live for a window (ref: the keyResolvers
-        updates flowing to proxies via resolutionBalancing)."""
-        while True:
-            req, reply = await self.resolver_map_updates.pop()
-            self.key_resolvers.move(req.begin, req.end, req.to_idx,
-                                    self.committed_version.get())
-            reply.send(None)
 
     def stop(self) -> None:
         """Epoch over: stop serving and break queued/future requests so
@@ -252,7 +232,6 @@ class Proxy:
         self.commits.close()
         self.grvs.close()
         self.raw_committed.close()
-        self.resolver_map_updates.close()
         # a stop mid-confirmation must fail the popped batch too, or
         # those clients wait out the full request timeout (code review)
         for entry in self._grv_queue + self._grv_inflight:
@@ -458,7 +437,18 @@ class Proxy:
             # always advances the interlocks so a failed batch can never
             # wedge its successors)
             await self.batch_resolving.when_at_least(local - 1)
-            ver = await self.master_ref.get_reply(None, self.process)
+            ver = await self.master_ref.get_reply(self._moves_seen,
+                                                  self.process)
+            # apply version-stamped keyResolvers moves BEFORE routing:
+            # this batch's version is at/above every carried move's
+            # effective version, and every other proxy applies the same
+            # move before ITS first batch at/above that version — the
+            # apply point is a property of the version chain, not of
+            # per-proxy delivery timing (ref: keyResolvers riding the
+            # commit stream, MasterProxyServer.actor.cpp:204)
+            for eff, mb, me, to_idx in ver.moves:
+                self.key_resolvers.move(mb, me, to_idx, eff)
+            self._moves_seen += len(ver.moves)
 
             # phase 2: conflict resolution — single resolver fast path, or
             # key-range split across resolvers with min-combined verdicts

@@ -191,17 +191,6 @@ class TLogLockRequest(NamedTuple):
     servers can finish pulling the old generation)."""
 
 
-class ResolverMoveRequest(NamedTuple):
-    """Move ownership of [begin, end) to another resolver in the
-    proxies' keyResolvers maps (ref: ResolutionSplitRequest /
-    resolutionBalancing, fdbserver/ResolverInterface.h:121 +
-    masterserver.actor.cpp:1008)."""
-
-    begin: bytes
-    end: Optional[bytes]
-    to_idx: int
-
-
 class ResolutionMetricsReply(NamedTuple):
     """(ref: ResolutionMetricsRequest — cumulative work + key-space
     sample so the master can pick split points)"""

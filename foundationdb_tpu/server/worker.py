@@ -147,8 +147,7 @@ class Worker:
         p.start()
         self.roles[name] = p
         return ProxyRefs(name, p.grvs.ref(), p.commits.ref(),
-                         p.raw_committed.ref(),
-                         p.resolver_map_updates.ref())
+                         p.raw_committed.ref())
 
     def recruit_ratekeeper(self, name: str, cc):
         """(ref: the CC recruiting the ratekeeper singleton)"""
