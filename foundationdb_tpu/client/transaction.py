@@ -133,6 +133,19 @@ class Database:
         await _rpc(self.management_ref.get_reply(
             ExcludeRequest(worker, exclude), self.process))
 
+    async def change_coordinators(self, coordinators) -> None:
+        """Move the coordinated state to a new coordinator set; the
+        old coordinators forward until decommissioned (ref:
+        ManagementAPI changeQuorum / `coordinators` in fdbcli). The
+        change is durable once this returns — the move has a longer
+        quorum path than other management ops, hence the wider bound."""
+        from ..server.cluster_controller import ChangeCoordinatorsRequest
+        if self.management_ref is None:
+            raise error("client_invalid_operation")
+        await flow.timeout_error(self.management_ref.get_reply(
+            ChangeCoordinatorsRequest(tuple(coordinators)), self.process),
+            30.0)
+
     async def info(self):
         if self._info is None:
             self._info = await self.cluster_ref.get_reply(

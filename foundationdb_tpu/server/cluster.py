@@ -103,8 +103,7 @@ class SimCluster:
         # are exercised in the coordination unit tests)
         self.cc = ClusterController(
             self.net.new_process(f"{px}cc", machine=f"{px}cc"),
-            [(c.reads.ref(), c.writes.ref(), c.candidacies.ref())
-             for c in self.coordinators],
+            [self._coord_refs(c) for c in self.coordinators],
             self.config)
         self.cc.start()
 
@@ -126,6 +125,26 @@ class SimCluster:
         self.workers: dict = {}
         for i in range(n_workers):
             self._start_worker(f"{px}worker{i}", f"{px}w{i}")
+
+    @staticmethod
+    def _coord_refs(c: Coordinator) -> tuple:
+        return (c.reads.ref(), c.writes.ref(), c.candidacies.ref(),
+                c.forwards.ref())
+
+    def add_coordinators(self, n: int, tag: str = "new") -> list:
+        """Start n fresh coordinator servers (for a coordinators
+        change); returns their ref 4-tuples (ref: the operator standing
+        up new coordination hosts before `coordinators ...`)."""
+        out = []
+        for i in range(n):
+            name = f"{self.prefix}coord-{tag}{i}"
+            cproc = self.net.new_process(name, machine=name)
+            c = Coordinator(cproc, disk=(self.net.disk(name)
+                                         if self.durable else None))
+            c.start()
+            self.coordinators.append(c)
+            out.append(self._coord_refs(c))
+        return out
 
     # -- worker lifecycle ------------------------------------------------
     def _start_worker(self, name: str, machine: str) -> Worker:

@@ -69,6 +69,15 @@ class ExcludeRequest(NamedTuple):
     exclude: bool = True
 
 
+class ChangeCoordinatorsRequest(NamedTuple):
+    """Move the coordinated state to a new coordinator set (ref:
+    ManagementAPI changeQuorum + MovableCoordinatedState,
+    CoordinatedState.actor.cpp:220). `coordinators` is the new set's
+    ref 4-tuples (reads, writes, candidacies, forwards)."""
+
+    coordinators: tuple
+
+
 class _WorkerInfo(NamedTuple):
     name: str
     machine: str
@@ -107,6 +116,7 @@ class ClusterController:
         self.shard_map: dict = {}          # name -> (tag, begin, end)
         self._recovery: Optional[MasterRecovery] = None
         self._recovery_task = None
+        self._cstate: Optional[CoordinatedState] = None  # set once elected
         self._storage_objs: dict = {}      # name -> StorageServer (registry)
         self._rr = 0                       # recruitment round-robin
         self._seq = 0                      # dbinfo broadcast counter
@@ -133,15 +143,17 @@ class ClusterController:
         self.process.on_kill(self._actors.cancel_all)
 
     async def _run(self) -> None:
-        await elect_leader([c[2] for c in self.coordinators],
-                           b"\xff/clusterLeader", self.process.name,
-                           self.process)
-        cstate = CoordinatedState(
+        # an election against a moved-away quorum follows the forwards
+        # to the live coordinator set
+        self.coordinators = await elect_leader(
+            self.coordinators, b"\xff/clusterLeader", self.process.name,
+            self.process)
+        self._cstate = CoordinatedState(
             [(c[0], c[1]) for c in self.coordinators], self.process)
         while True:
             await self._wait_for_workers()
-            self._recovery = MasterRecovery(self.process, self, cstate,
-                                            self.config)
+            self._recovery = MasterRecovery(self.process, self,
+                                            self._cstate, self.config)
             self._recovery_task = flow.spawn(
                 self._recovery.run(), TaskPriority.CLUSTER_CONTROLLER,
                 name=f"master-recovery-e{self._recovery.epoch}")
@@ -420,8 +432,78 @@ class ClusterController:
                 else:
                     self.excluded.discard(req.worker)
                 reply.send(None)
+            elif isinstance(req, ChangeCoordinatorsRequest):
+                try:
+                    await self._change_coordinators(
+                        tuple(req.coordinators))
+                    reply.send(None)
+                except flow.FdbError as e:
+                    reply.send_error(e)
             else:
                 reply.send_error(error("client_invalid_operation"))
+
+    @staticmethod
+    def _coord_id(c) -> tuple:
+        """Stable identity of a coordinator ref-tuple (refs deserialize
+        into fresh objects, so compare (process, token) pairs)."""
+        return tuple((r.endpoint.process.name, r.endpoint.token)
+                     for r in c[:4])
+
+    async def _change_coordinators(self, new_coords: tuple) -> None:
+        """MovableCoordinatedState (ref: CoordinatedState.actor.cpp:220
+        + ManagementAPI changeQuorum): seed the NEW quorum with the
+        current core state, then EXCLUSIVELY tombstone the old quorum
+        with a MovedValue (a concurrent recovery's write makes this
+        conflict and the whole change aborts cleanly), then decommission
+        the old coordinators so everything redirects. Ends the epoch so
+        the next recovery commits through the new quorum."""
+        from .coordination import ForwardRequest, MovedValue
+        # validate BEFORE touching anything: a malformed request must
+        # fail the request, never the management loop
+        if len(new_coords) < 1 or any(len(c) < 4 for c in new_coords):
+            raise error("invalid_option_value")
+        if getattr(self, "_cstate", None) is None:
+            raise error("operation_failed")   # not elected yet
+        new_ids = {self._coord_id(c) for c in new_coords}
+        if new_ids == {self._coord_id(c) for c in self.coordinators}:
+            flow.cover("coordination.change.noop")
+            return  # already the active set (ref: changeQuorum no-op);
+                    # re-running the move would forward the live quorum
+                    # at itself and brick the cluster
+        # the move runs on a PRIVATE handle over the old quorum: the
+        # epoch machinery shares self._cstate, and sharing its
+        # generation would let the tombstone commit at a generation
+        # this mover never read — breaking the exclusivity that makes
+        # a racing recovery abort the change
+        old_cs = CoordinatedState(
+            [(c[0], c[1]) for c in self.coordinators], self.process)
+        # 1. current state through the current quorum (raises read gens)
+        cur = await old_cs.read()
+        # 2. seed the new quorum
+        new_cs = CoordinatedState(
+            [(c[0], c[1]) for c in new_coords], self.process)
+        await new_cs.read()
+        await new_cs.set_exclusive(cur)
+        # 3. exclusive tombstone on the old quorum — the linearization
+        # point: past this await the change IS committed
+        await old_cs.set_exclusive(MovedValue(new_coords, cur))
+        # the change is durable: adopt the new quorum unconditionally
+        # before the best-effort decommissioning below
+        old_set = [c for c in self.coordinators
+                   if self._coord_id(c) not in new_ids]
+        self.coordinators = list(new_coords)
+        self._cstate = new_cs
+        flow.TraceEvent("CoordinatorsChanged", self.process.name).detail(
+            N=len(new_coords)).log()
+        # force a recovery: the next epoch's core state commits through
+        # the new quorum (ref: changeQuorum triggering recovery)
+        self._config_dirty = True
+        # 4. decommission old coordinators NOT in the new set. Pure
+        # best effort: the MovedValue tombstone already redirects any
+        # reader that reaches a non-forwarded old coordinator
+        await flow.all_of([flow.catch_errors(flow.timeout_error(
+            c[3].get_reply(ForwardRequest(new_coords), self.process), 2.0))
+            for c in old_set])
 
     def _live_included_workers(self, without: str = None) -> int:
         return sum(1 for name, wi in self.workers.items()

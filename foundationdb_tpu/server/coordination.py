@@ -70,6 +70,32 @@ class CandidacyReply(NamedTuple):
     change_id: int
 
 
+class ForwardRequest(NamedTuple):
+    """Decommission this coordinator: every further register/candidacy
+    request is answered with the NEW coordinator set (ref:
+    ForwardRequest, fdbserver/CoordinationInterface.h — the old quorum
+    keeps redirecting clients after a coordinators change)."""
+
+    coordinators: tuple          # ref 4-tuples (reads,writes,cand,fwd)
+
+
+class Forwarded(NamedTuple):
+    """Reply from a decommissioned coordinator."""
+
+    coordinators: tuple
+
+
+class MovedValue(NamedTuple):
+    """Tombstone written EXCLUSIVELY into the old quorum when the
+    coordinated state moves: readers that raced the forward requests
+    still learn the new set, and the carried value keeps the state
+    readable even if the mover crashed before the forwards landed
+    (ref: MovableValue modes, CoordinatedState.actor.cpp:220)."""
+
+    coordinators: tuple
+    value: object
+
+
 class Coordinator:
     """One coordination server (ref: coordinationServer,
     Coordination.actor.cpp). With a disk, the generation register
@@ -87,6 +113,12 @@ class Coordinator:
         self.reads = RequestStream(process)
         self.writes = RequestStream(process)
         self.candidacies = RequestStream(process)
+        self.forwards = RequestStream(process)
+        # set after a coordinators change: all traffic redirects. Not
+        # persisted — refs don't survive a process restart in sim, and
+        # a moved-away quorum is decommissioned anyway (the reference
+        # persists a connection STRING with an expiry instead).
+        self._forward: Optional[tuple] = None
         if disk is not None:
             from .diskqueue import DiskQueue
             self._dq = DiskQueue(disk, f"{process.name}.reg", owner=process)
@@ -111,9 +143,16 @@ class Coordinator:
                 self._dq.pop(self._dq.next_seq - 2)
         for coro, name in ((self._read_loop(), "genReads"),
                            (self._write_loop(), "genWrites"),
-                           (self._leader_loop(), "leader")):
+                           (self._leader_loop(), "leader"),
+                           (self._forward_loop(), "forward")):
             self._actors.add(flow.spawn(coro, TaskPriority.COORDINATION,
                                         name=f"{self.process.name}.{name}"))
+
+    async def _forward_loop(self):
+        while True:
+            req, reply = await self.forwards.pop()
+            self._forward = tuple(req.coordinators)
+            reply.send(None)
 
     async def _persist(self) -> None:
         """Fsync the register image BEFORE acking (ref: the reference's
@@ -133,6 +172,9 @@ class Coordinator:
     async def _read_loop(self):
         while True:
             req, reply = await self.reads.pop()
+            if self._forward is not None:
+                reply.send(Forwarded(self._forward))
+                continue
             value, wgen, rgen = self._reg.get(req.key, (None, ZERO_GEN,
                                                         ZERO_GEN))
             if req.gen > rgen:
@@ -146,6 +188,9 @@ class Coordinator:
     async def _write_loop(self):
         while True:
             req, reply = await self.writes.pop()
+            if self._forward is not None:
+                reply.send(Forwarded(self._forward))
+                continue
             value, wgen, rgen = self._reg.get(req.key, (None, ZERO_GEN,
                                                         ZERO_GEN))
             if req.gen >= rgen and req.gen >= wgen:
@@ -160,6 +205,9 @@ class Coordinator:
     async def _leader_loop(self):
         while True:
             req, reply = await self.candidacies.pop()
+            if self._forward is not None:
+                reply.send(Forwarded(self._forward))
+                continue
             cur, change = self._leader.get(req.key, (None, 0))
             if cur is None or (req.candidate is not None
                                and req.candidate < cur):
@@ -196,50 +244,83 @@ class CoordinatedState:
             raise error("coordinators_changed")
         return oks
 
+    def _follow(self, coordinators: tuple) -> None:
+        """Retarget at a forwarded-to coordinator set (ref:
+        MovableCoordinatedState following a move)."""
+        self.coordinators = [(c[0], c[1]) for c in coordinators]
+        self._gen = ZERO_GEN
+
     async def read(self):
         """Quorum read, raising read generations so any older in-flight
-        write can no longer succeed (ref: replicatedRead)."""
-        g = self._fresh_gen()
-        futs = [flow.catch_errors(reads.get_reply(
-            GenRegReadRequest(self.key, g), self.process))
-            for reads, _w in self.coordinators]
-        replies = await self._quorum(futs)
-        best = max(replies, key=lambda r: r.gen)
-        max_rgen = max(r.read_gen for r in replies)
-        self._gen = max(g, max_rgen, best.gen)
-        return best.value
+        write can no longer succeed (ref: replicatedRead). Follows a
+        moved quorum: Forwarded replies from decommissioned
+        coordinators, or a MovedValue tombstone left by the mover."""
+        for _hop in range(4):
+            g = self._fresh_gen()
+            futs = [flow.catch_errors(reads.get_reply(
+                GenRegReadRequest(self.key, g), self.process))
+                for reads, _w in self.coordinators]
+            replies = await self._quorum(futs)
+            fwd = next((r for r in replies if isinstance(r, Forwarded)),
+                       None)
+            if fwd is not None:
+                self._follow(fwd.coordinators)
+                continue
+            best = max(replies, key=lambda r: r.gen)
+            max_rgen = max(r.read_gen for r in replies)
+            self._gen = max(g, max_rgen, best.gen)
+            if isinstance(best.value, MovedValue):
+                # mover may have crashed before the forwards landed:
+                # the new quorum was seeded BEFORE this tombstone was
+                # written, so following always finds the state
+                flow.cover("coordination.read.moved_value")
+                self._follow(best.value.coordinators)
+                continue
+            return best.value
+        raise error("coordinators_changed")
 
     async def set_exclusive(self, value) -> None:
         """Quorum write at the generation observed by the last read;
         fails with coordinated_state_conflict if any newer reader or
-        writer intervened (ref: replicatedWrite + seq checks)."""
+        writer intervened (ref: replicatedWrite + seq checks). A
+        forwarded coordinator means the quorum moved under us — the
+        caller must re-read (which follows) before writing again."""
         g = self._gen
         futs = [flow.catch_errors(writes.get_reply(
             GenRegWriteRequest(self.key, g, value), self.process))
             for _r, writes in self.coordinators]
         replies = await self._quorum(futs)
+        if any(isinstance(r, Forwarded) for r in replies):
+            raise error("coordinated_state_conflict")
         if any(r.gen > g for r in replies):
             raise error("coordinated_state_conflict")
 
 
-async def elect_leader(candidacy_refs, key: bytes, candidate,
-                       process: SimProcess) -> None:
+async def elect_leader(coordinators, key: bytes, candidate,
+                       process: SimProcess):
     """Poll the coordinators until a majority nominate `candidate`
     (ref: tryBecomeLeaderInternal, LeaderElection.actor.cpp:78).
-    Returns when elected; raises operation_failed if a different
-    candidate holds a majority."""
+    `coordinators` is the ref-tuple list (candidacy endpoint at [2]).
+    Returns the coordinator set the election concluded on — a
+    forwarded (moved-away) quorum redirects the candidate to the new
+    set. Raises operation_failed if a different candidate holds a
+    majority."""
     while True:
-        futs = [flow.catch_errors(ref.get_reply(
+        futs = [flow.catch_errors(c[2].get_reply(
             CandidacyRequest(key, candidate, 0), process))
-            for ref in candidacy_refs]
+            for c in coordinators]
         settled = await flow.all_of(futs)
         replies = [f.get() for f in settled if not f.is_error]
+        fwd = next((r for r in replies if isinstance(r, Forwarded)), None)
+        if fwd is not None:
+            coordinators = list(fwd.coordinators)
+            continue
         votes: dict = {}
         for r in replies:
             votes[r.leader] = votes.get(r.leader, 0) + 1
-        need = len(candidacy_refs) // 2 + 1
+        need = len(coordinators) // 2 + 1
         if votes.get(candidate, 0) >= need:
-            return
+            return coordinators
         for other, n in votes.items():
             if other != candidate and n >= need:
                 raise error("operation_failed")
