@@ -90,7 +90,8 @@ class ClusterController:
                  config: ClusterConfig):
         self.process = process
         self.config = config
-        self.coordinators = coordinators   # [(reads, writes, candidacy)]
+        self.coordinators = coordinators   # ref 4-tuples:
+        # (reads, writes, candidacies, forwards) — see SimCluster._coord_refs
         self.dbinfo = AsyncVar(EMPTY_DBINFO)
         self.workers: dict = {}            # name -> _WorkerInfo
         self.log_stores: dict = {}         # store name -> LogRefs (live)
@@ -439,6 +440,10 @@ class ClusterController:
                     reply.send(None)
                 except flow.FdbError as e:
                     reply.send_error(e)
+                except Exception:
+                    # a malformed payload (non-ref elements) must fail
+                    # the REQUEST, never the management loop
+                    reply.send_error(error("operation_failed"))
             else:
                 reply.send_error(error("client_invalid_operation"))
 
@@ -477,6 +482,12 @@ class ClusterController:
         # a racing recovery abort the change
         old_cs = CoordinatedState(
             [(c[0], c[1]) for c in self.coordinators], self.process)
+        # 0. rejoin broadcast: members of the new set clear any STALE
+        # forward left from a previous decommissioning (a change-back
+        # to once-retired hosts must not chase their old forwards)
+        await flow.all_of([flow.catch_errors(flow.timeout_error(
+            c[3].get_reply(ForwardRequest(new_coords), self.process), 2.0))
+            for c in new_coords])
         # 1. current state through the current quorum (raises read gens)
         cur = await old_cs.read()
         # 2. seed the new quorum

@@ -151,7 +151,15 @@ class Coordinator:
     async def _forward_loop(self):
         while True:
             req, reply = await self.forwards.pop()
-            self._forward = tuple(req.coordinators)
+            if any(c[0].endpoint.process.name == self.process.name
+                   for c in req.coordinators):
+                # this coordinator is a MEMBER of the new set: it is
+                # rejoining, not being decommissioned — clear any stale
+                # forward so a change-back can reuse old hosts
+                flow.cover("coordination.forward.rejoin")
+                self._forward = None
+            else:
+                self._forward = tuple(req.coordinators)
             reply.send(None)
 
     async def _persist(self) -> None:
@@ -244,6 +252,20 @@ class CoordinatedState:
             raise error("coordinators_changed")
         return oks
 
+    @staticmethod
+    def _ref_id(r) -> tuple:
+        return (r.endpoint.process.name, r.endpoint.token)
+
+    def _is_current_set(self, coordinators: tuple) -> bool:
+        """True iff `coordinators` names the set this client already
+        targets (refs deserialize into fresh objects — compare
+        process/token identity)."""
+        mine = {(self._ref_id(r), self._ref_id(w))
+                for r, w in self.coordinators}
+        theirs = {(self._ref_id(c[0]), self._ref_id(c[1]))
+                  for c in coordinators}
+        return mine == theirs
+
     def _follow(self, coordinators: tuple) -> None:
         """Retarget at a forwarded-to coordinator set (ref:
         MovableCoordinatedState following a move)."""
@@ -270,6 +292,13 @@ class CoordinatedState:
             max_rgen = max(r.read_gen for r in replies)
             self._gen = max(g, max_rgen, best.gen)
             if isinstance(best.value, MovedValue):
+                if self._is_current_set(best.value.coordinators):
+                    # the move landed HERE: when old and new sets
+                    # overlap, shared members hold the tombstone as
+                    # their newest write — its carried value IS the
+                    # state (following would loop into ourselves)
+                    flow.cover("coordination.read.moved_self")
+                    return best.value.value
                 # mover may have crashed before the forwards landed:
                 # the new quorum was seeded BEFORE this tombstone was
                 # written, so following always finds the state
@@ -305,6 +334,7 @@ async def elect_leader(coordinators, key: bytes, candidate,
     forwarded (moved-away) quorum redirects the candidate to the new
     set. Raises operation_failed if a different candidate holds a
     majority."""
+    hops = 0
     while True:
         futs = [flow.catch_errors(c[2].get_reply(
             CandidacyRequest(key, candidate, 0), process))
@@ -313,8 +343,14 @@ async def elect_leader(coordinators, key: bytes, candidate,
         replies = [f.get() for f in settled if not f.is_error]
         fwd = next((r for r in replies if isinstance(r, Forwarded)), None)
         if fwd is not None:
+            # bounded: a forward CYCLE (only possible via operator
+            # error) must surface as a failure, not an infinite chase
+            hops += 1
+            if hops > 8:
+                raise error("coordinators_changed")
             coordinators = list(fwd.coordinators)
             continue
+        hops = 0
         votes: dict = {}
         for r in replies:
             votes[r.leader] = votes.get(r.leader, 0) + 1

@@ -85,6 +85,62 @@ def test_change_coordinators_under_live_traffic():
         c.shutdown()
 
 
+def test_overlapping_change_and_change_back():
+    """The standard operational cases: replace ONE coordinator of
+    three (old and new sets overlap — shared members hold the
+    tombstone as their newest register write and must serve its
+    carried value, not chase it), then change BACK to a set containing
+    previously-decommissioned hosts (their stale forwards must
+    clear)."""
+    c = SimCluster(seed=607, n_coordinators=3, durable=True)
+    try:
+        db = c.client()
+
+        async def recovered_past(epoch):
+            while c.cc.dbinfo.get().epoch <= epoch or \
+                    c.cc.dbinfo.get().recovery_state != "fully_recovered":
+                await flow.delay(0.1)
+
+        async def main():
+            async def put(k, v):
+                async def body(tr):
+                    tr.set(k, v)
+                await run_transaction(db, body, max_retries=500)
+
+            await put(b"a", b"1")
+            old_refs = [c._coord_refs(x) for x in c.coordinators[:3]]
+            (extra,) = c.add_coordinators(1, tag="x")
+
+            # overlap change: {0,1,2} -> {1,2,extra}
+            e0 = c.cc.dbinfo.get().epoch
+            await db.change_coordinators([old_refs[1], old_refs[2],
+                                          extra])
+            await recovered_past(e0)
+            await put(b"b", b"2")
+
+            # change BACK to the original three: host 0 was
+            # decommissioned (forwarding) and must rejoin cleanly
+            e1 = c.cc.dbinfo.get().epoch
+            await db.change_coordinators(old_refs)
+            await recovered_past(e1)
+            await put(b"c", b"3")
+
+            # recovery still works on the final quorum
+            e2 = c.cc.dbinfo.get().epoch
+            c.kill_role("tlog")
+            await recovered_past(e2)
+
+            async def check(tr):
+                rows = await tr.get_range(b"a", b"d")
+                assert rows == [(b"a", b"1"), (b"b", b"2"), (b"c", b"3")]
+            await run_transaction(db, check, max_retries=200)
+            return True
+
+        assert c.run(main(), timeout_time=900)
+    finally:
+        c.shutdown()
+
+
 def test_moved_value_followed_after_partial_change():
     """Mid-move crash: the mover seeded the new quorum and wrote the
     MovedValue tombstone but died before any ForwardRequest landed. A

@@ -10,6 +10,7 @@ import pytest
 from foundationdb_tpu import flow
 from foundationdb_tpu.client import run_transaction
 from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.server.consistency import check_consistency
 
 N = 6  # cycle length
 
@@ -87,6 +88,9 @@ def test_cycle_survives_attrition(seed):
             tasks.append(flow.spawn(_attrition(c, 2, machines)))
             await flow.wait_for_all(tasks)
             await _cycle_check(db)
+            # post-workload replica sweep (ref: tester.actor.cpp:741
+            # running ConsistencyCheck after sim tests)
+            await check_consistency(c)
             return True
 
         assert c.run(main(), timeout_time=900)
@@ -109,6 +113,9 @@ def test_replicated_sharded_cycle_attrition(seed):
             tasks.append(flow.spawn(_attrition(c, 3, machines)))
             await flow.wait_for_all(tasks)
             await _cycle_check(db)
+            # post-workload replica sweep (ref: tester.actor.cpp:741
+            # running ConsistencyCheck after sim tests)
+            await check_consistency(c)
             return True
 
         assert c.run(main(), timeout_time=900)
@@ -206,6 +213,9 @@ def test_random_cluster_shapes_survive_attrition(seed):
             tasks.append(flow.spawn(_attrition(c, 2, machines)))
             await flow.wait_for_all(tasks)
             await _cycle_check(db)
+            # post-workload replica sweep (ref: tester.actor.cpp:741
+            # running ConsistencyCheck after sim tests)
+            await check_consistency(c)
             return True
 
         assert c.run(main(), timeout_time=900), kw
