@@ -171,14 +171,8 @@ class BackupAgent:
                 await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
 
     def _pick_source(self, info, needed: int):
-        gens = sorted(info.old_logs, key=lambda g: g.end_version)
-        for gen in gens:
-            if gen.end_version >= needed and gen.logs:
-                return gen, gen.logs[self._replica_rr % len(gen.logs)]
-        if info.logs.logs:
-            return (info.logs,
-                    info.logs.logs[self._replica_rr % len(info.logs.logs)])
-        return None
+        from ..server.dbinfo import pick_log_source
+        return pick_log_source(info, needed, self._replica_rr)
 
     async def _nudge_commit(self) -> None:
         from ..server.types import CommitRequest

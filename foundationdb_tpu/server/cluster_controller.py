@@ -111,6 +111,7 @@ class ClusterController:
         self.probe_paused = False          # quiet_database pauses probes
         self.backup_active = False         # continuous-backup tagging
         self.backup_agent = None           # the live agent, when any
+        self.region = None                 # attached RemoteRegion, if any
         # authoritative shard boundaries (ref: the keyServers system
         # keyspace as ground truth); rebooted servers whose persisted
         # meta disagrees — e.g. crashed mid-move — are clamped to this

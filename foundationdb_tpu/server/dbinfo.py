@@ -95,3 +95,18 @@ EMPTY_DBINFO = ServerDBInfo(0, UNINITIALIZED, 0, (), LogSetInfo(0, 0, -1, ()),
 from ..rpc import wire as _wire
 
 _wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary
+
+
+def pick_log_source(info: "ServerDBInfo", needed: int, rr: int):
+    """The generation-chasing cursor shared by every log tail (backup
+    agent, region log router): the oldest generation still covering
+    `needed` serves first, then the current one; `rr` rotates replicas
+    on failure (ref: LogSystemPeekCursor merging old generations before
+    the live set). Returns (generation, log refs) or None."""
+    gens = sorted(info.old_logs, key=lambda g: g.end_version)
+    for gen in gens:
+        if gen.end_version >= needed and gen.logs:
+            return gen, gen.logs[rr % len(gen.logs)]
+    if info.logs.logs:
+        return info.logs, info.logs.logs[rr % len(info.logs.logs)]
+    return None

@@ -196,6 +196,9 @@ class MasterRecovery:
             from .proxy import BACKUP_TAG
             from ..layers.backup_agent import AGENT_NAME
             expected[BACKUP_TAG] = (AGENT_NAME,)
+        if getattr(self.cc, "region", None) is not None:
+            from .proxy import REGION_TAG
+            expected[REGION_TAG] = (self.cc.region.router_name,)
         for i, w in enumerate(log_workers):
             w.roles[f"tlog-e{self.epoch}-{i}"].set_expected_replicas(
                 expected)
@@ -215,6 +218,8 @@ class MasterRecovery:
                 storage_tags=self.cc.storage_tags()))
             if self.cc.backup_active:
                 w.roles[f"proxy-e{self.epoch}-{i}"].backup_active = True
+            if getattr(self.cc, "region", None) is not None:
+                w.roles[f"proxy-e{self.epoch}-{i}"].region_active = True
             self.critical_procs.add(w.process)
         proxies = tuple(proxies)
         # each proxy confirms GRVs with every other proxy (ref:

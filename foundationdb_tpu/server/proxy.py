@@ -62,6 +62,9 @@ MWTLV = 5_000_000  # fallback window (ref: MAX_WRITE_TRANSACTION_LIFE_VERSIONS)
 # active (ref: the backup mutation-log tags — a single stream preserves
 # exact intra-version mutation order for point-in-time restore)
 BACKUP_TAG = 0xFFFF
+# ...and here while a remote region is attached (ref: the log-router
+# tags of a fearless configuration; see server/region.py)
+REGION_TAG = 0xFFFE
 
 
 class KeyResolverMap:
@@ -164,6 +167,7 @@ class Proxy:
         assert len(self._stags) == len(self._sbounds) - 1
         self._moving: list = []   # (begin, end, extra_tag) dual-tag ranges
         self.backup_active = False
+        self.region_active = False
         self.tlog_refs = list(tlog_refs)
         batch_window = max(batch_window,
                            SERVER_KNOBS.commit_transaction_batch_interval_min)
@@ -348,7 +352,7 @@ class Proxy:
         holding both teams during moveKeys); an active backup adds the
         backup tag to everything."""
         n = len(self._sbounds) - 1
-        if n == 1 and not self._moving:
+        if n == 1 and not self._moving and not self.region_active:
             return ((self._stags[0], BACKUP_TAG) if self.backup_active
                     else (self._stags[0],))
         if m.type == CLEAR_RANGE:
@@ -362,6 +366,8 @@ class Proxy:
                     tags.add(extra)
             if self.backup_active:
                 tags.add(BACKUP_TAG)
+            if self.region_active:
+                tags.add(REGION_TAG)
             return tuple(sorted(tags))
         tags = {self._shard_of(m.param1)}
         for mb, me, extra in self._moving:
@@ -369,6 +375,8 @@ class Proxy:
                 tags.add(extra)
         if self.backup_active:
             tags.add(BACKUP_TAG)
+        if self.region_active:
+            tags.add(REGION_TAG)
         return tuple(sorted(tags))
 
     def _shard_of(self, key: bytes) -> int:
