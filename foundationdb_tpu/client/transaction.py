@@ -47,8 +47,12 @@ REFRESH_ERRORS = {"broken_promise", "commit_unknown_result", "tlog_stopped",
                   "coordinators_changed", "wrong_shard_server"}
 
 
-REQUEST_TIMEOUT = 5.0  # seconds; a hung role surfaces as retryable
-                       # timed_out (ref: failure-monitored getReply)
+# seconds before a hung role surfaces as retryable timed_out
+# (ref: failure-monitored getReply); see CLIENT_REQUEST_TIMEOUT knob
+
+
+def _request_timeout() -> float:
+    return flow.SERVER_KNOBS.client_request_timeout
 
 # "no limit" sentinel for range reads: the default get_range cap, the
 # overlay full-fetch, and the parallel-fan-out threshold must agree
@@ -72,7 +76,7 @@ ENGINE_PREFIX = b"\xff\xff"
 
 
 def _rpc(fut: Future) -> Future:
-    return flow.timeout_error(fut, REQUEST_TIMEOUT)
+    return flow.timeout_error(fut, _request_timeout())
 
 
 def _next_key(k: bytes) -> bytes:
@@ -308,7 +312,7 @@ class Transaction:
         if remaining <= 0:
             fut.abandon()
             return flow.error_future(error("transaction_timed_out"))
-        if remaining >= REQUEST_TIMEOUT:
+        if remaining >= _request_timeout():
             return _rpc(fut)
         return flow.timeout_error(fut, remaining, "transaction_timed_out")
 
@@ -419,7 +423,7 @@ class Transaction:
             e = settled.exception()
             if e.name not in ("broken_promise", "timed_out"):
                 raise e
-            db.note_latency(rep.name, REQUEST_TIMEOUT)  # penalty
+            db.note_latency(rep.name, _request_timeout())  # penalty
             last_err = e
 
     # -- read version ---------------------------------------------------
@@ -887,7 +891,10 @@ class Transaction:
         if e.name in REFRESH_ERRORS:
             flow.cover("client.refresh_stale_picture")
             await self.db.refresh_past(self._used_seq)
-        await flow.delay(0.001 + flow.g_random.random01() * 0.01,
+        await flow.delay(
+            flow.SERVER_KNOBS.client_retry_backoff_min
+            + flow.g_random.random01()
+            * flow.SERVER_KNOBS.client_retry_backoff_jitter,
                          TaskPriority.DEFAULT_ENDPOINT)
         # a RETRY reset keeps the logical transaction's spent budgets
         # and priority class — only an explicit user reset() re-arms
@@ -900,9 +907,12 @@ class Transaction:
             self._timeout_deadline = deadline
 
 
-async def run_transaction(db: Database, body, max_retries: int = 100):
+async def run_transaction(db: Database, body,
+                          max_retries: Optional[int] = None):
     """The standard retry loop (ref: the `doTransaction` idiom / python
     binding @fdb.transactional)."""
+    if max_retries is None:
+        max_retries = int(flow.SERVER_KNOBS.client_default_max_retries)
     tr = db.create_transaction()
     for _ in range(max_retries):
         try:

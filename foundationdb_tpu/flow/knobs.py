@@ -44,29 +44,115 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
          lambda: g_random.random_choice([1_000_000, 100_000, 10_000_000]))
     init("MAX_WRITE_TRANSACTION_LIFE_VERSIONS", 5 * 1_000_000,
          lambda: 1_000_000)
-    init("MAX_VERSIONS_IN_FLIGHT", 100 * 1_000_000)
     init("MAX_COMMIT_BATCH_INTERVAL", 0.5, lambda: 2.0)
     init("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.001)
     init("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 32768, lambda: 1000)
     init("COMMIT_TRANSACTION_BATCH_BYTES_MAX", 8 << 20)
     init("RESOLVER_STATE_MEMORY_LIMIT", 1 << 20)
-    init("PROXY_SPIN_DELAY", 0.01)
     init("GRV_BATCH_INTERVAL", 0.0005)
     init("DESIRED_TOTAL_BYTES", 150000)
     init("STORAGE_DURABILITY_LAG", 5.0)
     init("TLOG_SPILL_THRESHOLD", 1500 << 20)
-    init("MAX_TRANSACTION_BYTE_LIMIT", 10_000_000)
     init("TRANSACTION_SIZE_LIMIT", 10_000_000)
     init("KEY_SIZE_LIMIT", 10_000)
     init("VALUE_SIZE_LIMIT", 100_000)
-    init("RESOLVER_COALESCE_TIME", 1.0)
+    init("RESOLVER_REPLY_CACHE_SIZE", 256)
     init("LOAD_BALANCE_BACKUP_DELAY", 0.005, lambda: 0.0005)
     # DD shard sizing (ref: SHARD_MAX_BYTES_PER_KSEC family — row-count
     # stand-ins for the byte/bandwidth thresholds)
     init("DD_SHARD_SPLIT_ROWS", 1000, lambda: 120)
     init("DD_SHARD_MERGE_ROWS", 40, lambda: 10)
-    init("SAMPLE_EXPIRATION_TIME", 1.0)
     init("WATCH_TIMEOUT", 900.0, lambda: 20.0)
+
+    # -- master / recovery (ref: fdbserver/Knobs.cpp recovery family) --
+    init("MAX_VERSION_ADVANCE", 5_000_000, lambda: 50_000)
+    init("RECOVERY_WAIT_FOR_LOGS_DELAY", 0.5, lambda: 2.0)
+    init("RESOLUTION_BALANCING_INTERVAL", 2.0, lambda: 0.3)
+    init("RESOLUTION_METRICS_TIMEOUT", 2.0)
+    init("RESOLUTION_BALANCING_MIN_WORK", 100, lambda: 5)
+    init("OLD_LOG_CLEANUP_INTERVAL", 1.0, lambda: 0.1)
+    init("TLOG_LOCK_TIMEOUT", 2.0, lambda: 0.5)
+
+    # -- cluster controller (ref: CC_* / FAILURE_* knobs) --------------
+    init("CC_WORKER_POLL_DELAY", 0.05)
+    init("FAILURE_DETECTION_INTERVAL", 0.1, lambda: 0.5)
+    init("LATENCY_PROBE_INTERVAL", 5.0)
+    init("DD_POLL_INTERVAL", 2.0, lambda: 0.3)
+    init("DD_MOVE_NUDGE_INTERVAL", 0.1)
+    init("STORAGE_RECRUIT_RECOVERY_TIMEOUT", 30.0)
+    init("COORDINATOR_FORWARD_TIMEOUT", 2.0)
+
+    # -- coordination / election (ref: POLLING_FREQUENCY etc.) ---------
+    init("CANDIDACY_POLL_INTERVAL", 0.05, lambda: 0.3)
+    init("COORDINATOR_FORWARD_HOPS_MAX", 8)
+
+    # -- storage (ref: STORAGE_* / FETCH_* knobs) ----------------------
+    init("STORAGE_PULL_IDLE_DELAY", 0.2)
+    init("STORAGE_PEEK_TIMEOUT", 5.0)
+    init("STORAGE_ROLLBACK_DELAY", 0.05)
+    init("STORAGE_COMMIT_INTERVAL", 0.05, lambda: 0.5)
+    init("WATCH_EXPIRY_SWEEP_INTERVAL", 30.0, lambda: 1.0)
+
+    # -- tlog (ref: TLOG_* knobs) --------------------------------------
+    init("TLOG_STALLED_PEEK_DELAY", 1.0)
+    init("TLOG_FSYNC_DELAY", 0.0005, lambda: 0.01)
+
+    # -- proxy / GRV (ref: START_TRANSACTION_* knobs) ------------------
+    init("GRV_RATE_POLL_INTERVAL", 0.1)
+    init("GRV_CONFIRM_TIMEOUT", 2.0)
+    init("GRV_BURST_INTERVALS", 10, lambda: 1)
+    init("RATEKEEPER_POLL_TIMEOUT", 1.0)
+
+    # -- ratekeeper (ref: Ratekeeper.actor.cpp knobs) ------------------
+    init("RK_UPDATE_INTERVAL", 0.1)
+    init("RK_MIN_RATE", 10.0)
+    init("RK_MAX_RATE", 1e9)
+    init("RK_TLOG_BACKLOG_LIMIT", 10_000, lambda: 500)
+
+    # -- region / log router (ref: LOG_ROUTER_* knobs) -----------------
+    init("LOG_ROUTER_PEEK_TIMEOUT", 2.0)
+    init("LOG_ROUTER_IDLE_DELAY", 0.2)
+    init("LOG_ROUTER_RETRY_DELAY", 0.1)
+    init("REGION_SETTLE_DELAY", 0.05)
+
+    # -- backup agent (ref: BACKUP_* knobs) ----------------------------
+    init("BACKUP_TAIL_IDLE_DELAY", 0.1)
+    init("BACKUP_PEEK_TIMEOUT", 2.0)
+    init("BACKUP_SOURCE_RETRY_DELAY", 0.2)
+    init("BACKUP_NUDGE_INTERVAL", 0.1)
+
+    # -- simulation environment (ref: sim2 latency/reboot model) -------
+    init("SIM_REBOOT_DELAY", 1.0, lambda: 5.0)
+    init("QUIET_DATABASE_POLL", 0.25)
+    init("SIM_LATENCY_MIN", 0.0002)
+    init("SIM_LATENCY_MAX", 0.002, lambda: 0.02)
+    init("SIM_CLOG_EXTRA_LATENCY", 0.05)
+    init("SIM_DISK_WRITE_LATENCY", 0.0001)
+    init("SIM_DISK_SYNC_LATENCY", 0.0005, lambda: 0.01)
+    init("SIM_DISK_WRITE_JITTER", 0.0002)
+    init("SIM_DISK_SYNC_JITTER", 0.002)
+    init("SIM_POWER_LOSS_DROP_PROB", 0.5)
+
+    # -- client (ref: fdbclient/Knobs.cpp) -----------------------------
+    init("CLIENT_REQUEST_TIMEOUT", 5.0)
+    init("CLIENT_RETRY_BACKOFF_MIN", 0.001)
+    init("CLIENT_RETRY_BACKOFF_JITTER", 0.01, lambda: 0.1)
+    init("CLIENT_DEFAULT_MAX_RETRIES", 100)
+
+    # -- consistency check (ref: ConsistencyCheck workload knobs) ------
+    init("CONSISTENCY_CHECK_PAGE_ROWS", 10_000, lambda: 7)
+    init("CONSISTENCY_CHECK_READ_TIMEOUT", 30.0)
+
+    # -- engines (ref: page/file sizing knobs). The btree constants are
+    # read at module import (on-disk format must stay constant within a
+    # process), so they are settable but not BUGGIFY-randomized
+    init("DISK_QUEUE_FILE_SIZE", 1 << 20, lambda: 4096)
+    init("BTREE_PAGE_SIZE", 4096)
+    init("BTREE_MAX_FANOUT", 32)
+
+    # -- real TCP transport (wall-clock; never BUGGIFY-distorted) ------
+    init("TCP_HANDSHAKE_TIMEOUT", 5.0)
+    init("TCP_CONNECT_TIMEOUT", 5.0)
     return k
 
 

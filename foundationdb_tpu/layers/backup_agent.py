@@ -68,7 +68,8 @@ class BackupAgent:
         while min((p.committed_version.get()
                    for p in cc._current_proxies()), default=0) < v_enable:
             await self._nudge_commit()
-            await flow.delay(0.05, TaskPriority.DEFAULT_ENDPOINT)
+            await flow.delay(flow.SERVER_KNOBS.backup_nudge_interval,
+                             TaskPriority.DEFAULT_ENDPOINT)
         self._tail_task = flow.spawn(self._tail(start_v),
                                      TaskPriority.DEFAULT_ENDPOINT,
                                      name="backupAgent.tail")
@@ -96,7 +97,8 @@ class BackupAgent:
         while True:
             ep = cc.dbinfo.get().epoch
             self._apply_tagging(active)
-            await flow.delay(0.05, TaskPriority.DEFAULT_ENDPOINT)
+            await flow.delay(flow.SERVER_KNOBS.backup_nudge_interval,
+                             TaskPriority.DEFAULT_ENDPOINT)
             info = cc.dbinfo.get()
             if info.epoch != ep or \
                     info.recovery_state != "fully_recovered":
@@ -126,16 +128,20 @@ class BackupAgent:
             info = self.cluster.cc.dbinfo.get()
             src = self._pick_source(info, version + 1)
             if src is None:
-                await flow.delay(0.2, TaskPriority.DEFAULT_ENDPOINT)
+                await flow.delay(
+                    flow.SERVER_KNOBS.backup_source_retry_delay,
+                    TaskPriority.DEFAULT_ENDPOINT)
                 continue
             gen, refs = src
             try:
                 reply = await flow.timeout_error(refs.peeks.get_reply(
                     TLogPeekRequest(version + 1, BACKUP_TAG),
-                    self.db.process), 2.0)
+                    self.db.process),
+                    flow.SERVER_KNOBS.backup_peek_timeout)
             except flow.FdbError:
                 self._replica_rr += 1   # rotate off a dead replica
-                await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+                await flow.delay(flow.SERVER_KNOBS.backup_tail_idle_delay,
+                                 TaskPriority.DEFAULT_ENDPOINT)
                 continue
             cap = gen.end_version if gen.end_version >= 0 else None
             # never record beyond what is known replicated cluster-wide:
@@ -168,7 +174,8 @@ class BackupAgent:
                 # no progress on the open generation: known_committed
                 # only advances with fresh commits — nudge one through
                 await self._nudge_commit()
-                await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+                await flow.delay(flow.SERVER_KNOBS.backup_tail_idle_delay,
+                                 TaskPriority.DEFAULT_ENDPOINT)
 
     def _pick_source(self, info, needed: int):
         from ..server.dbinfo import pick_log_source
@@ -191,7 +198,8 @@ class BackupAgent:
             if flow.now() > deadline:
                 raise flow.error("timed_out")
             await self._nudge_commit()
-            await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+            await flow.delay(flow.SERVER_KNOBS.backup_nudge_interval,
+                             TaskPriority.DEFAULT_ENDPOINT)
 
     async def wait_tailed_to(self, version: int, max_wait: float = 30.0):
         await self._wait_until(lambda: self._tailed_to >= version, max_wait)

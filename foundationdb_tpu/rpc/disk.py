@@ -102,8 +102,10 @@ class SimFile:
         """Each unsynced write independently survives or vanishes — the
         OS may or may not have flushed it (ref: AsyncFileNonDurable
         KILLED mode). Ordering of survivors is preserved."""
+        from ..flow import SERVER_KNOBS
         for offset, data in self._pending:
-            if rng.random01() < 0.5:
+            # survives with probability (1 - drop_prob)
+            if rng.random01() >= SERVER_KNOBS.sim_power_loss_drop_prob:
                 self._apply(offset, data)
         self._pending.clear()
         self._open = False
@@ -145,8 +147,11 @@ class SimDisk:
 
     async def _io_latency(self, sync: bool = False):
         from .. import flow
-        base = 0.0001 if not sync else 0.0005
-        jitter = flow.g_random.random01() * (0.0002 if not sync else 0.002)
+        k = flow.SERVER_KNOBS
+        base = k.sim_disk_write_latency if not sync else \
+            k.sim_disk_sync_latency
+        jitter = flow.g_random.random01() * (
+            k.sim_disk_write_jitter if not sync else k.sim_disk_sync_jitter)
         await flow.delay(base + jitter, TaskPriority.DISK_IO_LATENCY)
 
     def power_loss(self, rng, owner=None) -> None:

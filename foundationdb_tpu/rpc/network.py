@@ -128,8 +128,14 @@ class NetworkRef:
 class SimNetwork:
     """The simulated transport + fault API (ref: sim2.actor.cpp)."""
 
-    def __init__(self, sched: Scheduler, rng, min_latency: float = 0.0002,
-                 max_latency: float = 0.002, serialize: bool = True):
+    def __init__(self, sched: Scheduler, rng,
+                 min_latency: float = None,
+                 max_latency: float = None, serialize: bool = True):
+        from ..flow import SERVER_KNOBS
+        if min_latency is None:
+            min_latency = SERVER_KNOBS.sim_latency_min
+        if max_latency is None:
+            max_latency = SERVER_KNOBS.sim_latency_max
         self.sched = sched
         self.rng = rng
         self.min_latency = min_latency
@@ -239,7 +245,8 @@ class SimNetwork:
             # occasional pathological latency: reorders far more
             # aggressively than the uniform draw (ref: sim2's BUGGIFY'd
             # connection delays)
-            lat += self.rng.random01() * 0.05
+            from ..flow import SERVER_KNOBS
+            lat += self.rng.random01() * SERVER_KNOBS.sim_clog_extra_latency
         key = (src.machine, dst.machine)
         unclog = self._clogged.get(key, 0.0)
         now = self.sched.now()

@@ -34,8 +34,14 @@ from . import wire
 _HDR = struct.Struct("<IBQQ")   # len, kind, req_id, token
 PROTOCOL_VERSION = b"fdbtpu01"
 K_REQUEST, K_REPLY, K_ERROR = 0, 1, 2
-HANDSHAKE_TIMEOUT = 5.0
-CONNECT_TIMEOUT = 5.0
+def HANDSHAKE_TIMEOUT():
+    from ..flow import SERVER_KNOBS
+    return SERVER_KNOBS.tcp_handshake_timeout
+
+
+def CONNECT_TIMEOUT():
+    from ..flow import SERVER_KNOBS
+    return SERVER_KNOBS.tcp_connect_timeout
 
 
 class TlsConfig(NamedTuple):
@@ -146,7 +152,7 @@ class _Conn:
         try:
             if self.sock is None:
                 self.sock = socket.create_connection(
-                    self.addr, timeout=CONNECT_TIMEOUT)
+                    self.addr, timeout=CONNECT_TIMEOUT())
                 ctx = self.transport.tls_client_ctx()
                 if ctx is not None:
                     # TLS handshake before the protocol tag, exactly
@@ -156,7 +162,7 @@ class _Conn:
                 self.sock.settimeout(None)
                 self.sock.sendall(PROTOCOL_VERSION)
             elif self.handshake_in:
-                self.sock.settimeout(HANDSHAKE_TIMEOUT)
+                self.sock.settimeout(HANDSHAKE_TIMEOUT())
                 ctx = self.transport.tls_server_ctx()
                 if ctx is not None:
                     self.sock = ctx.wrap_socket(self.sock,

@@ -22,7 +22,7 @@ from .cluster_controller import ClusterConfig, ClusterController
 from .coordination import Coordinator
 from .worker import RegisterWorkerRequest, Worker
 
-REBOOT_DELAY = 0.5   # seconds before a killed worker restarts
+# seconds before a killed worker restarts: see SIM_REBOOT_DELAY knob
 
 
 class SimCluster:
@@ -172,7 +172,7 @@ class SimCluster:
     async def _reboot_worker(self, name: str, machine: str) -> None:
         """(ref: simulatedFDBDRebooter — the machine comes back after a
         delay and its worker recovers whatever the disk kept)"""
-        await flow.delay(REBOOT_DELAY)
+        await flow.delay(flow.SERVER_KNOBS.sim_reboot_delay)
         if name in self.net.processes and self.net.processes[name].alive:
             return
         self._start_worker(name, machine)
@@ -254,7 +254,7 @@ class SimCluster:
                     info.proxies[0].commits.get_reply(
                         CommitRequest(0, (), (), ()),
                         self.cc.process), 1.0))
-            await flow.delay(0.25)
+            await flow.delay(flow.SERVER_KNOBS.quiet_database_poll)
         raise flow.error("timed_out")
 
     # -- running ---------------------------------------------------------

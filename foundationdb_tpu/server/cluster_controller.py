@@ -174,7 +174,8 @@ class ClusterController:
     async def _wait_for_workers(self) -> None:
         need = max(self.config.n_logs, 1)
         while self._live_included_workers() < need:
-            await flow.delay(0.05, TaskPriority.CLUSTER_CONTROLLER)
+            await flow.delay(flow.SERVER_KNOBS.cc_worker_poll_delay,
+                             TaskPriority.CLUSTER_CONTROLLER)
 
     async def _watch_epoch(self, recovery_task) -> str:
         """Resolve when this epoch is over: recovery errored, or a
@@ -203,7 +204,8 @@ class ClusterController:
             for proc in self._recovery.critical_procs:
                 if not proc.alive:
                     return f"process_failed:{proc.name}"
-            await flow.delay(0.1, TaskPriority.FAILURE_MONITOR)
+            await flow.delay(flow.SERVER_KNOBS.failure_detection_interval,
+                             TaskPriority.FAILURE_MONITOR)
 
     def _cancel_old_roles(self) -> None:
         """Cancel surviving roles of the failed epoch so stale proxies
@@ -255,6 +257,17 @@ class ClusterController:
         new_old = []
         for gen in info.old_logs:
             logs = tuple(by_store.get(lr.store, lr) for lr in gen.logs)
+            # a store that was UNREACHABLE when this generation's
+            # picture was built rejoins by name — without this, a
+            # reader needing the generation would wait forever (and
+            # before the strict-coverage rule, it silently skipped)
+            present = {lr.store for lr in logs}
+            for store, _machine in gen.stores:
+                lr = by_store.get(store)
+                if lr is not None and store not in present:
+                    flow.cover("cc.old_log_rejoined")
+                    logs = logs + (lr,)
+                    present.add(store)
             if logs != gen.logs:
                 changed = True
             new_old.append(gen._replace(logs=logs))
@@ -487,7 +500,8 @@ class ClusterController:
         # forward left from a previous decommissioning (a change-back
         # to once-retired hosts must not chase their old forwards)
         await flow.all_of([flow.catch_errors(flow.timeout_error(
-            c[3].get_reply(ForwardRequest(new_coords), self.process), 2.0))
+            c[3].get_reply(ForwardRequest(new_coords), self.process),
+            flow.SERVER_KNOBS.coordinator_forward_timeout))
             for c in new_coords])
         # 1. current state through the current quorum (raises read gens)
         cur = await old_cs.read()
@@ -514,7 +528,8 @@ class ClusterController:
         # best effort: the MovedValue tombstone already redirects any
         # reader that reaches a non-forwarded old coordinator
         await flow.all_of([flow.catch_errors(flow.timeout_error(
-            c[3].get_reply(ForwardRequest(new_coords), self.process), 2.0))
+            c[3].get_reply(ForwardRequest(new_coords), self.process),
+            flow.SERVER_KNOBS.coordinator_forward_timeout))
             for c in old_set])
 
     def _live_included_workers(self, without: str = None) -> int:
@@ -544,7 +559,8 @@ class ClusterController:
         self._latency_probe = {}
         probe_seen_committed = -1
         while True:
-            await flow.delay(5.0, TaskPriority.LOW_PRIORITY)
+            await flow.delay(flow.SERVER_KNOBS.latency_probe_interval,
+                             TaskPriority.LOW_PRIORITY)
             if self.dbinfo.get().recovery_state != FULLY_RECOVERED or \
                     self.probe_paused:
                 continue
@@ -686,7 +702,8 @@ class ClusterController:
         dataDistributionQueue scheduling moveKeys). One move at a time;
         only when the cluster is healthy."""
         while True:
-            await flow.delay(2.0, TaskPriority.DATA_DISTRIBUTION)
+            await flow.delay(flow.SERVER_KNOBS.dd_poll_interval,
+                             TaskPriority.DATA_DISTRIBUTION)
             info = self.dbinfo.get()
             if info.recovery_state != FULLY_RECOVERED or self._move_inflight:
                 continue
@@ -858,7 +875,9 @@ class ClusterController:
                 Old=old_name, New=new_name, Worker=dst_wi.name).log()
             # the newcomer's engine must finish recovering before a
             # durable install can land on it
-            await flow.timeout_error(new_obj.recovered, 30.0)
+            await flow.timeout_error(
+                new_obj.recovered,
+                flow.SERVER_KNOBS.storage_recruit_recovery_timeout)
             v_s = min(src.known_committed, src.version.get())
             rows = src.snapshot_range(shard.begin, shard.end, v_s)
             if self.dbinfo.get().epoch != epoch0:
@@ -960,7 +979,9 @@ class ClusterController:
                 p.start_move(split, shard.end, new_tag)
             dual_tagged = True
             for o in new_objs:
-                await flow.timeout_error(o.recovered, 30.0)
+                await flow.timeout_error(
+                    o.recovered,
+                    flow.SERVER_KNOBS.storage_recruit_recovery_timeout)
             v_s = await self._wait_replication_horizon(src, epoch0, proxies)
             rows = src.snapshot_range(split, shard.end, v_s)
             if self.dbinfo.get().epoch != epoch0:
@@ -1133,7 +1154,8 @@ class ClusterController:
             if self.dbinfo.get().epoch != epoch0:
                 raise error("operation_failed")
             await self._nudge_commit()
-            await flow.delay(0.1, TaskPriority.DATA_DISTRIBUTION)
+            await flow.delay(flow.SERVER_KNOBS.dd_move_nudge_interval,
+                             TaskPriority.DATA_DISTRIBUTION)
         return min(src.known_committed, src.version.get())
 
     async def _move_boundary(self, left_idx: int, direction: str,

@@ -16,9 +16,9 @@ from .. import flow
 from ..flow import TaskPriority, error
 from .types import StorageGetRangeRequest
 
-# the sweep's page size: chunked like the reference's range reads so a
-# huge shard cannot produce an unbounded reply
-PAGE_ROWS = 10_000
+# the sweep's page size lives in the CONSISTENCY_CHECK_PAGE_ROWS knob:
+# chunked like the reference's range reads so a huge shard cannot
+# produce an unbounded reply (BUGGIFY shrinks it so paging is exercised)
 
 
 class ConsistencyError(AssertionError):
@@ -29,15 +29,16 @@ async def _read_replica(rep, begin: bytes, end, version: int, process):
     """Full contents of [begin, end) from one replica, paged."""
     out = []
     cursor = begin
+    page_rows = int(flow.SERVER_KNOBS.consistency_check_page_rows)
     # an open-ended last shard is swept through the stored system rows
     # too (\xff\x02 is replicated data); \xff\xff engine metadata is not
     hard_end = end if end is not None else b"\xff\xff"
     while True:
         rows = await flow.timeout_error(rep.ranges.get_reply(
-            StorageGetRangeRequest(cursor, hard_end, version, PAGE_ROWS),
-            process), 30.0)
+            StorageGetRangeRequest(cursor, hard_end, version, page_rows),
+            process), flow.SERVER_KNOBS.consistency_check_read_timeout)
         out.extend(rows)
-        if len(rows) < PAGE_ROWS:
+        if len(rows) < page_rows:
             return out
         cursor = rows[-1][0] + b"\x00"
 

@@ -346,7 +346,7 @@ async def elect_leader(coordinators, key: bytes, candidate,
             # bounded: a forward CYCLE (only possible via operator
             # error) must surface as a failure, not an infinite chase
             hops += 1
-            if hops > 8:
+            if hops > flow.SERVER_KNOBS.coordinator_forward_hops_max:
                 raise error("coordinators_changed")
             coordinators = list(fwd.coordinators)
             continue
@@ -360,7 +360,8 @@ async def elect_leader(coordinators, key: bytes, candidate,
         for other, n in votes.items():
             if other != candidate and n >= need:
                 raise error("operation_failed")
-        await flow.delay(0.05, TaskPriority.COORDINATION)
+        await flow.delay(flow.SERVER_KNOBS.candidacy_poll_interval,
+                         TaskPriority.COORDINATION)
 
 from ..rpc import wire as _wire
 

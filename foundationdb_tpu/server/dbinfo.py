@@ -41,6 +41,11 @@ class LogSetInfo(NamedTuple):
     begin_version: int    # first version this generation may contain
     end_version: int      # last version (locked gens; -1 = open)
     logs: Tuple[LogRefs, ...]
+    # EVERY member store's (name, machine), including ones unreachable
+    # when this picture was built — a store that reboots later rejoins
+    # its generation by name (losing the name would orphan its records:
+    # readers would skip the generation and silently lose data)
+    stores: Tuple[Tuple[str, str], ...] = ()
 
 
 class ProxyRefs(NamedTuple):
@@ -99,14 +104,26 @@ _wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary
 
 def pick_log_source(info: "ServerDBInfo", needed: int, rr: int):
     """The generation-chasing cursor shared by every log tail (backup
-    agent, region log router): the oldest generation still covering
-    `needed` serves first, then the current one; `rr` rotates replicas
-    on failure (ref: LogSystemPeekCursor merging old generations before
-    the live set). Returns (generation, log refs) or None."""
+    agent, region log router, storage pull): the oldest generation
+    COVERING `needed` serves first, then the current one; `rr` rotates
+    replicas on failure (ref: LogSystemPeekCursor merging old
+    generations before the live set). Returns (generation, log refs)
+    or None.
+
+    Coverage is strict: a generation serves `needed` only if
+    begin_version < needed <= end_version. Picking a LATER generation
+    when the covering one is temporarily unreachable (e.g. its store's
+    worker is mid-reboot) would let the reply's durable watermark
+    advance the reader past records it never saw — silent data loss.
+    The caller must wait and retry until the covering store
+    re-registers."""
     gens = sorted(info.old_logs, key=lambda g: g.end_version)
     for gen in gens:
-        if gen.end_version >= needed and gen.logs:
+        if gen.begin_version < needed <= gen.end_version:
+            if not gen.logs:
+                return None   # covering gen unreachable: wait, never skip
             return gen, gen.logs[rr % len(gen.logs)]
-    if info.logs.logs:
-        return info.logs, info.logs.logs[rr % len(info.logs.logs)]
+    cur = info.logs
+    if cur.logs and needed > cur.begin_version:
+        return cur, cur.logs[rr % len(cur.logs)]
     return None

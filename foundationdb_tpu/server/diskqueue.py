@@ -37,10 +37,13 @@ class DiskQueue:
     """Two-file durable FIFO. Single writer, cooperative scheduling."""
 
     def __init__(self, disk: SimDisk, name: str, owner=None,
-                 file_size_limit: int = 1 << 20):
+                 file_size_limit: int = None):
         self._disk = disk
         self._name = name
         self._owner = owner
+        if file_size_limit is None:
+            from ..flow import SERVER_KNOBS
+            file_size_limit = int(SERVER_KNOBS.disk_queue_file_size)
         self._limit = file_size_limit
         self._files: List[SimFile] = [
             disk.open(f"{name}.dq0", owner), disk.open(f"{name}.dq1", owner)]

@@ -27,8 +27,6 @@ from . import dbinfo as dbi
 from .dbinfo import LogSetInfo, ServerDBInfo
 from .types import CommitRequest, TLogLockRequest
 
-VERSIONS_PER_SECOND = 1_000_000          # ref: Knobs.cpp VERSIONS_PER_SECOND
-MAX_VERSION_ADVANCE = 5_000_000          # cap per request (ref: :918)
 
 
 class GetCommitVersionReply(NamedTuple):
@@ -81,8 +79,10 @@ class Master:
         if self._last_time is None:
             advance = 1
         else:
-            advance = max(1, min(MAX_VERSION_ADVANCE,
-                                 int(VERSIONS_PER_SECOND * (t - self._last_time))))
+            advance = max(1, min(
+                flow.SERVER_KNOBS.max_version_advance,
+                int(flow.SERVER_KNOBS.versions_per_second
+                    * (t - self._last_time))))
         self._last_time = t
         prev = self.version
         self.version = prev + advance
@@ -149,13 +149,18 @@ class MasterRecovery:
             self._set_state(dbi.LOCKING_CSTATE)
             recovery_version, locked = await self._epoch_end(prev)
             old_log_sets = (LogSetInfo(prev.epoch, prev.recovery_version,
-                                       recovery_version, locked),)
-            # older generations still draining chain through
+                                       recovery_version, locked,
+                                       stores=prev.logs),)
+            # older generations still draining chain through. Store
+            # NAMES are carried even when a store is unreachable right
+            # now: its worker may still be rebooting, and dropping the
+            # name would orphan the generation's records forever
             for oe, ob, oend, stores in prev.old_logs:
                 refs = tuple(r for r in (self.cc.log_stores.get(s)
                                          for s, _m in stores)
                              if r is not None)
-                old_log_sets += (LogSetInfo(oe, ob, oend, refs),)
+                old_log_sets += (LogSetInfo(oe, ob, oend, refs,
+                                            stores=tuple(stores)),)
         self.epoch = (prev.epoch if prev is not None else 0) + 1
 
         # Phase 3: recruit the new transaction subsystem
@@ -231,9 +236,13 @@ class MasterRecovery:
         # Phase 4: commit the new core state; a conflict means a newer
         # master exists and this one must die (ref: trackTlogRecovery /
         # cstate.write exclusivity)
+        # persist every member store's NAME, reachable or not — the
+        # cstate must preserve the rejoin-by-name invariant across
+        # back-to-back recoveries or a down store's generation would be
+        # orphaned forever (readers would then wait on it forever)
         old_for_cstate = tuple(
             (ls.epoch, ls.begin_version, ls.end_version,
-             tuple((r.store, r.machine) for r in ls.logs))
+             ls.stores or tuple((r.store, r.machine) for r in ls.logs))
             for ls in old_log_sets)
         await self.cstate.set_exclusive(CoreState(
             self.epoch, recovery_version, tuple(new_log_stores),
@@ -242,7 +251,8 @@ class MasterRecovery:
         # Phase 5: broadcast the new picture; commits may now flow
         info = ServerDBInfo(
             self.epoch, dbi.ACCEPTING_COMMITS, recovery_version, proxies,
-            LogSetInfo(self.epoch, recovery_version, -1, tuple(new_logs)),
+            LogSetInfo(self.epoch, recovery_version, -1, tuple(new_logs),
+                       stores=tuple(new_log_stores)),
             old_log_sets, self.cc.dbinfo.get().storages)
         self.cc.publish(info)
         self._trace("MasterRecoveryState", State=dbi.ACCEPTING_COMMITS,
@@ -290,7 +300,8 @@ class MasterRecovery:
             locked = []
             if refs:
                 futs = [flow.catch_errors(flow.timeout_error(
-                    r.locks.get_reply(TLogLockRequest(), self.process), 2.0))
+                    r.locks.get_reply(TLogLockRequest(), self.process),
+                    flow.SERVER_KNOBS.tlog_lock_timeout))
                     for r in refs]
                 settled = await flow.all_of(futs)
                 locked = [(r, f.get()) for r, f in zip(refs, settled)
@@ -302,7 +313,8 @@ class MasterRecovery:
             # a surviving store (ref: recovery waits for tlogs)
             self._trace("MasterRecoveryWaitingForLogs",
                         Stores=",".join(s for s, _m in prev.logs))
-            await flow.delay(0.5, TaskPriority.CLUSTER_CONTROLLER)
+            await flow.delay(flow.SERVER_KNOBS.recovery_wait_for_logs_delay,
+                             TaskPriority.CLUSTER_CONTROLLER)
 
     async def _resolution_balancing(self, metric_refs) -> None:
         """Shift key-range ownership from the most- to the least-loaded
@@ -316,9 +328,12 @@ class MasterRecovery:
         last_work = [0] * n
         last_hist = [[0] * 256 for _ in range(n)]
         while True:
-            await flow.delay(2.0, TaskPriority.RESOLUTION_METRICS)
+            await flow.delay(flow.SERVER_KNOBS.resolution_balancing_interval,
+                             TaskPriority.RESOLUTION_METRICS)
             settled = await flow.all_of([flow.catch_errors(
-                flow.timeout_error(ref.get_reply(None, self.process), 2.0))
+                flow.timeout_error(
+                    ref.get_reply(None, self.process),
+                    flow.SERVER_KNOBS.resolution_metrics_timeout))
                 for ref in metric_refs])
             if any(f.is_error for f in settled):
                 continue
@@ -331,7 +346,8 @@ class MasterRecovery:
             last_hist = [list(r.key_hist) for r in replies]
             hi = max(range(n), key=lambda i: dwork[i])
             lo = min(range(n), key=lambda i: dwork[i])
-            if dwork[hi] < 100 or dwork[hi] <= 2 * (dwork[lo] + 1):
+            if dwork[hi] < flow.SERVER_KNOBS.resolution_balancing_min_work \
+                    or dwork[hi] <= 2 * (dwork[lo] + 1):
                 continue
             bucket = max(range(256), key=lambda b: dhist[hi][b])
             moved = dhist[hi][bucket]
@@ -352,7 +368,8 @@ class MasterRecovery:
         every storage server has pulled past its end (ref: the oldest
         log epoch retiring in TagPartitionedLogSystem)."""
         while True:
-            await flow.delay(1.0, TaskPriority.CLUSTER_CONTROLLER)
+            await flow.delay(flow.SERVER_KNOBS.old_log_cleanup_interval,
+                             TaskPriority.CLUSTER_CONTROLLER)
             info = self.cc.dbinfo.get()
             if not info.old_logs:
                 continue
@@ -362,6 +379,12 @@ class MasterRecovery:
                 # an active backup tail must drain a generation before
                 # it retires, or the mutation log gets a silent hole
                 floor = min(floor, agent._tailed_to)
+            region = getattr(self.cc, "region", None)
+            if region is not None:
+                # same rule for the region log router: retiring a
+                # generation it has not shipped would stall it forever
+                # under the strict source-coverage rule
+                floor = min(floor, region._pushed_to)
             keep = tuple(ls for ls in info.old_logs
                          if ls.end_version > floor)
             if len(keep) != len(info.old_logs):

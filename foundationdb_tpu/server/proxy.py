@@ -169,8 +169,10 @@ class Proxy:
         self.backup_active = False
         self.region_active = False
         self.tlog_refs = list(tlog_refs)
-        batch_window = max(batch_window,
-                           SERVER_KNOBS.commit_transaction_batch_interval_min)
+        batch_window = min(
+            max(batch_window,
+                SERVER_KNOBS.commit_transaction_batch_interval_min),
+            SERVER_KNOBS.max_commit_batch_interval)
         max_batch = min(max_batch,
                         SERVER_KNOBS.commit_transaction_batch_count_max)
         if flow.buggify("proxy/small_batch_window"):
@@ -270,8 +272,10 @@ class Proxy:
             if self._rate <= 0:
                 tokens = 0.0
             else:
-                tokens = min(tokens + self._rate * (now - last),
-                             max(1.0, self._rate * 10 * interval))
+                tokens = min(
+                    tokens + self._rate * (now - last),
+                    max(1.0, self._rate
+                        * SERVER_KNOBS.grv_burst_intervals * interval))
             last = now
             if not self._grv_queue:
                 continue
@@ -315,7 +319,7 @@ class Proxy:
             version = self.committed_version.get()
             if self._peers:
                 futs = [flow.timeout_error(p.get_reply(None, self.process),
-                                           2.0)
+                                           SERVER_KNOBS.grv_confirm_timeout)
                         for p in self._peers]
                 others = await flow.all_of(futs)
                 version = max([version] + list(others))
@@ -332,11 +336,13 @@ class Proxy:
         while True:
             try:
                 r = await flow.timeout_error(
-                    self._ratekeeper_ref.get_reply(None, self.process), 1.0)
+                    self._ratekeeper_ref.get_reply(None, self.process),
+                    SERVER_KNOBS.ratekeeper_poll_timeout)
                 self._rate = r.tps
             except flow.FdbError:
                 pass  # keep the last known rate
-            await flow.delay(0.1, TaskPriority.PROXY_GRV_TIMER)
+            await flow.delay(SERVER_KNOBS.grv_rate_poll_interval,
+                             TaskPriority.PROXY_GRV_TIMER)
 
     async def _raw_committed_loop(self):
         while True:

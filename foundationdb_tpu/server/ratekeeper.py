@@ -25,9 +25,8 @@ from .. import flow
 from ..flow import SERVER_KNOBS, TaskPriority
 from ..rpc import RequestStream, SimProcess
 
-MAX_RATE = 1e9          # "unlimited" (ref: the rate when nothing limits)
-MIN_RATE = 10.0         # survival trickle (keeps recovery txns moving)
-TLOG_BACKLOG_LIMIT = 10_000   # unpopped records before throttling
+# rate bounds + backlog threshold live in the RK_* knobs (ref:
+# Ratekeeper.actor.cpp limit computation)
 
 
 class GetRateReply(NamedTuple):
@@ -38,7 +37,7 @@ class Ratekeeper:
     def __init__(self, process: SimProcess, cc):
         self.process = process
         self.cc = cc
-        self.rate = MAX_RATE
+        self.rate = flow.SERVER_KNOBS.rk_max_rate
         self.get_rate = RequestStream(process)
         self._actors = flow.ActorCollection()
 
@@ -60,7 +59,8 @@ class Ratekeeper:
 
     async def _update_loop(self):
         while True:
-            await flow.delay(0.1, TaskPriority.RATEKEEPER)
+            await flow.delay(flow.SERVER_KNOBS.rk_update_interval,
+                             TaskPriority.RATEKEEPER)
             self.rate = self._compute_rate()
 
     def _compute_rate(self) -> float:
@@ -76,7 +76,7 @@ class Ratekeeper:
             obj = self.cc._storage_objs.get(rep.name)
             if obj is None or not obj.process.alive:
                 # a dead replica: lag is unbounded until it rejoins
-                return MIN_RATE
+                return flow.SERVER_KNOBS.rk_min_rate
             if obj.kv is None:
                 continue  # no engine: the durability loop is inert and
                 # lag is meaningless (defensive; cluster-recruited
@@ -86,15 +86,15 @@ class Ratekeeper:
             worst_excess = max(worst_excess, excess)
         backlog = max((len(t.entries) for t in self.cc.tlog_objs()),
                       default=0)
-        if backlog > TLOG_BACKLOG_LIMIT:
-            return MIN_RATE
+        if backlog > flow.SERVER_KNOBS.rk_tlog_backlog_limit:
+            return flow.SERVER_KNOBS.rk_min_rate
         target = window // 5    # distress threshold for excess lag
         if worst_excess <= target:
-            return MAX_RATE
+            return flow.SERVER_KNOBS.rk_max_rate
         if worst_excess >= window:
-            return MIN_RATE
+            return flow.SERVER_KNOBS.rk_min_rate
         frac = 1.0 - (worst_excess - target) / max(1, window - target)
-        return max(MIN_RATE, MAX_RATE * frac * frac)
+        return max(flow.SERVER_KNOBS.rk_min_rate, flow.SERVER_KNOBS.rk_max_rate * frac * frac)
 
 from ..rpc import wire as _wire
 

@@ -46,7 +46,7 @@ class Resolver:
         self._reply_order: deque[int] = deque()
         # a tiny cache stresses the duplicate-delivery fallback path
         self._cache_cap = 2 if flow.buggify("resolver/small_reply_cache") \
-            else 256
+            else int(SERVER_KNOBS.resolver_reply_cache_size)
 
     def start(self) -> None:
         self._actors.add(flow.spawn(self._resolve_loop(),

@@ -496,15 +496,18 @@ class StorageServer:
             if src is None:
                 await flow.first_of(
                     self.dbinfo.on_change(),
-                    flow.delay(0.2, TaskPriority.UPDATE_STORAGE))
+                    flow.delay(flow.SERVER_KNOBS.storage_pull_idle_delay,
+                               TaskPriority.UPDATE_STORAGE))
                 continue
             gen, refs = src
             try:
                 reply = await flow.timeout_error(refs.peeks.get_reply(
-                    TLogPeekRequest(needed, self.tag), self.process), 5.0)
+                    TLogPeekRequest(needed, self.tag), self.process),
+                    SERVER_KNOBS.storage_peek_timeout)
             except flow.FdbError:
                 self._replica_rr += 1  # rotate to another replica
-                await flow.delay(0.05, TaskPriority.UPDATE_STORAGE)
+                await flow.delay(SERVER_KNOBS.storage_rollback_delay,
+                                 TaskPriority.UPDATE_STORAGE)
                 continue
             cap = gen.end_version if gen.end_version >= 0 else None
             before = self.version.get()
@@ -519,7 +522,8 @@ class StorageServer:
                 # lacks the generation's tail (it died behind its peers):
                 # rotate instead of re-peeking it forever
                 self._replica_rr += 1
-                await flow.delay(0.05, TaskPriority.UPDATE_STORAGE)
+                await flow.delay(SERVER_KNOBS.storage_rollback_delay,
+                                 TaskPriority.UPDATE_STORAGE)
 
     def _apply_peek(self, reply, cap: Optional[int]) -> None:
         if reply.known_committed > self.known_committed:
@@ -573,16 +577,13 @@ class StorageServer:
         return tuple(out)
 
     def _pick_source(self, needed: int):
-        """The generation that owns `needed`, and one of its replicas."""
-        info = self.dbinfo.get()
-        gens = sorted(info.old_logs, key=lambda g: g.end_version)
-        for gen in gens:
-            if gen.end_version >= needed and gen.logs:
-                return gen, gen.logs[self._replica_rr % len(gen.logs)]
-        cur = info.logs
-        if cur.logs:
-            return cur, cur.logs[self._replica_rr % len(cur.logs)]
-        return None
+        """The generation that OWNS `needed`, and one of its replicas
+        (see dbinfo.pick_log_source for the strict-coverage rule — a
+        non-covering generation's durable watermark would silently skip
+        records)."""
+        from .dbinfo import pick_log_source
+        return pick_log_source(self.dbinfo.get(), needed,
+                               self._replica_rr)
 
     def _maybe_rollback(self) -> None:
         """A new epoch whose recovery version is below what we pulled
@@ -614,7 +615,8 @@ class StorageServer:
         if self.kv is None:
             return
         while True:
-            await flow.delay(0.05, TaskPriority.UPDATE_STORAGE)
+            await flow.delay(SERVER_KNOBS.storage_commit_interval,
+                             TaskPriority.UPDATE_STORAGE)
             # never make durable a version that could still be rolled
             # back by an epoch recovery: cap at the highest version known
             # replicated across the whole log set (ref: storageserver
@@ -934,7 +936,8 @@ class StorageServer:
         WATCH timeout, DEFAULT_MAX_WATCHES/timeout handling) — expired
         waiters get timed_out; a live client just re-arms."""
         while True:
-            await flow.delay(30.0, TaskPriority.LOW_PRIORITY)
+            await flow.delay(flow.SERVER_KNOBS.watch_expiry_sweep_interval,
+                             TaskPriority.LOW_PRIORITY)
             now = flow.now()
             for k in list(self._watch_map):
                 keep = []

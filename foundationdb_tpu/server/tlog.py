@@ -44,11 +44,12 @@ def _payload_bytes(tagged) -> int:
 
 class TLog:
     def __init__(self, process: SimProcess, disk: Optional[SimDisk] = None,
-                 name: str = "tlog", fsync_delay: float = 0.0005,
+                 name: str = "tlog", fsync_delay: Optional[float] = None,
                  recovery_version: int = 0):
         self.process = process
         self.name = name
-        self.fsync_delay = fsync_delay
+        self.fsync_delay = (fsync_delay if fsync_delay is not None
+                            else flow.SERVER_KNOBS.tlog_fsync_delay)
         self._dq = (DiskQueue(disk, name, owner=process)
                     if disk is not None else None)
         # [(version, tagged_mutations, seq)] sorted by version; a
@@ -301,7 +302,8 @@ class TLog:
             # throttle: the reader will re-peek the same version forever
             # (no progress is possible); don't let that become a hot
             # RPC loop that floods the scheduler and the trace file
-            await flow.delay(1.0, TaskPriority.LOW_PRIORITY)
+            await flow.delay(flow.SERVER_KNOBS.tlog_stalled_peek_delay,
+                             TaskPriority.LOW_PRIORITY)
             reply.send(TLogPeekReply((), req.begin_version - 1,
                                      self.known_committed))
             return
@@ -335,7 +337,8 @@ class TLog:
                     flow.TraceEvent("TLogPeekRecordFreed", self.name,
                                     severity=flow.SevError).detail(
                         Tag=req.tag, Version=v).log()
-                    await flow.delay(1.0, TaskPriority.LOW_PRIORITY)
+                    await flow.delay(flow.SERVER_KNOBS.tlog_stalled_peek_delay,
+                                     TaskPriority.LOW_PRIORITY)
                     reply.send(TLogPeekReply(
                         tuple(out), max(0, v - 1), self.known_committed))
                     return

@@ -1,0 +1,62 @@
+"""Knob registry hygiene + BUGGIFY distortion coverage.
+
+Ref: flow/Knobs.cpp `init(NAME, default)` with `if(randomize && BUGGIFY)`
+distortions. Two properties the round-3 verdict asked to make real:
+every registered knob is actually READ by code (a dead knob is a lie
+about the tunable surface), and the distortion machinery actually
+produces distorted values under a buggified seed."""
+
+import pathlib
+import re
+import subprocess
+
+from foundationdb_tpu import flow
+from foundationdb_tpu.flow.knobs import make_server_knobs
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_every_knob_is_consumed():
+    k = make_server_knobs()
+    unconsumed = []
+    for name in k._defaults:
+        r = subprocess.run(
+            ["grep", "-rl", name.lower(), "foundationdb_tpu/", "bench.py",
+             "--include=*.py"], capture_output=True, text=True, cwd=REPO)
+        files = [f for f in r.stdout.split()
+                 if not f.endswith("flow/knobs.py")]
+        if not files:
+            unconsumed.append(name)
+    assert not unconsumed, f"dead knobs (registered, never read): {unconsumed}"
+
+
+def test_knob_surface_size():
+    k = make_server_knobs()
+    assert len(k._defaults) >= 80, len(k._defaults)
+    # distortion surface: at least a quarter of the knobs can be
+    # BUGGIFY-randomized (control-flow knobs)
+    src = (REPO / "foundationdb_tpu/flow/knobs.py").read_text()
+    assert len(re.findall(r"lambda", src)) >= 25
+
+
+def test_buggify_actually_distorts():
+    """Across a handful of seeds, SOME knob must come up distorted —
+    and with buggify off, none may."""
+    try:
+        distorted = set()
+        for seed in range(12):
+            flow.set_seed(seed, buggify_enabled=True)
+            k = make_server_knobs(randomize=True)
+            for name, default in k._defaults.items():
+                if getattr(k, name.lower()) != default:
+                    distorted.add(name)
+        assert len(distorted) >= 3, distorted
+
+        flow.set_seed(0, buggify_enabled=False)
+        k = make_server_knobs(randomize=False)
+        for name, default in k._defaults.items():
+            assert getattr(k, name.lower()) == default, name
+    finally:
+        # restore the ambient registry for later tests in this process
+        flow.set_seed(0, buggify_enabled=False)
+        flow.reset_server_knobs(randomize=False)
