@@ -119,10 +119,10 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("BACKUP_TAIL_IDLE_DELAY", 0.1)
     init("BACKUP_PEEK_TIMEOUT", 2.0)
     init("BACKUP_SOURCE_RETRY_DELAY", 0.2)
-    init("BACKUP_NUDGE_INTERVAL", 0.1)
+    init("BACKUP_NUDGE_INTERVAL", 0.05)
 
     # -- simulation environment (ref: sim2 latency/reboot model) -------
-    init("SIM_REBOOT_DELAY", 1.0, lambda: 5.0)
+    init("SIM_REBOOT_DELAY", 0.5, lambda: 5.0)
     init("QUIET_DATABASE_POLL", 0.25)
     init("SIM_LATENCY_MIN", 0.0002)
     init("SIM_LATENCY_MAX", 0.002, lambda: 0.02)
@@ -143,12 +143,9 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("CONSISTENCY_CHECK_PAGE_ROWS", 10_000, lambda: 7)
     init("CONSISTENCY_CHECK_READ_TIMEOUT", 30.0)
 
-    # -- engines (ref: page/file sizing knobs). The btree constants are
-    # read at module import (on-disk format must stay constant within a
-    # process), so they are settable but not BUGGIFY-randomized
+    # -- engines (ref: page/file sizing knobs; btree page geometry is a
+    # module constant — an on-disk format, not a runtime tunable)
     init("DISK_QUEUE_FILE_SIZE", 1 << 20, lambda: 4096)
-    init("BTREE_PAGE_SIZE", 4096)
-    init("BTREE_MAX_FANOUT", 32)
 
     # -- real TCP transport (wall-clock; never BUGGIFY-distorted) ------
     init("TCP_HANDSHAKE_TIMEOUT", 5.0)

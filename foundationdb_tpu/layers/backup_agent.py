@@ -205,19 +205,58 @@ class BackupAgent:
         await self._wait_until(lambda: self._tailed_to >= version, max_wait)
 
     # -- container -------------------------------------------------------
+    def save_to(self, container, chunk_records: int = 500) -> dict:
+        """Write this backup into a container using the reference's
+        file layout: one snapshot object + chunked mutation-log objects
+        whose names carry their version coverage (ref: BackupContainer
+        snapshots/ + logs/ naming). Returns the container's describe().
+        Plain sync object IO — the agent tool runs it outside the
+        simulation loop, like fdbbackup writing to its target."""
+        from .backup_container import _records_to_log_blob
+        if self.base_blob is None:
+            raise ValueError("backup has no snapshot yet (start() first)")
+        container.store_snapshot(self.base_blob, self.base_version)
+        recs = [r for r in self.log_records if r[0] > self.base_version]
+        prev_end = self.base_version
+        i = 0
+        while i < len(recs):
+            chunk = recs[i:i + chunk_records]
+            i += chunk_records
+            end = chunk[-1][0]
+            if i >= len(recs):
+                # the final chunk's coverage extends to the tail
+                # frontier: versions with no backup-tagged payload are
+                # still certified mutation-free up to there
+                end = max(end, self._tailed_to)
+            container.store_log(
+                _records_to_log_blob(chunk, self.base_version),
+                prev_end, end)
+            prev_end = end
+        if not recs and self._tailed_to > self.base_version:
+            container.store_log(
+                _records_to_log_blob([], self.base_version),
+                self.base_version, self._tailed_to)
+        return container.describe()
+
     def write_log(self) -> bytes:
-        out = [LOG_MAGIC, _U64.pack(self.base_version),
-               _U64.pack(len(self.log_records))]
-        for v, mutations in self.log_records:
-            out.append(_U64.pack(v))
-            out.append(_U32.pack(len(mutations)))
-            for m in mutations:
-                out.append(bytes([m.type]))
-                out.append(_U32.pack(len(m.param1)))
-                out.append(m.param1)
-                out.append(_U32.pack(len(m.param2)))
-                out.append(m.param2)
-        return b"".join(out)
+        return encode_log(self.log_records, self.base_version)
+
+
+def encode_log(records, base_version: int) -> bytes:
+    """The mutation-log wire format (one encoder, one decoder —
+    read_log below): MAGIC, base version, then (version, mutations)
+    records."""
+    out = [LOG_MAGIC, _U64.pack(base_version), _U64.pack(len(records))]
+    for v, mutations in records:
+        out.append(_U64.pack(v))
+        out.append(_U32.pack(len(mutations)))
+        for m in mutations:
+            out.append(bytes([m.type]))
+            out.append(_U32.pack(len(m.param1)))
+            out.append(m.param1)
+            out.append(_U32.pack(len(m.param2)))
+            out.append(m.param2)
+    return b"".join(out)
 
 
 def read_log(blob: bytes):

@@ -26,13 +26,11 @@ from typing import Dict, List, Optional, Tuple
 from ..rpc.disk import SimDisk
 from .kvstore import IKeyValueStore
 
-from ..flow import SERVER_KNOBS as _K
-
-PAGE_SIZE = int(_K.btree_page_size)
+PAGE_SIZE = 4096
 _SUPER = struct.Struct("<IQQQQ")      # crc, commit_seq, root, next_page, nfree
 _PHDR = struct.Struct("<IBH")         # crc, kind, n_items
 _LEAF, _INNER, _FREE = 0, 1, 2
-MAX_FANOUT = int(_K.btree_max_fanout)  # split threshold (items/page)
+MAX_FANOUT = 32        # split threshold (items per page)
 # per-item limits keep any two items fitting one page, so byte-aware
 # splits always converge (the reference stores oversized values via
 # overflow pages; this engine enforces limits instead — fdbcli-visible

@@ -32,7 +32,7 @@ def test_every_knob_is_consumed():
 
 def test_knob_surface_size():
     k = make_server_knobs()
-    assert len(k._defaults) >= 80, len(k._defaults)
+    assert len(k._defaults) >= 78, len(k._defaults)
     # distortion surface: at least a quarter of the knobs can be
     # BUGGIFY-randomized (control-flow knobs)
     src = (REPO / "foundationdb_tpu/flow/knobs.py").read_text()
