@@ -632,7 +632,8 @@ OptBytes apply_atomic(int op, const OptBytes& existing,
             if (param.empty()) return std::string();
             if (!existing || existing->empty()) return param;
             return le_add_like(*existing, param, true, false);
-        case FDB_TPU_OP_AND: {
+        case FDB_TPU_OP_AND:
+        case FDB_TPU_OP_AND_V2: {
             if (!existing) return param; /* V2 semantics */
             std::string out(param);
             for (size_t k = 0; k < out.size(); k++) {
@@ -662,6 +663,7 @@ OptBytes apply_atomic(int op, const OptBytes& existing,
             if (!existing || existing->empty() || param.empty()) return param;
             return le_add_like(*existing, param, false, true);
         case FDB_TPU_OP_MIN:
+        case FDB_TPU_OP_MIN_V2:
             if (!existing) return param; /* V2 semantics */
             if (param.empty()) return param;
             return le_add_like(*existing, param, false, false);
@@ -684,7 +686,8 @@ bool is_atomic_op(int op) {
         case FDB_TPU_OP_ADD: case FDB_TPU_OP_AND: case FDB_TPU_OP_OR:
         case FDB_TPU_OP_XOR: case FDB_TPU_OP_APPEND_IF_FITS:
         case FDB_TPU_OP_MAX: case FDB_TPU_OP_MIN: case FDB_TPU_OP_BYTE_MIN:
-        case FDB_TPU_OP_BYTE_MAX: case FDB_TPU_OP_COMPARE_AND_CLEAR:
+        case FDB_TPU_OP_BYTE_MAX: case FDB_TPU_OP_MIN_V2:
+        case FDB_TPU_OP_AND_V2: case FDB_TPU_OP_COMPARE_AND_CLEAR:
             return true;
         default:
             return false;

@@ -57,6 +57,8 @@ enum {
     FDB_TPU_OP_SET_VERSIONSTAMPED_VALUE = 15,
     FDB_TPU_OP_BYTE_MIN = 16,
     FDB_TPU_OP_BYTE_MAX = 17,
+    FDB_TPU_OP_MIN_V2 = 18,  /* MIN already applies V2 semantics */
+    FDB_TPU_OP_AND_V2 = 19,
     FDB_TPU_OP_COMPARE_AND_CLEAR = 20,
 };
 

@@ -21,10 +21,10 @@ from ..rpc import NetworkRef, SimProcess
 from ..server import atomic as _atomic
 from ..server.cluster_controller import \
     OpenDatabaseRequest as _OpenDatabaseRequest
-from ..server.types import (ADD_VALUE, AND, APPEND_IF_FITS, ATOMIC_OPS,
-                            BYTE_MAX, BYTE_MIN, CLEAR_RANGE,
+from ..server.types import (ADD_VALUE, AND, AND_V2, APPEND_IF_FITS,
+                            ATOMIC_OPS, BYTE_MAX, BYTE_MIN, CLEAR_RANGE,
                             COMPARE_AND_CLEAR, CommitRequest, KeySelector,
-                            MAX, MIN, MutationRef, OR, SET_VALUE,
+                            MAX, MIN, MIN_V2, MutationRef, OR, SET_VALUE,
                             SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
                             StorageGetKeyRequest, StorageGetRangeRequest,
                             StorageGetRequest, StorageWatchRequest, XOR)
@@ -34,6 +34,7 @@ _ATOMIC_APPLY = {
     XOR: _atomic.bit_xor, APPEND_IF_FITS: _atomic.append_if_fits,
     MAX: _atomic.vmax, MIN: _atomic.vmin, BYTE_MIN: _atomic.byte_min,
     BYTE_MAX: _atomic.byte_max, COMPARE_AND_CLEAR: _atomic.compare_and_clear,
+    MIN_V2: _atomic.vmin, AND_V2: _atomic.bit_and,
 }
 
 RETRYABLE = {"not_committed", "transaction_too_old", "future_version",

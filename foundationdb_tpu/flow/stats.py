@@ -8,7 +8,8 @@ status; a trace loop would emit them as TraceEvents in production.
 
 from __future__ import annotations
 
-from typing import Dict
+from bisect import bisect_left
+from typing import Dict, Tuple
 
 class Counter:
     __slots__ = ("name", "value")
@@ -40,3 +41,40 @@ class CounterCollection:
 
     def snapshot(self) -> Dict[str, int]:
         return {n: c.value for n, c in self.counters.items()}
+
+
+# thresholds in seconds (ref: LatencyBandConfig's default band edges —
+# status reports how many requests finished within each band)
+DEFAULT_BANDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0)
+
+
+class LatencyBands:
+    """Banded latency histogram (ref: fdbserver/LatencyBandConfig.cpp +
+    the latency_band_included counters in status): each recorded
+    latency increments every band whose threshold it fits under, plus
+    a total — so a consumer reads "fraction under X seconds" directly.
+    """
+
+    __slots__ = ("name", "bands", "counts", "total", "max_seen")
+
+    def __init__(self, name: str, bands: Tuple[float, ...] = DEFAULT_BANDS):
+        self.name = name
+        self.bands = tuple(bands)
+        self.counts = [0] * len(self.bands)
+        self.total = 0
+        self.max_seen = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.total += 1
+        if seconds > self.max_seen:
+            self.max_seen = seconds
+        for i in range(bisect_left(self.bands, seconds),
+                       len(self.bands)):
+            self.counts[i] += 1
+
+    def snapshot(self) -> dict:
+        return {"total": self.total,
+                "max_seconds": round(self.max_seen, 6),
+                "bands": {f"<={t:g}s": c
+                          for t, c in zip(self.bands, self.counts)}}

@@ -292,7 +292,8 @@ def _replay_mutations(tr, mutations) -> None:
     serves both paths). System-key mutations (the \\xff\\x02 stored
     subspace rides the backup tag like everything else) need the
     option, exactly as the reference's restore does."""
-    from ..server.types import ATOMIC_OPS, CLEAR_RANGE, SET_VALUE
+    from ..server.types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS,
+                                SET_VALUE)
     tr.set_option("access_system_keys")
     for m in mutations:
         if m.type == SET_VALUE:
@@ -301,6 +302,8 @@ def _replay_mutations(tr, mutations) -> None:
             tr.clear_range(m.param1, m.param2)
         elif m.type in ATOMIC_OPS:
             tr.atomic_op(m.param1, m.param2, m.type)
+        elif m.type in INERT_OPS:
+            pass  # debug markers/no-ops ride the log but mutate nothing
         else:
             raise ValueError(f"unreplayable mutation {m.type}")
 

@@ -113,8 +113,10 @@ class BackupContainer:
                 break
             chunk = self.get_object(f"logs/log,{b:020d},{e:020d}")
             _bv, recs = agent_mod.read_log(chunk)
+            # clip to (covered, target]: overlapping chunks (e.g. two
+            # save_to() calls) must not replay a record twice
             records.extend((v, ms) for v, ms in recs
-                           if base < v <= target)
+                           if covered < v <= target and v > base)
             covered = max(covered, e)
         if covered < target:
             raise ValueError(
@@ -149,9 +151,12 @@ class DirectoryContainer(BackupContainer):
 
     def _path(self, name: str) -> str:
         import os
-        # object names map to REAL subdirectories (bijective: no
-        # escaping scheme to collide distinct names)
-        parts = [p for p in name.split("/") if p not in ("", ".", "..")]
+        # object names map to REAL subdirectories; non-canonical names
+        # (empty/./.. segments) are rejected rather than normalized so
+        # distinct names can never collide on disk
+        parts = name.split("/")
+        if not parts or any(p in ("", ".", "..") for p in parts):
+            raise ValueError(f"non-canonical object name: {name!r}")
         return os.path.join(self._root, *parts)
 
     def put_object(self, name: str, data: bytes) -> None:

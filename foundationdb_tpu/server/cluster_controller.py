@@ -644,7 +644,9 @@ class ClusterController:
                     rentry.update(alive=obj.process.alive,
                                   version=obj.version.get(),
                                   durable_version=obj.durable_version.get(),
-                                  counters=obj.stats.snapshot())
+                                  counters=obj.stats.snapshot(),
+                                  latency_bands={
+                                      "read": obj.read_bands.snapshot()})
                 entry["replicas"].append(rentry)
             storages.append(entry)
         from .proxy import Proxy
@@ -657,7 +659,10 @@ class ClusterController:
                     proxies.append({
                         "name": rn,
                         "committed_version": role.committed_version.get(),
-                        "counters": role.stats.snapshot()})
+                        "counters": role.stats.snapshot(),
+                        "latency_bands": {
+                            "grv": role.grv_bands.snapshot(),
+                            "commit": role.commit_bands.snapshot()}})
                 elif isinstance(role, Ratekeeper) and \
                         rn.endswith(f"-e{info.epoch}"):
                     rate = role.rate

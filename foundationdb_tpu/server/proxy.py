@@ -26,11 +26,20 @@ from .. import flow
 from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
-from .types import (CLEAR_RANGE, PRIORITY_DEFAULT, PRIORITY_IMMEDIATE,
-                    SET_VALUE, SET_VERSIONSTAMPED_KEY,
-                    SET_VERSIONSTAMPED_VALUE, CommitReply, CommitRequest,
-                    GetReadVersionReply, MutationRef, ResolveRequest,
-                    TLogCommitRequest, TaggedMutation, mutation_bytes)
+from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS,
+                    PRIORITY_DEFAULT, PRIORITY_IMMEDIATE, SET_VALUE,
+                    SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
+                    CommitReply, CommitRequest, GetReadVersionReply,
+                    MutationRef, ResolveRequest, TLogCommitRequest,
+                    TaggedMutation, mutation_bytes)
+
+# the mutation types a transaction may carry (ref: the commit path
+# asserting isValidMutationType — AvailableForReuse and the
+# LogProtocolMessage escape are never legal in a transaction)
+LEGAL_MUTATIONS = (frozenset({SET_VALUE, CLEAR_RANGE,
+                              SET_VERSIONSTAMPED_KEY,
+                              SET_VERSIONSTAMPED_VALUE})
+                   | ATOMIC_OPS | INERT_OPS)
 
 
 def make_versionstamp(version: int, batch_index: int) -> bytes:
@@ -198,6 +207,10 @@ class Proxy:
         self._grv_inflight = []        # batch being confirmed right now
         # (ref: ProxyStats — txn admission/commit counters for status)
         self.stats = flow.CounterCollection("proxy")
+        # banded request latencies (ref: LatencyBandConfig applied to
+        # GRV and commit in status)
+        self.grv_bands = flow.LatencyBands("grv")
+        self.commit_bands = flow.LatencyBands("commit")
         self.commits = RequestStream(process)
         self.grvs = RequestStream(process)
         self.raw_committed = RequestStream(process)
@@ -255,7 +268,7 @@ class Proxy:
             req, reply = await self.grvs.pop()
             count = getattr(req, "transaction_count", None) or 1
             prio = getattr(req, "priority", PRIORITY_DEFAULT)
-            self._grv_queue.append((reply, count, prio))
+            self._grv_queue.append((reply, count, prio, flow.now()))
 
     async def _grv_batcher(self):
         """Release queued GRVs in rate-gated batches; one causal
@@ -286,7 +299,7 @@ class Proxy:
             take = 0
             charged = 0
             while take < len(self._grv_queue):
-                _r, cnt, prio = self._grv_queue[take]
+                _r, cnt, prio, _t = self._grv_queue[take]
                 if prio < PRIORITY_IMMEDIATE:
                     if charged + cnt > tokens:
                         break
@@ -325,7 +338,9 @@ class Proxy:
                 version = max([version] + list(others))
             self.stats.counter("transactions_started").add(
                 sum(e[1] for e in batch))
+            now = flow.now()
             for entry in batch:
+                self.grv_bands.record(now - entry[3])
                 entry[0].send(GetReadVersionReply(version))
         except flow.FdbError as e:
             for entry in batch:
@@ -443,6 +458,7 @@ class Proxy:
                        TaskPriority.PROXY_COMMIT)
 
     async def _commit_batch(self, batch, local: int):
+        t0 = flow.now()
         reqs = [r for r, _ in batch]
         replies = [p for _, p in batch]
         try:
@@ -451,6 +467,20 @@ class Proxy:
             # always advances the interlocks so a failed batch can never
             # wedge its successors)
             await self.batch_resolving.when_at_least(local - 1)
+            # reject illegal mutation types BEFORE resolution: an
+            # illegal txn must not register write-conflict ranges the
+            # pipeline will never log (phantom aborts for others)
+            illegal = set()
+            for idx, req in enumerate(reqs):
+                if any(m.type not in LEGAL_MUTATIONS
+                       for m in req.mutations):
+                    flow.cover("proxy.commit.illegal_mutation")
+                    illegal.add(idx)
+            if illegal:
+                reqs = [r._replace(read_conflict_ranges=(),
+                                   write_conflict_ranges=(), mutations=())
+                        if i in illegal else r
+                        for i, r in enumerate(reqs)]
             ver = await self.master_ref.get_reply(self._moves_seen,
                                                   self.process)
             # apply version-stamped keyResolvers moves BEFORE routing:
@@ -487,7 +517,7 @@ class Proxy:
             # — tag assignment per mutation via keyServers)
             mutations = []
             for idx, (req, verdict) in enumerate(zip(reqs, verdicts)):
-                if verdict != COMMITTED:
+                if verdict != COMMITTED or idx in illegal:
                     continue
                 stamp = None
                 for m in req.mutations:
@@ -520,8 +550,12 @@ class Proxy:
             # phase 5: per-transaction replies
             st = self.stats
             st.counter("commit_batches").add(1)
+            elapsed = flow.now() - t0
             for idx, (verdict, reply) in enumerate(zip(verdicts, replies)):
-                if verdict == COMMITTED:
+                self.commit_bands.record(elapsed)
+                if idx in illegal:
+                    reply.send_error(error("client_invalid_operation"))
+                elif verdict == COMMITTED:
                     st.counter("transactions_committed").add(1)
                     reply.send(CommitReply(ver.version, idx))
                 elif verdict == TOO_OLD:

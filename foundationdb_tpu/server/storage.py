@@ -27,11 +27,13 @@ from ..flow import (SERVER_KNOBS, Future, NotifiedVersion, TaskPriority,
 from ..rpc import NetworkRef, RequestStream, SimProcess
 from . import atomic
 from .kvstore import IKeyValueStore
-from .types import (ADD_VALUE, AND, APPEND_IF_FITS, BYTE_MAX, BYTE_MIN,
-                    CLEAR_RANGE, COMPARE_AND_CLEAR, KeySelector, MAX, MIN,
-                    MutationRef, OR, SET_VALUE, StorageGetKeyRequest,
+from .types import (ADD_VALUE, AND, AND_V2, APPEND_IF_FITS, BYTE_MAX,
+                    BYTE_MIN, CLEAR_RANGE, COMPARE_AND_CLEAR, INERT_OPS,
+                    KeySelector, MAX, MIN, MIN_V2, MutationRef, OR,
+                    SET_VALUE, StorageGetKeyRequest,
                     StorageGetRangeRequest, StorageGetRequest,
-                    StorageWatchRequest, TLogPeekRequest, TLogPopRequest, XOR)
+                    StorageWatchRequest, TLogPeekRequest, TLogPopRequest,
+                    XOR)
 
 DURABLE_VERSION_KEY = b"\xff\xff/storageDurableVersion"
 SHARD_META_KEY = b"\xff\xff/shardMeta"   # persisted tag + owned range
@@ -112,6 +114,8 @@ _ATOMIC_APPLY = {
     APPEND_IF_FITS: atomic.append_if_fits,
     MAX: atomic.vmax,
     MIN: atomic.vmin,
+    MIN_V2: atomic.vmin,       # MIN already applies V2 semantics
+    AND_V2: atomic.bit_and,    # ...as does AND
     BYTE_MIN: atomic.byte_min,
     BYTE_MAX: atomic.byte_max,
     COMPARE_AND_CLEAR: atomic.compare_and_clear,
@@ -201,6 +205,10 @@ class VersionedMap:
             existing = self.get(m.param1, version)
             self._set(version, m.param1, _ATOMIC_APPLY[m.type](existing,
                                                                m.param2))
+        elif m.type in INERT_OPS:
+            # DebugKeyRange/DebugKey/NoOp ride the commit stream but
+            # never change data (ref: applyMutation ignoring them)
+            pass
         else:
             raise error("client_invalid_operation")
 
@@ -408,6 +416,7 @@ class StorageServer:
         self._watch_map: Dict[bytes, list] = {}
         # (ref: StorageServer::counters — query/mutation accounting)
         self.stats = flow.CounterCollection("storage")
+        self.read_bands = flow.LatencyBands("read")
         self._actors = flow.ActorCollection()
         self.recovered = Future()   # engine recovery complete (fetchKeys
                                     # sources/destinations wait on this)
@@ -874,11 +883,14 @@ class StorageServer:
                 raise error("wrong_shard_server")
 
     async def _serve_get(self, req: StorageGetRequest, reply):
+        t0 = flow.now()
         try:
             self.stats.counter("get_queries").add(1)
             self._check_owned(req.key, None)
             await self._wait_version(req.version)
-            reply.send(self.data.get(req.key, req.version))
+            value = self.data.get(req.key, req.version)
+            self.read_bands.record(flow.now() - t0)
+            reply.send(value)
         except flow.FdbError as e:
             reply.send_error(e)
 

@@ -1,8 +1,7 @@
 """Shared transaction-subsystem types.
 
-Reference: fdbclient/CommitTransaction.h — `MutationRef` (:49-109, 21
-mutation types; the slice carries SetValue/ClearRange, atomic ops land
-with the storage engine work) and `CommitTransactionRef` (:136-168:
+Reference: fdbclient/CommitTransaction.h — `MutationRef` (:49-109, the
+full 21-type vocabulary) and `CommitTransactionRef` (:136-168:
 read/write conflict ranges + mutations + read_snapshot).
 """
 
@@ -13,20 +12,31 @@ from typing import NamedTuple, Optional, Sequence, Tuple
 SET_VALUE = 0
 CLEAR_RANGE = 1
 ADD_VALUE = 2
+DEBUG_KEY_RANGE = 3     # tracing marker: carried, never mutates data
+DEBUG_KEY = 4           # tracing marker
+NO_OP = 5
 AND = 6                 # applied with V2 (absent -> operand) semantics
 OR = 7
 XOR = 8
 APPEND_IF_FITS = 9
+AVAILABLE_FOR_REUSE = 10        # never legal in a transaction
+RESERVED_LOG_PROTOCOL = 11      # LogProtocolMessage escape, server-only
 MAX = 12
 MIN = 13                # applied with V2 semantics
 SET_VERSIONSTAMPED_KEY = 14
 SET_VERSIONSTAMPED_VALUE = 15
 BYTE_MIN = 16
 BYTE_MAX = 17
+MIN_V2 = 18             # explicit V2 code (MIN already applies V2)
+AND_V2 = 19
 COMPARE_AND_CLEAR = 20
 
 ATOMIC_OPS = frozenset({ADD_VALUE, AND, OR, XOR, APPEND_IF_FITS, MAX, MIN,
-                        BYTE_MIN, BYTE_MAX, COMPARE_AND_CLEAR})
+                        BYTE_MIN, BYTE_MAX, MIN_V2, AND_V2,
+                        COMPARE_AND_CLEAR})
+# inert through the pipeline: logged and shipped but mutate nothing
+# (ref: DebugKeyRange/DebugKey/NoOp in applyMutation)
+INERT_OPS = frozenset({DEBUG_KEY_RANGE, DEBUG_KEY, NO_OP})
 
 Range = Tuple[bytes, bytes]
 

@@ -210,7 +210,7 @@ def _make_script(seed: int, n_ops: int = 80):
     rng = random.Random(seed)
     keys = [b"bt/%02d" % i for i in range(14)] + \
            [b"bt/\x00bin", b"bt/\xfe\xff", b"bt/"]
-    atomic_ops = [2, 6, 7, 8, 9, 12, 13, 16, 17, 20]
+    atomic_ops = [2, 6, 7, 8, 9, 12, 13, 16, 17, 18, 19, 20]
 
     def rkey():
         return rng.choice(keys)

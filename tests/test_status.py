@@ -1,6 +1,7 @@
 """Status document + counters (ref: fdbserver/Status.actor.cpp
 clusterGetStatus :1802, flow/Stats.actor.cpp CounterCollection)."""
 
+from foundationdb_tpu import flow
 from foundationdb_tpu.client import run_transaction
 from foundationdb_tpu.server import SimCluster
 
@@ -88,6 +89,105 @@ def test_status_latency_probe():
                     return True
                 await flow.delay(1.0)
             raise AssertionError("latency probe never reported")
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
+
+
+def test_latency_bands_in_status():
+    """Banded GRV/read/commit latencies appear in the status document
+    once traffic has flowed (ref: fdbserver/LatencyBandConfig.cpp)."""
+    from foundationdb_tpu.client import run_transaction
+    c = SimCluster(seed=63)
+    try:
+        db = c.client()
+
+        async def main():
+            for i in range(10):
+                async def body(tr, i=i):
+                    await tr.get(b"lb%d" % i)
+                    tr.set(b"lb%d" % i, b"x")
+                await run_transaction(db, body)
+            status = await db.get_status()
+            proxies = status["cluster"]["proxies"]
+            assert proxies
+            for p in proxies:
+                bands = p["latency_bands"]
+                assert bands["grv"]["total"] >= 10
+                assert bands["commit"]["total"] >= 10
+                # cumulative bands: the widest band covers everything
+                widest = list(bands["commit"]["bands"].values())[-1]
+                assert widest == bands["commit"]["total"]
+            reads = [rep["latency_bands"]["read"]
+                     for s in status["cluster"]["storages"]
+                     for rep in s["replicas"] if "latency_bands" in rep]
+            assert reads and sum(b["total"] for b in reads) >= 10
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
+
+
+def test_full_mutation_vocabulary():
+    """21/21 mutation types (ref: CommitTransaction.h:49-109): V2 op
+    codes apply, debug/no-op types are inert, and the never-legal
+    types fail the transaction instead of poisoning the pipeline."""
+    import pytest as _pytest
+
+    from foundationdb_tpu.client import run_transaction
+    from foundationdb_tpu.server.types import (AND_V2,
+                                               AVAILABLE_FOR_REUSE,
+                                               CommitRequest, DEBUG_KEY,
+                                               DEBUG_KEY_RANGE, MIN_V2,
+                                               MutationRef, NO_OP,
+                                               RESERVED_LOG_PROTOCOL,
+                                               SET_VALUE)
+    c = SimCluster(seed=65)
+    try:
+        db = c.client()
+
+        async def main():
+            # V2 atomic codes through the client API
+            async def setup(tr):
+                tr.set(b"v2", (9).to_bytes(8, "little"))
+            await run_transaction(db, setup)
+
+            async def ops(tr):
+                tr.atomic_op(b"v2", (4).to_bytes(8, "little"), MIN_V2)
+                tr.atomic_op(b"missing_v2", b"\xf0", AND_V2)
+            await run_transaction(db, ops)
+
+            tr = db.create_transaction()
+            assert await tr.get(b"v2") == (4).to_bytes(8, "little")
+            # AND_V2 on an absent key takes the operand (V2 semantics)
+            assert await tr.get(b"missing_v2") == b"\xf0"
+
+            # inert types commit cleanly and change nothing
+            info = await tr._get_info()
+            proxy = info.proxies[0]
+            await proxy.commits.get_reply(CommitRequest(
+                0, (), ((b"inert", b"inert\x00"),),
+                (MutationRef(NO_OP, b"", b""),
+                 MutationRef(DEBUG_KEY, b"v2", b""),
+                 MutationRef(DEBUG_KEY_RANGE, b"a", b"z"))),
+                db.process)
+            tr2 = db.create_transaction()
+            assert await tr2.get(b"v2") == (4).to_bytes(8, "little")
+
+            # never-legal types fail the txn loudly
+            for t in (AVAILABLE_FOR_REUSE, RESERVED_LOG_PROTOCOL):
+                with _pytest.raises(flow.FdbError) as ei:
+                    await proxy.commits.get_reply(CommitRequest(
+                        0, (), ((b"bad", b"bad\x00"),),
+                        (MutationRef(t, b"bad", b"x"),)), db.process)
+                assert ei.value.name == "client_invalid_operation"
+            # and the client API refuses them outright
+            tr3 = db.create_transaction()
+            with _pytest.raises(flow.FdbError):
+                tr3.atomic_op(b"k", b"x", AVAILABLE_FOR_REUSE)
+            return True
 
         assert c.run(main(), timeout_time=120)
     finally:
