@@ -38,14 +38,16 @@ def _build_info(d: dict, transport: TcpTransport, host: str,
         return transport.ref(host, port, token)
 
     proxies = tuple(
-        ProxyRefs(f"proxy-{i}", mk(p["grvs"]), mk(p["commits"]))
+        ProxyRefs(p.get("name", f"proxy-{i}"), mk(p["grvs"]),
+                  mk(p["commits"]))
         for i, p in enumerate(d["proxies"]))
     shards = []
     for s in d["shards"]:
         end = s["end"] if s["has_end"] else None
         replicas = tuple(
-            StorageRefs(f"rep-{r['gets']}", 0, s["begin"], end,
-                        mk(r["gets"]), mk(r["ranges"]), mk(r["get_keys"]),
+            StorageRefs(r.get("name", f"rep-{r['gets']}"), 0, s["begin"],
+                        end, mk(r["gets"]), mk(r["ranges"]),
+                        mk(r["get_keys"]),
                         mk(r["watches"]) if r.get("watches") else None)
             for r in s["replicas"])
         shards.append(StorageShard(0, s["begin"], end, replicas))
@@ -54,7 +56,8 @@ def _build_info(d: dict, transport: TcpTransport, host: str,
         recovery_state=d.get("recovery_state", "fully_recovered"),
         recovery_version=0, proxies=proxies,
         logs=LogSetInfo(0, 0, -1, ()), old_logs=(),
-        storages=tuple(shards), seq=d["seq"])
+        storages=tuple(shards), seq=d["seq"],
+        failed=tuple(d.get("failed", ())))
 
 
 class RemoteDatabase(Database):

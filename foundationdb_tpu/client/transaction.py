@@ -80,6 +80,14 @@ def _rpc(fut: Future) -> Future:
     return flow.timeout_error(fut, _request_timeout())
 
 
+def _pick_live_proxy(info):
+    """A random proxy, preferring ones the failure monitor has not
+    pushed as down (all-failed falls back to any — they may be wrong)."""
+    live = [p for p in info.proxies if p.name not in info.failed]
+    cands = live or list(info.proxies)
+    return cands[flow.g_random.random_int(0, len(cands))]
+
+
 def _next_key(k: bytes) -> bytes:
     return k + b"\x00"
 
@@ -105,6 +113,7 @@ class Database:
         #: replica name -> latency EMA seconds (ref: LoadBalance's
         #: per-alternative latency model, fdbrpc/LoadBalance.actor.h)
         self._latency_ema: Dict[str, float] = {}
+        self._watch_task = None   # standing dbinfo long-poll
 
     def note_latency(self, replica: str, seconds: float) -> None:
         prev = self._latency_ema.get(replica)
@@ -155,7 +164,36 @@ class Database:
         if self._info is None:
             self._info = await self.cluster_ref.get_reply(
                 _OpenDatabaseRequest(-1), self.process)
+            # keep the picture fresh from here on: long-poll the CC's
+            # broadcast so PUSHED state (failure monitor, recoveries)
+            # reaches a long-lived client before — not after — it burns
+            # a timeout on a known-dead endpoint (ref: MonitorLeader's
+            # standing connection + FailureMonitorClient)
+            if self._watch_task is None:
+                self._watch_task = flow.spawn(
+                    self._watch_info(), TaskPriority.DEFAULT_ENDPOINT,
+                    name="client.infoWatch")
         return self._info
+
+    async def _watch_info(self) -> None:
+        while True:
+            try:
+                seq = self._info.seq if self._info is not None else -1
+                info = await self.cluster_ref.get_reply(
+                    _OpenDatabaseRequest(seq), self.process)
+                if self._info is None or info.seq > self._info.seq:
+                    self._info = info
+            except flow.FdbError as e:
+                if e.name == "operation_cancelled":
+                    raise  # teardown must actually tear this down
+                await flow.delay(0.5, TaskPriority.DEFAULT_ENDPOINT)
+
+    def close(self) -> None:
+        """Stop the standing dbinfo watcher (sim Databases are
+        otherwise scheduler-lifetime objects)."""
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            self._watch_task = None
 
     async def refresh_past(self, used_seq: int) -> None:
         """Ensure the cached picture is newer than `used_seq` — the
@@ -170,9 +208,7 @@ class Database:
             _OpenDatabaseRequest(used_seq), self.process)
 
     async def proxy(self):
-        info = await self.info()
-        return info.proxies[flow.g_random.random_int(
-            0, len(info.proxies))]
+        return _pick_live_proxy(await self.info())
 
     async def shard_for(self, key: bytes):
         info = await self.info()
@@ -368,9 +404,7 @@ class Transaction:
         return info
 
     async def _proxy(self):
-        info = await self._get_info()
-        return info.proxies[flow.g_random.random_int(
-            0, len(info.proxies))]
+        return _pick_live_proxy(await self._get_info())
 
     async def _shard(self, key: bytes):
         info = await self._get_info()
@@ -384,10 +418,17 @@ class Transaction:
         failures penalize the replica's model and rotate on)."""
         db = self.db
         ema = db._latency_ema
+        info = await self._get_info()
+        down = set(info.failed)
         reps = list(shard.replicas)
         start = flow.g_random.random_int(0, len(reps))
         reps = reps[start:] + reps[:start]     # tie-break rotation
-        reps.sort(key=lambda r: ema.get(r.name, 0.0))  # stable sort
+        # replicas the failure monitor pushed as DOWN sort last: they
+        # stay reachable as a final fallback but never burn the first
+        # attempt's latency (ref: FailureMonitorClient-informed
+        # LoadBalance ordering)
+        reps.sort(key=lambda r: (r.name in down,
+                                 ema.get(r.name, 0.0)))  # stable sort
         inflight = []   # (replica, settled-wrapper, t0)
         last_err = None
         idx = 0

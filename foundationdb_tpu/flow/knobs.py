@@ -76,6 +76,7 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # -- cluster controller (ref: CC_* / FAILURE_* knobs) --------------
     init("CC_WORKER_POLL_DELAY", 0.05)
     init("FAILURE_DETECTION_INTERVAL", 0.1, lambda: 0.5)
+    init("FAILURE_MONITOR_PING_TIMEOUT", 0.5)
     init("LATENCY_PROBE_INTERVAL", 5.0)
     init("DD_POLL_INTERVAL", 2.0, lambda: 0.3)
     init("DD_MOVE_NUDGE_INTERVAL", 0.1)

@@ -124,6 +124,7 @@ class TcpGateway:
             "seq": info.seq,
             "epoch": info.epoch,
             "recovery_state": info.recovery_state,
+            "failed": list(info.failed),
             # control plane (ref: StatusClient / ManagementAPI reach the
             # CC the same way data ops reach the roles)
             "status": (self._expose(self.db.status_ref)
@@ -131,7 +132,8 @@ class TcpGateway:
             "management": (self._expose(self.db.management_ref)
                            if self.db.management_ref is not None else 0),
             "proxies": [
-                {"grvs": self._expose(p.grvs),
+                {"name": p.name,
+                 "grvs": self._expose(p.grvs),
                  "commits": self._expose(p.commits)}
                 for p in info.proxies],
             "shards": [
@@ -139,7 +141,8 @@ class TcpGateway:
                  "end": s.end if s.end is not None else b"",
                  "has_end": s.end is not None,
                  "replicas": [
-                     {"gets": self._expose(r.gets),
+                     {"name": r.name,
+                      "gets": self._expose(r.gets),
                       "ranges": self._expose(r.ranges),
                       "get_keys": self._expose(r.get_keys),
                       "watches": self._expose(r.watches)}

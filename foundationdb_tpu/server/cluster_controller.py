@@ -139,6 +139,7 @@ class ClusterController:
                            (self._status_loop(), "status"),
                            (self._management_loop(), "management"),
                            (self._dd_loop(), "dataDistribution"),
+                           (self._failure_monitor_loop(), "failureMonitor"),
                            (self._latency_probe_loop(), "latencyProbe")):
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
@@ -170,6 +171,38 @@ class ClusterController:
             if self._recovery.master is not None:
                 self._recovery.master.stop()
             self._cancel_old_roles()
+
+    async def _failure_monitor_loop(self) -> None:
+        """Heartbeat every registered worker over the network and PUSH
+        the failed set through the dbinfo broadcast (ref: the failure
+        detection server + FailureMonitorClient — clients learn about
+        down or unreachable machines without burning per-request
+        timeouts; catches clogged-but-alive processes a liveness flag
+        would miss)."""
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.failure_detection_interval,
+                             TaskPriority.FAILURE_MONITOR)
+            pinged, futs = [], []
+            for name, wi in self.workers.items():
+                # snapshot the incarnation AND its roles with the ping:
+                # a worker that reboots mid-round must not have its
+                # freshly recovered roles blamed for the old ping
+                pinged.append((name, tuple(wi.worker.roles.keys())))
+                futs.append(flow.catch_errors(flow.timeout_error(
+                    wi.worker.pings.ref().get_reply(None, self.process),
+                    flow.SERVER_KNOBS.failure_monitor_ping_timeout)))
+            settled = await flow.all_of(futs)
+            failed: set = set()
+            for (name, roles), f in zip(pinged, settled):
+                if f.is_error:
+                    failed.add(name)
+                    # the roles a down worker hosts are down too —
+                    # replica names are what clients route by
+                    failed.update(roles)
+            cur = self.dbinfo.get()
+            if tuple(sorted(failed)) != cur.failed:
+                flow.cover("cc.failure_state_pushed")
+                self.publish(cur._replace(failed=tuple(sorted(failed))))
 
     async def _wait_for_workers(self) -> None:
         need = max(self.config.n_logs, 1)

@@ -92,6 +92,12 @@ class ServerDBInfo(NamedTuple):
     old_logs: Tuple[LogSetInfo, ...]      # locked gens still draining
     storages: Tuple[StorageShard, ...]    # shard map ordered by begin
     seq: int = 0                          # broadcast sequence number
+    # process/role names the CC's failure monitor currently considers
+    # down — PUSHED to clients through this broadcast so they stop
+    # trying known-dead endpoints first (ref: FailureMonitor state
+    # pushed from the cluster controller, fdbrpc/FailureMonitor.h:123 +
+    # fdbclient/FailureMonitorClient.actor.cpp)
+    failed: Tuple[str, ...] = ()
 
 
 EMPTY_DBINFO = ServerDBInfo(0, UNINITIALIZED, 0, (), LogSetInfo(0, 0, -1, ()),

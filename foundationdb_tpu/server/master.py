@@ -253,7 +253,8 @@ class MasterRecovery:
             self.epoch, dbi.ACCEPTING_COMMITS, recovery_version, proxies,
             LogSetInfo(self.epoch, recovery_version, -1, tuple(new_logs),
                        stores=tuple(new_log_stores)),
-            old_log_sets, self.cc.dbinfo.get().storages)
+            old_log_sets, self.cc.dbinfo.get().storages,
+            failed=self.cc.dbinfo.get().failed)
         self.cc.publish(info)
         self._trace("MasterRecoveryState", State=dbi.ACCEPTING_COMMITS,
                     Epoch=self.epoch, RecoveryVersion=recovery_version)

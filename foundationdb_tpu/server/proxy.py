@@ -343,8 +343,26 @@ class Proxy:
                 self.grv_bands.record(now - entry[3])
                 entry[0].send(GetReadVersionReply(version))
         except flow.FdbError as e:
+            cancelled = e.name == "operation_cancelled"
+            if cancelled:
+                # cancelled mid-confirmation (the epoch ended): stale
+                # clients must see a retryable failure and refresh —
+                # never the server's own cancellation
+                e = error("broken_promise")
             for entry in batch:
-                entry[0].send_error(e)
+                try:
+                    entry[0].send_error(e)
+                except Exception:
+                    pass  # already answered
+            if cancelled:
+                raise flow.ActorCancelled()
+        except BaseException:
+            for entry in batch:
+                try:
+                    entry[0].send_error(error("broken_promise"))
+                except Exception:
+                    pass
+            raise
 
     async def _rate_loop(self):
         """(ref: proxies polling GetRateInfo from the ratekeeper)"""
@@ -573,7 +591,11 @@ class Proxy:
             # refreshed proxy (ref: the proxy dying with its epoch and
             # NativeAPI mapping broken connections to
             # commit_unknown_result)
-            if e.name in ("tlog_stopped", "broken_promise"):
+            if e.name in ("tlog_stopped", "broken_promise",
+                          "operation_cancelled"):
+                # operation_cancelled = this proxy's actors were torn
+                # down mid-batch (epoch over): same unknown outcome as
+                # a broken downstream
                 e = error("commit_unknown_result")
             for reply in replies:
                 reply.send_error(e)
