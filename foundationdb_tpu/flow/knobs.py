@@ -109,6 +109,14 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("RK_MIN_RATE", 10.0)
     init("RK_MAX_RATE", 1e9)
     init("RK_TLOG_BACKLOG_LIMIT", 10_000, lambda: 500)
+    # spring-zone queue-byte controller (ref: TARGET_BYTES_PER_STORAGE_
+    # SERVER / _TLOG + SPRING_BYTES_* + SMOOTHING_AMOUNT, sim-scaled)
+    init("RK_TARGET_STORAGE_QUEUE_BYTES", 4 << 20, lambda: 1 << 14)
+    init("RK_SPRING_STORAGE_QUEUE_BYTES", 1 << 20)
+    init("RK_TARGET_TLOG_QUEUE_BYTES", 64 << 20, lambda: 1 << 16)
+    init("RK_SPRING_TLOG_QUEUE_BYTES", 16 << 20)
+    init("RK_BATCH_TARGET_FRACTION", 0.5)
+    init("RK_SMOOTHING_SECONDS", 1.0)
 
     # -- region / log router (ref: LOG_ROUTER_* knobs) -----------------
     init("LOG_ROUTER_PEEK_TIMEOUT", 2.0)

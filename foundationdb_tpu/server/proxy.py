@@ -26,7 +26,7 @@ from .. import flow
 from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
-from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS,
+from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS, PRIORITY_BATCH,
                     PRIORITY_DEFAULT, PRIORITY_IMMEDIATE, SET_VALUE,
                     SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
                     CommitReply, CommitRequest, GetReadVersionReply,
@@ -203,6 +203,7 @@ class Proxy:
         self._peers = []               # other proxies' raw-committed refs
         self._ratekeeper_ref = ratekeeper_ref
         self._rate = 1e9               # tps budget (ratekeeper-fed)
+        self._batch_rate = 1e9         # batch-priority budget (<= _rate)
         self._grv_queue = []           # waiting GRV replies
         self._grv_inflight = []        # batch being confirmed right now
         # (ref: ProxyStats — txn admission/commit counters for status)
@@ -276,11 +277,12 @@ class Proxy:
         GRV batching in transactionStarter + getLiveCommittedVersion)."""
         interval = SERVER_KNOBS.grv_batch_interval
         tokens = 0.0
+        btokens = 0.0     # batch-priority bucket (always <= the default)
         last = flow.now()
         while True:
             await flow.delay(interval, TaskPriority.PROXY_GRV_TIMER)
             now = flow.now()
-            # token bucket with a one-interval burst allowance; a ZERO
+            # token buckets with a bounded burst allowance; a ZERO
             # rate is a full stop (emergency throttle), not a trickle
             if self._rate <= 0:
                 tokens = 0.0
@@ -289,30 +291,49 @@ class Proxy:
                     tokens + self._rate * (now - last),
                     max(1.0, self._rate
                         * SERVER_KNOBS.grv_burst_intervals * interval))
+            if self._batch_rate <= 0:
+                btokens = 0.0
+            else:
+                btokens = min(
+                    btokens + self._batch_rate * (now - last),
+                    max(1.0, self._batch_rate
+                        * SERVER_KNOBS.grv_burst_intervals * interval))
             last = now
             if not self._grv_queue:
                 continue
             # priority classes (ref: TransactionPriority): IMMEDIATE
-            # bypasses the gate and pays no tokens; DEFAULT next; BATCH
-            # sorts last so it is throttled first when tokens run out
+            # bypasses the gate and pays no tokens; DEFAULT pays the
+            # default bucket; BATCH sorts last and must afford BOTH
+            # buckets, so batch traffic throttles first (ref: the
+            # separate batchTransactions limit in GetRateInfoReply)
             self._grv_queue.sort(key=lambda e: -e[2])
             take = 0
             charged = 0
+            bcharged = 0
             while take < len(self._grv_queue):
                 _r, cnt, prio, _t = self._grv_queue[take]
                 if prio < PRIORITY_IMMEDIATE:
                     if charged + cnt > tokens:
                         break
+                    if prio <= PRIORITY_BATCH:
+                        if bcharged + cnt > btokens:
+                            break
+                        bcharged += cnt
                     charged += cnt
                 take += 1
             if take == 0:
                 if tokens < 1:
                     continue
+                first = self._grv_queue[0]
+                if first[2] <= PRIORITY_BATCH and btokens < 1:
+                    continue   # batch head throttled; wait for budget
                 # a batch bigger than the burst cap still admits by
                 # running the bucket into debt, or it would starve
-                charged = self._grv_queue[0][1]
+                charged = first[1]
+                bcharged = first[1] if first[2] <= PRIORITY_BATCH else 0
                 take = 1
             tokens -= charged
+            btokens -= bcharged
             self._grv_inflight, self._grv_queue = (self._grv_queue[:take],
                                                    self._grv_queue[take:])
             try:
@@ -372,6 +393,8 @@ class Proxy:
                     self._ratekeeper_ref.get_reply(None, self.process),
                     SERVER_KNOBS.ratekeeper_poll_timeout)
                 self._rate = r.tps
+                bt = getattr(r, "batch_tps", -1.0)
+                self._batch_rate = r.tps if bt < 0 else min(bt, r.tps)
             except flow.FdbError:
                 pass  # keep the last known rate
             await flow.delay(SERVER_KNOBS.grv_rate_poll_interval,

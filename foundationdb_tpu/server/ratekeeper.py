@@ -1,36 +1,62 @@
 """Ratekeeper: cluster-wide transaction admission control.
 
 Reference: fdbserver/Ratekeeper.actor.cpp — a controller computes the
-cluster's transactions-per-second budget from storage queue depths /
-durability lag and TLog queue depth (updateRate, :150-635); proxies
-fetch the rate periodically (GetRateInfoRequest served to proxies,
-MasterProxyServer.actor.cpp:79) and release batched GRV requests no
-faster than their share of it (transactionStarter :1102).
+cluster's transactions-per-second budget from SMOOTHED per-storage
+queue bytes, TLog queue bytes, and durability lag (updateRate,
+:176-635), with a SEPARATE, lower limit for batch-priority traffic so
+background work throttles before interactive work; proxies fetch both
+rates periodically (GetRateInfoRequest, MasterProxyServer.actor.cpp:79)
+and release batched GRV requests no faster than their share
+(transactionStarter :1102).
 
-The controller here is the proportional core of the reference's: full
-speed while the worst storage lag is inside the target window, scaling
-down linearly to a survival trickle as lag approaches the MVCC window
-size (beyond which reads start failing with transaction_too_old), and
-a trickle while any shard is dead or a TLog's unpopped backlog grows
-past its threshold. Stats are read from the role registry directly —
-the simulated stand-in for StorageQueuingMetricsRequest /
-TLogQueuingMetricsRequest polling.
+Per-input controller (the reference's spring-zone shape): each storage
+replica's MVCC-window bytes and each TLog's unpopped memory bytes are
+exponentially smoothed (ref: fdbrpc/Smoother.h) and mapped through a
+spring zone — full speed below (target - spring), linear decay inside
+the zone, the survival trickle above target. Durability lag in excess
+of the configured intent scales the result quadratically toward the
+trickle as it approaches the MVCC window (beyond which reads fail with
+transaction_too_old). Batch limits use a fraction of the targets, so
+batch admission collapses first. A dead replica pins everything to the
+trickle until it rejoins.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+import math
+from typing import Dict, NamedTuple
 
 from .. import flow
-from ..flow import SERVER_KNOBS, TaskPriority
+from ..flow import TaskPriority
 from ..rpc import RequestStream, SimProcess
+from .types import mutation_bytes
 
-# rate bounds + backlog threshold live in the RK_* knobs (ref:
-# Ratekeeper.actor.cpp limit computation)
+
+class Smoother:
+    """Exponential smoothing toward the newest sample with time
+    constant `tau` seconds (ref: fdbrpc/Smoother.h)."""
+
+    __slots__ = ("_t", "value")
+
+    def __init__(self):
+        self._t = None
+        self.value = 0.0
+
+    def sample(self, x: float, now: float, tau: float) -> float:
+        # tau comes in per sample so a live knob change applies to
+        # existing smoothers (a frozen tau would make the knob a no-op)
+        if self._t is None or tau <= 0:
+            self.value = x
+        else:
+            a = math.exp(-(now - self._t) / tau)
+            self.value = x + (self.value - x) * a
+        self._t = now
+        return self.value
 
 
 class GetRateReply(NamedTuple):
     tps: float
+    batch_tps: float = -1.0   # -1: pre-batch-limit peer (defaults to tps)
 
 
 class Ratekeeper:
@@ -38,7 +64,10 @@ class Ratekeeper:
         self.process = process
         self.cc = cc
         self.rate = flow.SERVER_KNOBS.rk_max_rate
+        self.batch_rate = flow.SERVER_KNOBS.rk_max_rate
         self.get_rate = RequestStream(process)
+        self._storage_smooth: Dict[str, Smoother] = {}
+        self._tlog_smooth: Dict[str, Smoother] = {}
         self._actors = flow.ActorCollection()
 
     def start(self) -> None:
@@ -55,46 +84,99 @@ class Ratekeeper:
     async def _serve_loop(self):
         while True:
             _req, reply = await self.get_rate.pop()
-            reply.send(GetRateReply(self.rate))
+            reply.send(GetRateReply(self.rate, self.batch_rate))
 
     async def _update_loop(self):
         while True:
             await flow.delay(flow.SERVER_KNOBS.rk_update_interval,
                              TaskPriority.RATEKEEPER)
-            self.rate = self._compute_rate()
+            self.rate, self.batch_rate = self._compute_rates()
 
-    def _compute_rate(self) -> float:
+    @staticmethod
+    def _spring_limit(queue: float, target: float, spring: float,
+                      max_rate: float, min_rate: float) -> float:
+        """Full speed below (target - spring); linear decay through the
+        spring zone; the trickle at/above target (ref: the
+        storage/tlog limit shape in updateRate)."""
+        head = target - queue
+        if head >= spring:
+            return max_rate
+        if head <= 0:
+            return min_rate
+        return max(min_rate, max_rate * head / spring)
+
+    def _compute_rates(self):
+        k = flow.SERVER_KNOBS
         info = self.cc.dbinfo.get()
-        window = SERVER_KNOBS.max_write_transaction_life_versions
-        # a storage holds durability AT its configured lag by design;
-        # only lag IN EXCESS of that intent signals distress (the first
-        # controller compared raw lag against a window equal to the
-        # intent, throttling healthy clusters — code review r3)
+        now = flow.now()
+        window = k.max_write_transaction_life_versions
+        min_rate, max_rate = k.rk_min_rate, k.rk_max_rate
+        batch_frac = k.rk_batch_target_fraction
+        tau = k.rk_smoothing_seconds
+        limit, batch_limit = max_rate, max_rate
+
         worst_excess = 0
-        for s in info.storages:
-          for rep in s.replicas:
-            obj = self.cc._storage_objs.get(rep.name)
+        # one pass per REPLICA, not per (shard x replica): a server
+        # holding many shards appears once (dedupe by name), and the
+        # smoother dicts are pruned to the names seen this tick so
+        # recoveries/moves cannot grow them without bound
+        replicas = {rep.name for s in info.storages for rep in s.replicas}
+        for name in sorted(replicas):
+            obj = self.cc._storage_objs.get(name)
             if obj is None or not obj.process.alive:
                 # a dead replica: lag is unbounded until it rejoins
-                return flow.SERVER_KNOBS.rk_min_rate
+                return min_rate, min_rate
             if obj.kv is None:
-                continue  # no engine: the durability loop is inert and
-                # lag is meaningless (defensive; cluster-recruited
-                # storages always have at least an ephemeral engine)
+                continue  # no engine: durability is inert (defensive)
             excess = (obj.version.get() - obj.durable_version.get()
                       - obj._lag)
             worst_excess = max(worst_excess, excess)
-        backlog = max((len(t.entries) for t in self.cc.tlog_objs()),
-                      default=0)
-        if backlog > flow.SERVER_KNOBS.rk_tlog_backlog_limit:
-            return flow.SERVER_KNOBS.rk_min_rate
+            # MVCC-window bytes not yet durable (ref: the smoothed
+            # storage queue bytes in StorageQueuingMetrics)
+            qbytes = sum(mutation_bytes(m)
+                         for _v, ms in obj._pending for m in ms)
+            sm = self._storage_smooth.get(name)
+            if sm is None:
+                sm = self._storage_smooth[name] = Smoother()
+            q = sm.sample(qbytes, now, tau)
+            t = k.rk_target_storage_queue_bytes
+            sp = k.rk_spring_storage_queue_bytes
+            limit = min(limit, self._spring_limit(
+                q, t, sp, max_rate, min_rate))
+            batch_limit = min(batch_limit, self._spring_limit(
+                q, t * batch_frac, sp, max_rate, min_rate))
+        for stale in set(self._storage_smooth) - replicas:
+            del self._storage_smooth[stale]
+
+        live_logs = set()
+        for t_obj in self.cc.tlog_objs():
+            live_logs.add(t_obj.name)
+            sm = self._tlog_smooth.get(t_obj.name)
+            if sm is None:
+                sm = self._tlog_smooth[t_obj.name] = Smoother()
+            q = sm.sample(t_obj.mem_bytes, now, tau)
+            tt = k.rk_target_tlog_queue_bytes
+            sp = k.rk_spring_tlog_queue_bytes
+            limit = min(limit, self._spring_limit(
+                q, tt, sp, max_rate, min_rate))
+            batch_limit = min(batch_limit, self._spring_limit(
+                q, tt * batch_frac, sp, max_rate, min_rate))
+            if len(t_obj.entries) > k.rk_tlog_backlog_limit:
+                return min_rate, min_rate
+        for stale in set(self._tlog_smooth) - live_logs:
+            del self._tlog_smooth[stale]
+
+        # durability-lag excess scales everything quadratically toward
+        # the trickle as it approaches the MVCC window
         target = window // 5    # distress threshold for excess lag
-        if worst_excess <= target:
-            return flow.SERVER_KNOBS.rk_max_rate
         if worst_excess >= window:
-            return flow.SERVER_KNOBS.rk_min_rate
-        frac = 1.0 - (worst_excess - target) / max(1, window - target)
-        return max(flow.SERVER_KNOBS.rk_min_rate, flow.SERVER_KNOBS.rk_max_rate * frac * frac)
+            return min_rate, min_rate
+        if worst_excess > target:
+            frac = 1.0 - (worst_excess - target) / max(1, window - target)
+            limit = min(limit, max(min_rate, max_rate * frac * frac))
+            batch_limit = min(batch_limit, limit)
+        return limit, min(batch_limit, limit)
+
 
 from ..rpc import wire as _wire
 
