@@ -20,7 +20,7 @@ from .actors import (
 )
 from .rng import DeterministicRandom, buggify, g_random, set_seed
 from .knobs import SERVER_KNOBS, Knobs, make_server_knobs, reset_server_knobs
-from .stats import Counter, CounterCollection, LatencyBands
+from .stats import Counter, CounterCollection, LatencyBands, TimeSeries
 from .trace import TraceEvent, g_trace, reset_trace
 from .coverage import cover, declare
 from . import coverage, trace
@@ -36,5 +36,5 @@ __all__ = [
     "DeterministicRandom", "buggify", "g_random", "set_seed",
     "SERVER_KNOBS", "Knobs", "make_server_knobs", "reset_server_knobs",
     "TraceEvent", "g_trace", "reset_trace",
-    "Counter", "CounterCollection", "LatencyBands",
+    "Counter", "CounterCollection", "LatencyBands", "TimeSeries",
 ]

@@ -78,6 +78,7 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("FAILURE_DETECTION_INTERVAL", 0.1, lambda: 0.5)
     init("FAILURE_MONITOR_PING_TIMEOUT", 0.5)
     init("LATENCY_PROBE_INTERVAL", 5.0)
+    init("METRIC_SAMPLE_INTERVAL", 1.0)
     init("DD_POLL_INTERVAL", 2.0, lambda: 0.3)
     init("DD_MOVE_NUDGE_INTERVAL", 0.1)
     init("STORAGE_RECRUIT_RECOVERY_TIMEOUT", 30.0)

@@ -9,6 +9,7 @@ status; a trace loop would emit them as TraceEvents in production.
 from __future__ import annotations
 
 from bisect import bisect_left
+from collections import deque
 from typing import Dict, Tuple
 
 class Counter:
@@ -78,3 +79,42 @@ class LatencyBands:
                 "max_seconds": round(self.max_seen, 6),
                 "bands": {f"<={t:g}s": c
                           for t, c in zip(self.bands, self.counts)}}
+
+
+class TimeSeries:
+    """Multi-resolution time series (ref: flow/TDMetric.actor.h — a
+    metric keeps LEVELS of samples, each level 4x coarser than the one
+    below, so recent history is fine-grained and old history cheap).
+    Level 0 holds the newest `samples_per_level` raw samples; every
+    CASCADE-th append to a level emits one aggregated sample (the mean
+    of the cascade window) to the level above."""
+
+    CASCADE = 4
+
+    __slots__ = ("samples_per_level", "levels", "_carry")
+
+    def __init__(self, samples_per_level: int = 64, n_levels: int = 4):
+        self.samples_per_level = samples_per_level
+        self.levels = [deque(maxlen=samples_per_level)
+                       for _ in range(n_levels)]
+        self._carry = [[] for _ in range(n_levels)]
+
+    def append(self, t: float, value: float) -> None:
+        self._append_level(0, t, value)
+
+    def _append_level(self, lvl: int, t: float, value: float) -> None:
+        self.levels[lvl].append((t, value))
+        if lvl + 1 >= len(self.levels):
+            return
+        carry = self._carry[lvl]
+        carry.append((t, value))
+        if len(carry) >= self.CASCADE:
+            mean = sum(v for _t, v in carry) / len(carry)
+            self._append_level(lvl + 1, carry[-1][0], mean)
+            carry.clear()
+
+    def series(self, level: int = 0):
+        return list(self.levels[level])
+
+    def latest(self):
+        return self.levels[0][-1] if self.levels[0] else None

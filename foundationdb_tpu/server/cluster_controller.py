@@ -120,6 +120,8 @@ class ClusterController:
         self._recovery_task = None
         self._cstate: Optional[CoordinatedState] = None  # set once elected
         self._storage_objs: dict = {}      # name -> StorageServer (registry)
+        # (instance name, counter) -> TimeSeries (ref: TDMetric levels)
+        self.metrics: dict = {}
         self._rr = 0                       # recruitment round-robin
         self._seq = 0                      # dbinfo broadcast counter
         self._actors = flow.ActorCollection()
@@ -140,6 +142,7 @@ class ClusterController:
                            (self._management_loop(), "management"),
                            (self._dd_loop(), "dataDistribution"),
                            (self._failure_monitor_loop(), "failureMonitor"),
+                           (self._metric_sampler_loop(), "metricSampler"),
                            (self._latency_probe_loop(), "latencyProbe")):
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
@@ -171,6 +174,36 @@ class ClusterController:
             if self._recovery.master is not None:
                 self._recovery.master.stop()
             self._cancel_old_roles()
+
+    async def _metric_sampler_loop(self) -> None:
+        """Sample every live role's counters into multi-resolution time
+        series (ref: flow/TDMetric.actor.h levels + the SystemMonitor
+        periodic events): recent history fine-grained, old history
+        cheap, all served through status."""
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.metric_sample_interval,
+                             TaskPriority.LOW_PRIORITY)
+            now = flow.now()
+            live: set = set()
+            for wi in self.workers.values():
+                if not wi.worker.process.alive:
+                    continue
+                for rn, role in wi.worker.roles.items():
+                    stats = getattr(role, "stats", None)
+                    if stats is None:
+                        continue
+                    live.add(rn)
+                    for cname, value in stats.snapshot().items():
+                        ts = self.metrics.get((rn, cname))
+                        if ts is None:
+                            ts = self.metrics[(rn, cname)] = \
+                                flow.TimeSeries()
+                        ts.append(now, value)
+            # prune series of retired roles (old epochs, vacated
+            # replicas): unbounded growth and stale 'latest' values
+            # otherwise leak into every status document
+            for key in [k for k in self.metrics if k[0] not in live]:
+                del self.metrics[key]
 
     async def _failure_monitor_loop(self) -> None:
         """Heartbeat every registered worker over the network and PUSH
@@ -711,6 +744,18 @@ class ClusterController:
                 "proxies": proxies,
                 "qos": {"transactions_per_second_limit": rate},
                 "latency_probe": getattr(self, "_latency_probe", {}),
+                # multi-resolution counter time series (ref: TDMetric):
+                # newest sample + a short fine-grained tail per metric
+                "metrics": {
+                    f"{rn}/{cn}": {
+                        "latest": ts.latest(),
+                        "tail": [ts.levels[0][i]
+                                 for i in range(max(0, len(ts.levels[0])
+                                                   - 5),
+                                                len(ts.levels[0]))],
+                        "levels": [len(lv) for lv in ts.levels],
+                    }
+                    for (rn, cn), ts in sorted(self.metrics.items())},
                 # run-loop profiler (ref: Net2 slow-task sampling /
                 # SystemMonitor machine metrics in status)
                 "run_loop": {

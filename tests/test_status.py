@@ -192,3 +192,45 @@ def test_full_mutation_vocabulary():
         assert c.run(main(), timeout_time=120)
     finally:
         c.shutdown()
+
+
+def test_time_series_metrics():
+    """TDMetric-style multi-resolution counter series (ref:
+    flow/TDMetric.actor.h levels): level 0 fine-grained, each level
+    above 4x coarser; sampled from live roles into status."""
+    # unit: the cascade
+    ts = flow.TimeSeries(samples_per_level=8, n_levels=3)
+    for i in range(32):
+        ts.append(float(i), float(i))
+    assert len(ts.series(0)) == 8          # ring holds the newest 8
+    assert ts.latest() == (31.0, 31.0)
+    l1 = ts.series(1)
+    assert l1 and len(l1) == 8             # 32/4 = 8 cascaded samples
+    assert l1[-1][1] == (28 + 29 + 30 + 31) / 4.0
+    assert len(ts.series(2)) == 2          # 32/16
+
+    # integration: the CC samples role counters into series
+    from foundationdb_tpu.client import run_transaction
+    c = SimCluster(seed=67)
+    try:
+        db = c.client()
+
+        async def main():
+            for i in range(5):
+                async def body(tr, i=i):
+                    tr.set(b"m%d" % i, b"x")
+                await run_transaction(db, body)
+            await flow.delay(3.5)   # a few sample intervals
+            status = await db.get_status()
+            metrics = status["cluster"]["metrics"]
+            commit_series = [v for k, v in metrics.items()
+                             if k.endswith("/transactions_committed")]
+            assert commit_series, list(metrics)[:10]
+            s = commit_series[0]
+            assert s["latest"][1] >= 5
+            assert len(s["tail"]) >= 2     # multiple samples over time
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
