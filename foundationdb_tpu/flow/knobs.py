@@ -81,6 +81,12 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("METRIC_SAMPLE_INTERVAL", 1.0)
     init("DD_POLL_INTERVAL", 2.0, lambda: 0.3)
     init("DD_MOVE_NUDGE_INTERVAL", 0.1)
+    # how long a team may stay degraded before DD rebuilds the missing
+    # replica. Must exceed SIM_REBOOT_DELAY under EVERY knob combination
+    # (default 7.5 > buggified reboot 5.0; buggified 15.0 likewise) so
+    # an auto-rebooting worker always wins the race (ref:
+    # DDTeamCollection's server-failure rebuild delays)
+    init("DD_TEAM_REBUILD_DELAY", 7.5, lambda: 15.0)
     init("STORAGE_RECRUIT_RECOVERY_TIMEOUT", 30.0)
     init("COORDINATOR_FORWARD_TIMEOUT", 2.0)
 

@@ -106,6 +106,7 @@ class ClusterController:
         self._move_inflight = False        # one shard move at a time
         self._vacate_seq = 0               # unique vacate-replica names
         self._vacate_retry_at = 0.0        # backoff for stuck vacates
+        self._team_unhealthy_since: dict = {}  # tag -> first-seen time
         self._dd_last_committed = -1       # idle detection for DD nudges
         self._max_tag_ever = max(config.n_storage - 1, 0)  # no tag reuse
         self.probe_paused = False          # quiet_database pauses probes
@@ -184,15 +185,18 @@ class ClusterController:
             await flow.delay(flow.SERVER_KNOBS.metric_sample_interval,
                              TaskPriority.LOW_PRIORITY)
             now = flow.now()
-            live: set = set()
+            known: set = set()
             for wi in self.workers.values():
+                # a rebooting worker's roles keep their HISTORY (its
+                # registry entry persists through the reboot window);
+                # only roles gone from the registry entirely are pruned
+                known.update(wi.worker.roles.keys())
                 if not wi.worker.process.alive:
                     continue
                 for rn, role in wi.worker.roles.items():
                     stats = getattr(role, "stats", None)
                     if stats is None:
                         continue
-                    live.add(rn)
                     for cname, value in stats.snapshot().items():
                         ts = self.metrics.get((rn, cname))
                         if ts is None:
@@ -202,7 +206,7 @@ class ClusterController:
             # prune series of retired roles (old epochs, vacated
             # replicas): unbounded growth and stale 'latest' values
             # otherwise leak into every status document
-            for key in [k for k in self.metrics if k[0] not in live]:
+            for key in [k for k in self.metrics if k[0] not in known]:
                 del self.metrics[key]
 
     async def _failure_monitor_loop(self) -> None:
@@ -749,10 +753,7 @@ class ClusterController:
                 "metrics": {
                     f"{rn}/{cn}": {
                         "latest": ts.latest(),
-                        "tail": [ts.levels[0][i]
-                                 for i in range(max(0, len(ts.levels[0])
-                                                   - 5),
-                                                len(ts.levels[0]))],
+                        "tail": ts.series(0)[-5:],
                         "levels": [len(lv) for lv in ts.levels],
                     }
                     for (rn, cn), ts in sorted(self.metrics.items())},
@@ -795,6 +796,10 @@ class ClusterController:
             # check in dataDistribution — removeKeysFromFailedServers /
             # teams containing excluded servers get rebuilt)
             if await self._vacate_excluded(info):
+                continue
+            # team health: a team missing a replica past the rebuild
+            # delay gets a replacement built from a live teammate
+            if await self._heal_unhealthy_teams(info):
                 continue
             teams = [[self._storage_objs.get(rep.name)
                       for rep in s.replicas] for s in info.storages]
@@ -873,6 +878,63 @@ class ClusterController:
             if role_name in wi.worker.roles:
                 return name, wi
         return None, None
+
+    async def _heal_unhealthy_teams(self, info) -> bool:
+        """Team-health tracking (ref: DDTeamCollection,
+        DataDistribution.actor.cpp:539 — teams are continuously
+        monitored; a team below its replication target is rebuilt).
+        A dead replica is given DD_TEAM_REBUILD_DELAY to come back (the
+        auto-reboot path); past that, a fresh replica is built from a
+        live teammate with the same fetchKeys machinery exclusion
+        vacates use. Returns True when a rebuild ran this tick."""
+        now = flow.now()
+        healthy_tags = set()
+        acted = False
+        for si, shard in enumerate(info.storages):
+            dead = [rep.name for rep in shard.replicas
+                    if self._storage_objs.get(rep.name) is None
+                    or not self._storage_objs[rep.name].process.alive]
+            if not dead:
+                healthy_tags.add(shard.tag)
+                continue
+            live = [rep for rep in shard.replicas
+                    if rep.name not in dead]
+            if not live:
+                # total team loss: only a disk-recovering reboot can
+                # bring the data back — nothing to copy from. Keep the
+                # grace FRESH: when replicas start reappearing, the
+                # remaining dead ones get a full grace window again
+                # (a stale timer would rebuild over a reboot in flight)
+                self._team_unhealthy_since[shard.tag] = now
+                continue
+            first = self._team_unhealthy_since.setdefault(shard.tag, now)
+            if now - first < flow.SERVER_KNOBS.dd_team_rebuild_delay:
+                continue
+            flow.cover("dd.team_rebuild")
+            flow.TraceEvent("TeamUnhealthyRebuild",
+                            self.process.name).detail(
+                Tag=shard.tag, Dead=dead[0],
+                DegradedSeconds=round(now - first, 1)).log()
+            try:
+                await self._replace_replica(si, dead[0])
+                self._team_unhealthy_since.pop(shard.tag, None)
+                acted = True
+            except Exception as e:  # noqa: BLE001 — DD survives
+                flow.TraceEvent(
+                    "TeamRebuildError", self.process.name,
+                    severity=flow.trace.SevWarnAlways).detail(
+                    Tag=shard.tag, Error=repr(e)).log()
+                # re-arm the grace so a stuck rebuild (e.g. no eligible
+                # destination yet) retries without a hot loop
+                self._team_unhealthy_since[shard.tag] = \
+                    now - flow.SERVER_KNOBS.dd_team_rebuild_delay / 2
+            break   # one rebuild attempt per tick
+        # stale timers: healed teams AND tags retired by merges
+        live_tags = {s.tag for s in info.storages}
+        for tag in list(self._team_unhealthy_since):
+            if tag in healthy_tags or tag not in live_tags:
+                del self._team_unhealthy_since[tag]
+        return acted
 
     async def _vacate_excluded(self, info) -> bool:
         """Move one storage replica off an excluded worker (ref:
