@@ -87,6 +87,12 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # an auto-rebooting worker always wins the race (ref:
     # DDTeamCollection's server-failure rebuild delays)
     init("DD_TEAM_REBUILD_DELAY", 7.5, lambda: 15.0)
+    # a live replica this many versions behind the log frontier with NO
+    # progress for the rebuild delay is wedged (e.g. it rebooted at a
+    # version whose covering log generation already retired) and gets
+    # rebuilt like a dead one (ref: the reference removing storage
+    # servers that cannot catch up)
+    init("DD_REPLICA_STUCK_VERSIONS", 100_000)
     init("STORAGE_RECRUIT_RECOVERY_TIMEOUT", 30.0)
     init("COORDINATOR_FORWARD_TIMEOUT", 2.0)
 

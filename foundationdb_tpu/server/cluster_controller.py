@@ -107,6 +107,7 @@ class ClusterController:
         self._vacate_seq = 0               # unique vacate-replica names
         self._vacate_retry_at = 0.0        # backoff for stuck vacates
         self._team_unhealthy_since: dict = {}  # tag -> first-seen time
+        self._replica_progress: dict = {}  # name -> (version, since)
         self._dd_last_committed = -1       # idle detection for DD nudges
         self._max_tag_ever = max(config.n_storage - 1, 0)  # no tag reuse
         self.probe_paused = False          # quiet_database pauses probes
@@ -890,10 +891,34 @@ class ClusterController:
         now = flow.now()
         healthy_tags = set()
         acted = False
+        frontier = max((t.version.get() for t in self.tlog_objs()),
+                       default=0)
         for si, shard in enumerate(info.storages):
-            dead = [rep.name for rep in shard.replicas
-                    if self._storage_objs.get(rep.name) is None
-                    or not self._storage_objs[rep.name].process.alive]
+            dead = []
+            for rep in shard.replicas:
+                obj = self._storage_objs.get(rep.name)
+                if obj is None or not obj.process.alive:
+                    # reset the stuck clock: time spent DEAD must not
+                    # count as "no progress", or a rebooted replica
+                    # gets rebuilt as stuck before it can catch up
+                    self._replica_progress.pop(rep.name, None)
+                    dead.append(rep.name)
+                    continue
+                # STUCK detection: alive, far behind the frontier, and
+                # making no progress — e.g. it recovered at a version
+                # whose covering log generation retired while it was
+                # down; only a rebuild can bring it back
+                v = obj.version.get()
+                last_v, since = self._replica_progress.get(
+                    rep.name, (None, now))
+                if v != last_v:
+                    self._replica_progress[rep.name] = (v, now)
+                elif (frontier - v >
+                        flow.SERVER_KNOBS.dd_replica_stuck_versions
+                        and now - since >
+                        flow.SERVER_KNOBS.dd_team_rebuild_delay):
+                    flow.cover("dd.replica_stuck")
+                    dead.append(rep.name)
             if not dead:
                 healthy_tags.add(shard.tag)
                 continue
@@ -934,6 +959,9 @@ class ClusterController:
         for tag in list(self._team_unhealthy_since):
             if tag in healthy_tags or tag not in live_tags:
                 del self._team_unhealthy_since[tag]
+        current = {rep.name for s in info.storages for rep in s.replicas}
+        for n in [n for n in self._replica_progress if n not in current]:
+            del self._replica_progress[n]
         return acted
 
     async def _vacate_excluded(self, info) -> bool:

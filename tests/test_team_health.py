@@ -100,3 +100,50 @@ def test_rebooting_worker_wins_the_grace_race():
         assert c.run(main(), timeout_time=600)
     finally:
         c.shutdown()
+
+
+def test_stuck_replica_is_rebuilt():
+    """A replica that is ALIVE but cannot make progress (it recovered
+    at a version whose covering log generation retired while it was
+    down) must be detected as stuck and rebuilt — found by a fresh-seed
+    sweep where exactly this wedged quiet_database forever."""
+    c = SimCluster(seed=925, durable=True, n_storage=1,
+                   storage_replicas=2, n_workers=6)
+    try:
+        db = c.client()
+
+        async def main():
+            for i in range(10):
+                async def body(tr, i=i):
+                    tr.set(b"s%03d" % i, b"v%d" % i)
+                await run_transaction(db, body)
+
+            # wedge one replica: no log source ever covers its needs
+            info = c.cc.dbinfo.get()
+            victim = info.storages[0].replicas[0].name
+            obj = c.cc._storage_objs[victim]
+            obj.version.rollback(0)          # "recovered at version 0"
+            obj._pick_source = lambda needed: None   # nothing covers it
+
+            # commits keep flowing; the healer detects the stuck
+            # replica and rebuilds the team
+            deadline = flow.now() + 120
+            while True:
+                assert flow.now() < deadline, "stuck replica never healed"
+                info = c.cc.dbinfo.get()
+                team = info.storages[0].replicas
+                if victim not in [r.name for r in team]:
+                    break
+                async def body(tr):
+                    tr.set(b"nudge", b"x")
+                await run_transaction(db, body, max_retries=500)
+                await flow.delay(0.5)
+
+            await c.quiet_database()
+            stats = await check_consistency(c, quiesce=False)
+            assert stats["replicas"] >= 2
+            return True
+
+        assert c.run(main(), timeout_time=900)
+    finally:
+        c.shutdown()
