@@ -120,7 +120,8 @@ class RemoteCluster:
     transport and scheduler; `call(coro)` executes client coroutines
     there and returns the result to the calling thread."""
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 30.0,
+    def __init__(self, host: str, port: int,
+                 connect_timeout: float = None,
                  tls=None):
         self.host = host
         self.port = port
@@ -128,6 +129,9 @@ class RemoteCluster:
         self._submissions: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._started: queue.Queue = queue.Queue()
+        if connect_timeout is None:
+            from ..flow import SERVER_KNOBS
+            connect_timeout = SERVER_KNOBS.remote_connect_timeout
         self._connect_timeout = connect_timeout
         self._thread = threading.Thread(target=self._main, daemon=True)
         self._thread.start()
@@ -182,10 +186,13 @@ class RemoteCluster:
         finally:
             done.set()
 
-    def call(self, coro, timeout: float = 600.0):
+    def call(self, coro, timeout: float = None):
         """Run a client coroutine on the loop thread; blocking."""
         if self._stop.is_set() or not self._thread.is_alive():
             raise flow.error("broken_promise")   # loop gone: fail fast
+        if timeout is None:
+            from ..flow import SERVER_KNOBS
+            timeout = SERVER_KNOBS.remote_call_timeout
         box: list = []
         done = threading.Event()
         self._submissions.put((coro, box, done))

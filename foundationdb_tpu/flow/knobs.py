@@ -172,6 +172,22 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # -- real TCP transport (wall-clock; never BUGGIFY-distorted) ------
     init("TCP_HANDSHAKE_TIMEOUT", 5.0)
     init("TCP_CONNECT_TIMEOUT", 5.0)
+    init("REMOTE_CONNECT_TIMEOUT", 30.0)
+    init("REMOTE_CALL_TIMEOUT", 600.0)
+
+    # -- supervisor (ref: fdbmonitor restart backoff) ------------------
+    init("MONITOR_BACKOFF_INITIAL", 0.5)
+    init("MONITOR_BACKOFF_MAX", 30.0)
+    init("MONITOR_BACKOFF_RESET_AFTER", 10.0)
+
+    # -- layers (ref: TaskBucket timeout + backup chunking) ------------
+    init("TASKBUCKET_LEASE_SECONDS", 10.0, lambda: 0.5)
+    init("BACKUP_LOG_CHUNK_RECORDS", 500, lambda: 3)
+    init("BLOBSTORE_REQUEST_TIMEOUT", 10.0)
+    init("METRIC_LOGGER_INTERVAL", 1.0)
+
+    # -- conflict-set backends (ref: resolver window GC cadence) -------
+    init("CONFLICT_SET_COMPACT_EVERY", 16, lambda: 1)
     return k
 
 

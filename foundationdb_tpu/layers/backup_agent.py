@@ -205,7 +205,7 @@ class BackupAgent:
         await self._wait_until(lambda: self._tailed_to >= version, max_wait)
 
     # -- container -------------------------------------------------------
-    def save_to(self, container, chunk_records: int = 500) -> dict:
+    def save_to(self, container, chunk_records: int = None) -> dict:
         """Write this backup into a container using the reference's
         file layout: one snapshot object + chunked mutation-log objects
         whose names carry their version coverage (ref: BackupContainer
@@ -213,6 +213,9 @@ class BackupAgent:
         Plain sync object IO — the agent tool runs it outside the
         simulation loop, like fdbbackup writing to its target."""
         from .backup_container import _records_to_log_blob
+        if chunk_records is None:
+            chunk_records = int(
+                flow.SERVER_KNOBS.backup_log_chunk_records)
         if self.base_blob is None:
             raise ValueError("backup has no snapshot yet (start() first)")
         container.store_snapshot(self.base_blob, self.base_version)

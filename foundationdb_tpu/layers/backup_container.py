@@ -282,7 +282,10 @@ class BlobStoreContainer(BackupContainer):
     HTTP.actor.cpp — here stdlib http.client over the same wire
     shapes: PUT/GET/DELETE an object, GET ?list= for a prefix)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = None):
+        if timeout is None:
+            from ..flow import SERVER_KNOBS
+            timeout = SERVER_KNOBS.blobstore_request_timeout
         self.host, self.port, self.timeout = host, port, timeout
 
     def _conn(self):

@@ -40,9 +40,11 @@ async def read_series(db, role: str, counter: str,
     return [(space.unpack(k)[-1], int(v)) for k, v in rows]
 
 
-async def metric_logger(db, collections, interval: float = 1.0,
+async def metric_logger(db, collections, interval: float = None,
                         space: Subspace = DEFAULT_SPACE):
     """Periodic flush actor (ref: runMetrics)."""
+    if interval is None:
+        interval = flow.SERVER_KNOBS.metric_logger_interval
     while True:
         await flow.delay(interval)
         await log_counters(db, collections, space)

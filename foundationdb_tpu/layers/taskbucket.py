@@ -16,7 +16,7 @@ from .. import flow
 from . import tuple_layer
 from .subspace import Subspace
 
-DEFAULT_LEASE = 10.0     # seconds of sim time
+
 
 
 class Task:
@@ -30,7 +30,9 @@ class Task:
 
 
 class TaskBucket:
-    def __init__(self, subspace: Subspace, lease: float = DEFAULT_LEASE):
+    def __init__(self, subspace: Subspace, lease: float = None):
+        if lease is None:
+            lease = flow.SERVER_KNOBS.taskbucket_lease_seconds
         self._available = subspace.subspace(("avail",))
         self._claimed = subspace.subspace(("claimed",))
         self._lease = lease

@@ -168,7 +168,9 @@ class PyConflictSet(ConflictSetBase):
         if new_oldest_version > self._oldest:
             self._oldest = new_oldest_version
         self._resolved_batches += 1
-        if self._resolved_batches % 16 == 0:
+        from ..flow import SERVER_KNOBS
+        if self._resolved_batches % int(
+                SERVER_KNOBS.conflict_set_compact_every) == 0:
             self._compact()
 
         return [TOO_OLD if too_old[t] else (CONFLICT if conflict[t] else COMMITTED)

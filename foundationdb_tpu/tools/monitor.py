@@ -15,16 +15,20 @@ import sys
 import time
 from typing import List, Optional
 
-BACKOFF_INITIAL = 0.5
-BACKOFF_MAX = 30.0
-RESET_AFTER = 10.0   # a run this long resets the backoff
+def _backoff_knobs():
+    """-> (initial, maximum, reset_after) restart-backoff seconds."""
+    from ..flow import SERVER_KNOBS
+    return (SERVER_KNOBS.monitor_backoff_initial,
+            SERVER_KNOBS.monitor_backoff_max,
+            SERVER_KNOBS.monitor_backoff_reset_after)
 
 
 def supervise(server_args: List[str], max_restarts: Optional[int] = None,
               announce=print, python: Optional[str] = None) -> int:
     """Run tools.server under supervision; returns only when
     max_restarts is exhausted (None = forever / until SIGINT)."""
-    backoff = BACKOFF_INITIAL
+    initial, maximum, reset_after = _backoff_knobs()
+    backoff = initial
     restarts = 0
     while True:
         cmd = [python or sys.executable, "-m",
@@ -60,10 +64,10 @@ def supervise(server_args: List[str], max_restarts: Optional[int] = None,
         restarts += 1
         if max_restarts is not None and restarts > max_restarts:
             return 1
-        if ran >= RESET_AFTER:
-            backoff = BACKOFF_INITIAL
+        if ran >= reset_after:
+            backoff = initial
         time.sleep(backoff)
-        backoff = min(backoff * 2, BACKOFF_MAX)
+        backoff = min(backoff * 2, maximum)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
