@@ -331,6 +331,10 @@ class Transaction:
                                           if value > 0 else None)
             else:
                 self._retry_limit = value if value >= 0 else None
+        elif option == "debug_transaction_identifier":
+            # sampled-transaction stitching (ref: the TransactionDebug
+            # attach + per-station events through the commit path)
+            self._debug_id = value
         elif option == "priority_batch":
             self._grv_priority = PRIORITY_BATCH
         elif option == "priority_system_immediate":
@@ -374,6 +378,7 @@ class Transaction:
     def reset(self) -> None:
         self._access_system = False   # options reset with the txn
         self._read_system = False
+        self._debug_id = None
         self._grv_priority = None     # ...including the priority class
         # timeout/retry OPTIONS survive an explicit reset, but their
         # spent budgets re-arm — a reused object starts a fresh logical
@@ -861,9 +866,13 @@ class Transaction:
             self._arm_watches(self.committed_version)
             return self.committed_version
         snapshot = await self.get_read_version()
+        debug_id = getattr(self, "_debug_id", None)
+        if debug_id is not None:
+            flow.g_trace_batch.add_event("CommitDebug", debug_id,
+                                         "NativeAPI.commit.Before")
         req = CommitRequest(snapshot, tuple(self._read_conflicts),
                             tuple(self._write_conflicts),
-                            tuple(self._mutations))
+                            tuple(self._mutations), debug_id=debug_id)
         try:
             proxy = await self._proxy()
             reply = await self._rpc(
@@ -875,6 +884,9 @@ class Transaction:
             raise e
         self.committed_version = reply.version
         self.committed_batch_index = reply.batch_index
+        if debug_id is not None:
+            flow.g_trace_batch.add_event("CommitDebug", debug_id,
+                                         "NativeAPI.commit.After")
         self._arm_watches(reply.version)
         return reply.version
 
@@ -942,9 +954,13 @@ class Transaction:
         # and priority class — only an explicit user reset() re-arms
         retries = getattr(self, "_retries_used", 0)
         prio = getattr(self, "_grv_priority", None)
+        debug_id = getattr(self, "_debug_id", None)
         self.reset()
         self._retries_used = retries
         self._grv_priority = prio
+        # the RETRY attempt is usually the interesting one (it hit a
+        # conflict/failure) — keep it sampled
+        self._debug_id = debug_id
         if deadline is not None:
             self._timeout_deadline = deadline
 

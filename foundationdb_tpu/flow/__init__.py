@@ -21,6 +21,7 @@ from .actors import (
 from .rng import DeterministicRandom, buggify, g_random, set_seed
 from .knobs import SERVER_KNOBS, Knobs, make_server_knobs, reset_server_knobs
 from .stats import Counter, CounterCollection, LatencyBands, TimeSeries
+from .trace import g_trace_batch
 from .trace import TraceEvent, g_trace, reset_trace
 from .coverage import cover, declare
 from . import coverage, trace
@@ -37,4 +38,5 @@ __all__ = [
     "SERVER_KNOBS", "Knobs", "make_server_knobs", "reset_server_knobs",
     "TraceEvent", "g_trace", "reset_trace",
     "Counter", "CounterCollection", "LatencyBands", "TimeSeries",
+    "g_trace_batch",
 ]

@@ -53,6 +53,7 @@ g_trace = TraceCollector()
 
 def reset_trace(path: Optional[str] = None) -> TraceCollector:
     g_trace.reset(path)
+    g_trace_batch.clear()
     return g_trace
 
 
@@ -85,3 +86,54 @@ class TraceEvent:
             self.log()
         except Exception:
             pass
+
+
+class TraceBatch:
+    """Cross-role latency stitching for SAMPLED transactions (ref:
+    g_traceBatch, flow/Trace.h:107 — attach/event pairs with a shared
+    debug id let a tool reassemble one transaction's path across the
+    client, proxy, resolver, and log). Events buffer here (bounded —
+    the oldest spill into the trace stream, like the reference's
+    periodic dump) and can be flushed or queried by id."""
+
+    MAX_BUFFERED = 4096
+
+    def __init__(self):
+        self._events: list = []
+        self._seq = 0   # insertion order: same-tick events must stitch
+                        # causally, not alphabetically by location
+
+    def add_event(self, event_type: str, debug_id, location: str) -> None:
+        t = 0.0
+        try:
+            from .scheduler import g
+            t = g().now()
+        except Exception:
+            pass
+        self._seq += 1
+        self._events.append((t, self._seq, event_type, debug_id, location))
+        if len(self._events) > self.MAX_BUFFERED:
+            self.dump()
+
+    def add_events(self, debug_ids, event_type: str, location: str) -> None:
+        for d in debug_ids:
+            self.add_event(event_type, d, location)
+
+    def events(self, debug_id) -> list:
+        """Causally-ordered (time, type, location) for one debug id."""
+        return [(t, et, loc) for t, seq, et, d, loc
+                in sorted(e for e in self._events if e[3] == debug_id)]
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def dump(self) -> None:
+        """Flush buffered events as TraceEvents (ref: TraceBatch::dump)."""
+        for t, _seq, et, d, loc in self._events:
+            ev = TraceEvent(et, str(d))
+            ev._ev["Time"] = t
+            ev.detail(Location=loc).log()
+        self._events.clear()
+
+
+g_trace_batch = TraceBatch()

@@ -498,10 +498,21 @@ class Proxy:
             flow.spawn(self._commit_batch(batch, self._local_batch),
                        TaskPriority.PROXY_COMMIT)
 
+    @staticmethod
+    def _debug_ids(reqs):
+        return tuple(r.debug_id for r in reqs
+                     if getattr(r, "debug_id", None) is not None)
+
+    @staticmethod
+    def _mark(ids, location):
+        flow.g_trace_batch.add_events(ids, "CommitDebug", location)
+
     async def _commit_batch(self, batch, local: int):
         t0 = flow.now()
         reqs = [r for r, _ in batch]
         replies = [p for _, p in batch]
+        dbg = self._debug_ids(reqs)
+        self._mark(dbg, "MasterProxyServer.commitBatch.Before")
         try:
             # phase 1: version assignment, ordered with this proxy's
             # earlier batches by local batch number (the finally below
@@ -534,6 +545,8 @@ class Proxy:
             for eff, mb, me, to_idx in ver.moves:
                 self.key_resolvers.move(mb, me, to_idx, eff)
             self._moves_seen += len(ver.moves)
+            self._mark(dbg,
+                       "MasterProxyServer.commitBatch.GotCommitVersion")
 
             # phase 2: conflict resolution — single resolver fast path, or
             # key-range split across resolvers with min-combined verdicts
@@ -545,12 +558,15 @@ class Proxy:
             if len(self.resolver_refs) == 1:
                 vf = self.resolver_refs[0].get_reply(
                     ResolveRequest(ver.prev_version, ver.version,
-                                   tuple(reqs)), self.process)
+                                   tuple(reqs), debug_ids=dbg),
+                    self.process)
             else:
                 vf = flow.spawn(self._resolve_split(ver, reqs),
                                 TaskPriority.PROXY_COMMIT)
             self._advance(self.batch_resolving, local)
             verdicts = await vf
+            self._mark(dbg,
+                       "MasterProxyServer.commitBatch.AfterResolution")
 
             # phase 3: assemble mutations of committed transactions with
             # their destination storage tags, resolving versionstamped
@@ -585,6 +601,7 @@ class Proxy:
                                     for ref in self.tlog_refs])
             self._advance(self.batch_logging, local)
             await log_done
+            self._mark(dbg, "MasterProxyServer.commitBatch.AfterLogPush")
             if self.committed_version.get() < ver.version:
                 self.committed_version.set(ver.version)
 
@@ -658,7 +675,9 @@ class Proxy:
                 per[0].append((idx, req._replace(mutations=())))
         futs = [ref.get_reply(
             ResolveRequest(ver.prev_version, ver.version,
-                           tuple(r for _, r in plist)), self.process)
+                           tuple(r for _, r in plist),
+                           debug_ids=self._debug_ids(
+                               [r for _, r in plist])), self.process)
             for ref, plist in zip(self.resolver_refs, per)]
         results = await flow.all_of(futs)
         combined = [COMMITTED] * len(reqs)

@@ -68,6 +68,11 @@ class Resolver:
             reply.send(ResolutionMetricsReply(self.work_units,
                                               tuple(self.key_hist)))
 
+    @staticmethod
+    def _mark(req, location):
+        flow.g_trace_batch.add_events(getattr(req, "debug_ids", ()),
+                                      "CommitDebug", location)
+
     async def _resolve_loop(self):
         while True:
             req, reply = await self.resolves.pop()
@@ -75,6 +80,7 @@ class Resolver:
                        TaskPriority.PROXY_RESOLVER_REPLY)
 
     async def _resolve_batch(self, req: ResolveRequest, reply):
+        self._mark(req, "Resolver.resolveBatch.Before")
         # order batches by version, whatever the arrival order
         await self.version.when_at_least(req.prev_version)
         if self.version.get() >= req.version:
@@ -116,6 +122,7 @@ class Resolver:
         while len(self._reply_order) > self._cache_cap:
             self._reply_cache.pop(self._reply_order.popleft(), None)
         self.version.set(req.version)
+        self._mark(req, "Resolver.resolveBatch.After")
         reply.send(verdicts)
         self._check_state_pressure(req.version)
 

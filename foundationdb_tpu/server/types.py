@@ -85,6 +85,9 @@ class CommitRequest(NamedTuple):
     read_conflict_ranges: Tuple[Range, ...]
     write_conflict_ranges: Tuple[Range, ...]
     mutations: Tuple[MutationRef, ...]
+    # sampled-transaction stitching token (ref: debugTransaction /
+    # the debugID riding CommitTransactionRequest)
+    debug_id: Optional[int] = None
 
 
 class CommitReply(NamedTuple):
@@ -120,6 +123,7 @@ class ResolveRequest(NamedTuple):
     prev_version: int
     version: int
     transactions: Tuple[CommitRequest, ...]
+    debug_ids: Tuple[int, ...] = ()
 
 
 class StorageGetRequest(NamedTuple):

@@ -234,3 +234,68 @@ def test_time_series_metrics():
         assert c.run(main(), timeout_time=120)
     finally:
         c.shutdown()
+
+
+def test_trace_batch_stitches_commit_path():
+    """Sampled-transaction latency stitching (ref: g_traceBatch,
+    flow/Trace.h:107 — a debug id rides the commit through client,
+    proxy, and resolver; the stations reassemble in time order)."""
+    c = SimCluster(seed=69)
+    try:
+        db = c.client()
+
+        async def main():
+            tr = db.create_transaction()
+            tr.set_option("debug_transaction_identifier", 4242)
+            tr.set(b"dbg", b"1")
+            await tr.commit()
+            events = flow.g_trace_batch.events(4242)
+            locations = [loc for _t, _et, loc in events]
+            for expect in ("NativeAPI.commit.Before",
+                           "MasterProxyServer.commitBatch.Before",
+                           "MasterProxyServer.commitBatch.GotCommitVersion",
+                           "Resolver.resolveBatch.Before",
+                           "Resolver.resolveBatch.After",
+                           "MasterProxyServer.commitBatch.AfterResolution",
+                           "MasterProxyServer.commitBatch.AfterLogPush",
+                           "NativeAPI.commit.After"):
+                assert expect in locations, (expect, locations)
+            # stations are stitched in causal (time) order
+            idx = [locations.index(l) for l in (
+                "NativeAPI.commit.Before",
+                "MasterProxyServer.commitBatch.Before",
+                "Resolver.resolveBatch.Before",
+                "MasterProxyServer.commitBatch.AfterLogPush",
+                "NativeAPI.commit.After")]
+            assert idx == sorted(idx), locations
+            times = [t for t, _et, _loc in events]
+            assert times == sorted(times)
+            # an unsampled transaction adds nothing
+            tr2 = db.create_transaction()
+            tr2.set(b"plain", b"1")
+            await tr2.commit()
+            assert flow.g_trace_batch.events(None) == []
+
+            # the debug id survives an on_error retry (the retry is
+            # the interesting attempt)
+            t3 = db.create_transaction()
+            t3.set_option("debug_transaction_identifier", 777)
+            await t3.get(b"dbg")
+            side = db.create_transaction()
+            side.set(b"dbg", b"2")
+            await side.commit()
+            t3.set(b"dbg", b"mine")
+            try:
+                await t3.commit()
+            except flow.FdbError as e:
+                await t3.on_error(e)
+            t3.set(b"dbg", b"mine")
+            await t3.commit()
+            locs = [l for _t, _et, l in flow.g_trace_batch.events(777)]
+            assert locs.count("NativeAPI.commit.After") >= 1, locs
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        flow.g_trace_batch.clear()
+        c.shutdown()
