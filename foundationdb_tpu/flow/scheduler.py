@@ -88,6 +88,12 @@ class Scheduler:
         self.busy_seconds = 0.0
         self.slow_task_threshold = 0.05
         self.slow_tasks: list = []     # (task name, seconds), worst kept
+        # on-demand sampling profiler (ref: flow/Profiler.actor.cpp —
+        # the SIGPROF stack sampler, expressed cooperatively: every
+        # Nth task step records the task's coroutine suspension stack)
+        self._profile_every = 0        # 0 = off
+        self._profile_samples: dict = {}
+        self._profile_countdown = 0
 
     # -- time ---------------------------------------------------------------
     def now(self) -> float:
@@ -156,6 +162,11 @@ class Scheduler:
             return False
         _, _, task, value, exc = heapq.heappop(self._ready)
         self.tasks_run += 1
+        if self._profile_every:
+            self._profile_countdown -= 1
+            if self._profile_countdown <= 0:
+                self._profile_countdown = self._profile_every
+                self._profile_sample(task)
         t0 = _time.monotonic()
         task._step(value, exc)
         dt = _time.monotonic() - t0
@@ -200,6 +211,40 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stopped = True
+
+    # -- sampling profiler --------------------------------------------------
+    def _profile_sample(self, task) -> None:
+        frames = []
+        coro = getattr(task, "_coro", None)
+        depth = 0
+        while coro is not None and depth < 32:
+            frame = getattr(coro, "cr_frame", None)
+            if frame is None:
+                break
+            code = frame.f_code
+            frames.append(f"{code.co_name} "
+                          f"({code.co_filename.rsplit('/', 1)[-1]}"
+                          f":{frame.f_lineno})")
+            coro = getattr(coro, "cr_await", None)
+            depth += 1
+        key = (getattr(task, "name", "") or "?",
+               " <- ".join(reversed(frames)) or "?")
+        self._profile_samples[key] = self._profile_samples.get(key, 0) + 1
+
+    def start_profiler(self, sample_every: int = 16) -> None:
+        """Sample every Nth task step until stop_profiler() (ref: the
+        on-demand ProfilerRequest turning SIGPROF sampling on)."""
+        self._profile_every = max(1, sample_every)
+        self._profile_countdown = 1
+        self._profile_samples = {}
+
+    def stop_profiler(self) -> list:
+        """-> [{task, stack, samples}] sorted by sample count."""
+        self._profile_every = 0
+        out = [{"task": t, "stack": st, "samples": n}
+               for (t, st), n in self._profile_samples.items()]
+        out.sort(key=lambda e: -e["samples"])
+        return out
 
 
 class _TimerFuture(Future):

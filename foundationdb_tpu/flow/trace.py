@@ -52,8 +52,8 @@ g_trace = TraceCollector()
 
 
 def reset_trace(path: Optional[str] = None) -> TraceCollector:
+    g_trace_batch.dump()   # sampled events survive into the stream
     g_trace.reset(path)
-    g_trace_batch.clear()
     return g_trace
 
 
@@ -113,7 +113,10 @@ class TraceBatch:
         self._seq += 1
         self._events.append((t, self._seq, event_type, debug_id, location))
         if len(self._events) > self.MAX_BUFFERED:
-            self.dump()
+            # spill the OLDEST half only: in-flight stitches keep their
+            # recent legs queryable in memory
+            self.dump(self._events[:self.MAX_BUFFERED // 2])
+            del self._events[:self.MAX_BUFFERED // 2]
 
     def add_events(self, debug_ids, event_type: str, location: str) -> None:
         for d in debug_ids:
@@ -127,13 +130,16 @@ class TraceBatch:
     def clear(self) -> None:
         self._events.clear()
 
-    def dump(self) -> None:
-        """Flush buffered events as TraceEvents (ref: TraceBatch::dump)."""
-        for t, _seq, et, d, loc in self._events:
+    def dump(self, events=None) -> None:
+        """Flush events as TraceEvents (ref: TraceBatch::dump); with no
+        argument, flushes and clears the whole buffer."""
+        batch = self._events if events is None else events
+        for t, _seq, et, d, loc in batch:
             ev = TraceEvent(et, str(d))
             ev._ev["Time"] = t
             ev.detail(Location=loc).log()
-        self._events.clear()
+        if events is None:
+            self._events.clear()
 
 
 g_trace_batch = TraceBatch()
