@@ -33,6 +33,10 @@ Commands (ref: fdbcli):
   exclude <worker>           bar a worker from hosting roles
   include <worker>           re-admit an excluded worker
   writemode <on|off>         allow mutations (default on)
+  coordinators <n>           move the coordination state to n fresh
+                             coordinators (in-sim cli)
+  consistencycheck           full-replica byte sweep (in-sim cli)
+  profile <on|off>           run-loop sampling profiler (in-sim cli)
   help                       this text
   exit                       leave
 Keys/values support \\xNN escapes and quoting."""
@@ -58,18 +62,23 @@ def _printable(b: bytes) -> str:
 
 
 class Cli:
-    def __init__(self, db, runner):
+    def __init__(self, db, runner, cluster=None):
         """`db` is any Database-shaped handle (in-sim or remote);
         `runner` executes a client coroutine to completion — the sim
-        loop locally, RemoteCluster.call over TCP."""
+        loop locally, RemoteCluster.call over TCP. `cluster` (in-sim
+        only) enables the operator commands that need cluster-level
+        access: coordinators, consistencycheck, profile."""
         self.db = db
         self._runner = runner
+        self.cluster = cluster
         self.writemode = True
+        self._coord_changes = 0   # deterministic unique names
 
     @classmethod
     def for_cluster(cls, cluster: SimCluster) -> "Cli":
         return cls(cluster.client("fdbcli"),
-                   lambda coro: cluster.run(coro, timeout_time=600))
+                   lambda coro: cluster.run(coro, timeout_time=600),
+                   cluster=cluster)
 
     @classmethod
     def for_remote(cls, remote) -> "Cli":
@@ -143,6 +152,53 @@ class Cli:
                 await self.db.configure(**kwargs)
             self._run(body())
             return "Configuration changed"
+        if cmd == "coordinators":
+            # (ref: fdbcli `coordinators` -> ManagementAPI changeQuorum)
+            if self.cluster is None:
+                return ("ERROR: coordinators change requires cluster "
+                        "access (in-sim cli)")
+            if len(raw) != 1 or not raw[0].isdigit() or \
+                    int(raw[0]) < 1:
+                return "usage: coordinators <n>   (n >= 1)"
+            n = int(raw[0])
+            self._coord_changes += 1
+            new_refs = self.cluster.add_coordinators(
+                n, tag=f"cli{self._coord_changes}-")
+            try:
+                self._run(self.db.change_coordinators(new_refs))
+            except Exception:
+                # the change failed: reap the freshly spawned quorum so
+                # retries don't accumulate orphan coordinators
+                for coord in self.cluster.coordinators[-n:]:
+                    self.cluster.net.kill(coord.process)
+                del self.cluster.coordinators[-n:]
+                raise
+            return f"Coordination state moved to {n} new coordinators"
+        if cmd == "consistencycheck":
+            # (ref: `fdbserver -r consistencycheck` / the post-test
+            # sweep, tester.actor.cpp:741)
+            if self.cluster is None:
+                return ("ERROR: consistencycheck requires cluster "
+                        "access (in-sim cli)")
+            from ..server.consistency import check_consistency
+            stats = self._run(check_consistency(self.cluster))
+            return (f"Consistency check passed: {stats['shards']} shards,"
+                    f" {stats['replicas']} replicas, {stats['rows']} rows"
+                    f" at version {stats['version']}")
+        if cmd == "profile":
+            # (ref: fdbcli `profile` + ProfilerRequest)
+            if self.cluster is None:
+                return "ERROR: profile requires cluster access"
+            sched = self.cluster.sched
+            if raw and raw[0] == "on":
+                sched.start_profiler()
+                return "Profiler on"
+            if raw and raw[0] == "off":
+                report = sched.stop_profiler()
+                lines = [f"{e['samples']:6d}  {e['task']}  {e['stack']}"
+                         for e in report[:10]]
+                return "Profiler off\n" + "\n".join(lines)
+            return "usage: profile on|off"
         if cmd in ("exclude", "include"):
             async def body():
                 await self.db.exclude(raw[0], exclude=cmd == "exclude")

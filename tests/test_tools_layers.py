@@ -123,3 +123,31 @@ def test_fdb_binding_surface():
         assert c.run(main(), timeout_time=120)
     finally:
         c.shutdown()
+
+
+def test_cli_operator_commands():
+    """coordinators / consistencycheck / profile (ref: the fdbcli
+    command table + `-r consistencycheck` + ProfilerRequest)."""
+    from foundationdb_tpu.tools.cli import Cli
+
+    c = SimCluster(seed=73, durable=True, n_coordinators=3)
+    try:
+        cli = Cli.for_cluster(c)
+        assert cli.execute("set alpha 1") == "Committed"
+        out = cli.execute("consistencycheck")
+        assert out.startswith("Consistency check passed"), out
+
+        assert cli.execute("profile on") == "Profiler on"
+        for i in range(5):
+            cli.execute(f"set p{i} x")
+        out = cli.execute("profile off")
+        assert out.startswith("Profiler off"), out
+        assert any(ch.isdigit() for ch in out)
+
+        out = cli.execute("coordinators 3")
+        assert "3 new coordinators" in out, out
+        # the cluster still serves traffic on the new quorum
+        assert cli.execute("set beta 2") == "Committed"
+        assert "2" in cli.execute("get beta")
+    finally:
+        c.shutdown()
