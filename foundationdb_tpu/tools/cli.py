@@ -283,6 +283,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     script = None
     seed = 0
     connect = None
+    cluster_file = None
     tls_args = {}
     while argv:
         a = argv.pop(0)
@@ -292,6 +293,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             seed = int(argv.pop(0))
         elif a == "--connect":
             connect = argv.pop(0)
+        elif a in ("--cluster-file", "-C"):
+            cluster_file = argv.pop(0)
         elif a in TLS_FLAGS:
             tls_args[TLS_FLAGS[a]] = argv.pop(0)
     try:
@@ -299,22 +302,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
-    if tls is not None and connect is None:
-        print("--tls-* flags require --connect (local mode has no "
-              "network)", file=sys.stderr)
+    from ..client.cluster_file import resolve_connect
+    try:
+        addr = resolve_connect(connect, cluster_file)
+    except (OSError, ValueError) as e:
+        what = "--connect" if connect is not None else "cluster file"
+        print(f"bad {what}: {e}", file=sys.stderr)
+        return 2
+    if tls is not None and addr is None:
+        print("--tls-* flags require --connect/--cluster-file (local "
+              "mode has no network)", file=sys.stderr)
         return 2
     cluster = None
     remote = None
-    if connect is not None:
+    if addr is not None:
         # remote mode (ref: fdbcli -C cluster-file): speak the wire
         # protocol to a tools.server / TcpGateway in another process
         from ..client.remote import RemoteCluster
-        host, _colon, port = connect.rpartition(":")
-        if not port.isdigit():
-            print(f"--connect expects host:port, got `{connect}'",
-                  file=sys.stderr)
-            return 2
-        remote = RemoteCluster(host or "127.0.0.1", int(port), tls=tls)
+        host, port = addr
+        remote = RemoteCluster(host or "127.0.0.1", port, tls=tls)
         cli = Cli.for_remote(remote)
     else:
         cluster = SimCluster(seed=seed, durable=True)

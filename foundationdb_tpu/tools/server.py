@@ -21,10 +21,20 @@ from ..server import SimCluster
 
 def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
           storage_replicas: int = 1, n_logs: int = 1, n_proxies: int = 1,
-          tls=None, data_dir=None, announce=print) -> None:
+          tls=None, data_dir=None, announce=print,
+          cluster_file=None) -> None:
     """Run until interrupted; announces `LISTENING <port>` once up.
     With --data-dir, durable state lives in REAL files there and
-    survives restarting this process."""
+    survives restarting this process. With --cluster-file, writes the
+    fdb.cluster-style connection string clients dial (ref: the cluster
+    file convention, fdbclient/MonitorLeader.actor.cpp)."""
+    if cluster_file is not None:
+        # fail BEFORE booting a cluster if the path can't be written
+        import os as _os
+        d = _os.path.dirname(cluster_file) or "."
+        if not _os.path.isdir(d) or not _os.access(d, _os.W_OK):
+            raise SystemExit(
+                f"--cluster-file directory not writable: {d}")
     c = SimCluster(seed=seed, virtual=False, durable=True,
                    n_storage=n_storage, storage_replicas=storage_replicas,
                    n_logs=n_logs, n_proxies=n_proxies, data_dir=data_dir)
@@ -32,6 +42,12 @@ def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
     try:
         async def main():
             gw.start()
+            if cluster_file is not None:
+                from ..client.cluster_file import (
+                    ClusterConnectionString, write_cluster_file)
+                write_cluster_file(cluster_file, ClusterConnectionString(
+                    "fdbtpu", f"s{seed}",
+                    (("127.0.0.1", gw.port),)))
             announce(f"LISTENING {gw.port}", flush=True)
             while True:
                 await flow.delay(0.5)
@@ -67,6 +83,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             kwargs["n_logs"] = int(argv.pop(0))
         elif a == "--proxies":
             kwargs["n_proxies"] = int(argv.pop(0))
+        elif a in ("--cluster-file", "-C"):
+            kwargs["cluster_file"] = argv.pop(0)
         else:
             print(f"unknown argument {a}", file=sys.stderr)
             return 2

@@ -319,3 +319,52 @@ def test_remote_watch_fires_over_the_gateway():
             rc.call(await_watch())   # raises timed_out if never fired
         finally:
             rc.close()
+
+
+def test_cluster_file_roundtrip(tmp_path):
+    """fdb.cluster format (ref: MonitorLeader.actor.cpp:185 parsing
+    tests): parse/write round-trip, comment tolerance, validation, and
+    the CLI dialing a server through --cluster-file."""
+    from foundationdb_tpu.client.cluster_file import (
+        ClusterConnectionString, parse_connection_string,
+        read_cluster_file, resolve_connect, write_cluster_file)
+
+    conn = parse_connection_string(
+        "# a comment\n  mydb:abc123@10.0.0.1:4500,10.0.0.2:4501\n")
+    assert conn.description == "mydb"
+    assert conn.cluster_id == "abc123"
+    assert conn.addresses == (("10.0.0.1", 4500), ("10.0.0.2", 4501))
+    assert str(conn) == "mydb:abc123@10.0.0.1:4500,10.0.0.2:4501"
+
+    path = str(tmp_path / "fdb.cluster")
+    write_cluster_file(path, conn)
+    assert read_cluster_file(path) == conn
+    assert resolve_connect(None, path) == ("10.0.0.1", 4500)
+    assert resolve_connect("h:9", path) == ("h", 9)  # --connect wins
+    assert resolve_connect(None, None) is None
+
+    import pytest as _pytest
+    for bad in ("nope", "a:b", "db:id@", "db:id@host:notaport",
+                "db/x:id@h:1", "one:1@h:1\ntwo:2@h:2"):
+        with _pytest.raises(ValueError):
+            parse_connection_string(bad)
+
+    # e2e: server writes the file; the CLI dials through it
+    import subprocess
+    import sys as _sys
+    cf = str(tmp_path / "live.cluster")
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "foundationdb_tpu.tools.server",
+         "--port", "0", "--cluster-file", cf],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("LISTENING"), line
+        out = subprocess.run(
+            [_sys.executable, "-m", "foundationdb_tpu.tools.cli",
+             "--cluster-file", cf, "--exec", "set cf works; get cf"],
+            capture_output=True, text=True, timeout=120)
+        assert "works" in out.stdout, (out.stdout, out.stderr)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
