@@ -296,7 +296,7 @@ class TLog:
         popped_floor = self._tag_popped(req.tag)
         if popped_floor >= req.begin_version:
             flow.TraceEvent("TLogPeekBelowPopped", self.name,
-                            severity=flow.SevError).detail(
+                            severity=flow.trace.SevError).detail(
                 Tag=req.tag, Begin=req.begin_version,
                 Popped=popped_floor).log()
             # throttle: the reader will re-peek the same version forever
@@ -335,7 +335,7 @@ class TLog:
                     # advance past the hole even when v == begin (the
                     # byte-limit floor would swallow exactly that case).
                     flow.TraceEvent("TLogPeekRecordFreed", self.name,
-                                    severity=flow.SevError).detail(
+                                    severity=flow.trace.SevError).detail(
                         Tag=req.tag, Version=v).log()
                     await flow.delay(flow.SERVER_KNOBS.tlog_stalled_peek_delay,
                                      TaskPriority.LOW_PRIORITY)

@@ -84,6 +84,49 @@ class Cli:
     def for_remote(cls, remote) -> "Cli":
         return cls(remote.db, remote.call)
 
+    def _move_may_have_landed(self, new_refs) -> bool:
+        """True when the coordinators change may have committed even
+        though the client RPC errored/timed out — in that case the new
+        quorum must NOT be reaped (the old set redirects to it
+        forever). First FENCES the mover: a quorum read on the old
+        coordinators raises their read generations, so an in-flight
+        tombstone write that has not applied anywhere yet can never
+        commit (the mover does not retry conflicts) — making "not
+        landed" a stable fact rather than a point-in-time observation.
+        Then scans the old quorum for a MovedValue tombstone or a
+        forward pointing at the new set."""
+        from ..server.coordination import CoordinatedState, MovedValue
+        n = len(new_refs)
+        old = self.cluster.coordinators[:-n]
+        old_refs = [self.cluster._coord_refs(c) for c in old]
+        proc = self.cluster.net.new_process(
+            f"cli-fence{self._coord_changes}",
+            machine=f"cli-fence{self._coord_changes}")
+
+        async def fence():
+            cs = CoordinatedState([(r[0], r[1]) for r in old_refs], proc)
+            await cs.read()
+
+        try:
+            self._run(fence())
+        except Exception:
+            return True   # fence unproven: keep the new quorum alive
+
+        new_names = {r[0].endpoint.process.name for r in new_refs}
+
+        def _points_at_new(refs) -> bool:
+            return any(r[0].endpoint.process.name in new_names
+                       for r in refs)
+
+        for coord in old:
+            if coord._forward is not None and _points_at_new(coord._forward):
+                return True
+            for value, _wgen, _rgen in coord._reg.values():
+                if isinstance(value, MovedValue) and \
+                        _points_at_new(value.coordinators):
+                    return True
+        return False
+
     def execute(self, line: str) -> str:
         """Run one command line; returns the printed output."""
         try:
@@ -167,11 +210,17 @@ class Cli:
             try:
                 self._run(self.db.change_coordinators(new_refs))
             except Exception:
-                # the change failed: reap the freshly spawned quorum so
-                # retries don't accumulate orphan coordinators
-                for coord in self.cluster.coordinators[-n:]:
-                    self.cluster.net.kill(coord.process)
-                del self.cluster.coordinators[-n:]
+                # the change failed — but change_coordinators has a 30s
+                # timeout that can fire AFTER the move committed (the
+                # MovedValue tombstone landed in the old quorum).
+                # Reaping the new quorum then bricks the coordinated
+                # state: the old set forwards to a dead set. Only reap
+                # when no old coordinator shows evidence the move
+                # reached the new set (advisor r4).
+                if not self._move_may_have_landed(new_refs):
+                    for coord in self.cluster.coordinators[-n:]:
+                        self.cluster.net.kill(coord.process)
+                    del self.cluster.coordinators[-n:]
                 raise
             return f"Coordination state moved to {n} new coordinators"
         if cmd == "consistencycheck":

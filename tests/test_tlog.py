@@ -146,6 +146,32 @@ def test_lock_stops_commits_keeps_peeks(env):
     assert s.run(until=t, timeout_time=30)
 
 
+def test_peek_below_popped_stalls_with_error_trace(env):
+    """Peeking at/below the tag's freed floor must emit a SevError
+    TLogPeekBelowPopped event and reply with the watermark clamped below
+    the hole — not crash the peek actor (advisor r4: flow.SevError was
+    an AttributeError, so the safeguard died exactly when it fired)."""
+    s, tlog, client = env
+
+    async def main():
+        for i in range(1, 6):
+            await tlog.commits.ref().get_reply(
+                TLogCommitRequest(i - 1, i, (_tm(0, b"k%d" % i, b"v"),),
+                                  i - 1), client)
+        tlog.pops.ref().send(TLogPopRequest(3, 0), client)
+        await fl.delay(0.05)
+        before = fl.trace.g_trace.counts.get("TLogPeekBelowPopped", 0)
+        r = await tlog.peeks.ref().get_reply(TLogPeekRequest(2, 0), client)
+        # clamped below begin: the reader cannot advance past the hole
+        assert r.entries == () and r.committed_version == 1
+        assert fl.trace.g_trace.counts.get(
+            "TLogPeekBelowPopped", 0) == before + 1
+        return True
+
+    t = s.spawn(main())
+    assert s.run(until=t, timeout_time=30)
+
+
 def test_spill_bounds_memory_and_peeks_from_disk():
     """Once payload bytes exceed TLOG_SPILL_THRESHOLD the oldest durable
     entries spill: memory keeps only DiskQueue positions, a lagging
