@@ -47,10 +47,16 @@ class SimProcess:
     """A simulated process hosting request streams (ref: simulator.h
     ProcessInfo). Kill breaks everything it owns."""
 
-    def __init__(self, net: "SimNetwork", name: str, machine: str = ""):
+    def __init__(self, net: "SimNetwork", name: str, machine: str = "",
+                 zone: str = "", dc: str = ""):
         self.net = net
         self.name = name
         self.machine = machine or name
+        # failure-domain locality (ref: flow/Locality.h LocalityData —
+        # machineid ⊂ zoneid ⊂ dcid). Defaults collapse to the legacy
+        # one-process-per-machine model: zone == machine, one dc.
+        self.zone = zone or self.machine
+        self.dc = dc or "dc0"
         self.alive = True
         self._streams: Dict[int, PromiseStream] = {}
         self._pending_replies: list[Promise] = []
@@ -158,10 +164,28 @@ class SimNetwork:
         self.disks: Dict[str, "SimDisk"] = {}
 
     # -- topology -------------------------------------------------------
-    def new_process(self, name: str, machine: str = "") -> SimProcess:
-        p = SimProcess(self, name, machine)
+    def new_process(self, name: str, machine: str = "", zone: str = "",
+                    dc: str = "") -> SimProcess:
+        p = SimProcess(self, name, machine, zone, dc)
         self.processes[name] = p
         return p
+
+    def processes_on(self, machine: str) -> list:
+        """Live processes sharing a machine (ref: simulator.h
+        MachineInfo.processes — machines group processes so failures
+        correlate)."""
+        return [p for p in self.processes.values()
+                if p.alive and p.machine == machine]
+
+    def kill_machine(self, machine: str) -> list:
+        """Correlated failure: kill every live process on the machine
+        at once (ref: killMachine, sim2.actor.cpp:1717 — machine-level
+        kills take out all co-located processes and their unsynced
+        writes in one power-loss event). Returns the killed names."""
+        victims = self.processes_on(machine)
+        for p in victims:
+            self.kill(p)
+        return [p.name for p in victims]
 
     def disk(self, machine: str) -> "SimDisk":
         """The machine's persistent file namespace (survives kills).
@@ -229,7 +253,7 @@ class SimNetwork:
         (ref: simulatedFDBDRebooter, SimulatedCluster.actor.cpp:194)."""
         old = self.processes[name]
         self.kill(old)
-        return self.new_process(name, old.machine)
+        return self.new_process(name, old.machine, old.zone, old.dc)
 
     def clog_pair(self, a: str, b: str, seconds: float) -> None:
         """Delay all messages between two machines until now+seconds

@@ -36,7 +36,16 @@ class SimCluster:
                  storage_engine: str = "memory",
                  storage_replicas: int = 1,
                  share_with: "SimCluster" = None, name_prefix: str = "",
-                 virtual: bool = True, data_dir: Optional[str] = None):
+                 virtual: bool = True, data_dir: Optional[str] = None,
+                 workers_per_machine: int = 1, n_zones: int = 0,
+                 storage_policy=None):
+        if storage_policy is not None and \
+                storage_policy.replica_count() != max(1, storage_replicas):
+            raise ValueError(
+                f"storage_policy places {storage_policy.replica_count()} "
+                f"replicas but storage_replicas={storage_replicas}: the "
+                "team size and the tag-pinning/naming machinery would "
+                "silently diverge")
         self.prefix = name_prefix
         self._owns_scheduler = share_with is None
         # co-scheduled clusters (share_with): any of them may publish a
@@ -86,7 +95,8 @@ class SimCluster:
                                     conflict_backend=conflict_backend,
                                     durable=durable,
                                     storage_engine=storage_engine,
-                                    storage_replicas=storage_replicas)
+                                    storage_replicas=storage_replicas,
+                                    storage_policy=storage_policy)
 
         # coordinators (ref: coordinationServer)
         px = self.prefix
@@ -117,14 +127,25 @@ class SimCluster:
             validator(self.cc.dbinfo, self.validator_state),
             name=f"{px}simValidator")
 
-        # workers, one per simulated machine
+        # workers grouped onto machines and zones (ref: simulator.h
+        # MachineInfo + SimulatedCluster setupSimulatedSystem building
+        # machines across zones/DCs). Defaults keep the legacy model:
+        # one worker per machine, each machine its own zone.
         if n_workers is None:
             n_workers = max(4, n_logs + 1, n_storage * storage_replicas,
                             n_resolvers, storage_replicas + 1)
         self.n_workers = n_workers
+        self.workers_per_machine = max(1, workers_per_machine)
+        self.n_zones = n_zones
         self.workers: dict = {}
         for i in range(n_workers):
-            self._start_worker(f"{px}worker{i}", f"{px}w{i}")
+            if self.workers_per_machine > 1 or n_zones > 0:
+                mi = i // self.workers_per_machine
+                machine = f"{px}m{mi}"
+                zone = f"{px}z{mi % n_zones}" if n_zones else machine
+            else:
+                machine, zone = f"{px}w{i}", ""
+            self._start_worker(f"{px}worker{i}", machine, zone)
 
     @staticmethod
     def _coord_refs(c: Coordinator) -> tuple:
@@ -147,8 +168,9 @@ class SimCluster:
         return out
 
     # -- worker lifecycle ------------------------------------------------
-    def _start_worker(self, name: str, machine: str) -> Worker:
-        proc = self.net.new_process(name, machine=machine)
+    def _start_worker(self, name: str, machine: str,
+                      zone: str = "") -> Worker:
+        proc = self.net.new_process(name, machine=machine, zone=zone)
         w = Worker(proc, self.net, durable=self.durable,
                    dbinfo=self.cc.dbinfo,
                    conflict_backend=self.conflict_backend,
@@ -159,7 +181,7 @@ class SimCluster:
         flow.spawn(self._register_worker(w), name=f"{name}.register")
         if self.auto_reboot:
             proc.on_kill(lambda: flow.spawn(
-                self._reboot_worker(name, machine),
+                self._reboot_worker(name, machine, zone),
                 name=f"{name}.rebooter"))
         return w
 
@@ -169,17 +191,28 @@ class SimCluster:
             RegisterWorkerRequest(w.process.name, w.process.machine, w,
                                   logs, storages), w.process)
 
-    async def _reboot_worker(self, name: str, machine: str) -> None:
+    async def _reboot_worker(self, name: str, machine: str,
+                             zone: str = "") -> None:
         """(ref: simulatedFDBDRebooter — the machine comes back after a
         delay and its worker recovers whatever the disk kept)"""
         await flow.delay(flow.SERVER_KNOBS.sim_reboot_delay)
         if name in self.net.processes and self.net.processes[name].alive:
             return
-        self._start_worker(name, machine)
+        self._start_worker(name, machine, zone)
 
     # -- faults ----------------------------------------------------------
     def kill_worker(self, name: str) -> None:
         self.net.kill(self.net.processes[name])
+
+    def kill_machine(self, machine: str) -> list:
+        """Correlated whole-machine failure: every co-located worker
+        dies at once; auto-reboot (if on) brings each back onto the
+        same machine/zone with its disks intact (ref: killMachine,
+        sim2.actor.cpp:1717)."""
+        return self.net.kill_machine(machine)
+
+    def machine_of(self, worker_name: str) -> str:
+        return self.net.processes[worker_name].machine
 
     def _find_worker_of(self, prefix: str) -> Optional[str]:
         """Name of a live worker hosting a role whose name starts with

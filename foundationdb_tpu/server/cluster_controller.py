@@ -38,6 +38,13 @@ class ClusterConfig(NamedTuple):
     conflict_backend: str = "python"
     durable: bool = False
     storage_engine: str = "memory"   # memory | btree (ref: ssd engine)
+    # explicit storage-team placement policy (a ReplicationPolicy over
+    # processid/machineid/zoneid/dcid localities). None = the default
+    # Across(storage_replicas, zoneid, One()). When set explicitly,
+    # team construction is STRICT: an unsatisfiable policy refuses the
+    # team instead of degrading (ref: DatabaseConfiguration
+    # storagePolicy driving DDTeamCollection team building).
+    storage_policy: object = None
 
 
 class OpenDatabaseRequest(NamedTuple):
@@ -83,6 +90,9 @@ class _WorkerInfo(NamedTuple):
     machine: str
     worker: object
     roles: Tuple[str, ...]
+    # always non-empty: registration falls back to machine / "dc0"
+    zone: str
+    dc: str
 
 
 class ClusterController:
@@ -300,8 +310,11 @@ class ClusterController:
         while True:
             req, reply = await self.registrations.pop()
             assert isinstance(req, RegisterWorkerRequest)
-            self.workers[req.name] = _WorkerInfo(req.name, req.machine,
-                                                 req.worker, ())
+            p = req.worker.process
+            self.workers[req.name] = _WorkerInfo(
+                req.name, req.machine, req.worker, (),
+                getattr(p, "zone", req.machine),
+                getattr(p, "dc", "dc0"))
             for lr in req.recovered_logs:
                 self.log_stores[lr.store] = lr
             if req.recovered_logs:
@@ -376,16 +389,35 @@ class ClusterController:
             self.publish(info._replace(storages=tuple(shards)))
 
     # -- recruitment helpers (used by MasterRecovery) -------------------
-    def pick_workers(self, n: int, role: str):
+    @staticmethod
+    def _locality_of(wi) -> "Locality":
+        from .replication_policy import Locality
+        return Locality(processid=wi.name, machineid=wi.machine,
+                        zoneid=wi.zone, dcid=wi.dc)
+
+    def storage_policy(self, n: int):
+        """The storage-team policy and whether it is strict: an
+        explicitly configured policy refuses unsatisfiable teams; the
+        default Across(n, zoneid, One()) degrades (ref:
+        DatabaseConfiguration storagePolicy)."""
+        from .replication_policy import PolicyAcross, PolicyOne
+        if self.config.storage_policy is not None:
+            return self.config.storage_policy, True
+        return PolicyAcross(n, "zoneid", PolicyOne()), False
+
+    def pick_workers(self, n: int, role: str, policy=None,
+                     strict: bool = False):
         """Policy-placed selection over live, non-excluded workers:
-        replicas land in distinct zones (machines) when the worker pool
-        allows it — PolicyAcross(n, zoneid, One()) — degrading to
-        round-robin when it cannot (ref: clusterRecruitFromConfiguration
-        applying the configuration's storagePolicy/tLogPolicy;
+        replicas land in distinct failure domains when the worker pool
+        allows it, degrading to round-robin when it cannot — unless
+        `strict`, in which case an unsatisfiable policy raises
+        no_more_servers (a policy-violating team is unconstructible)
+        (ref: clusterRecruitFromConfiguration applying the
+        configuration's storagePolicy/tLogPolicy;
         fdbrpc/ReplicationPolicy.h). Candidate order rotates so
         consecutive recruitments spread roles the way the reference's
         fitness ranking does."""
-        from .replication_policy import Locality, PolicyAcross, PolicyOne
+        from .replication_policy import PolicyAcross, PolicyOne
         live = [wi for name, wi in self.workers.items()
                 if wi.worker.process.alive and name not in self.excluded]
         if not live:
@@ -393,18 +425,25 @@ class ClusterController:
         rot = self._rr % len(live)
         self._rr += n
         ordered = live[rot:] + live[:rot]
-        cands = [(wi.worker, Locality(processid=wi.name, zoneid=wi.machine,
-                                      machineid=wi.machine, dcid="dc0"))
-                 for wi in ordered]
-        team = PolicyAcross(n, "zoneid", PolicyOne()).select(cands)
+        cands = [(wi.worker, self._locality_of(wi)) for wi in ordered]
+        if policy is None:
+            policy = PolicyAcross(n, "zoneid", PolicyOne())
+        team = policy.select(cands)
         if team is not None:
             return team
+        if strict:
+            flow.TraceEvent("RecruitmentPolicyUnsatisfiable",
+                            self.process.name,
+                            severity=flow.trace.SevWarnAlways).detail(
+                Role=role, Policy=repr(policy),
+                Zones=len({wi.zone for wi in live})).log()
+            raise error("no_more_servers")
         # not enough failure domains: place anyway, spread round-robin
         # (the reference recruits in degraded mode rather than stall)
         flow.TraceEvent("RecruitmentPolicyDegraded", self.process.name,
                         severity=flow.trace.SevWarn).detail(
-            Role=role, Needed=n, Zones=len({wi.machine for wi in live})
-        ).log()
+            Role=role, Needed=n,
+            Zones=len({wi.zone for wi in live})).log()
         return [ordered[i % len(ordered)].worker for i in range(n)]
 
     def storage_splits(self) -> Tuple[bytes, ...]:
@@ -432,9 +471,11 @@ class ClusterController:
         splits = list(self.storage_splits())
         bounds = [b""] + splits + [None]
         nrep = max(1, self.config.storage_replicas)
+        pol, strict = self.storage_policy(nrep)
         storages = []
         for i in range(self.config.n_storage):
-            team = self.pick_workers(nrep, role="storage")
+            team = self.pick_workers(nrep, role="storage", policy=pol,
+                                     strict=strict)
             replicas = []
             for j, w in enumerate(team):
                 refs = w.recruit_storage(f"storage-{i}-r{j}", i, bounds[i],
@@ -689,6 +730,8 @@ class ClusterController:
         cfg = self.config
         workers = {
             name: {"machine": wi.machine,
+                   "zone": wi.zone,
+                   "dc": wi.dc,
                    "alive": wi.worker.process.alive,
                    "roles": sorted(wi.worker.roles)}
             for name, wi in self.workers.items()}
@@ -1004,16 +1047,36 @@ class ClusterController:
         team_workers = {self._worker_of_role(rep.name)[0]
                         for rep in shard.replicas}
         # destination: included, live, not already hosting this shard;
-        # prefer a zone the team doesn't cover (the replication policy)
+        # the replacement must leave a team the replication policy
+        # validates (ref: DDTeamCollection rebuilding through the
+        # configured storagePolicy, DataDistribution.actor.cpp:539) —
+        # candidates producing a policy-violating team are skipped
         cands = [wi for name, wi in self.workers.items()
                  if wi.worker.process.alive and name not in self.excluded
                  and name not in team_workers]
         if not cands:
             raise error("no_more_servers")
-        fresh_zone = [wi for wi in cands if wi.machine not in
-                      {self.workers[w].machine for w in team_workers
-                       if w in self.workers}]
-        dst_wi = (fresh_zone or cands)[self._rr % len(fresh_zone or cands)]
+        pol, strict = self.storage_policy(len(shard.replicas))
+        keep_locs = [self._locality_of(self.workers[w])
+                     for rep in shard.replicas if rep.name != old_name
+                     for w in [self._worker_of_role(rep.name)[0]]
+                     if w in self.workers]
+        fits = []
+        if len(keep_locs) == len(shard.replicas) - 1:
+            fits = [wi for wi in cands
+                    if pol.validate(keep_locs + [self._locality_of(wi)])]
+            if not fits and strict:
+                raise error("no_more_servers")
+        # teammates unresolvable (e.g. their workers rebooted with
+        # empty role sets) or no policy-fitting candidate: degrade
+        # like recruitment does — prefer at least a fresh zone over a
+        # doubled-up one, never wedge the heal
+        if not fits:
+            team_zones = {self.workers[w].zone
+                          for w in team_workers if w in self.workers}
+            fits = [wi for wi in cands
+                    if wi.zone not in team_zones] or cands
+        dst_wi = fits[self._rr % len(fits)]
         self._rr += 1
         # source: a LIVE teammate (the excluded server may itself be the
         # only live copy — exclusion is not death)
@@ -1123,8 +1186,12 @@ class ClusterController:
         self._max_tag_ever += 1
         new_tag = self._max_tag_ever
         nrep = max(1, self.config.storage_replicas)
-        team = self.pick_workers(nrep, role="storage")
-        names = [f"storage-{new_tag}-r{j}" for j in range(nrep)]
+        pol, strict = self.storage_policy(nrep)
+        team = self.pick_workers(nrep, role="storage", policy=pol,
+                                 strict=strict)
+        # names follow the team actually built — a mismatched policy
+        # must never pin phantom replica names into the tlogs
+        names = [f"storage-{new_tag}-r{j}" for j in range(len(team))]
         proxies = self._current_proxies()
         if not proxies:
             raise error("operation_failed")
