@@ -58,10 +58,16 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("VALUE_SIZE_LIMIT", 100_000)
     init("RESOLVER_REPLY_CACHE_SIZE", 256)
     init("LOAD_BALANCE_BACKUP_DELAY", 0.005, lambda: 0.0005)
-    # DD shard sizing (ref: SHARD_MAX_BYTES_PER_KSEC family — row-count
-    # stand-ins for the byte/bandwidth thresholds)
-    init("DD_SHARD_SPLIT_ROWS", 1000, lambda: 120)
-    init("DD_SHARD_MERGE_ROWS", 40, lambda: 10)
+    # DD shard sizing on SAMPLED BYTES and write bandwidth (ref:
+    # SHARD_MAX_BYTES / SHARD_MIN_BYTES_PER_KSEC family, Knobs.cpp;
+    # storageserver byteSample at storageserver.actor.cpp:310)
+    init("DD_SHARD_SPLIT_BYTES", 50_000, lambda: 6_000)
+    init("DD_SHARD_MERGE_BYTES", 1_500, lambda: 400)
+    init("DD_SHARD_SPLIT_BYTES_PER_KSEC", 2_000_000_000,
+         lambda: 4_000_000)
+    init("BYTE_SAMPLE_FACTOR", 100, lambda: 10)
+    init("DD_BANDWIDTH_TAU", 5.0, lambda: 1.0)
+    init("DD_MIN_BALANCE_BYTES", 2_000, lambda: 600)
     init("WATCH_TIMEOUT", 900.0, lambda: 20.0)
 
     # -- master / recovery (ref: fdbserver/Knobs.cpp recovery family) --

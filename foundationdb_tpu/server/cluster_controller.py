@@ -758,6 +758,9 @@ class ClusterController:
                     rentry.update(alive=obj.process.alive,
                                   version=obj.version.get(),
                                   durable_version=obj.durable_version.get(),
+                                  sampled_bytes=obj.sampled_bytes(),
+                                  write_bytes_per_sec=round(
+                                      obj.write_bandwidth(), 1),
                                   counters=obj.stats.snapshot(),
                                   latency_bands={
                                       "read": obj.read_bands.snapshot()})
@@ -862,11 +865,20 @@ class ClusterController:
                 if any(o._pending or o.data._keys for o in objs0):
                     await self._nudge_commit()
             objs = [team[0] for team in teams]   # per-shard spokesman
-            counts = [o.approx_rows() for o in objs]
+            counts = [o.sampled_bytes() for o in objs]
             from ..flow import SERVER_KNOBS as _K
-            # split a hot shard (ref: shardSplitter on size)
+            # split a hot shard: too many sampled bytes OR sustained
+            # write bandwidth past the per-shard ceiling (ref:
+            # shardSplitter on getStorageMetrics bytes +
+            # SHARD_MAX_BYTES_PER_KSEC bandwidth splits)
             hot = [i for i, n in enumerate(counts)
-                   if n > _K.dd_shard_split_rows]
+                   if (n > _K.dd_shard_split_bytes
+                       or objs[i].write_bandwidth() * 1000.0
+                       > _K.dd_shard_split_bytes_per_ksec)
+                   # splittable only: a one-key hotspot has no interior
+                   # split point — retrying would livelock DD and
+                   # starve merges/balance moves
+                   and objs[i].split_key_estimate() is not None]
             if hot:
                 try:
                     await self._split_shard(hot[0])
@@ -879,7 +891,7 @@ class ClusterController:
             # merge adjacent cold shards — never below the configured
             # baseline count (ref: shardMerger; SHARD_MIN_BYTES floor)
             cold = [i for i in range(len(counts) - 1)
-                    if counts[i] + counts[i + 1] < _K.dd_shard_merge_rows]
+                    if counts[i] + counts[i + 1] < _K.dd_shard_merge_bytes]
             if cold and len(info.storages) > self.config.n_storage:
                 try:
                     await self._merge_shards(cold[0])
@@ -896,7 +908,7 @@ class ClusterController:
                 src, direction = (i, "right") if big > small else (i + 1,
                                                                    "left")
                 hi, lo = max(big, small), min(big, small)
-                if hi < 200 or hi <= 2 * lo:
+                if hi < _K.dd_min_balance_bytes or hi <= 2 * lo:
                     continue
                 split = objs[src].split_key_estimate()
                 if split is None:

@@ -40,6 +40,126 @@ SHARD_META_KEY = b"\xff\xff/shardMeta"   # persisted tag + owned range
 _NO_HINT = object()  # sentinel: _get_hinted must consult the base engine
 
 
+class StorageMetrics:
+    """Sampled byte metrics + smoothed write bandwidth for DD
+    decisions (ref: storageserver.actor.cpp:310-312 byteSample — each
+    entry is sampled with probability min(1, size/factor) and recorded
+    at weight max(size, factor), an unbiased estimator of total bytes
+    whose memory cost is O(total/factor); StorageMetrics.actor.h:302
+    splitMetrics picking byte-balanced split points). Inclusion is a
+    deterministic hash of the key so every replica samples
+    identically and sim runs replay exactly."""
+
+    __slots__ = ("_sample", "_keys", "_total", "_rate", "_rate_t")
+
+    def __init__(self):
+        self._sample: Dict[bytes, int] = {}
+        self._keys: List[bytes] = []   # sorted index over the sample
+        self._total = 0                # running sum of sampled weights
+        self._rate = 0.0               # smoothed write bytes/sec
+        self._rate_t: Optional[float] = None
+
+    @staticmethod
+    def _weight(key: bytes, nbytes: int) -> int:
+        factor = SERVER_KNOBS.byte_sample_factor
+        if nbytes >= factor:
+            return nbytes
+        import zlib
+        if zlib.crc32(key) / 0xFFFFFFFF < nbytes / factor:
+            return factor
+        return 0
+
+    def note_set(self, key: bytes, nbytes: int) -> None:
+        w = self._weight(key, nbytes)
+        old = self._sample.get(key)
+        if w:
+            self._sample[key] = w
+            self._total += w - (old or 0)
+            if old is None:
+                insort(self._keys, key)
+        elif old is not None:
+            del self._sample[key]
+            self._total -= old
+            del self._keys[bisect_left(self._keys, key)]
+
+    def note_clear(self, begin: bytes, end: bytes) -> None:
+        i = bisect_left(self._keys, begin)
+        j = bisect_left(self._keys, end)
+        for k in self._keys[i:j]:
+            self._total -= self._sample.pop(k)
+        del self._keys[i:j]
+
+    def apply(self, m: MutationRef) -> None:
+        if m.type == CLEAR_RANGE:
+            self.note_clear(m.param1, m.param2)
+        elif m.type not in INERT_OPS:
+            # atomics: the result's size is approximated by the
+            # operand's (exact for set, bounded for the fold ops)
+            self.note_set(m.param1,
+                          len(m.param1) + len(m.param2 or b""))
+
+    def rebuild(self, rows) -> None:
+        self._sample.clear()
+        self._keys.clear()
+        self._total = 0
+        for k, v in rows:
+            self.note_set(k, len(k) + len(v))
+
+    def sampled_bytes(self, begin: bytes = b"",
+                      end: Optional[bytes] = None) -> int:
+        if begin == b"" and end is None:
+            return self._total
+        i = bisect_left(self._keys, begin)
+        j = (bisect_left(self._keys, end) if end is not None
+             else len(self._keys))
+        return sum(self._sample[k] for k in self._keys[i:j])
+
+    def split_key(self, begin: bytes,
+                  end: Optional[bytes]) -> Optional[bytes]:
+        """First key past half the sampled bytes — the byte-balanced
+        split point (ref: splitMetrics). None when the sample is too
+        thin to name an interior key."""
+        i = bisect_left(self._keys, begin)
+        j = (bisect_left(self._keys, end) if end is not None
+             else len(self._keys))
+        keys = self._keys[i:j]
+        if len(keys) < 2:
+            return None
+        total = sum(self._sample[k] for k in keys)
+        acc = 0
+        for k in keys:
+            acc += self._sample[k]
+            if acc * 2 >= total and k > begin:
+                return k
+        return None
+
+    def reset_rate(self) -> None:
+        """Forget the smoothed write rate — the meter is server-scoped,
+        so after bounds shrink (split/shrink_to) the departed range's
+        traffic must not keep counting against this shard."""
+        self._rate = 0.0
+        self._rate_t = None
+
+    def note_write(self, nbytes: int, now: float) -> None:
+        """Leaky-integrator bandwidth: rate decays with time constant
+        DD_BANDWIDTH_TAU and each write adds nbytes/tau — steady-state
+        equals the true bytes/sec (ref: bytesInput rate smoothing
+        feeding SHARD_MAX_BYTES_PER_KSEC splits)."""
+        import math
+        tau = SERVER_KNOBS.dd_bandwidth_tau
+        if self._rate_t is not None and tau > 0:
+            self._rate *= math.exp(-(now - self._rate_t) / tau)
+        self._rate_t = now
+        self._rate += nbytes / max(tau, 1e-9)
+
+    def write_bytes_per_sec(self, now: float) -> float:
+        import math
+        tau = SERVER_KNOBS.dd_bandwidth_tau
+        if self._rate_t is None or tau <= 0:
+            return 0.0
+        return self._rate * math.exp(-(now - self._rate_t) / tau)
+
+
 def encode_shard_meta(tag: int, begin: bytes, end: Optional[bytes],
                       floors=()) -> bytes:
     """Shard identity + fetched-range floors: a floor records that
@@ -417,6 +537,8 @@ class StorageServer:
         # (ref: StorageServer::counters — query/mutation accounting)
         self.stats = flow.CounterCollection("storage")
         self.read_bands = flow.LatencyBands("read")
+        # byte sample + write bandwidth for DD sizing decisions
+        self.metrics = StorageMetrics()
         self._actors = flow.ActorCollection()
         self.recovered = Future()   # engine recovery complete (fetchKeys
                                     # sources/destinations wait on this)
@@ -483,6 +605,10 @@ class StorageServer:
                         encode_shard_meta(self.tag, self.shard_begin,
                                           self.shard_end))
             await self.kv.commit()
+        # re-seed the byte sample from the recovered base (the
+        # reference persists its byteSample; a scan-on-boot is the
+        # sim-scale equivalent)
+        self._rebuild_metrics()
 
     async def _pull_loop(self):
         """Pull this tag's committed mutations from the log
@@ -543,8 +669,21 @@ class StorageServer:
             if cap is not None and version > cap:
                 break  # stale data beyond the generation's locked end
             apply_now = self._partition(version, mutations)
+            wbytes = 0
+            hi = self.shard_end if self.shard_end is not None else b"\xff"
             for m in apply_now:
                 self.data.apply(version, m)
+                self.metrics.apply(m)
+                # bandwidth counts OWNED-range traffic only: stray
+                # parts of shard-spanning mutations must not push this
+                # shard over the split ceiling
+                if m.type == CLEAR_RANGE:
+                    if m.param1 < hi and m.param2 > self.shard_begin:
+                        wbytes += len(m.param1) + len(m.param2)
+                elif self.shard_begin <= m.param1 < hi:
+                    wbytes += len(m.param1) + len(m.param2 or b"")
+            if wbytes:
+                self.metrics.note_write(wbytes, flow.now())
             self.stats.counter("mutations").add(len(mutations))
             if apply_now:
                 self._pending.append((version, apply_now))
@@ -560,7 +699,15 @@ class StorageServer:
         """Route each mutation part: the in-flight incoming range
         buffers until its snapshot lands; floored ranges drop parts the
         installed snapshot already contains (post-crash replay); the
-        rest applies now. Clears are clipped at the range edges."""
+        rest applies now. Clears are clipped at the range edges.
+
+        Parts outside the owned range apply too — clipping to bounds
+        here would be WRONG: a rebooted replica replays history
+        against stale persisted bounds (the authoritative clamp
+        arrives asynchronously after registration) and would drop
+        clears it legitimately owns. Stale out-of-range window state
+        left by a shard-spanning mutation is purged when the range is
+        (re-)acquired (_purge_window_range at install)."""
         if self._adding is None and not self._floors:
             return tuple(mutations)
         out = []
@@ -608,9 +755,11 @@ class StorageServer:
             return
         keep = [(v, ms) for v, ms in self._pending if v <= rv]
         self.data = VersionedMap(base=self.kv)
+        self._rebuild_metrics()
         for v, ms in keep:
             for m in ms:
                 self.data.apply(v, m)
+                self.metrics.apply(m)
         self._pending = keep
         self.version.rollback(rv)
         flow.cover("storage.rollback")
@@ -716,8 +865,25 @@ class StorageServer:
         the install durable first keeps a crash from resurrecting the
         old ownership after the source has shrunk."""
         begin, end = self._adding
+        # purge stale window/pending state for the acquired range at
+        # versions <= at_version FIRST: a vacate clear left by an
+        # earlier shrink_to would otherwise shadow the installed base
+        # rows on reads (its window stamp survives re-acquisition) and
+        # clobber them on the durability replay (ref: fetchKeys
+        # clearing the fetched range in versioned data before
+        # inserting the snapshot, storageserver.actor.cpp fetchKeys)
+        self._purge_window_range(begin, end, at_version)
+        # the snapshot IS the range's complete state at at_version:
+        # wipe the base range first — stale rows from a previous
+        # ownership era (whose vacate clear the purge just dropped
+        # from the pending queue) must not shine through under the
+        # installed data (ref: fetchKeys clear-then-insert)
+        hi = end if end is not None else b"\xff"
+        self.kv.clear_range(begin, hi)
+        self.metrics.note_clear(begin, hi)
         for k, v in rows:
             self.kv.set(k, v)
+            self.metrics.note_set(k, len(k) + len(v))
         self._floors.append((begin, end if end is not None else b"\xff",
                              at_version))
         self._read_floor = max(self._read_floor, at_version)
@@ -748,8 +914,56 @@ class StorageServer:
         replay = [(v, m) for v, m in buf if v > at_version]
         for v, m in replay:
             self.data.apply(v, m)
+            self.metrics.apply(m)
         if replay:
             self._merge_pending(replay)
+
+    def _purge_window_range(self, begin: bytes, end: Optional[bytes],
+                            up_to: int) -> None:
+        """Drop window chains, clears, and pending replay covering
+        [begin, end) at versions <= up_to — the installed snapshot IS
+        that range's state at up_to. Parts outside the range (a clear
+        spanning the boundary) are kept. Reads below up_to are already
+        rejected by the install's read floor, so no reader can miss
+        the removed history."""
+        hi = end if end is not None else b"\xff"
+        d = self.data
+        i = bisect_left(d._keys, begin)
+        j = bisect_left(d._keys, hi)
+        survivors = []
+        for k in d._keys[i:j]:
+            chain = [e for e in d._chains[k] if e[0] > up_to]
+            if chain:
+                d._chains[k] = chain
+                survivors.append(k)
+            else:
+                del d._chains[k]
+        d._keys[i:j] = survivors
+        kept = []
+        for v, s, cb, ce in d._clears:
+            if v > up_to or ce <= begin or cb >= hi:
+                kept.append((v, s, cb, ce))
+                continue
+            if cb < begin:
+                kept.append((v, s, cb, begin))
+            if ce > hi:
+                kept.append((v, s, hi, ce))
+        d._clears = kept
+        d._clear_index = _ClearIndex()
+        for v, s, cb, ce in kept:
+            d._clear_index.insert(v, s, cb, ce)
+        pending = []
+        for v, ms in self._pending:
+            if v > up_to:
+                pending.append((v, ms))
+                continue
+            keep_ms = []
+            for m in ms:
+                _inside, outside = _split_mutation(m, begin, end)
+                keep_ms.extend(outside)
+            if keep_ms:
+                pending.append((v, tuple(keep_ms)))
+        self._pending = pending
 
     async def set_bounds(self, begin: bytes, end: Optional[bytes]) -> None:
         """Adopt authoritative bounds (the CC's shard map is ground
@@ -784,6 +998,7 @@ class StorageServer:
                 self.shard_end if self.shard_end is not None else b"\xff"))
         for m in clears:
             self.data.apply(v, m)
+            self.metrics.apply(m)
         if clears:
             self._merge_pending([(v, m) for m in clears])
         # watches on vacated keys will never fire here again: fail them
@@ -791,6 +1006,9 @@ class StorageServer:
         self._fail_watches(
             lambda k: k < begin or (end is not None and k >= end))
         self.shard_begin, self.shard_end = begin, end
+        # the departed range's write traffic must not keep this shard
+        # over the bandwidth-split ceiling (the meter is server-scoped)
+        self.metrics.reset_rate()
         self._persist_meta()
         if self.kv is not None:
             await self.kv.commit()
@@ -810,18 +1028,41 @@ class StorageServer:
             self._pending.insert(i, (v, (m,)))
 
     def approx_rows(self) -> int:
-        """Row-count estimate for data-distribution decisions: the base
-        engine's O(1) count (which lags the durability horizon and
-        includes a couple of metadata keys) plus the window's key-index
-        size — cheap and monotone enough to compare adjacent shards."""
+        """Row-count estimate (status/observability; DD sizing runs on
+        sampled BYTES — see sampled_bytes): the base engine's O(1)
+        count plus the window's key-index size."""
         base = self.kv.row_count() if self.kv is not None else 0
         win = len(self.data._keys)
         return base + win
 
-    def split_key_estimate(self) -> Optional[bytes]:
-        """A key near the middle of this shard's data (ref: the
-        byte-sample-driven split point in DataDistributionTracker)."""
+    def _rebuild_metrics(self) -> None:
+        """Re-seed the byte sample from the durable base's owned range
+        (rollback discarded window state; recovery starts fresh)."""
+        if self.kv is None:
+            self.metrics.rebuild(())
+            return
         hi = self.shard_end if self.shard_end is not None else b"\xff"
+        self.metrics.rebuild(self.kv.get_range(self.shard_begin, hi))
+
+    def sampled_bytes(self) -> int:
+        """Estimated logical bytes in this shard (ref:
+        storageserver.actor.cpp:310 byteSample → getStorageMetrics)."""
+        return self.metrics.sampled_bytes(
+            self.shard_begin, self.shard_end)
+
+    def write_bandwidth(self) -> float:
+        """Smoothed write bytes/sec into this shard (ref: bytesInput
+        rate driving SHARD_MAX_BYTES_PER_KSEC splits)."""
+        return self.metrics.write_bytes_per_sec(flow.now())
+
+    def split_key_estimate(self) -> Optional[bytes]:
+        """A byte-balanced interior key from the sample (ref:
+        StorageMetrics.actor.h:302 splitMetrics); the window's row
+        median is the fallback while the sample is too thin."""
+        hi = self.shard_end if self.shard_end is not None else b"\xff"
+        k = self.metrics.split_key(self.shard_begin, self.shard_end)
+        if k is not None:
+            return k
         rows = self.data.get_range(self.shard_begin, hi,
                                    self.version.get(), 5000)
         if len(rows) < 2:

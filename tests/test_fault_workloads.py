@@ -232,7 +232,7 @@ def test_dd_split_merge_vacate_under_attrition(seed):
     moveKeys + MachineAttrition stacked, the reference's DD churn
     coverage)."""
     c = SimCluster(seed=seed, durable=True, n_storage=1, n_workers=7)
-    flow.SERVER_KNOBS.init("DD_SHARD_SPLIT_ROWS", 120)
+    flow.SERVER_KNOBS.init("DD_SHARD_SPLIT_BYTES", 1000)
     try:
         db = c.client()
         machines = [f"w{i}" for i in range(c.n_workers)]
@@ -341,7 +341,7 @@ def test_dd_churn_with_buggify(seed):
     every simulation run)."""
     c = SimCluster(seed=seed, durable=True, n_storage=1, n_workers=6,
                    buggify=True)
-    flow.SERVER_KNOBS.init("DD_SHARD_SPLIT_ROWS", 100)
+    flow.SERVER_KNOBS.init("DD_SHARD_SPLIT_BYTES", 900)
     try:
         db = c.client()
         machines = [f"w{i}" for i in range(c.n_workers)]

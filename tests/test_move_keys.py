@@ -272,7 +272,7 @@ def test_dd_splits_hot_shard_with_fresh_tag():
     c = SimCluster(seed=1401, durable=True, n_storage=1, n_workers=5)
     try:
         db = c.client()
-        SERVER_KNOBS.init("DD_SHARD_SPLIT_ROWS", 150)
+        SERVER_KNOBS.init("DD_SHARD_SPLIT_BYTES", 1200)
 
         async def main():
             async def seed(tr):
@@ -322,7 +322,7 @@ def test_dd_merges_cold_split_back():
     c = SimCluster(seed=1402, durable=True, n_storage=1, n_workers=5)
     try:
         db = c.client()
-        SERVER_KNOBS.init("DD_SHARD_SPLIT_ROWS", 150)
+        SERVER_KNOBS.init("DD_SHARD_SPLIT_BYTES", 1200)
 
         async def main():
             async def seed(tr):
@@ -357,6 +357,10 @@ def test_dd_merges_cold_split_back():
 
             async def check(tr):
                 assert await tr.get(b"survivor") == b"1"
+                # no resurrection: the left team's kv held the m-rows
+                # from before the split; the merge install must not let
+                # them shine through under the (cleared) snapshot
+                assert await tr.get_range(b"m", b"n") == []
                 tr.set(b"post-merge", b"2")
             await run_transaction(db, check)
             return True
