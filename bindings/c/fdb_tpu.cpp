@@ -1018,19 +1018,12 @@ fdb_tpu_error_t fdb_tpu_transaction_get(FDBTpuTransaction* tr,
     return 0;
 }
 
-fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
-                                            const uint8_t* key,
-                                            int key_length, int or_equal,
-                                            int offset, int snapshot,
-                                            uint8_t** out_key,
-                                            int* out_key_length) {
-    /* cross-shard selector walk (client/transaction.py get_key; ref:
-     * NativeAPI getKey readThrough iteration) */
-    std::string anchor((const char*)key, key_length);
-    /* anchor == "\xff" (allKeys.end) stays legal: the canonical
-     * last-key idiom, same exclusive-end convention as get_range */
-    if (in_system(anchor) && anchor != kSystemBegin && !tr->read_system)
-        return 2004;
+static fdb_tpu_error_t get_key_storage_walk(FDBTpuTransaction* tr,
+                                            const std::string& anchor,
+                                            int or_equal, int offset,
+                                            std::string* out) {
+    /* raw cross-shard selector walk against storage (ref: NativeAPI
+     * getKey readThrough iteration) */
     int64_t version;
     fdb_tpu_error_t err = tr->grv(&version);
     if (err) return err;
@@ -1081,6 +1074,84 @@ fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
             sel_off = leftover;
         }
     }
+    *out = resolved;
+    return 0;
+}
+
+fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
+                                            const uint8_t* key,
+                                            int key_length, int or_equal,
+                                            int offset, int snapshot,
+                                            uint8_t** out_key,
+                                            int* out_key_length) {
+    /* selector resolution against the READ-YOUR-WRITES view — merged
+     * committed data + this transaction's uncommitted writes/clears
+     * (client/transaction.py get_key; ref: ReadYourWrites getKey via
+     * RYWIterator). User-space anchors resolve via bounded merged
+     * scans; system-space anchors use the raw storage walk. */
+    std::string anchor((const char*)key, key_length);
+    /* anchor == "\xff" (allKeys.end) stays legal: the canonical
+     * last-key idiom, same exclusive-end convention as get_range */
+    if (in_system(anchor) && anchor != kSystemBegin && !tr->read_system)
+        return 2004;
+    fdb_tpu_error_t err;
+    std::string resolved;
+    if (in_system(anchor) && anchor != kSystemBegin) {
+        err = get_key_storage_walk(tr, anchor, or_equal, offset,
+                                   &resolved);
+        if (err) return err;
+    } else {
+        std::string a = anchor;
+        if (or_equal) a.push_back('\0');
+        FDBTpuKeyValue* kv = nullptr;
+        int n = 0;
+        if (offset >= 1) {
+            /* the offset-th present merged key >= anchor */
+            std::string b = std::min(a, kSystemBegin);
+            err = fdb_tpu_transaction_get_range(
+                tr, (const uint8_t*)b.data(), int(b.size()),
+                (const uint8_t*)kSystemBegin.data(),
+                int(kSystemBegin.size()), offset, 0, 1, &kv, &n);
+            if (err) return err;
+            if (n >= offset) {
+                resolved.assign((const char*)kv[offset - 1].key,
+                                kv[offset - 1].key_length);
+            } else if (tr->read_system) {
+                /* walk leaves user space: continue into stored \xff
+                 * rows with the RESIDUAL offset — the merged scan
+                 * already counted n present keys (replaying the raw
+                 * selector would re-count rows the overlay changed) */
+                int residual = offset - n;
+                fdb_tpu_free_keyvalues(kv, n);
+                kv = nullptr;
+                n = 0;
+                err = get_key_storage_walk(tr, kSystemBegin, 0, residual,
+                                           &resolved);
+                if (err) return err;
+            } else {
+                resolved = kSystemBegin;
+            }
+        } else {
+            /* the (1-offset)-th present merged key < anchor */
+            int needed = 1 - offset;
+            std::string e = std::min(a, kSystemBegin);
+            if (e.empty()) {
+                resolved.clear();
+            } else {
+                err = fdb_tpu_transaction_get_range(
+                    tr, (const uint8_t*)"", 0,
+                    (const uint8_t*)e.data(), int(e.size()), needed, 1,
+                    1, &kv, &n);
+                if (err) return err;
+                if (n >= needed)
+                    resolved.assign((const char*)kv[needed - 1].key,
+                                    kv[needed - 1].key_length);
+                else
+                    resolved.clear();
+            }
+        }
+        if (kv) fdb_tpu_free_keyvalues(kv, n);
+    }
     /* a selector walking off user space clamps to maxKey instead of
      * leaking stored \xff rows (client/transaction.py get_key) */
     if (resolved > kSystemBegin && !tr->read_system) resolved = kSystemBegin;
@@ -1115,12 +1186,26 @@ fdb_tpu_error_t fdb_tpu_transaction_get_range(
     auto p = tr->picture();
     if (!p) return 1100;
 
-    /* an overlay can add/remove rows: fetch the full range and merge
+    /* Overlay writes/atomics remove at most one base row each, so the
+     * base fetch stays bounded at limit + overlay count in the
+     * requested direction; only a clear intersecting the range can
+     * delete unboundedly many base rows and forces the full fetch
      * (client/transaction.py get_range; ref: RYWIterator) */
-    bool overlay = !tr->clears.empty() || !tr->writes.empty() ||
-                   !tr->ops.empty();
-    int fetch_limit = overlay ? (1 << 20) : limit;
-    bool fetch_rev = overlay ? false : (reverse != 0);
+    bool clear_in_range = false;
+    for (const auto& cl : tr->clears)
+        if (cl.first < end && cl.second > begin) clear_in_range = true;
+    int64_t n_writes = 0;
+    for (auto it = tr->writes.lower_bound(begin);
+         it != tr->writes.end() && it->first < end; ++it)
+        n_writes++;
+    int64_t n_ops = 0;
+    for (const auto& kv : tr->ops)
+        if (begin <= kv.first && kv.first < end) n_ops++;
+    int fetch_limit = clear_in_range
+                          ? (1 << 20)
+                          : int(std::min<int64_t>(limit + n_writes + n_ops,
+                                                  1 << 20));
+    bool fetch_rev = clear_in_range ? false : (reverse != 0);
 
     std::vector<std::pair<std::string, std::string>> base;
     std::vector<const Shard*> overlapping;

@@ -566,9 +566,14 @@ class Transaction:
 
     async def get_key(self, selector: KeySelector,
                       snapshot: bool = False) -> bytes:
-        """Resolve a key selector, walking across shard boundaries when
-        the offset leaves the anchor shard (ref: Transaction::getKey /
-        NativeAPI getKey readThrough iteration)."""
+        """Resolve a key selector against the READ-YOUR-WRITES view —
+        the merged stream of committed data and this transaction's
+        uncommitted writes/clears (ref: ReadYourWrites getKey through
+        RYWIterator; found as a divergence by the WriteDuringRead
+        model checker: the old path resolved against storage alone).
+        User-space anchors walk via bounded merged scans; system-space
+        anchors (READ_SYSTEM_KEYS holders) use the raw storage walk —
+        there are no RYW writes in \\xff space to merge."""
         # anchor == b"\xff" (allKeys.end) stays legal without the option
         # — last_less_than(\xff) is the canonical "last key" idiom, the
         # same exclusive-end convention the range gate honors
@@ -576,33 +581,39 @@ class Transaction:
                 selector.key != SYSTEM_PREFIX and \
                 not getattr(self, "_read_system", False):
             raise error("key_outside_legal_range")
-        version = await self.get_read_version()
-        info = await self._get_info()
-        storages = info.storages
-        i = _shard_index(storages, selector.key)
-        sel = selector
-        while True:
-            key, leftover = await self._storage_rpc(
-                storages[i], lambda rep, sel=sel: rep.get_keys.get_reply(
-                    StorageGetKeyRequest(sel, version), self.db.process))
-            if leftover == 0:
-                resolved = key
-                break
-            if leftover < 0:
-                if i == 0:
-                    resolved = b""
-                    break
-                i -= 1
-                # the |leftover|-th present key left of the boundary:
-                # anchor "last key < boundary", advance leftover+1
-                sel = KeySelector(storages[i + 1].begin, False, leftover + 1)
+        if selector.key.startswith(SYSTEM_PREFIX) and \
+                selector.key != SYSTEM_PREFIX:
+            resolved = await self._get_key_storage(selector)
+        else:
+            anchor = (selector.key + b"\x00" if selector.or_equal
+                      else selector.key)
+            if selector.offset >= 1:
+                # the offset-th present merged key >= anchor
+                rows = await self.get_range(
+                    min(anchor, SYSTEM_PREFIX), SYSTEM_PREFIX,
+                    limit=selector.offset, snapshot=True)
+                if len(rows) >= selector.offset:
+                    resolved = rows[selector.offset - 1][0]
+                elif getattr(self, "_read_system", False):
+                    # the walk leaves user space: a READ_SYSTEM_KEYS
+                    # holder continues into stored \xff rows with the
+                    # RESIDUAL offset — the merged scan already counted
+                    # len(rows) present keys (replaying the original
+                    # selector raw would re-count storage rows the
+                    # overlay added or cleared)
+                    resolved = await self._get_key_storage(KeySelector(
+                        SYSTEM_PREFIX, False,
+                        selector.offset - len(rows)))
+                else:
+                    resolved = SYSTEM_PREFIX
             else:
-                if i == len(storages) - 1:
-                    resolved = b"\xff"
-                    break
-                i += 1
-                # the leftover-th present key right of the boundary
-                sel = KeySelector(storages[i].begin, False, leftover)
+                # the (1-offset)-th present merged key < anchor
+                needed = 1 - selector.offset
+                rows = await self.get_range(
+                    b"", min(anchor, SYSTEM_PREFIX), limit=needed,
+                    snapshot=True, reverse=True)
+                resolved = (rows[needed - 1][0] if len(rows) >= needed
+                            else b"")
         # without READ_SYSTEM_KEYS a selector walking off the end of user
         # space clamps to maxKey instead of leaking stored \xff rows
         # (ref: getKey clamps at allKeys.end)
@@ -614,6 +625,35 @@ class Transaction:
             hi = max(resolved, selector.key)
             self._read_conflicts.append((lo, _next_key(hi)))
         return resolved
+
+    async def _get_key_storage(self, selector: KeySelector) -> bytes:
+        """Raw selector resolution against storage, walking across
+        shard boundaries when the offset leaves the anchor shard (ref:
+        NativeAPI getKey readThrough iteration)."""
+        version = await self.get_read_version()
+        info = await self._get_info()
+        storages = info.storages
+        i = _shard_index(storages, selector.key)
+        sel = selector
+        while True:
+            key, leftover = await self._storage_rpc(
+                storages[i], lambda rep, sel=sel: rep.get_keys.get_reply(
+                    StorageGetKeyRequest(sel, version), self.db.process))
+            if leftover == 0:
+                return key
+            if leftover < 0:
+                if i == 0:
+                    return b""
+                i -= 1
+                # the |leftover|-th present key left of the boundary:
+                # anchor "last key < boundary", advance leftover+1
+                sel = KeySelector(storages[i + 1].begin, False, leftover + 1)
+            else:
+                if i == len(storages) - 1:
+                    return b"\xff"
+                i += 1
+                # the leftover-th present key right of the boundary
+                sel = KeySelector(storages[i].begin, False, leftover)
 
     async def get_range(self, begin, end, limit: int = UNBOUNDED_ROW_LIMIT,
                         snapshot: bool = False,
@@ -656,14 +696,29 @@ class Transaction:
             return sorted(rows, reverse=reverse)[:limit]
         version = await self.get_read_version()
         # With no RYW overlay in the range the storage servers honor the
-        # caller's limit/reverse directly; an overlay (clears/writes/
-        # atomics) can remove or add rows, so fetch the full range and
-        # merge (ref: RYWIterator reads through the WriteMap instead).
-        has_overlay = bool(self._cleared or self._write_order or self._ops)
-        base = await self._fetch_range(
-            begin, end, version,
-            UNBOUNDED_ROW_LIMIT if has_overlay else limit,
-            False if has_overlay else reverse)
+        # caller's limit/reverse directly. Overlay writes/atomics remove
+        # at most one base row each, so the base fetch stays BOUNDED at
+        # limit + overlay count (in the requested direction — the
+        # truncated prefix then provably contains the merged top-limit
+        # rows). Only a clear intersecting the range can delete
+        # unboundedly many base rows and forces the full fetch
+        # (ref: RYWIterator reads through the WriteMap instead).
+        lo = bisect_left(self._write_order, begin)
+        hi = bisect_left(self._write_order, end)
+        n_ops = sum(1 for k in self._ops if begin <= k < end)
+        has_overlay = bool(hi > lo or n_ops
+                           or any(b < end and e > begin
+                                  for b, e in self._cleared))
+        if any(b < end and e > begin for b, e in self._cleared):
+            fetch_limit, fetch_rev = UNBOUNDED_ROW_LIMIT, False
+        elif has_overlay:
+            fetch_limit = min(limit + (hi - lo) + n_ops,
+                              UNBOUNDED_ROW_LIMIT)
+            fetch_rev = reverse
+        else:
+            fetch_limit, fetch_rev = limit, reverse
+        base = await self._fetch_range(begin, end, version, fetch_limit,
+                                       fetch_rev)
         # overlay uncommitted writes (ref: RYWIterator merge)
         merged: Dict[bytes, bytes] = {k: v for k, v in base}
         for b, e in self._cleared:

@@ -1046,9 +1046,12 @@ class StorageServer:
 
     def sampled_bytes(self) -> int:
         """Estimated logical bytes in this shard (ref:
-        storageserver.actor.cpp:310 byteSample → getStorageMetrics)."""
+        storageserver.actor.cpp:310 byteSample → getStorageMetrics).
+        Capped at \\xff: system-space rows (backup progress, \\xff/conf)
+        must not count toward user-shard sizing or split points."""
         return self.metrics.sampled_bytes(
-            self.shard_begin, self.shard_end)
+            self.shard_begin,
+            self.shard_end if self.shard_end is not None else b"\xff")
 
     def write_bandwidth(self) -> float:
         """Smoothed write bytes/sec into this shard (ref: bytesInput
@@ -1060,7 +1063,7 @@ class StorageServer:
         StorageMetrics.actor.h:302 splitMetrics); the window's row
         median is the fallback while the sample is too thin."""
         hi = self.shard_end if self.shard_end is not None else b"\xff"
-        k = self.metrics.split_key(self.shard_begin, self.shard_end)
+        k = self.metrics.split_key(self.shard_begin, hi)
         if k is not None:
             return k
         rows = self.data.get_range(self.shard_begin, hi,

@@ -1,0 +1,227 @@
+"""Model-checked client workloads.
+
+Reference: fdbserver/workloads/WriteDuringRead.actor.cpp:29-143 — a
+random operation mix (sets, clears, range clears, atomics, gets,
+selector/limit/reverse range reads, watches) driven through the full
+client surface and replayed against an in-memory model database, with
+every read asserted against the model mid-transaction (read-your-writes
+included); stacked with attrition/BUGGIFY by the callers. Also covers
+the FuzzApiCorrectness/RyowCorrectness ground: the model implements
+selector resolution and atomic folds locally, so any divergence in the
+distributed pipeline (proxy batching, tlog replication, storage MVCC,
+shard moves) surfaces as an assertion with the op trace attached.
+
+Retried commits are resolved exactly: every transaction writes a
+sequence key, and a commit_unknown_result is settled by reading it
+back — the model then applies or discards the staged effects, never
+guesses (ref: the reference workloads' use of idempotent markers for
+commit_unknown_result).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+from .. import flow
+from ..client.transaction import _ATOMIC_APPLY, run_transaction
+from .types import (ADD_VALUE, AND_V2, APPEND_IF_FITS, BYTE_MAX, BYTE_MIN,
+                    COMPARE_AND_CLEAR, KeySelector, MAX, MIN_V2, OR, XOR)
+
+_ATOMIC_CHOICES = (ADD_VALUE, AND_V2, OR, XOR, MAX, MIN_V2, BYTE_MIN,
+                   BYTE_MAX, APPEND_IF_FITS, COMPARE_AND_CLEAR)
+
+RETRYABLE = {"not_committed", "transaction_too_old", "future_version",
+             "commit_unknown_result", "broken_promise",
+             "proxy_memory_limit_exceeded", "process_behind",
+             "wrong_shard_server", "transaction_timed_out"}
+
+
+def model_select(keys: List[bytes], sel: KeySelector) -> bytes:
+    """KeySelector resolution against a sorted key list (the model's
+    findKey — mirrors storage resolve_selector + the client's cross-
+    shard walk + user-space clamps, storage.py resolve_selector)."""
+    anchor = sel.key + b"\x00" if sel.or_equal else sel.key
+    if sel.offset >= 1:
+        i = bisect_left(keys, anchor) + sel.offset - 1
+        return keys[i] if i < len(keys) else b"\xff"
+    i = bisect_left(keys, anchor) - (1 - sel.offset)
+    return keys[i] if i >= 0 else b""
+
+
+def model_range(staged: Dict[bytes, bytes], begin: bytes, end: bytes,
+                limit: int, reverse: bool) -> List[Tuple[bytes, bytes]]:
+    rows = sorted((k, v) for k, v in staged.items() if begin <= k < end)
+    if reverse:
+        rows.reverse()
+    return rows[:limit] if limit else rows
+
+
+class WriteDuringRead:
+    """One seeded run: `await WriteDuringRead(db, rng).run(rounds)`.
+    Raises AssertionError (with the failing op) on any divergence."""
+
+    def __init__(self, db, rng, prefix: bytes = b"wdr/",
+                 keyspace: int = 24, max_ops: int = 8,
+                 check_watches: bool = True):
+        self.db = db
+        self.rng = rng
+        self.prefix = prefix
+        self.keyspace = keyspace
+        self.max_ops = max_ops
+        self.check_watches = check_watches
+        self.seq_key = prefix + b"\xfeseq"
+        self.model: Dict[bytes, bytes] = {}
+        # armed watches: (key, value at arm time, future, seq armed at)
+        self.watches: list = []
+        self.stats = {"txns": 0, "retries": 0, "unknown_resolved": 0,
+                      "ops": 0, "watches_fired": 0}
+
+    # -- op generation ---------------------------------------------------
+    def _key(self) -> bytes:
+        return self.prefix + b"k%02d" % self.rng.random_int(
+            0, self.keyspace - 1)
+
+    def _gen_ops(self) -> list:
+        ops = []
+        for _ in range(self.rng.random_int(1, self.max_ops)):
+            kind = self.rng.random_int(0, 9)
+            k = self._key()
+            if kind == 0:
+                ops.append(("set", k, b"v%d" % self.rng.random_int(0, 999)))
+            elif kind == 1:
+                ops.append(("clear", k))
+            elif kind == 2:
+                e = self._key()
+                ops.append(("clear_range", min(k, e), max(k, e)))
+            elif kind == 3:
+                op_type = _ATOMIC_CHOICES[self.rng.random_int(
+                    0, len(_ATOMIC_CHOICES) - 1)]
+                width = self.rng.random_int(1, 8)
+                param = bytes(self.rng.random_int(0, 255)
+                              for _ in range(width))
+                ops.append(("atomic", k, param, op_type))
+            elif kind == 4:
+                ops.append(("get", k))
+            elif kind in (5, 6):
+                e = self._key()
+                ops.append(("get_range", min(k, e), max(k, e) + b"\xfe",
+                            self.rng.random_int(0, 6),
+                            bool(self.rng.random_int(0, 1))))
+            elif kind == 7:
+                ops.append(("get_key", k,
+                            bool(self.rng.random_int(0, 1)),
+                            self.rng.random_int(-3, 3)))
+            elif kind == 8 and self.check_watches:
+                ops.append(("watch", k))
+            else:
+                ops.append(("get", k))
+        return ops
+
+    # -- one transaction -------------------------------------------------
+    async def _apply_ops(self, tr, ops, staged: Dict[bytes, bytes],
+                         armed: list) -> None:
+        for op in ops:
+            self.stats["ops"] += 1
+            kind = op[0]
+            if kind == "set":
+                _g, k, v = op
+                tr.set(k, v)
+                staged[k] = v
+            elif kind == "clear":
+                tr.clear(op[1])
+                staged.pop(op[1], None)
+            elif kind == "clear_range":
+                _g, b, e = op
+                tr.clear_range(b, e)
+                for kk in [kk for kk in staged if b <= kk < e]:
+                    del staged[kk]
+            elif kind == "atomic":
+                _g, k, param, op_type = op
+                tr.atomic_op(k, param, op_type)
+                folded = _ATOMIC_APPLY[op_type](staged.get(k), param)
+                if folded is None:
+                    staged.pop(k, None)
+                else:
+                    staged[k] = folded
+            elif kind == "get":
+                got = await tr.get(op[1])
+                want = staged.get(op[1])
+                assert got == want, ("get diverged", op, got, want)
+            elif kind == "get_range":
+                _g, b, e, limit, rev = op
+                got = await tr.get_range(b, e, limit=limit or 10 ** 9,
+                                         reverse=rev)
+                want = model_range(staged, b, e, limit, rev)
+                assert got == want, ("get_range diverged", op, got, want)
+            elif kind == "get_key":
+                _g, k, or_eq, off = op
+                sel = KeySelector(k, or_eq, off)
+                got = await tr.get_key(sel)
+                want = model_select(sorted(staged), sel)
+                assert got == want, ("get_key diverged", op, got, want)
+            elif kind == "watch":
+                # the compare value is resolved at COMMIT version, so
+                # the model value is taken at end of txn (run() fixes
+                # it up from the final staged dict)
+                armed.append((op[1], tr.watch(op[1])))
+
+    async def _resolve_unknown(self, want_seq: bytes) -> bool:
+        """After commit_unknown_result: did the transaction land? The
+        seq key answers exactly (every txn writes a unique value)."""
+        async def body(tr):
+            return await tr.get(self.seq_key)
+        got = await run_transaction(self.db, body, max_retries=200)
+        return got == want_seq
+
+    async def run(self, rounds: int = 50) -> dict:
+        for seq in range(rounds):
+            ops = self._gen_ops()
+            seq_val = b"s%06d" % seq
+            while True:
+                tr = self.db.create_transaction()
+                staged = dict(self.model)
+                armed: list = []
+                try:
+                    await self._apply_ops(tr, ops, staged, armed)
+                    tr.set(self.seq_key, seq_val)
+                    staged[self.seq_key] = seq_val
+                    await tr.commit()
+                    self.model = staged
+                    self.watches.extend(
+                        (k, staged.get(k), f) for k, f in armed)
+                    break
+                except flow.FdbError as e:
+                    if e.name == "commit_unknown_result":
+                        if await self._resolve_unknown(seq_val):
+                            flow.cover("workload.wdr.unknown_committed")
+                            self.stats["unknown_resolved"] += 1
+                            self.model = staged
+                            self.watches.extend(
+                                (k, staged.get(k), f) for k, f in armed)
+                            break
+                    if e.name not in RETRYABLE:
+                        raise
+                    self.stats["retries"] += 1
+                    await flow.delay(0.05 + self.rng.random01() * 0.2)
+            self.stats["txns"] += 1
+        if self.check_watches:
+            await self._check_watches()
+        return self.stats
+
+    async def _check_watches(self) -> None:
+        """Every watch armed on a value that LATER changed must fire;
+        errors (shard moved, replica died) count as fired — the client
+        contract is 'wake up and re-read' either way."""
+        for key, val_at_arm, fut in self.watches:
+            if self.model.get(key) == val_at_arm:
+                continue  # may legitimately stay parked
+            try:
+                await flow.timeout_error(fut, 30.0)
+                self.stats["watches_fired"] += 1
+            except flow.FdbError as e:
+                if e.name in ("timed_out",):
+                    raise AssertionError(
+                        ("watch never fired", key, val_at_arm,
+                         self.model.get(key))) from e
+                self.stats["watches_fired"] += 1  # woke with an error
