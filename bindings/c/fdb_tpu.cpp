@@ -1018,66 +1018,6 @@ fdb_tpu_error_t fdb_tpu_transaction_get(FDBTpuTransaction* tr,
     return 0;
 }
 
-static fdb_tpu_error_t get_key_storage_walk(FDBTpuTransaction* tr,
-                                            const std::string& anchor,
-                                            int or_equal, int offset,
-                                            std::string* out) {
-    /* raw cross-shard selector walk against storage (ref: NativeAPI
-     * getKey readThrough iteration) */
-    int64_t version;
-    fdb_tpu_error_t err = tr->grv(&version);
-    if (err) return err;
-    auto p = tr->picture();
-    if (!p) return 1100;
-    size_t i = tr->shard_index(p, anchor);
-    std::string sel_key = anchor;
-    bool sel_eq = or_equal != 0;
-    int64_t sel_off = offset;
-    std::string resolved;
-    for (;;) {
-        WVal reply;
-        err = tr->storage_rpc(
-            p->shards[i], &Replica::get_keys,
-            WVal::nt("StorageGetKeyRequest",
-                     {WVal::nt("KeySelector",
-                               {WVal::bytes(sel_key),
-                                WVal::boolean(sel_eq),
-                                WVal::integer(sel_off)}),
-                      WVal::integer(version)}),
-            &reply);
-        if (err) return err;
-        if (reply.t != WVal::TUPLE || reply.items.size() != 2 ||
-            reply.items[1].t != WVal::INT)
-            return 4000;
-        int64_t leftover = reply.items[1].i;
-        if (leftover == 0) {
-            resolved = reply.items[0].s;
-            break;
-        }
-        if (leftover < 0) {
-            if (i == 0) {
-                resolved.clear();
-                break;
-            }
-            i -= 1;
-            sel_key = p->shards[i + 1].begin;
-            sel_eq = false;
-            sel_off = leftover + 1;
-        } else {
-            if (i == p->shards.size() - 1) {
-                resolved = "\xff";
-                break;
-            }
-            i += 1;
-            sel_key = p->shards[i].begin;
-            sel_eq = false;
-            sel_off = leftover;
-        }
-    }
-    *out = resolved;
-    return 0;
-}
-
 fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
                                             const uint8_t* key,
                                             int key_length, int or_equal,
@@ -1087,8 +1027,9 @@ fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
     /* selector resolution against the READ-YOUR-WRITES view — merged
      * committed data + this transaction's uncommitted writes/clears
      * (client/transaction.py get_key; ref: ReadYourWrites getKey via
-     * RYWIterator). User-space anchors resolve via bounded merged
-     * scans; system-space anchors use the raw storage walk. */
+     * RYWIterator). ALL anchors resolve via bounded merged scans so
+     * get_key always agrees with what get_range enumerates;
+     * READ_SYSTEM_KEYS widens the walk to the system region. */
     std::string anchor((const char*)key, key_length);
     /* anchor == "\xff" (allKeys.end) stays legal: the canonical
      * last-key idiom, same exclusive-end convention as get_range */
@@ -1096,62 +1037,44 @@ fdb_tpu_error_t fdb_tpu_transaction_get_key(FDBTpuTransaction* tr,
         return 2004;
     fdb_tpu_error_t err;
     std::string resolved;
-    if (in_system(anchor) && anchor != kSystemBegin) {
-        err = get_key_storage_walk(tr, anchor, or_equal, offset,
-                                   &resolved);
-        if (err) return err;
-    } else {
-        std::string a = anchor;
-        if (or_equal) a.push_back('\0');
-        FDBTpuKeyValue* kv = nullptr;
-        int n = 0;
-        if (offset >= 1) {
-            /* the offset-th present merged key >= anchor */
-            std::string b = std::min(a, kSystemBegin);
+    const std::string& hi_bound =
+        tr->read_system ? kEngineBegin : kSystemBegin;
+    std::string a = anchor;
+    if (or_equal) a.push_back('\0');
+    FDBTpuKeyValue* kv = nullptr;
+    int n = 0;
+    if (offset >= 1) {
+        /* the offset-th present merged key >= anchor */
+        std::string b = std::min(a, hi_bound);
+        if (b < hi_bound) {
             err = fdb_tpu_transaction_get_range(
                 tr, (const uint8_t*)b.data(), int(b.size()),
-                (const uint8_t*)kSystemBegin.data(),
-                int(kSystemBegin.size()), offset, 0, 1, &kv, &n);
+                (const uint8_t*)hi_bound.data(), int(hi_bound.size()),
+                offset, 0, 1, &kv, &n);
             if (err) return err;
-            if (n >= offset) {
-                resolved.assign((const char*)kv[offset - 1].key,
-                                kv[offset - 1].key_length);
-            } else if (tr->read_system) {
-                /* walk leaves user space: continue into stored \xff
-                 * rows with the RESIDUAL offset — the merged scan
-                 * already counted n present keys (replaying the raw
-                 * selector would re-count rows the overlay changed) */
-                int residual = offset - n;
-                fdb_tpu_free_keyvalues(kv, n);
-                kv = nullptr;
-                n = 0;
-                err = get_key_storage_walk(tr, kSystemBegin, 0, residual,
-                                           &resolved);
-                if (err) return err;
-            } else {
-                resolved = kSystemBegin;
-            }
-        } else {
-            /* the (1-offset)-th present merged key < anchor */
-            int needed = 1 - offset;
-            std::string e = std::min(a, kSystemBegin);
-            if (e.empty()) {
-                resolved.clear();
-            } else {
-                err = fdb_tpu_transaction_get_range(
-                    tr, (const uint8_t*)"", 0,
-                    (const uint8_t*)e.data(), int(e.size()), needed, 1,
-                    1, &kv, &n);
-                if (err) return err;
-                if (n >= needed)
-                    resolved.assign((const char*)kv[needed - 1].key,
-                                    kv[needed - 1].key_length);
-                else
-                    resolved.clear();
-            }
         }
-        if (kv) fdb_tpu_free_keyvalues(kv, n);
+        if (n >= offset)
+            resolved.assign((const char*)kv[offset - 1].key,
+                            kv[offset - 1].key_length);
+        else
+            resolved = hi_bound;
+    } else {
+        /* the (1-offset)-th present merged key < anchor */
+        int needed = 1 - offset;
+        std::string e = std::min(a, hi_bound);
+        if (!e.empty()) {
+            err = fdb_tpu_transaction_get_range(
+                tr, (const uint8_t*)"", 0, (const uint8_t*)e.data(),
+                int(e.size()), needed, 1, 1, &kv, &n);
+            if (err) return err;
+        }
+        if (n >= needed)
+            resolved.assign((const char*)kv[needed - 1].key,
+                            kv[needed - 1].key_length);
+        else
+            resolved.clear();
     }
+    if (kv) fdb_tpu_free_keyvalues(kv, n);
     /* a selector walking off user space clamps to maxKey instead of
      * leaking stored \xff rows (client/transaction.py get_key) */
     if (resolved > kSystemBegin && !tr->read_system) resolved = kSystemBegin;

@@ -96,23 +96,23 @@ class RemoteDatabase(Database):
                                   self._status_token)
         return await flow.timeout_error(ref.get_reply(None), 30.0)
 
-    async def configure(self, **kwargs) -> None:
-        from ..server.cluster_controller import ConfigureRequest
+    # configure/exclude ride the inherited Database implementations —
+    # ordinary \xff/conf//\xff/excluded transactions over the same
+    # remote refs as any other write (ref: ManagementAPI building
+    # system-key transactions client-side) — but keep the gateway's
+    # management-token authorization gate for the convenience API
+
+    def _check_management(self) -> None:
         if not self._management_token:
             raise flow.error("client_invalid_operation")
-        ref = self._transport.ref(self._host, self._port,
-                                  self._management_token)
-        await flow.timeout_error(
-            ref.get_reply(ConfigureRequest(**kwargs)), 30.0)
+
+    async def configure(self, **kwargs) -> None:
+        self._check_management()
+        await super().configure(**kwargs)
 
     async def exclude(self, worker: str, exclude: bool = True) -> None:
-        from ..server.cluster_controller import ExcludeRequest
-        if not self._management_token:
-            raise flow.error("client_invalid_operation")
-        ref = self._transport.ref(self._host, self._port,
-                                  self._management_token)
-        await flow.timeout_error(
-            ref.get_reply(ExcludeRequest(worker, exclude)), 30.0)
+        self._check_management()
+        await super().exclude(worker, exclude)
 
 
 class RemoteCluster:

@@ -68,6 +68,7 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("BYTE_SAMPLE_FACTOR", 100, lambda: 10)
     init("DD_BANDWIDTH_TAU", 5.0, lambda: 1.0)
     init("DD_MIN_BALANCE_BYTES", 2_000, lambda: 600)
+    init("CONF_SYNC_INTERVAL", 2.0, lambda: 0.3)
     init("WATCH_TIMEOUT", 900.0, lambda: 20.0)
 
     # -- master / recovery (ref: fdbserver/Knobs.cpp recovery family) --

@@ -24,6 +24,7 @@ from .coordination import CoordinatedState, elect_leader
 from .dbinfo import (EMPTY_DBINFO, FULLY_RECOVERED, ServerDBInfo,
                      StorageRefs, StorageShard)
 from .master import MasterRecovery
+from .types import CLEAR_RANGE, SET_VALUE, MetadataMutations
 from .worker import RegisterWorkerRequest
 
 
@@ -53,27 +54,6 @@ class OpenDatabaseRequest(NamedTuple):
     ClusterController + MonitorLeader client polling)."""
 
     known_seq: int
-
-
-class ConfigureRequest(NamedTuple):
-    """Change the transaction-subsystem configuration; a changed config
-    ends the current epoch so recovery rebuilds with the new shape
-    (ref: ManagementAPI changeConfig — the reference stores it in
-    system keys and the CC reacts; storage shard count is fixed after
-    creation until data distribution arrives)."""
-
-    n_proxies: Optional[int] = None
-    n_resolvers: Optional[int] = None
-    n_logs: Optional[int] = None
-    conflict_backend: Optional[str] = None
-
-
-class ExcludeRequest(NamedTuple):
-    """Exclude (or re-include) a worker from recruitment (ref:
-    ManagementAPI excludeServers / includeServers)."""
-
-    worker: str
-    exclude: bool = True
 
 
 class ChangeCoordinatorsRequest(NamedTuple):
@@ -155,7 +135,8 @@ class ClusterController:
                            (self._dd_loop(), "dataDistribution"),
                            (self._failure_monitor_loop(), "failureMonitor"),
                            (self._metric_sampler_loop(), "metricSampler"),
-                           (self._latency_probe_loop(), "latencyProbe")):
+                           (self._latency_probe_loop(), "latencyProbe"),
+                           (self._conf_sync_loop(), "confSync")):
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
         self.process.on_kill(self._actors.cancel_all)
@@ -516,49 +497,16 @@ class ClusterController:
 
     # -- management -------------------------------------------------------
     async def _management_loop(self):
-        """(ref: ManagementAPI — configuration changes and exclusions
-        arrive as requests; a config change ends the epoch so recovery
-        rebuilds the transaction subsystem with the new shape)"""
+        """(ref: ManagementAPI + ApplyMetadataMutation.h — management
+        state changes arrive as COMMITTED \\xff/conf//\\xff/excluded
+        mutations forwarded by the proxies; a config change ends the
+        epoch so recovery rebuilds the transaction subsystem with the
+        new shape. Only the coordinators change — which needs the
+        quorum-move dance, not a key write — remains a direct request.)"""
         while True:
             req, reply = await self.management.pop()
-            if isinstance(req, ConfigureRequest):
-                updates = {k: v for k, v in req._asdict().items()
-                           if v is not None}
-                cand = self.config._replace(**updates)
-                live = self._live_included_workers()
-                if (cand.n_proxies < 1 or cand.n_resolvers < 1
-                        or cand.n_logs < 1 or cand.n_logs > live
-                        or cand.n_resolvers > live
-                        or cand.n_proxies > live
-                        or cand.conflict_backend not in (
-                            "python", "native", "tpu", "tpu-point")):
-                    # an unrecruitable shape (or unknown backend) would
-                    # brick the cluster in a recovery-retry loop (ref:
-                    # changeConfig validating against the topology)
-                    reply.send_error(error("invalid_option_value"))
-                    continue
-                if updates:
-                    self.config = cand
-                    self._config_dirty = True
-                reply.send(None)
-            elif isinstance(req, ExcludeRequest):
-                if req.exclude:
-                    need = max(self.config.n_logs, self.config.n_proxies,
-                               self.config.n_resolvers, 1)
-                    if self._live_included_workers(
-                            without=req.worker) < need:
-                        # refuse an exclusion that leaves recovery
-                        # unrecruitable (ref: excludeServers safety check)
-                        reply.send_error(error("invalid_option_value"))
-                        continue
-                    self.excluded.add(req.worker)
-                    if self._hosts_current_txn_role(req.worker):
-                        # current-epoch transaction roles on the worker
-                        # end the epoch; the next recruitment avoids it
-                        self._config_dirty = True
-                else:
-                    self.excluded.discard(req.worker)
-                reply.send(None)
+            if isinstance(req, MetadataMutations):
+                self._apply_metadata_mutations(req)
             elif isinstance(req, ChangeCoordinatorsRequest):
                 try:
                     await self._change_coordinators(
@@ -572,6 +520,207 @@ class ClusterController:
                     reply.send_error(error("operation_failed"))
             else:
                 reply.send_error(error("client_invalid_operation"))
+
+    def _apply_metadata_mutations(self, req) -> None:
+        """React to committed management keys (ref:
+        ApplyMetadataMutation.h + the CC watching configuration: the
+        committed rows are the medium; this interprets them — the
+        low-latency trigger; _conf_sync_loop reconciles from the
+        stored rows, so a lost notice only delays, never diverges).
+        Invalid values are IGNORED with a SevWarnAlways trace rather
+        than bricking recovery in a retry loop — mirroring the
+        reference, where an unrecruitable \\xff/conf shape needs
+        operator repair."""
+        from .systemkeys import CONF_MUTABLE, CONF_PREFIX, CONF_ROWS, \
+            EXCLUDED_PREFIX
+        updates: dict = {}
+        excl_add: set = set()
+        excl_del: set = set()
+        for m in req.mutations:
+            if m.type == CLEAR_RANGE:
+                for w in list(self.excluded):
+                    if m.param1 <= EXCLUDED_PREFIX + w.encode() \
+                            < m.param2:
+                        excl_del.add(w)
+                for row in CONF_MUTABLE:
+                    if m.param1 <= CONF_PREFIX + row.encode() < m.param2:
+                        field = CONF_ROWS[row]
+                        updates[field] = getattr(ClusterConfig(), field)
+            elif m.type != SET_VALUE:
+                # atomics on management keys have storage-side results
+                # the proxy does not evaluate: leave them to the
+                # reconcile loop, which reads the actual rows back
+                flow.cover("cc.metadata.non_set_deferred")
+            elif m.param1.startswith(CONF_PREFIX):
+                row = m.param1[len(CONF_PREFIX):].decode(errors="replace")
+                if row not in CONF_MUTABLE:
+                    continue  # informational/unknown rows: inert
+                field = CONF_ROWS[row]
+                if row == "conflict_backend":
+                    updates[field] = m.param2.decode(errors="replace")
+                else:
+                    try:
+                        updates[field] = int(m.param2)
+                    except ValueError:
+                        flow.TraceEvent(
+                            "MetadataConfValueIgnored", self.process.name,
+                            severity=flow.trace.SevWarnAlways).detail(
+                            Key=row, Value=repr(m.param2)).log()
+            elif m.param1.startswith(EXCLUDED_PREFIX):
+                excl_add.add(m.param1[len(EXCLUDED_PREFIX):].decode(
+                    errors="replace"))
+        for w in excl_del:
+            self.excluded.discard(w)
+        for w in excl_add:
+            need = max(self.config.n_logs, self.config.n_proxies,
+                       self.config.n_resolvers, 1)
+            if self._live_included_workers(without=w) < need:
+                # honoring it would strand recovery in a retry loop;
+                # the committed row stays (operator repair, like the
+                # reference's FORCE-mode exclusions)
+                flow.cover("cc.metadata.exclusion_unrecruitable")
+                flow.TraceEvent(
+                    "MetadataExclusionIgnored", self.process.name,
+                    severity=flow.trace.SevWarnAlways).detail(
+                    Worker=w).log()
+                continue
+            self.excluded.add(w)
+            if self._hosts_current_txn_role(w):
+                self._config_dirty = True
+        if updates:
+            cand = self.config._replace(**updates)
+            live = self._live_included_workers()
+            if (cand.n_proxies < 1 or cand.n_resolvers < 1
+                    or cand.n_logs < 1 or cand.n_logs > live
+                    or cand.n_resolvers > live or cand.n_proxies > live
+                    or cand.conflict_backend not in (
+                        "python", "native", "tpu", "tpu-point")):
+                flow.cover("cc.metadata.config_unrecruitable")
+                flow.TraceEvent(
+                    "MetadataConfigIgnored", self.process.name,
+                    severity=flow.trace.SevWarnAlways).detail(
+                    Config=repr(updates)).log()
+            elif cand != self.config:
+                self.config = cand
+                self._config_dirty = True
+
+    async def _conf_sync_loop(self) -> None:
+        """The committed \\xff/conf//\\xff/excluded rows are
+        AUTHORITATIVE (ref: the reference reading its configuration
+        from the system keyspace during recovery): every sync round
+        (a) ADOPTS valid divergent rows into the live config and
+        exclusion set — so a lost proxy notice (the one-way datagram
+        is only the low-latency trigger) delays a change, never loses
+        it; (b) REPAIRS unparsable/unrecruitable rows back to the live
+        values — an acked-but-invalid row must not sit forever; and
+        (c) SEEDS missing rows (the initial `configure new`
+        analogue)."""
+        from ..client import Database
+        db = Database(self.process, self.open_db.ref(),
+                      status_ref=self.status_requests.ref(),
+                      management_ref=self.management.ref())
+        self.process.on_kill(db.close)
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.conf_sync_interval,
+                             TaskPriority.CLUSTER_CONTROLLER)
+            if self.dbinfo.get().recovery_state != FULLY_RECOVERED:
+                continue
+            try:
+                await self._conf_sync_once(db)
+            except flow.FdbError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                flow.TraceEvent("ConfSyncRetry", self.process.name,
+                                severity=flow.trace.SevWarn).detail(
+                    Error=e.name).log()
+
+    async def _conf_sync_once(self, db) -> None:
+        from ..client import run_transaction
+        from .systemkeys import (CONF_END, CONF_MUTABLE, CONF_PREFIX,
+                                 CONF_ROWS, EXCLUDED_END, EXCLUDED_PREFIX)
+
+        async def read(tr):
+            tr.set_option("read_system_keys")
+            conf = dict(await tr.get_range(CONF_PREFIX, CONF_END))
+            excl = dict(await tr.get_range(EXCLUDED_PREFIX, EXCLUDED_END))
+            return conf, excl
+
+        conf_rows, excl_rows = await run_transaction(db, read,
+                                                     max_retries=50)
+        repairs: dict = {}       # key -> value to set (None = clear)
+        updates: dict = {}
+        for row, field in CONF_ROWS.items():
+            key = CONF_PREFIX + row.encode()
+            live = str(getattr(self.config, field)).encode()
+            val = conf_rows.get(key)
+            if val is None:
+                repairs[key] = live          # seed missing row
+                continue
+            if row not in CONF_MUTABLE:
+                if val != live:
+                    repairs[key] = live      # informational: follow live
+                continue
+            if row == "conflict_backend":
+                updates[field] = val.decode(errors="replace")
+            else:
+                try:
+                    updates[field] = int(val)
+                except ValueError:
+                    repairs[key] = live
+        cand = self.config._replace(**updates)
+        live_workers = [name for name, wi in self.workers.items()
+                        if wi.worker.process.alive]
+        n_live = sum(1 for name in live_workers
+                     if name not in self.excluded)
+        if (cand.n_proxies < 1 or cand.n_resolvers < 1
+                or cand.n_logs < 1 or cand.n_logs > n_live
+                or cand.n_resolvers > n_live or cand.n_proxies > n_live
+                or cand.conflict_backend not in (
+                    "python", "native", "tpu", "tpu-point")):
+            flow.cover("cc.metadata.sync_repair_config")
+            flow.TraceEvent("ConfRowsRepaired", self.process.name,
+                            severity=flow.trace.SevWarnAlways).detail(
+                Config=repr(updates)).log()
+            for row in CONF_MUTABLE:
+                field = CONF_ROWS[row]
+                repairs[CONF_PREFIX + row.encode()] = \
+                    str(getattr(self.config, field)).encode()
+        elif cand != self.config:
+            flow.cover("cc.metadata.sync_adopted")
+            self.config = cand
+            self._config_dirty = True
+        # exclusions: the rows are the truth; refuse (and repair) only
+        # rows that would leave recruitment impossible
+        desired_excl: set = set()
+        need = max(self.config.n_logs, self.config.n_proxies,
+                   self.config.n_resolvers, 1)
+        for key in sorted(excl_rows):
+            w = key[len(EXCLUDED_PREFIX):].decode(errors="replace")
+            remaining = sum(1 for name in live_workers
+                            if name not in desired_excl and name != w)
+            if remaining < need:
+                flow.cover("cc.metadata.sync_repair_exclusion")
+                flow.TraceEvent(
+                    "ExclusionRowRepaired", self.process.name,
+                    severity=flow.trace.SevWarnAlways).detail(
+                    Worker=w).log()
+                repairs[key] = None
+                continue
+            desired_excl.add(w)
+        if desired_excl != self.excluded:
+            added = desired_excl - self.excluded
+            self.excluded = desired_excl
+            if any(self._hosts_current_txn_role(w) for w in added):
+                self._config_dirty = True
+        if repairs:
+            async def fix(tr):
+                tr.set_option("access_system_keys")
+                for k, v in repairs.items():
+                    if v is None:
+                        tr.clear(k)
+                    else:
+                        tr.set(k, v)
+            await run_transaction(db, fix, max_retries=50)
 
     @staticmethod
     def _coord_id(c) -> tuple:

@@ -220,7 +220,8 @@ class MasterRecovery:
                 resolver_refs, [r.commits for r in new_logs],
                 resolver_splits, storage_splits,
                 recovery_version, ratekeeper_ref=rk_ref,
-                storage_tags=self.cc.storage_tags()))
+                storage_tags=self.cc.storage_tags(),
+                management_ref=self.cc.management.ref()))
             if self.cc.backup_active:
                 w.roles[f"proxy-e{self.epoch}-{i}"].backup_active = True
             if getattr(self.cc, "region", None) is not None:

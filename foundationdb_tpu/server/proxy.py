@@ -30,8 +30,10 @@ from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS, PRIORITY_BATCH,
                     PRIORITY_DEFAULT, PRIORITY_IMMEDIATE, SET_VALUE,
                     SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
                     CommitReply, CommitRequest, GetReadVersionReply,
-                    MutationRef, ResolveRequest, TLogCommitRequest,
-                    TaggedMutation, mutation_bytes)
+                    MetadataMutations, MutationRef, ResolveRequest,
+                    TLogCommitRequest, TaggedMutation, mutation_bytes)
+
+from .systemkeys import is_management_mutation as _is_management_mutation
 
 # the mutation types a transaction may carry (ref: the commit path
 # asserting isValidMutationType — AvailableForReuse and the
@@ -150,7 +152,8 @@ class Proxy:
                  resolver_splits=(), storage_splits=(), storage_tags=None,
                  recovery_version: int = 0,
                  batch_window: float = 0.001, max_batch: int = 512,
-                 ratekeeper_ref: NetworkRef = None):
+                 ratekeeper_ref: NetworkRef = None,
+                 management_ref: NetworkRef = None):
         if not isinstance(resolver_refs, (list, tuple)):
             resolver_refs = [resolver_refs]
         if not isinstance(tlog_refs, (list, tuple)):
@@ -202,6 +205,9 @@ class Proxy:
         self._local_batch = 0
         self._peers = []               # other proxies' raw-committed refs
         self._ratekeeper_ref = ratekeeper_ref
+        # CC management stream: committed \xff/conf//\xff/excluded
+        # mutations are forwarded there (applyMetadataMutation seam)
+        self._management_ref = management_ref
         self._rate = 1e9               # tps budget (ratekeeper-fed)
         self._batch_rate = 1e9         # batch-priority budget (<= _rate)
         self._grv_queue = []           # waiting GRV replies
@@ -604,6 +610,18 @@ class Proxy:
             self._mark(dbg, "MasterProxyServer.commitBatch.AfterLogPush")
             if self.committed_version.get() < ver.version:
                 self.committed_version.set(ver.version)
+            # applyMetadataMutation analogue: committed management-key
+            # mutations are forwarded to the CC, which reacts (config
+            # change -> epoch recovery, exclusion updates). One-way and
+            # AFTER the log push: the keys are durable before anyone
+            # acts on them (ref: ApplyMetadataMutation.h — the proxy is
+            # where system mutations gain meaning)
+            if self._management_ref is not None:
+                meta = tuple(tm.mutation for tm in mutations
+                             if _is_management_mutation(tm.mutation))
+                if meta:
+                    self._management_ref.send(
+                        MetadataMutations(ver.version, meta), self.process)
 
             # phase 5: per-transaction replies
             st = self.stats

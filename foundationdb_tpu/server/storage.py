@@ -352,12 +352,13 @@ class VersionedMap:
     def _candidates(self, begin: bytes, end: bytes, reverse: bool = False):
         """Lazily yield candidate keys in [begin, end) in order (or
         reverse): window keys merged with base-engine chunks, dedup'd.
-        The user keyspace ends at \\xff — system keys (engine metadata
-        under \\xff\\xff) never surface in reads (ref: FDBTypes.h
-        normalKeys). Laziness is what keeps limited scans and selector
-        walks from materializing the whole shard (round-2 VERDICT weak
-        #5)."""
-        end = min(end, b"\xff")
+        Scans stop at \\xff\\xff — the engine's own metadata never
+        surfaces in reads; stored system rows under \\xff (conf,
+        excluded, backup progress) are real data the CLIENT gates
+        (ref: FDBTypes.h normalKeys/systemKeys). Laziness is what keeps
+        limited scans and selector walks from materializing the whole
+        shard (round-2 VERDICT weak #5)."""
+        end = min(end, b"\xff\xff")
         if begin >= end:
             return
         win = self._keys[bisect_left(self._keys, begin):
@@ -433,7 +434,7 @@ class VersionedMap:
         the leftover-th present key RIGHT of `end` — the client walks
         the neighboring shard with a boundary-anchored selector (ref:
         NativeAPI getKey readThrough iteration across shards)."""
-        hi = min(end if end is not None else b"\xff", b"\xff")
+        hi = min(end if end is not None else b"\xff\xff", b"\xff\xff")
         key = sel.key
         if sel.offset >= 1:
             # the offset-th present key >= key (> key when or_equal)
@@ -854,8 +855,10 @@ class StorageServer:
         """This shard's view of the range at `at_version` — the
         fetchKeys source side. The caller picks a version at or below
         known_committed so an epoch rollback can never invalidate the
-        snapshot after it lands durably on the destination."""
-        hi = end if end is not None else b"\xff"
+        snapshot after it lands durably on the destination. The bound
+        is \\xff\\xff: stored system rows move WITH the shard (engine
+        metadata never surfaces through the window's read path)."""
+        hi = end if end is not None else b"\xff\xff"
         return self.data.get_range(begin, hi, at_version, 1 << 30)
 
     async def install_snapshot(self, rows, at_version: int) -> None:
@@ -878,13 +881,14 @@ class StorageServer:
         # ownership era (whose vacate clear the purge just dropped
         # from the pending queue) must not shine through under the
         # installed data (ref: fetchKeys clear-then-insert)
-        hi = end if end is not None else b"\xff"
+        hi = end if end is not None else b"\xff\xff"
         self.kv.clear_range(begin, hi)
         self.metrics.note_clear(begin, hi)
         for k, v in rows:
             self.kv.set(k, v)
             self.metrics.note_set(k, len(k) + len(v))
-        self._floors.append((begin, end if end is not None else b"\xff",
+        self._floors.append((begin,
+                             end if end is not None else b"\xff\xff",
                              at_version))
         self._read_floor = max(self._read_floor, at_version)
         new_begin = min(self.shard_begin, begin)
@@ -926,7 +930,7 @@ class StorageServer:
         spanning the boundary) are kept. Reads below up_to are already
         rejected by the install's read floor, so no reader can miss
         the removed history."""
-        hi = end if end is not None else b"\xff"
+        hi = end if end is not None else b"\xff\xff"
         d = self.data
         i = bisect_left(d._keys, begin)
         j = bisect_left(d._keys, hi)
@@ -992,10 +996,11 @@ class StorageServer:
         if begin > self.shard_begin:
             clears.append(MutationRef(CLEAR_RANGE, self.shard_begin, begin))
         if end is not None and (self.shard_end is None
-                                or end < (self.shard_end or b"\xff")):
+                                or end < (self.shard_end or b"\xff\xff")):
             clears.append(MutationRef(
                 CLEAR_RANGE, end,
-                self.shard_end if self.shard_end is not None else b"\xff"))
+                self.shard_end if self.shard_end is not None
+                else b"\xff\xff"))
         for m in clears:
             self.data.apply(v, m)
             self.metrics.apply(m)

@@ -1,0 +1,54 @@
+"""The \\xff system keyspace schema — single source of truth.
+
+Reference: fdbclient/SystemData.cpp (keyServers/, conf/, excluded/
+prefixes and key names). Everything in [\\xff\\x02, \\xff\\xff) is real
+stored data committed through the ordinary pipeline EXCEPT
+\\xff/keyServers/, which is materialized from the broadcast shard map;
+\\xff\\xff is engine metadata and never surfaces. The management rows
+(\\xff/conf/, \\xff/excluded/) are the coordination medium: the proxy
+forwards committed mutations there to the CC
+(ref: ApplyMetadataMutation.h), and the CC also reconciles from the
+stored rows so the keys — not the RPC — are authoritative.
+"""
+
+SYSTEM_PREFIX = b"\xff"
+ENGINE_PREFIX = b"\xff\xff"
+# the stored region starts at the \xff\x02 latencyProbe/client rows
+STORED_SYSTEM_PREFIX = b"\xff\x02"
+
+KEY_SERVERS_PREFIX = b"\xff/keyServers/"
+KEY_SERVERS_END = b"\xff/keyServers0"
+
+CONF_PREFIX = b"\xff/conf/"
+CONF_END = b"\xff/conf0"
+EXCLUDED_PREFIX = b"\xff/excluded/"
+EXCLUDED_END = b"\xff/excluded0"
+
+MGMT_RANGES = ((CONF_PREFIX, CONF_END), (EXCLUDED_PREFIX, EXCLUDED_END))
+
+# \xff/conf/<row> -> ClusterConfig field. The first four are
+# operator-mutable (what `configure` accepts); the rest are seeded
+# informational rows.
+CONF_ROWS = {"proxies": "n_proxies", "resolvers": "n_resolvers",
+             "logs": "n_logs", "conflict_backend": "conflict_backend",
+             "storage_shards": "n_storage", "durable": "durable",
+             "storage_replicas": "storage_replicas",
+             "storage_engine": "storage_engine"}
+CONF_MUTABLE = ("proxies", "resolvers", "logs", "conflict_backend")
+CONF_ROW_BY_FIELD = {f: row for row, f in CONF_ROWS.items()
+                     if row in CONF_MUTABLE}
+
+
+def is_stored_system(key: bytes) -> bool:
+    """True when a \\xff key is backed by real storage rows (vs the
+    materialized keyServers view)."""
+    return (STORED_SYSTEM_PREFIX <= key < ENGINE_PREFIX
+            and not (KEY_SERVERS_PREFIX <= key < KEY_SERVERS_END))
+
+
+def is_management_mutation(m) -> bool:
+    """Does this mutation touch \\xff/conf/ or \\xff/excluded/?"""
+    from .types import CLEAR_RANGE
+    if m.type == CLEAR_RANGE:
+        return any(m.param1 < e and m.param2 > b for b, e in MGMT_RANGES)
+    return any(b <= m.param1 < e for b, e in MGMT_RANGES)

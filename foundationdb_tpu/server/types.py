@@ -96,6 +96,17 @@ class CommitReply(NamedTuple):
                        # (second half of the versionstamp)
 
 
+class MetadataMutations(NamedTuple):
+    """Committed mutations under the management system keys
+    (\\xff/conf/, \\xff/excluded/), forwarded one-way by the proxy to
+    the CC after the log push — the proxy-side applyMetadataMutation
+    analogue (ref: fdbserver/ApplyMetadataMutation.h interpreting
+    system-key mutations during commit)."""
+
+    version: int
+    mutations: tuple   # MutationRefs touching management keys
+
+
 PRIORITY_BATCH = 0
 PRIORITY_DEFAULT = 1
 PRIORITY_IMMEDIATE = 2

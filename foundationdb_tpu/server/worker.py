@@ -136,14 +136,16 @@ class Worker:
     def recruit_proxy(self, name: str, master_ref, resolver_refs, tlog_refs,
                       resolver_splits, storage_splits,
                       recovery_version: int,
-                      ratekeeper_ref=None, storage_tags=None) -> ProxyRefs:
+                      ratekeeper_ref=None, storage_tags=None,
+                      management_ref=None) -> ProxyRefs:
         self._check_alive()
         p = Proxy(self.process, master_ref, resolver_refs, tlog_refs,
                   resolver_splits=resolver_splits,
                   storage_splits=storage_splits,
                   storage_tags=storage_tags,
                   recovery_version=recovery_version,
-                  ratekeeper_ref=ratekeeper_ref)
+                  ratekeeper_ref=ratekeeper_ref,
+                  management_ref=management_ref)
         p.start()
         self.roles[name] = p
         return ProxyRefs(name, p.grvs.ref(), p.commits.ref(),

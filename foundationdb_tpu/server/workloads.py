@@ -32,9 +32,14 @@ _ATOMIC_CHOICES = (ADD_VALUE, AND_V2, OR, XOR, MAX, MIN_V2, BYTE_MIN,
                    BYTE_MAX, APPEND_IF_FITS, COMPARE_AND_CLEAR)
 
 RETRYABLE = {"not_committed", "transaction_too_old", "future_version",
-             "commit_unknown_result", "broken_promise",
+             "commit_unknown_result", "broken_promise", "timed_out",
+             "tlog_stopped", "coordinators_changed",
              "proxy_memory_limit_exceeded", "process_behind",
              "wrong_shard_server", "transaction_timed_out"}
+
+# commit outcomes the client cannot know: the seq key decides
+UNKNOWN_OUTCOME = {"commit_unknown_result", "timed_out",
+                   "broken_promise", "tlog_stopped"}
 
 
 def model_select(keys: List[bytes], sel: KeySelector) -> bytes:
@@ -192,7 +197,7 @@ class WriteDuringRead:
                         (k, staged.get(k), f) for k, f in armed)
                     break
                 except flow.FdbError as e:
-                    if e.name == "commit_unknown_result":
+                    if e.name in UNKNOWN_OUTCOME:
                         if await self._resolve_unknown(seq_val):
                             flow.cover("workload.wdr.unknown_committed")
                             self.stats["unknown_resolved"] += 1
