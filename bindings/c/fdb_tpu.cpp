@@ -530,6 +530,7 @@ struct Conn {
 
 struct Replica {
     uint64_t gets = 0, ranges = 0, get_keys = 0, watches = 0;
+    std::string name;
 };
 
 struct Shard {
@@ -576,10 +577,12 @@ bool parse_info(const WVal& d, ClusterInfo* out) {
             const WVal* rg = dict_get(r, "ranges");
             const WVal* gk = dict_get(r, "get_keys");
             const WVal* wa = dict_get(r, "watches");
+            const WVal* nm = dict_get(r, "name");
             if (!g || !rg || !gk) return false;
             sh.replicas.push_back(
                 {uint64_t(g->i), uint64_t(rg->i), uint64_t(gk->i),
-                 wa ? uint64_t(wa->i) : 0});
+                 wa ? uint64_t(wa->i) : 0,
+                 nm ? nm->s : std::string()});
         }
         out->shards.push_back(std::move(sh));
     }
@@ -747,16 +750,28 @@ struct Mutation {
 static bool in_system(const std::string& k) {
     return !k.empty() && (unsigned char)k[0] == 0xFFu;
 }
-static bool stored_system(const std::string& k) {
-    return k.size() >= 2 && (unsigned char)k[0] == 0xFFu &&
-           (unsigned char)k[1] == 0x02u;
-}
-static bool engine_space(const std::string& k) {
-    return k.size() >= 2 && (unsigned char)k[0] == 0xFFu &&
-           (unsigned char)k[1] == 0xFFu;
-}
 static const std::string kSystemBegin("\xff", 1);
+static const std::string kStoredBegin("\xff\x02", 2);
 static const std::string kEngineBegin("\xff\xff", 2);
+static const std::string kKeyServersPrefix("\xff/keyServers/");
+static const std::string kKeyServersEnd("\xff/keyServers0");
+/* the STORED region [\xff\x02, \xff\xff) minus the materialized
+ * \xff/keyServers/ view — matches server/systemkeys.py
+ * is_stored_system (conf/excluded/backup rows are real shard data) */
+static bool stored_system(const std::string& k) {
+    return k >= kStoredBegin && k < kEngineBegin &&
+           !(k >= kKeyServersPrefix && k < kKeyServersEnd);
+}
+/* one synthesized \xff/keyServers/ row value: the shard's replica
+ * team, comma-joined (client/transaction.py _system_rows) */
+static std::string team_value(const Shard& s) {
+    std::string v;
+    for (size_t i = 0; i < s.replicas.size(); ++i) {
+        if (i) v += ",";
+        v += s.replicas[i].name;
+    }
+    return v;
+}
 
 struct FDBTpuTransaction {
     FDBTpuDatabase* db;
@@ -902,17 +917,19 @@ struct FDBTpuTransaction {
     }
 
     /* client/transaction.py _check_writable: ACCESS_SYSTEM_KEYS admits
-     * only the stored \xff\x02 subspace; \xff\xff never */
+     * the stored region [\xff\x02, \xff\xff) — conf/excluded/backup
+     * rows are real transactional data — but never the materialized
+     * \xff/keyServers/ view and never \xff\xff engine metadata */
     fdb_tpu_error_t check_writable(const std::string& b,
                                    const std::string* e = nullptr) {
         if (e == nullptr) {
-            if (in_system(b) && !(access_system && stored_system(b) &&
-                                  !engine_space(b)))
+            if (in_system(b) && !(access_system && stored_system(b)))
                 return 2004;
         } else {
             if (in_system(b) || *e > kSystemBegin) {
-                if (!(access_system && stored_system(b) &&
-                      *e <= kEngineBegin))
+                if (!(access_system && b >= kStoredBegin &&
+                      *e <= kEngineBegin &&
+                      !(b < kKeyServersEnd && *e > kKeyServersPrefix)))
                     return 2004;
             }
         }
@@ -1003,6 +1020,27 @@ fdb_tpu_error_t fdb_tpu_transaction_get(FDBTpuTransaction* tr,
     std::string k((const char*)key, key_length);
     if (in_system(k) && !tr->read_system)
         return 2004; /* ref: key_outside_legal_range without the option */
+    if (in_system(k) && !stored_system(k)) {
+        /* the MATERIALIZED view (client/transaction.py _system_get):
+         * \xff/keyServers/<key> answers with the owning replica team;
+         * other non-stored system keys have no rows. No read conflict
+         * — the synthesized view is not transactional data. */
+        *out_present = 0;
+        *out_value = nullptr;
+        *out_value_length = 0;
+        if (k.compare(0, kKeyServersPrefix.size(),
+                      kKeyServersPrefix) == 0) {
+            auto p = tr->picture();
+            if (!p) return 1100;
+            const Shard& s = p->shards[shard_index_for(
+                p, k.substr(kKeyServersPrefix.size()))];
+            std::string v = team_value(s);
+            *out_present = 1;
+            *out_value = dup_bytes(v);
+            *out_value_length = int(v.size());
+        }
+        return 0;
+    }
     OptBytes v;
     fdb_tpu_error_t err = tr->get(k, snapshot != 0, &v);
     if (err) return err;
@@ -1103,6 +1141,69 @@ fdb_tpu_error_t fdb_tpu_transaction_get_range(
         return 2004;
     }
     if (limit <= 0) limit = 1 << 20;
+    /* system-region parity with client/transaction.py get_range: a
+     * scan crossing into \xff splits at the boundary, and a scan
+     * touching the materialized \xff/keyServers/ view merges the
+     * synthesized rows with the stored subranges around the hole */
+    if (tr->read_system &&
+        ((!in_system(begin) && end > kSystemBegin) ||
+         (in_system(begin) &&
+          (!stored_system(begin) ||
+           (begin < kKeyServersEnd && end > kKeyServersPrefix))))) {
+        std::vector<std::pair<std::string, std::string>> rows;
+        std::vector<std::pair<std::string, std::string>> subs;
+        if (!in_system(begin)) {
+            subs.emplace_back(begin, kSystemBegin);
+            subs.emplace_back(kSystemBegin, end);
+        } else {
+            auto p = tr->picture();
+            if (!p) return 1100;
+            for (const auto& s : p->shards) {
+                std::string rk = kKeyServersPrefix + s.begin;
+                if (begin <= rk && rk < end)
+                    rows.emplace_back(rk, team_value(s));
+            }
+            std::string lo = std::max(begin, kStoredBegin);
+            std::string hi = std::min(end, kEngineBegin);
+            std::string m1 = std::min(hi, kKeyServersPrefix);
+            std::string m2 = std::max(lo, kKeyServersEnd);
+            if (lo < m1) subs.emplace_back(lo, m1);
+            if (m2 < hi) subs.emplace_back(m2, hi);
+        }
+        for (const auto& sub : subs) {
+            FDBTpuKeyValue* kv = nullptr;
+            int cnt = 0;
+            fdb_tpu_error_t serr = fdb_tpu_transaction_get_range(
+                tr, (const uint8_t*)sub.first.data(),
+                int(sub.first.size()),
+                (const uint8_t*)sub.second.data(),
+                int(sub.second.size()),
+                in_system(begin) ? 0 : limit, reverse, snapshot,
+                &kv, &cnt);
+            if (serr) return serr;
+            for (int i = 0; i < cnt; ++i)
+                rows.emplace_back(
+                    std::string((const char*)kv[i].key,
+                                size_t(kv[i].key_length)),
+                    std::string((const char*)kv[i].value,
+                                size_t(kv[i].value_length)));
+            fdb_tpu_free_keyvalues(kv, cnt);
+        }
+        std::sort(rows.begin(), rows.end());
+        if (reverse) std::reverse(rows.begin(), rows.end());
+        if (int64_t(rows.size()) > limit) rows.resize(limit);
+        auto* arr = (FDBTpuKeyValue*)std::calloc(
+            rows.size() ? rows.size() : 1, sizeof(FDBTpuKeyValue));
+        for (size_t k = 0; k < rows.size(); k++) {
+            arr[k].key = dup_bytes(rows[k].first);
+            arr[k].key_length = int(rows[k].first.size());
+            arr[k].value = dup_bytes(rows[k].second);
+            arr[k].value_length = int(rows[k].second.size());
+        }
+        *out_kv = arr;
+        *out_count = int(rows.size());
+        return 0;
+    }
     int64_t version;
     fdb_tpu_error_t err = tr->grv(&version);
     if (err) return err;

@@ -96,11 +96,20 @@ class Database:
 
     def __init__(self, process: SimProcess, cluster_ref: NetworkRef,
                  status_ref: NetworkRef = None,
-                 management_ref: NetworkRef = None):
+                 management_ref: NetworkRef = None,
+                 coordinators=None):
         self.process = process
         self.cluster_ref = cluster_ref
         self.status_ref = status_ref
         self.management_ref = management_ref
+        # coordinator ref 4-tuples: with these the client survives the
+        # death of the controller it was handed — it re-finds the
+        # current leader through the coordinators, exactly how the
+        # reference's clients outlive any one CC (ref: MonitorLeader,
+        # fdbclient/MonitorLeader.actor.cpp — the cluster file names
+        # coordinators, never the CC)
+        self.coordinators = coordinators
+        self._leader_gen = 0       # bumped on every rediscovered leader
         self._info = None
         #: priority class -> waiting futures (batched per class so a
         #: BATCH rider can never borrow DEFAULT's admission)
@@ -205,10 +214,59 @@ class Database:
             ChangeCoordinatorsRequest(tuple(coordinators)), self.process),
             30.0)
 
+    @staticmethod
+    def _ref_endpoint(r) -> tuple:
+        ep = getattr(r, "endpoint", None)
+        if ep is None:
+            return (id(r),)
+        return (ep.process.name, ep.token)
+
+    async def _try_rediscover(self) -> bool:
+        """Re-find the cluster controller through the coordinators
+        after the one we knew stopped answering (ref: MonitorLeader's
+        standing coordinator poll). Returns True when the leader moved
+        and the endpoints were swapped."""
+        if not self.coordinators:
+            return False
+        from ..server.coordination import get_leader
+        li = await get_leader(self.coordinators, b"\xff/clusterLeader",
+                              self.process)
+        if li is None or getattr(li, "open_db", None) is None:
+            return False
+        if self._ref_endpoint(li.open_db) == \
+                self._ref_endpoint(self.cluster_ref):
+            return False
+        flow.cover("client.leader_rediscovered")
+        self.cluster_ref = li.open_db
+        self.status_ref = li.status or self.status_ref
+        self.management_ref = li.management or self.management_ref
+        # broadcast sequences are per-controller: start over (the gen
+        # bump tells in-flight transactions their captured seq is from
+        # the dead leader)
+        self._leader_gen += 1
+        self._info = None
+        return True
+
     async def info(self):
         if self._info is None:
-            self._info = await self.cluster_ref.get_reply(
-                _OpenDatabaseRequest(-1), self.process)
+            while True:
+                try:
+                    self._info = await flow.timeout_error(
+                        self.cluster_ref.get_reply(
+                            _OpenDatabaseRequest(-1), self.process),
+                        _request_timeout())
+                    break
+                except flow.FdbError as e:
+                    if e.name == "operation_cancelled":
+                        raise
+                    if await self._try_rediscover():
+                        continue
+                    if e.name == "timed_out" or self.coordinators:
+                        await flow.delay(
+                            flow.SERVER_KNOBS.client_retry_backoff_min,
+                            TaskPriority.DEFAULT_ENDPOINT)
+                        continue
+                    raise
             # keep the picture fresh from here on: long-poll the CC's
             # broadcast so PUSHED state (failure monitor, recoveries)
             # reaches a long-lived client before — not after — it burns
@@ -231,6 +289,11 @@ class Database:
             except flow.FdbError as e:
                 if e.name == "operation_cancelled":
                     raise  # teardown must actually tear this down
+                try:
+                    await self._try_rediscover()
+                except flow.FdbError as e2:
+                    if e2.name == "operation_cancelled":
+                        raise
                 await flow.delay(0.5, TaskPriority.DEFAULT_ENDPOINT)
 
     def close(self) -> None:
@@ -249,8 +312,30 @@ class Database:
         a healthy cluster (round-3 fix)."""
         if self._info is not None and self._info.seq > used_seq:
             return
-        self._info = await self.cluster_ref.get_reply(
-            _OpenDatabaseRequest(used_seq), self.process)
+        while True:
+            try:
+                self._info = await flow.timeout_error(
+                    self.cluster_ref.get_reply(
+                        _OpenDatabaseRequest(used_seq), self.process),
+                    _request_timeout())
+                return
+            except flow.FdbError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                if await self._try_rediscover():
+                    # a NEW controller numbers its broadcasts from 1:
+                    # any picture of it is newer than the dead one's
+                    used_seq = -1
+                    continue
+                if e.name == "timed_out" or self.coordinators:
+                    # CC alive but mid-recovery (keep long-polling), or
+                    # dead with a successor still being elected (keep
+                    # polling the coordinators) — both transient
+                    await flow.delay(
+                        flow.SERVER_KNOBS.client_retry_backoff_min,
+                        TaskPriority.DEFAULT_ENDPOINT)
+                    continue
+                raise
 
     async def proxy(self):
         return _pick_live_proxy(await self.info())
@@ -435,6 +520,10 @@ class Transaction:
         if getattr(self, "_timeout_seconds", None) is not None:
             self._timeout_deadline = flow.now() + self._timeout_seconds
         self._used_seq: int = 0       # newest dbinfo seq this attempt saw
+        # broadcast sequences are per-controller: remember WHICH leader
+        # the seq came from, so a retry after a failover never long-polls
+        # the new controller for the dead one's sequence numbers
+        self._used_leader_gen: int = getattr(self.db, "_leader_gen", 0)
         self._read_version: Optional[int] = None
         self._writes: Dict[bytes, Optional[bytes]] = {}  # RYW write map
         self._write_order: List[bytes] = []              # sorted keys
@@ -997,7 +1086,9 @@ class Transaction:
         flow.cover("client.retry.conflict", e.name == "not_committed")
         if e.name in REFRESH_ERRORS:
             flow.cover("client.refresh_stale_picture")
-            await self.db.refresh_past(self._used_seq)
+            used = self._used_seq \
+                if self._used_leader_gen == self.db._leader_gen else -1
+            await self.db.refresh_past(used)
         await flow.delay(
             flow.SERVER_KNOBS.client_retry_backoff_min
             + flow.g_random.random01()

@@ -247,7 +247,9 @@ class SimCluster:
         proc = self.net.new_process(name, machine or name)
         return Database(proc, self.cc.open_db.ref(),
                         status_ref=self.cc.status_requests.ref(),
-                        management_ref=self.cc.management.ref())
+                        management_ref=self.cc.management.ref(),
+                        coordinators=[self._coord_refs(c)
+                                      for c in self.coordinators])
 
     async def quiet_database(self, max_wait: float = 60.0) -> None:
         """Wait until the cluster is quiescent: every storage replica

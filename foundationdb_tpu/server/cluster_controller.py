@@ -77,12 +77,24 @@ class _WorkerInfo(NamedTuple):
 
 class ClusterController:
     def __init__(self, process: SimProcess, coordinators,
-                 config: ClusterConfig):
+                 config: ClusterConfig, dbinfo_var=None,
+                 takeover_from_region: bool = False,
+                 leader_priority: int = 0):
         self.process = process
         self.config = config
         self.coordinators = coordinators   # ref 4-tuples:
         # (reads, writes, candidacies, forwards) — see SimCluster._coord_refs
-        self.dbinfo = AsyncVar(EMPTY_DBINFO)
+        # dbinfo_var lets a promoted region's controller adopt the
+        # broadcast var its storage servers already follow; a fresh CC
+        # creates its own (ref: the remote DC's workers following the
+        # same ServerDBInfo stream after failover)
+        self.dbinfo = dbinfo_var if dbinfo_var is not None \
+            else AsyncVar(EMPTY_DBINFO)
+        # explicit region takeover (operator failover, ref: fdbcli
+        # force_recovery_with_data_loss): recovery may end the previous
+        # epoch by locking the REGION's log when no primary log survives
+        self.takeover_from_region = takeover_from_region
+        self.leader_priority = leader_priority
         self.workers: dict = {}            # name -> _WorkerInfo
         self.log_stores: dict = {}         # store name -> LogRefs (live)
         self.registrations = RequestStream(process)
@@ -143,9 +155,16 @@ class ClusterController:
 
     async def _run(self) -> None:
         # an election against a moved-away quorum follows the forwards
-        # to the live coordinator set
+        # to the live coordinator set. The nomination carries this CC's
+        # client endpoints so a client can re-find the controller
+        # through the coordinators after a failover (ref: LeaderInfo
+        # reaching clients via MonitorLeader)
+        from .coordination import LeaderInfo
         self.coordinators = await elect_leader(
-            self.coordinators, b"\xff/clusterLeader", self.process.name,
+            self.coordinators, b"\xff/clusterLeader",
+            LeaderInfo(self.leader_priority, self.process.name,
+                       self.open_db.ref(), self.status_requests.ref(),
+                       self.management.ref()),
             self.process)
         self._cstate = CoordinatedState(
             [(c[0], c[1]) for c in self.coordinators], self.process)
@@ -534,14 +553,18 @@ class ClusterController:
         from .systemkeys import CONF_MUTABLE, CONF_PREFIX, CONF_ROWS, \
             EXCLUDED_PREFIX
         updates: dict = {}
-        excl_add: set = set()
-        excl_del: set = set()
+        # worker -> desired excluded state, LAST mutation wins — a
+        # single transaction may set then clear the same row and the
+        # committed (ordered) outcome is what must apply
+        excl_state: dict = {}
         for m in req.mutations:
             if m.type == CLEAR_RANGE:
-                for w in list(self.excluded):
+                known = set(self.excluded) | \
+                    {w for w, v in excl_state.items() if v}
+                for w in known:
                     if m.param1 <= EXCLUDED_PREFIX + w.encode() \
                             < m.param2:
-                        excl_del.add(w)
+                        excl_state[w] = False
                 for row in CONF_MUTABLE:
                     if m.param1 <= CONF_PREFIX + row.encode() < m.param2:
                         field = CONF_ROWS[row]
@@ -567,11 +590,15 @@ class ClusterController:
                             severity=flow.trace.SevWarnAlways).detail(
                             Key=row, Value=repr(m.param2)).log()
             elif m.param1.startswith(EXCLUDED_PREFIX):
-                excl_add.add(m.param1[len(EXCLUDED_PREFIX):].decode(
-                    errors="replace"))
-        for w in excl_del:
-            self.excluded.discard(w)
-        for w in excl_add:
+                w = m.param1[len(EXCLUDED_PREFIX):].decode(
+                    errors="replace")
+                excl_state[w] = True
+        for w, want in excl_state.items():
+            if not want:
+                self.excluded.discard(w)
+        for w, want in excl_state.items():
+            if not want:
+                continue
             need = max(self.config.n_logs, self.config.n_proxies,
                        self.config.n_resolvers, 1)
             if self._live_included_workers(without=w) < need:

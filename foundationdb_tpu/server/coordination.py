@@ -61,13 +61,39 @@ class GenRegWriteReply(NamedTuple):
 
 class CandidacyRequest(NamedTuple):
     key: bytes
-    candidate: object            # opaque leader info, ordered by id
+    candidate: object            # LeaderInfo (None = read-only poll)
     prev_change_id: int
 
 
 class CandidacyReply(NamedTuple):
     leader: object
     change_id: int
+
+
+class LeaderInfo(NamedTuple):
+    """What the winning candidate publishes through the coordinators:
+    enough for a CLIENT to (re)connect to the cluster controller (ref:
+    LeaderInterface / ClientDBInfo reaching clients via MonitorLeader —
+    the coordinators are how a client finds the CC after the one it
+    knew died). Ordered by (priority, name): a lower priority value
+    wins, so an explicitly promoted controller (region failover,
+    forceRecovery) can take leadership over a dead incumbent the
+    coordinators cannot themselves detect (ref: the bestPriority rules
+    in LeaderElection.actor.cpp / ClusterController's leader fitness)."""
+
+    priority: int
+    name: str
+    open_db: object = None       # NetworkRef: openDatabase endpoint
+    status: object = None        # NetworkRef: status endpoint
+    management: object = None    # NetworkRef: management endpoint
+
+
+def _cand_key(c) -> tuple:
+    """Election ordering/equality key (refs deserialize into fresh
+    objects — never compare or hash them)."""
+    if isinstance(c, LeaderInfo):
+        return (c.priority, c.name)
+    return (0, c)
 
 
 class ForwardRequest(NamedTuple):
@@ -217,10 +243,11 @@ class Coordinator:
                 reply.send(Forwarded(self._forward))
                 continue
             cur, change = self._leader.get(req.key, (None, 0))
-            if cur is None or (req.candidate is not None
-                               and req.candidate < cur):
-                # smaller id wins (deterministic; ref: LeaderElection
-                # nominates the best candidate)
+            if req.candidate is not None and (
+                    cur is None
+                    or _cand_key(req.candidate) < _cand_key(cur)):
+                # smaller (priority, id) wins (deterministic; ref:
+                # LeaderElection nominates the best candidate)
                 cur, change = req.candidate, change + 1
                 self._leader[req.key] = (cur, change)
             reply.send(CandidacyReply(cur, change))
@@ -353,15 +380,53 @@ async def elect_leader(coordinators, key: bytes, candidate,
         hops = 0
         votes: dict = {}
         for r in replies:
-            votes[r.leader] = votes.get(r.leader, 0) + 1
+            k = None if r.leader is None else _cand_key(r.leader)
+            votes[k] = votes.get(k, 0) + 1
         need = len(coordinators) // 2 + 1
-        if votes.get(candidate, 0) >= need:
+        if votes.get(_cand_key(candidate), 0) >= need:
             return coordinators
         for other, n in votes.items():
-            if other != candidate and n >= need:
+            if other is not None and other != _cand_key(candidate) \
+                    and n >= need:
                 raise error("operation_failed")
         await flow.delay(flow.SERVER_KNOBS.candidacy_poll_interval,
                          TaskPriority.COORDINATION)
+
+
+async def get_leader(coordinators, key: bytes, process: SimProcess):
+    """Read the current leader from a coordinator majority WITHOUT
+    nominating (ref: MonitorLeader's getLeader — clients poll the
+    coordinators to find the cluster controller; this is how a client
+    survives the death of the CC it was handed at construction).
+    Returns the nominated LeaderInfo, or None when no majority of
+    coordinators agrees (election in progress / quorum loss)."""
+    for _hop in range(flow.SERVER_KNOBS.coordinator_forward_hops_max + 1):
+        futs = [flow.catch_errors(flow.timeout_error(
+            c[2].get_reply(CandidacyRequest(key, None, 0), process),
+            flow.SERVER_KNOBS.failure_monitor_ping_timeout))
+            for c in coordinators]
+        settled = await flow.all_of(futs)
+        replies = [f.get() for f in settled if not f.is_error]
+        fwd = next((r for r in replies if isinstance(r, Forwarded)), None)
+        if fwd is not None:
+            # bounded like elect_leader: a forward cycle (operator
+            # error) must surface as "no leader", not unbounded chasing
+            coordinators = list(fwd.coordinators)
+            continue
+        votes: dict = {}
+        leaders: dict = {}
+        for r in replies:
+            if r.leader is None:
+                continue
+            k = _cand_key(r.leader)
+            votes[k] = votes.get(k, 0) + 1
+            leaders[k] = r.leader
+        need = len(coordinators) // 2 + 1
+        for k, n in votes.items():
+            if n >= need:
+                return leaders[k]
+        return None
+    return None
 
 from ..rpc import wire as _wire
 

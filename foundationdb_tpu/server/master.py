@@ -50,6 +50,12 @@ class CoreState(NamedTuple):
     logs: Tuple[Tuple[str, str], ...]     # (store name, machine)
     old_logs: Tuple[Tuple[int, int, int, Tuple[Tuple[str, str], ...]], ...]
     # ^ (epoch, begin_version, end_version, stores) still draining
+    # the attached remote region's log store (store, machine) — what an
+    # explicitly promoted controller locks when no primary log survives
+    # a region blackout (ref: DBCoreState's remote/satellite tLog sets
+    # enabling epochEnd with remote logs,
+    # TagPartitionedLogSystem.actor.cpp:1265)
+    region_logs: Tuple[Tuple[str, str], ...] = ()
 
 
 class Master:
@@ -145,7 +151,21 @@ class MasterRecovery:
         # recovery version (ref: epochEnd)
         recovery_version = 0
         old_log_sets: Tuple[LogSetInfo, ...] = ()
-        if prev is not None:
+        if prev is not None and self.cc.takeover_from_region \
+                and prev.region_logs:
+            # explicit region failover (ref: forced recovery from the
+            # remote log sets, TagPartitionedLogSystem.actor.cpp:1265 +
+            # fdbcli force_recovery_with_data_loss): lock the REGION's
+            # log instead of the (blacked-out) primary's. Everything the
+            # router shipped recovers; the unshipped tail — bounded by
+            # the advertised lag — is what an async region admits
+            # losing. Older primary generations are abandoned with the
+            # primary: their undrained remainder is part of that loss.
+            self._set_state(dbi.LOCKING_CSTATE)
+            recovery_version, locked = await self._epoch_end_region(prev)
+            old_log_sets = (LogSetInfo(prev.epoch, 0, recovery_version,
+                                       locked, stores=prev.region_logs),)
+        elif prev is not None:
             self._set_state(dbi.LOCKING_CSTATE)
             recovery_version, locked = await self._epoch_end(prev)
             old_log_sets = (LogSetInfo(prev.epoch, prev.recovery_version,
@@ -245,9 +265,11 @@ class MasterRecovery:
             (ls.epoch, ls.begin_version, ls.end_version,
              ls.stores or tuple((r.store, r.machine) for r in ls.logs))
             for ls in old_log_sets)
+        region = getattr(self.cc, "region", None)
+        region_logs = region.log_stores() if region is not None else ()
         await self.cstate.set_exclusive(CoreState(
             self.epoch, recovery_version, tuple(new_log_stores),
-            old_for_cstate))
+            old_for_cstate, region_logs=region_logs))
 
         # Phase 5: broadcast the new picture; commits may now flow
         info = ServerDBInfo(
@@ -315,6 +337,36 @@ class MasterRecovery:
             # a surviving store (ref: recovery waits for tlogs)
             self._trace("MasterRecoveryWaitingForLogs",
                         Stores=",".join(s for s, _m in prev.logs))
+            await flow.delay(flow.SERVER_KNOBS.recovery_wait_for_logs_delay,
+                             TaskPriority.CLUSTER_CONTROLLER)
+
+    async def _epoch_end_region(self, prev: CoreState):
+        """Explicit region takeover: lock the region's log store and
+        recover at its durable frontier. The lock makes the takeover
+        exact — after it, no in-flight router push can extend the
+        remote log, so the reported end version is the last version the
+        promoted epoch preserves (ref: epochEnd over the remote log
+        set; the lock doubles as the fence the old promote() faked with
+        a quiesce poll)."""
+        while True:
+            refs = [self.cc.log_stores.get(store)
+                    for store, _m in prev.region_logs]
+            refs = [r for r in refs if r is not None]
+            if refs:
+                futs = [flow.catch_errors(flow.timeout_error(
+                    r.locks.get_reply(TLogLockRequest(), self.process),
+                    flow.SERVER_KNOBS.tlog_lock_timeout))
+                    for r in refs]
+                settled = await flow.all_of(futs)
+                locked = [(r, f.get()) for r, f in zip(refs, settled)
+                          if not f.is_error]
+                if locked:
+                    flow.cover("master.region_takeover")
+                    recovery_version = max(rep.end_version
+                                           for _r, rep in locked)
+                    return recovery_version, tuple(r for r, _ in locked)
+            self._trace("MasterRecoveryWaitingForRegionLogs",
+                        Stores=",".join(s for s, _m in prev.region_logs))
             await flow.delay(flow.SERVER_KNOBS.recovery_wait_for_logs_delay,
                              TaskPriority.CLUSTER_CONTROLLER)
 

@@ -343,10 +343,15 @@ class TLog:
                         tuple(out), max(0, v - 1), self.known_committed))
                     return
                 _v, tagged = decode_log_entry(payload)
-            ms = tuple(tm.mutation for tm in tagged if req.tag in tm.tags)
+            ms = tuple(tm for tm in tagged if req.tag in tm.tags)
             if ms:
-                out.append((v, ms))
-                sent_bytes += sum(mutation_bytes(m) for m in ms)
+                # with_tags keeps the full tag vectors (the region log
+                # router re-partitions by them); plain peeks get bare
+                # mutations
+                out.append((v, ms if getattr(req, "with_tags", False)
+                            else tuple(tm.mutation for tm in ms)))
+                sent_bytes += sum(mutation_bytes(tm.mutation)
+                                  for tm in ms)
         if truncated_at is not None:
             durable = min(durable, max(req.begin_version,
                                        truncated_at - 1))

@@ -186,10 +186,15 @@ class TLogCommitRequest(NamedTuple):
 
 
 class TLogPeekRequest(NamedTuple):
-    """(ref: TLogPeekRequest :1138 — per-tag long poll)"""
+    """(ref: TLogPeekRequest :1138 — per-tag long poll). with_tags
+    returns TaggedMutations (original tag vectors preserved) instead of
+    bare mutations — the region log router needs the full vocabulary to
+    re-partition the stream across the remote DC's storage tags (ref:
+    LogRouter shipping per-tag streams to the remote log set)."""
 
     begin_version: int
     tag: int = 0
+    with_tags: bool = False
 
 
 class TLogPopRequest(NamedTuple):

@@ -1,12 +1,18 @@
-"""Multi-region: LogRouter-style async replication + region failover.
+"""Multi-region: LogRouter-style async replication + failover THROUGH
+the recovery machinery.
 
 Ref: fdbserver/LogRouter.actor.cpp, TagPartitionedLogSystem remote log
-sets, SimulatedCluster.actor.cpp:790 (region configs). The contract
-under test is the fearless-async guarantee: after a full primary
-blackout, the promoted region serves every write the router had
-shipped (version <= the remote frontier) — losses are bounded by the
-advertised lag — and the promoted region is a live transaction system
-(commits, conflicts) afterwards.
+sets (epochEnd recovering from them, :1265), SimulatedCluster
+.actor.cpp:790 (region configs), fdbcli force_recovery_with_data_loss.
+
+The contract under test is the fearless-async guarantee plus the
+round-5 requirements: after a full primary blackout, promotion is a
+COORDINATED-STATE RECOVERY (new CC elected over the surviving
+coordinator quorum, remote log locked, roles recruited), the promoted
+region is sharded like the primary (>= 2 storage shards), every write
+the router shipped survives, and a concurrent client rides the
+transition on its ordinary retry loop by re-finding the controller
+through the coordinators.
 """
 
 import pytest
@@ -14,40 +20,67 @@ import pytest
 from foundationdb_tpu import flow
 from foundationdb_tpu.client import run_transaction
 from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.server.dbinfo import FULLY_RECOVERED
 from foundationdb_tpu.server.region import RemoteRegion
 
 
-def _blackout_primary(c):
-    """Kill every region-A process: workers, CC, coordinators."""
+def _blackout_primary(c, keep_coordinators=()):
+    """Kill every region-A process: workers, CC, and the primary-side
+    coordinators (the survivors model the reference's fearless layouts
+    placing a coordinator majority outside the primary DC)."""
     for w in list(c.workers.values()):
         if w.process.alive:
             c.net.kill(w.process)
     c.net.kill(c.cc.process)
-    for coord in c.coordinators:
-        if coord.process.alive:
+    for i, coord in enumerate(c.coordinators):
+        if i not in keep_coordinators and coord.process.alive:
             c.net.kill(coord.process)
 
 
-def test_region_failover_preserves_shipped_writes():
-    c = SimCluster(seed=801, durable=True, auto_reboot=False)
+def test_region_failover_through_recovery():
+    c = SimCluster(seed=801, durable=True, auto_reboot=False,
+                   n_coordinators=5, n_storage=2)
     try:
         db = c.client()
 
         async def main():
             region = RemoteRegion(c)
             await region.start()
+            # attach was a recovery: the epoch moved and the region's
+            # log store is in the coordinated state
+            cstate = await c.cc._cstate.read()
+            assert cstate.region_logs == region.log_stores()
 
             committed = {}   # key -> commit version
             for i in range(40):
+                # alternate halves of the keyspace so the stream feeds
+                # BOTH remote shards (split at 0x80)
+                key = (b"k%03d" if i % 2 else b"\xc8%03d") % i
                 tr = db.create_transaction()
-                tr.set(b"k%03d" % i, b"v%d" % i)
+                tr.set(key, b"v%d" % i)
                 v = await tr.commit()
-                committed[b"k%03d" % i] = v
+                committed[key] = v
                 if i % 5 == 0:
                     await flow.delay(0.05)
-
-            # advertised lag is a real number while replicating
             assert region.lag() >= 0
+
+            # a concurrent client that never stops: its writes ride
+            # the ordinary retry loop across the blackout
+            progress = {"before": 0, "after": 0}
+            phase = ["before"]
+            stop = [False]
+
+            async def writer():
+                n = 0
+                while not stop[0]:
+                    async def body(tr, n=n):
+                        tr.set(b"live-%04d" % n, b"x")
+                    await run_transaction(db, body, max_retries=100000)
+                    progress[phase[0]] += 1
+                    n += 1
+                    await flow.delay(0.1)
+
+            writer_task = flow.spawn(writer(), name="concurrentWriter")
 
             # let the router ship at least the first 30 writes, then
             # cut region A off mid-stream
@@ -60,42 +93,83 @@ def test_region_failover_preserves_shipped_writes():
                 await tr.commit()
                 await flow.delay(0.05)
 
-            _blackout_primary(c)
+            old_epoch = c.cc.dbinfo.get().epoch
+            _blackout_primary(c, keep_coordinators=(2, 3, 4))
+            phase[0] = "after"
+            writes_at_blackout = progress["before"]
+
             promoted = await region.promote()
             rv = promoted.recovery_version
 
+            # promotion WAS a recovery: a fresh epoch above the
+            # primary's, fully recovered, committed into the same
+            # coordinated state
+            info = promoted.cc.dbinfo.get()
+            assert info.epoch > old_epoch
+            assert info.recovery_state == FULLY_RECOVERED
+            cstate2 = await promoted.cc._cstate.read()
+            assert cstate2.epoch == info.epoch
+            # ...and the promoted region is SHARDED like the primary
+            assert len(info.storages) >= 2
+            assert len({s.tag for s in info.storages}) == len(info.storages)
+
             # the guarantee: every write at or below the remote
             # frontier survived the blackout
-            rows = dict(await promoted.get_range(b"k", b"l"))
+            pdb = promoted.client()
+
+            async def read_all(tr):
+                lo = await tr.get_range(b"k", b"l")
+                hi = await tr.get_range(b"\xc8", b"\xc9")
+                return list(lo) + list(hi)
+            rows = dict(await run_transaction(pdb, read_all,
+                                              max_retries=500))
             for key, v in committed.items():
                 if v <= rv:
                     assert rows.get(key) == b"v%d" % int(key[1:]), \
                         (key, v, rv)
-            # at least the forced-shipped prefix is there
             for i in range(30):
-                assert b"k%03d" % i in rows
+                key = (b"k%03d" if i % 2 else b"\xc8%03d") % i
+                assert key in rows
 
-            # region B is a live transaction system: commit + read
-            grv = await promoted.get_read_version()
-            from foundationdb_tpu.server.types import (MutationRef,
-                                                       SET_VALUE)
-            nk = (b"post-failover", b"post-failover\x00")
-            v2 = await promoted.commit(
-                grv, (), (nk,),
-                (MutationRef(SET_VALUE, b"post-failover", b"yes"),))
-            await promoted.wait_applied(v2)
-            assert await promoted.get(b"post-failover") == b"yes"
+            # the data really is spread across BOTH remote shards
+            per_shard = []
+            for s in region.storage_objs():
+                lo, hi = s.shard_begin, s.shard_end or b"\xff"
+                per_shard.append(sum(1 for k in rows
+                                     if lo <= k < hi))
+            assert all(n > 0 for n in per_shard), per_shard
 
-            # ...with real conflict detection: two writers of one key
-            # from the same snapshot — second one aborts
-            grv2 = await promoted.get_read_version()
-            ck = (b"occ", b"occ\x00")
-            await promoted.commit(grv2, (ck,), (ck,),
-                                  (MutationRef(SET_VALUE, b"occ", b"a"),))
+            # the concurrent client survived the transition: its loop
+            # keeps committing against the promoted cluster with no
+            # new handle — it re-found the CC through the coordinators
+            deadline = flow.now() + 120
+            while progress["after"] < 3:
+                assert flow.now() < deadline, \
+                    "writer never recovered after failover"
+                await flow.delay(0.5)
+            stop[0] = True
+            await flow.catch_errors(writer_task)
+            assert progress["after"] >= 3
+            # at least one of its post-blackout writes is readable
+            async def read_live(tr):
+                return await tr.get_range(b"live-", b"live.\xff")
+            live = dict(await run_transaction(pdb, read_live,
+                                              max_retries=500))
+            assert len(live) >= progress["after"] - 1
+            _ = writes_at_blackout  # (diagnostic)
+
+            # the promoted region is a live transaction system with
+            # real conflict detection: two writers of one key from the
+            # same snapshot — the second aborts
+            tr1 = pdb.create_transaction()
+            tr2 = pdb.create_transaction()
+            assert (await tr1.get(b"occ")) is None
+            assert (await tr2.get(b"occ")) is None
+            tr1.set(b"occ", b"a")
+            tr2.set(b"occ", b"b")
+            await tr1.commit()
             with pytest.raises(flow.FdbError) as ei:
-                await promoted.commit(grv2, (ck,), (ck,),
-                                      (MutationRef(SET_VALUE, b"occ",
-                                                   b"b"),))
+                await tr2.commit()
             assert ei.value.name == "not_committed"
             return True
 
@@ -131,24 +205,26 @@ def test_router_survives_primary_recovery():
             tr.set(b"final", b"1")
             last_v = await tr.commit()
 
-            # ship everything, then compare the remote replica's data
+            # ship everything, then compare the remote copy
             deadline = flow.now() + 120
             while region._pushed_to < last_v or \
-                    region.storage.version.get() < last_v:
+                    region.applied_version() < last_v:
                 assert flow.now() < deadline, (
-                    region._pushed_to, region.storage.version.get(),
-                    last_v)
+                    region._pushed_to, region.applied_version(), last_v)
                 tr = db.create_transaction()
                 tr.set(b"nudge", b"x")
                 await tr.commit()
                 await flow.delay(0.05)
 
+            rows = {}
             from foundationdb_tpu.server.types import \
                 StorageGetRangeRequest
-            rows = dict(await region.storage.ranges.ref().get_reply(
-                StorageGetRangeRequest(b"r", b"s",
-                                       region.storage.version.get(),
-                                       1 << 20), db.process))
+            for s in region.storage_objs():
+                part = await s.ranges.ref().get_reply(
+                    StorageGetRangeRequest(b"r", b"s",
+                                           s.version.get(), 1 << 20),
+                    db.process)
+                rows.update(dict(part))
             for i in range(30):
                 assert rows.get(b"r%03d" % i) == b"w%d" % i, i
             await region.stop()
