@@ -149,6 +149,10 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("BACKUP_PEEK_TIMEOUT", 2.0)
     init("BACKUP_SOURCE_RETRY_DELAY", 0.2)
     init("BACKUP_NUDGE_INTERVAL", 0.05)
+    # the cluster-side driver polling the \xff\x02/backup/ control rows
+    # (ref: the backup agent's task poll delay)
+    init("BACKUP_DRIVER_POLL_INTERVAL", 0.25, lambda: 0.05)
+    init("BACKUP_DRIVER_UPLOAD_INTERVAL", 1.0, lambda: 0.2)
 
     # -- simulation environment (ref: sim2 latency/reboot model) -------
     init("SIM_REBOOT_DELAY", 0.5, lambda: 5.0)

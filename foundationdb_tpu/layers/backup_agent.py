@@ -37,9 +37,17 @@ class BackupAgent:
     tool, like the CLI: it holds the cluster handle the way fdbbackup
     holds a cluster file)."""
 
-    def __init__(self, cluster, db):
+    def __init__(self, cluster, db,
+                 backup_range: Tuple[bytes, bytes] = (b"", b"\xff")):
         self.cluster = cluster
         self.db = db
+        # what this backup covers (ref: backupRanges — the default is
+        # the whole user keyspace). The tail CLIPS the stream to it, so
+        # \xff rows — notably the \xff\x02/backup/ control rows the
+        # driver itself writes — never enter the mutation log: a
+        # restore must not replay the tool's own state machine into
+        # the live control subspace
+        self.backup_range = backup_range
         self.base_blob: Optional[bytes] = None
         self.base_version = 0
         self.log_records: List[Tuple[int, Tuple[MutationRef, ...]]] = []
@@ -47,29 +55,51 @@ class BackupAgent:
         self._tailed_to = 0
         self._stop = False
         self._replica_rr = 0
+        # per-container incremental-upload state: id(container) ->
+        # {"snap": bool, "n": consumed record count, "end": last
+        # contiguously uploaded version}
+        self._upload_state: dict = {}
 
     # -- lifecycle -------------------------------------------------------
-    async def start(self) -> int:
-        """Enable the tag, wait out the tagging horizon, start tailing,
-        then snapshot; returns the snapshot (base) version."""
+    async def _tagging_recovery(self, active: bool) -> None:
+        """Flip the backup tag THROUGH an epoch recovery: the next
+        epoch's proxies are recruited with the flag and its TLogs with
+        the agent in BACKUP_TAG's replica set — nothing pokes live
+        roles, so the change also works over a real deployment (ref:
+        backup tagging as part of the log system configuration; same
+        shape as attaching a region)."""
         cc = self.cluster.cc
-        cc.backup_active = True
-        cc.backup_agent = self
-        await self._apply_tagging_settled(True)
-        # batches whose tags were computed BEFORE the flag landed carry
-        # versions at or below the master's issued max: wait for commits
-        # to pass that horizon so the snapshot (GRV above it) includes
-        # every untagged transaction (same horizon rule as shard moves)
-        v_enable = 0
-        if cc._recovery is not None and cc._recovery.master is not None:
-            v_enable = cc._recovery.master.version
-        start_v = min((p.committed_version.get()
-                       for p in cc._current_proxies()), default=0)
-        while min((p.committed_version.get()
-                   for p in cc._current_proxies()), default=0) < v_enable:
-            await self._nudge_commit()
-            await flow.delay(flow.SERVER_KNOBS.backup_nudge_interval,
-                             TaskPriority.DEFAULT_ENDPOINT)
+        cc.backup_active = active
+        cc.backup_agent = self if active else None
+        cc._config_dirty = True
+        # wait for a SETTLED epoch that advertises the flag — not
+        # merely the next epoch: a recovery already past recruitment
+        # when the flag flipped publishes the stale value, and the
+        # level-triggered config-dirty recovery after it publishes the
+        # corrected one (start: a silent log hole otherwise; stop: the
+        # tag would pin records forever)
+        while True:
+            info = cc.dbinfo.get()
+            if info.backup_active == active and \
+                    info.recovery_state == "fully_recovered":
+                return
+            await flow.first_of(
+                cc.dbinfo.on_change(),
+                flow.delay(flow.SERVER_KNOBS.backup_nudge_interval,
+                           TaskPriority.DEFAULT_ENDPOINT))
+
+    async def start(self) -> int:
+        """Enable the tag via recovery, start tailing at the new
+        epoch's recovery version (everything before it is untagged but
+        provably below the snapshot), then snapshot; returns the
+        snapshot (base) version."""
+        cc = self.cluster.cc
+        await self._tagging_recovery(True)
+        # every commit of the new epoch carries the tag; the snapshot's
+        # GRV is above the recovery version, so each untagged (older)
+        # transaction is inside the snapshot and each later one is in
+        # the tail
+        start_v = cc.dbinfo.get().recovery_version
         self._tail_task = flow.spawn(self._tail(start_v),
                                      TaskPriority.DEFAULT_ENDPOINT,
                                      name="backupAgent.tail")
@@ -80,45 +110,9 @@ class BackupAgent:
 
     async def stop(self) -> None:
         self._stop = True
-        cc = self.cluster.cc
-        cc.backup_active = False
-        cc.backup_agent = None
-        await self._apply_tagging_settled(False)
+        await self._tagging_recovery(False)
         if self._tail_task is not None:
             await flow.catch_errors(self._tail_task)
-
-    async def _apply_tagging_settled(self, active: bool) -> None:
-        """Apply the tag flag and re-apply until a stable epoch carries
-        it — a recovery in flight past its read of cc.backup_active
-        would otherwise publish proxies/tlogs with the stale setting
-        (start: silent log hole; stop: the tag pins log records
-        forever)."""
-        cc = self.cluster.cc
-        while True:
-            ep = cc.dbinfo.get().epoch
-            self._apply_tagging(active)
-            await flow.delay(flow.SERVER_KNOBS.backup_nudge_interval,
-                             TaskPriority.DEFAULT_ENDPOINT)
-            info = cc.dbinfo.get()
-            if info.epoch != ep or \
-                    info.recovery_state != "fully_recovered":
-                continue
-            if all(p.backup_active == active
-                   for p in cc._current_proxies()):
-                return
-
-    def _apply_tagging(self, active: bool) -> None:
-        from ..server.proxy import BACKUP_TAG
-        cc = self.cluster.cc
-        for p in cc._current_proxies():
-            p.backup_active = active
-        for t in cc.tlog_objs():
-            exp = dict(t.expected_replicas)
-            if active:
-                exp[BACKUP_TAG] = (AGENT_NAME,)
-            else:
-                exp.pop(BACKUP_TAG, None)
-            t.set_expected_replicas(exp)
 
     # -- the tail (modeled on the storage pull loop) ---------------------
     async def _tail(self, start_version: int) -> None:
@@ -160,7 +154,9 @@ class BackupAgent:
                     break
                 if v > safe:
                     break
-                self.log_records.append((v, mutations))
+                kept = self._clip(mutations)
+                if kept:
+                    self.log_records.append((v, kept))
                 version = v
             adv = min(reply.committed_version, safe)
             if cap is not None:
@@ -176,6 +172,22 @@ class BackupAgent:
                 await self._nudge_commit()
                 await flow.delay(flow.SERVER_KNOBS.backup_tail_idle_delay,
                                  TaskPriority.DEFAULT_ENDPOINT)
+
+    def _clip(self, mutations) -> Tuple[MutationRef, ...]:
+        """Clip a version's mutations to the backup range (ref: the
+        backup's backupRanges bounding what the mutation log keeps)."""
+        lo, hi = self.backup_range
+        from ..server.types import CLEAR_RANGE
+        out = []
+        for m in mutations:
+            if m.type == CLEAR_RANGE:
+                b, e = max(m.param1, lo), min(m.param2, hi)
+                if b < e:
+                    out.append(m if (b, e) == (m.param1, m.param2)
+                               else MutationRef(CLEAR_RANGE, b, e))
+            elif lo <= m.param1 < hi:
+                out.append(m)
+        return tuple(out)
 
     def _pick_source(self, info, needed: int):
         from ..server.dbinfo import pick_log_source
@@ -209,36 +221,44 @@ class BackupAgent:
         """Write this backup into a container using the reference's
         file layout: one snapshot object + chunked mutation-log objects
         whose names carry their version coverage (ref: BackupContainer
-        snapshots/ + logs/ naming). Returns the container's describe().
-        Plain sync object IO — the agent tool runs it outside the
-        simulation loop, like fdbbackup writing to its target."""
+        snapshots/ + logs/ naming). INCREMENTAL per container: the
+        snapshot and full chunks upload once; only the growing tail
+        chunk re-uploads (overlapping coverage is clipped at restore) —
+        so the periodic driver upload is O(new records), not O(whole
+        history). Returns the container's describe(). Plain sync object
+        IO, like fdbbackup writing to its target."""
         from .backup_container import _records_to_log_blob
         if chunk_records is None:
             chunk_records = int(
                 flow.SERVER_KNOBS.backup_log_chunk_records)
         if self.base_blob is None:
             raise ValueError("backup has no snapshot yet (start() first)")
-        container.store_snapshot(self.base_blob, self.base_version)
+        st = self._upload_state.setdefault(
+            id(container), {"snap": False, "n": 0,
+                            "end": self.base_version})
+        if not st["snap"]:
+            container.store_snapshot(self.base_blob, self.base_version)
+            st["snap"] = True
         recs = [r for r in self.log_records if r[0] > self.base_version]
-        prev_end = self.base_version
-        i = 0
-        while i < len(recs):
+        i = st["n"]
+        # complete chunks: upload once and consume
+        while len(recs) - i >= chunk_records:
             chunk = recs[i:i + chunk_records]
             i += chunk_records
             end = chunk[-1][0]
-            if i >= len(recs):
-                # the final chunk's coverage extends to the tail
-                # frontier: versions with no backup-tagged payload are
-                # still certified mutation-free up to there
-                end = max(end, self._tailed_to)
             container.store_log(
                 _records_to_log_blob(chunk, self.base_version),
-                prev_end, end)
-            prev_end = end
-        if not recs and self._tailed_to > self.base_version:
+                st["end"], end)
+            st["n"], st["end"] = i, end
+        # the partial tail: re-upload from the last consumed boundary
+        # with coverage out to the tail frontier (versions with no
+        # backup-tagged payload are still certified mutation-free)
+        tail = recs[i:]
+        tail_end = max([r[0] for r in tail] + [self._tailed_to])
+        if tail_end > st["end"]:
             container.store_log(
-                _records_to_log_blob([], self.base_version),
-                self.base_version, self._tailed_to)
+                _records_to_log_blob(tail, self.base_version),
+                st["end"], tail_end)
         return container.describe()
 
     def write_log(self) -> bytes:

@@ -360,10 +360,12 @@ async def restore_from_container(db, container: BackupContainer,
                                  to_version: Optional[int] = None) -> int:
     """Restore the database from a container: newest snapshot at or
     below the target, then replay its logs (ref: fdbrestore driving
-    FileBackupAgent restore from a container)."""
+    FileBackupAgent restore from a container). Returns the version the
+    database was restored to."""
     blob, records, target = container.latest_restorable(to_version)
     log_blob = _records_to_log_blob(records, 0)
-    return await agent_mod.restore_to_version(db, blob, log_blob, target)
+    await agent_mod.restore_to_version(db, blob, log_blob, target)
+    return target
 
 
 def _records_to_log_blob(records, base_version: int) -> bytes:

@@ -38,7 +38,7 @@ class SimCluster:
                  share_with: "SimCluster" = None, name_prefix: str = "",
                  virtual: bool = True, data_dir: Optional[str] = None,
                  workers_per_machine: int = 1, n_zones: int = 0,
-                 storage_policy=None):
+                 storage_policy=None, backup_driver: bool = False):
         if storage_policy is not None and \
                 storage_policy.replica_count() != max(1, storage_replicas):
             raise ValueError(
@@ -137,6 +137,14 @@ class SimCluster:
         self.n_workers = n_workers
         self.workers_per_machine = max(1, workers_per_machine)
         self.n_zones = n_zones
+        # the cluster-side backup runner (ref: `fdbbackup agent`
+        # processes run alongside the cluster) — opt-in; the
+        # fdbtpu-backup tool needs one watching the control rows
+        self.backup_driver = None
+        if backup_driver:
+            from ..layers.backup_driver import BackupDriver
+            self.backup_driver = BackupDriver(self)
+            self.backup_driver.start()
         self.workers: dict = {}
         for i in range(n_workers):
             if self.workers_per_machine > 1 or n_zones > 0:

@@ -98,6 +98,15 @@ class ServerDBInfo(NamedTuple):
     # pushed from the cluster controller, fdbrpc/FailureMonitor.h:123 +
     # fdbclient/FailureMonitorClient.actor.cpp)
     failed: Tuple[str, ...] = ()
+    # what this epoch was RECRUITED with: backup tagging / region
+    # shipping on every proxy+TLog. Observers (backup agent, region
+    # attach) wait on these rather than poking roles — a recovery that
+    # raced past their flag change publishes the stale value here and
+    # the level-triggered config-dirty recovery that follows publishes
+    # the corrected one (ref: the log system configuration carried in
+    # the LogSystemConfig the CC broadcasts)
+    backup_active: bool = False
+    region_attached: bool = False
 
 
 EMPTY_DBINFO = ServerDBInfo(0, UNINITIALIZED, 0, (), LogSetInfo(0, 0, -1, ()),

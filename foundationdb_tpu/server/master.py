@@ -217,13 +217,19 @@ class MasterRecovery:
         for name, (tag, _b, _e) in self.cc.shard_map.items():
             expected.setdefault(tag, []).append(name)
         expected = {t: tuple(ns) for t, ns in expected.items()}
-        if self.cc.backup_active:
+        # capture ONCE: the epoch is recruited consistently and the
+        # broadcast advertises exactly what was recruited, even if the
+        # flags flip mid-recovery (the config-dirty recovery that
+        # follows such a flip re-publishes the corrected picture)
+        backup_on = self.cc.backup_active
+        region = getattr(self.cc, "region", None)
+        if backup_on:
             from .proxy import BACKUP_TAG
             from ..layers.backup_agent import AGENT_NAME
             expected[BACKUP_TAG] = (AGENT_NAME,)
-        if getattr(self.cc, "region", None) is not None:
+        if region is not None:
             from .proxy import REGION_TAG
-            expected[REGION_TAG] = (self.cc.region.router_name,)
+            expected[REGION_TAG] = (region.router_name,)
         for i, w in enumerate(log_workers):
             w.roles[f"tlog-e{self.epoch}-{i}"].set_expected_replicas(
                 expected)
@@ -242,9 +248,9 @@ class MasterRecovery:
                 recovery_version, ratekeeper_ref=rk_ref,
                 storage_tags=self.cc.storage_tags(),
                 management_ref=self.cc.management.ref()))
-            if self.cc.backup_active:
+            if backup_on:
                 w.roles[f"proxy-e{self.epoch}-{i}"].backup_active = True
-            if getattr(self.cc, "region", None) is not None:
+            if region is not None:
                 w.roles[f"proxy-e{self.epoch}-{i}"].region_active = True
             self.critical_procs.add(w.process)
         proxies = tuple(proxies)
@@ -265,7 +271,6 @@ class MasterRecovery:
             (ls.epoch, ls.begin_version, ls.end_version,
              ls.stores or tuple((r.store, r.machine) for r in ls.logs))
             for ls in old_log_sets)
-        region = getattr(self.cc, "region", None)
         region_logs = region.log_stores() if region is not None else ()
         await self.cstate.set_exclusive(CoreState(
             self.epoch, recovery_version, tuple(new_log_stores),
@@ -277,7 +282,8 @@ class MasterRecovery:
             LogSetInfo(self.epoch, recovery_version, -1, tuple(new_logs),
                        stores=tuple(new_log_stores)),
             old_log_sets, self.cc.dbinfo.get().storages,
-            failed=self.cc.dbinfo.get().failed)
+            failed=self.cc.dbinfo.get().failed,
+            backup_active=backup_on, region_attached=region is not None)
         self.cc.publish(info)
         self._trace("MasterRecoveryState", State=dbi.ACCEPTING_COMMITS,
                     Epoch=self.epoch, RecoveryVersion=recovery_version)

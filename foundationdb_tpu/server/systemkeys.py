@@ -26,6 +26,20 @@ EXCLUDED_END = b"\xff/excluded0"
 
 MGMT_RANGES = ((CONF_PREFIX, CONF_END), (EXCLUDED_PREFIX, EXCLUDED_END))
 
+# \xff\x02/backup/ — the backup CONTROL rows (ref: the backup layer's
+# config/state subspaces under \xff\x02, FileBackupAgent.actor.cpp
+# config keyspace): fdbtpu-backup writes them through ordinary
+# transactions; the cluster-side BackupDriver watches them and runs
+# the agent. Rows: dest (container URL), state (see BACKUP_STATE_*),
+# base_version, restorable_version, error.
+BACKUP_PREFIX = STORED_SYSTEM_PREFIX + b"/backup/"
+BACKUP_END = STORED_SYSTEM_PREFIX + b"/backup0"
+BACKUP_STATE_SUBMITTED = b"submitted"
+BACKUP_STATE_RUNNING = b"running"
+BACKUP_STATE_ABORT = b"abort"          # requested by the tool
+BACKUP_STATE_STOPPED = b"stopped"
+BACKUP_STATE_ERROR = b"error"
+
 # \xff/conf/<row> -> ClusterConfig field. The first four are
 # operator-mutable (what `configure` accepts); the rest are seeded
 # informational rows.

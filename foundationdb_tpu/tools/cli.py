@@ -33,6 +33,9 @@ Commands (ref: fdbcli):
   exclude <worker>           bar a worker from hosting roles
   include <worker>           re-admit an excluded worker
   writemode <on|off>         allow mutations (default on)
+  backup start <url>         submit a backup to a container URL
+  backup status|wait|abort   drive/inspect it (needs a BackupDriver)
+  restore <url> [version]    restore from a container URL
   coordinators <n>           move the coordination state to n fresh
                              coordinators (in-sim cli)
   consistencycheck           full-replica byte sweep (in-sim cli)
@@ -248,6 +251,37 @@ class Cli:
                          for e in report[:10]]
                 return "Profiler off\n" + "\n".join(lines)
             return "usage: profile on|off"
+        if cmd == "backup":
+            # (ref: fdbcli-adjacent fdbbackup verbs; the tool's row
+            # protocol works over any Database, in-sim or remote)
+            from . import backup_tool as bt
+            sub = raw[0] if raw else ""
+            if sub in ("start", "abort") and not self.writemode:
+                # start/abort commit control rows — the same mutation
+                # guard every write verb honors
+                return "ERROR: writemode off"
+            if sub == "start":
+                if len(raw) < 2:
+                    return "usage: backup start <container-url>"
+                out = self._run(bt.backup_start(self.db, raw[1]))
+                return json.dumps(out)
+            if sub == "status":
+                return json.dumps(self._run(bt.backup_status(self.db)))
+            if sub == "wait":
+                v = int(raw[1]) if len(raw) > 1 else None
+                return json.dumps(self._run(bt.backup_wait(self.db, v)))
+            if sub == "abort":
+                return json.dumps(self._run(bt.backup_abort(self.db)))
+            return "usage: backup start|status|wait|abort ..."
+        if cmd == "restore":
+            from . import backup_tool as bt
+            if not raw:
+                return "usage: restore <container-url> [version]"
+            if not self.writemode:
+                return "ERROR: writemode off"
+            v = int(raw[1]) if len(raw) > 1 else None
+            out = self._run(bt.backup_restore(self.db, raw[0], v))
+            return json.dumps(out)
         if cmd in ("exclude", "include"):
             async def body():
                 await self.db.exclude(raw[0], exclude=cmd == "exclude")

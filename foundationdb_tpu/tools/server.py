@@ -22,7 +22,7 @@ from ..server import SimCluster
 def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
           storage_replicas: int = 1, n_logs: int = 1, n_proxies: int = 1,
           tls=None, data_dir=None, announce=print,
-          cluster_file=None) -> None:
+          cluster_file=None, backup_agent: bool = True) -> None:
     """Run until interrupted; announces `LISTENING <port>` once up.
     With --data-dir, durable state lives in REAL files there and
     survives restarting this process. With --cluster-file, writes the
@@ -37,7 +37,8 @@ def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
                 f"--cluster-file directory not writable: {d}")
     c = SimCluster(seed=seed, virtual=False, durable=True,
                    n_storage=n_storage, storage_replicas=storage_replicas,
-                   n_logs=n_logs, n_proxies=n_proxies, data_dir=data_dir)
+                   n_logs=n_logs, n_proxies=n_proxies, data_dir=data_dir,
+                   backup_driver=backup_agent)
     gw = TcpGateway(c.client("gateway-host"), port=port, tls=tls)
     try:
         async def main():
@@ -85,6 +86,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             kwargs["n_proxies"] = int(argv.pop(0))
         elif a in ("--cluster-file", "-C"):
             kwargs["cluster_file"] = argv.pop(0)
+        elif a == "--no-backup-agent":
+            kwargs["backup_agent"] = False
         else:
             print(f"unknown argument {a}", file=sys.stderr)
             return 2
