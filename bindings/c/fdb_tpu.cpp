@@ -327,7 +327,14 @@ const WVal* dict_get(const WVal& d, const char* key) {
 /* ---------------- connection (rpc/tcp.py peer) ---------------- */
 
 constexpr uint8_t K_REQUEST = 0, K_REPLY = 1, K_ERROR = 2;
-constexpr char kProtocol[] = "fdbtpu01"; /* 8 bytes, PROTOCOL_VERSION */
+/* 8 bytes, PROTOCOL_VERSION. Overridable at build time so versioned
+ * copies of this library can be built for a MultiVersion client to
+ * select among (ref: MultiVersionApi dlopening versioned libfdb_c) */
+#ifndef FDBTPU_PROTOCOL
+#define FDBTPU_PROTOCOL "fdbtpu01"
+#endif
+constexpr char kProtocol[] = FDBTPU_PROTOCOL;
+static_assert(sizeof(kProtocol) == 9, "protocol tag must be 8 bytes");
 constexpr size_t kHdrSize = 21;          /* <IBQQ: 4+1+8+8 */
 
 struct Pending {
@@ -948,6 +955,12 @@ extern "C" {
 
 const char* fdb_tpu_get_error(fdb_tpu_error_t code) {
     return err_name(code);
+}
+
+const char* fdb_tpu_get_protocol(void) {
+    /* the 8-byte wire tag this library speaks (ref: the protocol
+     * version a MultiVersion loader matches against the cluster's) */
+    return kProtocol;
 }
 
 int fdb_tpu_error_retryable(fdb_tpu_error_t code) {

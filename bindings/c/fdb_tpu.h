@@ -64,6 +64,10 @@ enum {
 
 const char* fdb_tpu_get_error(fdb_tpu_error_t code);
 int fdb_tpu_error_retryable(fdb_tpu_error_t code);
+/* The 8-byte wire-protocol tag this library speaks (build-time
+ * FDBTPU_PROTOCOL; a MultiVersion loader selects the copy matching
+ * the cluster). */
+const char* fdb_tpu_get_protocol(void);
 
 /* Connect to a cluster gateway and fetch the initial cluster picture. */
 fdb_tpu_error_t fdb_tpu_create_database(const char* host, int port,

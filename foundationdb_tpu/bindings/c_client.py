@@ -46,8 +46,27 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
             # serve; with none, the build failure is the real error
             if not os.path.exists(_LIB_PATH):
                 raise
-    lib = ctypes.CDLL(_LIB_PATH)
+    _lib = load_library_at(_LIB_PATH)
+    return _lib
+
+
+def load_library_at(path: str) -> ctypes.CDLL:
+    """dlopen + configure a C client library at an arbitrary path —
+    the seam the MultiVersion shim uses to hold several
+    protocol-versioned copies at once (ref: MultiVersionApi's
+    externalClients, each its own dlopen of a versioned libfdb_c)."""
+    lib = ctypes.CDLL(path)
+    _configure(lib)
+    return lib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    try:
+        lib.fdb_tpu_get_protocol.restype = ctypes.c_char_p
+        lib.fdb_tpu_get_protocol.argtypes = []
+    except AttributeError:
+        pass  # an older library without the protocol export
     lib.fdb_tpu_get_error.restype = ctypes.c_char_p
     lib.fdb_tpu_get_error.argtypes = [ctypes.c_int]
     lib.fdb_tpu_error_retryable.restype = ctypes.c_int
@@ -115,8 +134,6 @@ def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.fdb_tpu_free.argtypes = [ctypes.c_void_p]
     lib.fdb_tpu_free_keyvalues.argtypes = [ctypes.c_void_p, ctypes.c_int]
-    _lib = lib
-    return lib
 
 
 class _KeyValue(ctypes.Structure):
@@ -141,11 +158,24 @@ def _take_bytes(lib, ptr, length: int) -> bytes:
 class CDatabase:
     """Out-of-process database handle over a TcpGateway."""
 
-    def __init__(self, host: str, port: int):
-        self.lib = load_library()
+    def __init__(self, host: str, port: int, lib: ctypes.CDLL = None,
+                 connect_timeout: float = 5.0):
+        self.lib = lib if lib is not None else load_library()
         handle = ctypes.c_void_p()
-        _check(self.lib, self.lib.fdb_tpu_create_database(
-            host.encode(), port, ctypes.byref(handle)))
+        # connection establishment retries transient failures for a
+        # bounded window (ref: the client connecting to a cluster keeps
+        # trying through recoveries/boot; a cluster mid-recovery may
+        # drop or stall the first describe)
+        import time
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            code = self.lib.fdb_tpu_create_database(
+                host.encode(), port, ctypes.byref(handle))
+            if code == 0:
+                break
+            if code not in (1100, 1004) or time.monotonic() > deadline:
+                _check(self.lib, code)
+            time.sleep(0.1)
         self._h = handle
 
     def close(self) -> None:

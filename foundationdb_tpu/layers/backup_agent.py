@@ -55,10 +55,6 @@ class BackupAgent:
         self._tailed_to = 0
         self._stop = False
         self._replica_rr = 0
-        # per-container incremental-upload state: id(container) ->
-        # {"snap": bool, "n": consumed record count, "end": last
-        # contiguously uploaded version}
-        self._upload_state: dict = {}
 
     # -- lifecycle -------------------------------------------------------
     async def _tagging_recovery(self, active: bool) -> None:
@@ -233,9 +229,14 @@ class BackupAgent:
                 flow.SERVER_KNOBS.backup_log_chunk_records)
         if self.base_blob is None:
             raise ValueError("backup has no snapshot yet (start() first)")
-        st = self._upload_state.setdefault(
-            id(container), {"snap": False, "n": 0,
-                            "end": self.base_version})
+        # incremental state lives ON the container (keyed by this
+        # agent): it dies with the container, and a fresh container can
+        # never inherit another's consumed-record counters
+        st = getattr(container, "_agent_upload_state", None)
+        if st is None or st.get("agent") is not self:
+            st = {"agent": self, "snap": False, "n": 0,
+                  "end": self.base_version}
+            container._agent_upload_state = st
         if not st["snap"]:
             container.store_snapshot(self.base_blob, self.base_version)
             st["snap"] = True

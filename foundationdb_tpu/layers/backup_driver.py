@@ -66,12 +66,26 @@ class BackupDriver:
     async def _read_rows(self) -> dict:
         return await read_backup_rows(self.db, max_retries=10000)
 
-    async def _write_rows(self, **rows) -> None:
+    async def _write_rows(self, expect_state=None, **rows) -> bool:
+        """Commit status rows. With `expect_state`, the write happens
+        only if the state row still matches — an operator command
+        (abort, resubmit) committed while the driver was mid-transition
+        must win, not be clobbered by the driver's stale intention (the
+        read rides the same transaction, so the check is atomic)."""
+        skipped = []
+
         async def body(tr):
+            skipped.clear()   # a retried attempt re-decides from scratch
             tr.set_option("access_system_keys")
+            if expect_state is not None:
+                cur = await tr.get(BACKUP_PREFIX + b"state")
+                if cur != expect_state:
+                    skipped.append(cur)
+                    return
             for k, v in rows.items():
                 tr.set(BACKUP_PREFIX + k.encode(), v)
         await run_transaction(self.db, body, max_retries=10000)
+        return not skipped
 
     # -- the state machine ----------------------------------------------
     async def _run(self) -> None:
@@ -134,7 +148,11 @@ class BackupDriver:
         self.agent.save_to(self._container)
         self._last_upload = flow.now()
         d = self._container.describe()
+        # start() spans a full epoch recovery — if an abort committed
+        # meanwhile, the abort wins: don't stamp `running` over it (the
+        # next poll sees `abort` and finishes the agent)
         await self._write_rows(
+            expect_state=BACKUP_STATE_SUBMITTED,
             state=BACKUP_STATE_RUNNING,
             base_version=str(base).encode(),
             restorable_version=str(
@@ -149,6 +167,7 @@ class BackupDriver:
         d = self._container.describe()
         if d["max_restorable_version"] is not None:
             await self._write_rows(
+                expect_state=BACKUP_STATE_RUNNING,
                 restorable_version=str(d["max_restorable_version"]).encode())
 
     async def _finish(self) -> None:
@@ -160,8 +179,12 @@ class BackupDriver:
             if d["max_restorable_version"] is not None:
                 extra["restorable_version"] = str(
                     d["max_restorable_version"]).encode()
-            await self._write_rows(state=BACKUP_STATE_STOPPED, **extra)
+            # a fresh submit committed while we were stopping the old
+            # agent must not be clobbered with `stopped`
+            await self._write_rows(expect_state=BACKUP_STATE_ABORT,
+                                   state=BACKUP_STATE_STOPPED, **extra)
             self.agent = None
             self._container = None
         else:
-            await self._write_rows(state=BACKUP_STATE_STOPPED)
+            await self._write_rows(expect_state=BACKUP_STATE_ABORT,
+                                   state=BACKUP_STATE_STOPPED)

@@ -35,9 +35,10 @@ class TcpGateway:
     """Serve a cluster (via its client `Database` handle) over TCP."""
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
-                 tls=None):
+                 tls=None, protocol: bytes = None):
         self.db = db
-        self.transport = TcpTransport(host, port, tls=tls)
+        self.transport = TcpTransport(host, port, tls=tls,
+                                      protocol=protocol)
         self._describe = TcpRequestStream(self.transport)
         assert self._describe.token == DESCRIBE_TOKEN, \
             "describe must be the transport's first registered endpoint"

@@ -160,15 +160,24 @@ class _Conn:
                     # ConnectPacket (FDBLibTLS under FlowTransport)
                     self.sock = ctx.wrap_socket(self.sock)
                 self.sock.settimeout(None)
-                self.sock.sendall(PROTOCOL_VERSION)
+                self.sock.sendall(self.transport.protocol)
             elif self.handshake_in:
                 self.sock.settimeout(HANDSHAKE_TIMEOUT())
                 ctx = self.transport.tls_server_ctx()
                 if ctx is not None:
                     self.sock = ctx.wrap_socket(self.sock,
                                                 server_side=True)
-                if _read_exact(self.sock, len(PROTOCOL_VERSION)) != \
-                        PROTOCOL_VERSION:
+                got = _read_exact(self.sock, len(PROTOCOL_VERSION))
+                if got != self.transport.protocol:
+                    if got is not None and \
+                            got[:6] == PROTOCOL_VERSION[:6]:
+                        # a versioned peer we don't speak: answer with
+                        # OUR tag so a MultiVersion client can pick the
+                        # matching library (ref: getServerProtocol)
+                        try:
+                            self.sock.sendall(self.transport.protocol)
+                        except OSError:
+                            pass
                     raise OSError("bad handshake")
                 self.sock.settimeout(None)
             threading.Thread(target=self._reader, daemon=True).start()
@@ -229,9 +238,18 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 class TcpTransport:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 tls: Optional[TlsConfig] = None):
+                 tls: Optional[TlsConfig] = None,
+                 protocol: bytes = None):
         self.host = host
         self.tls = tls
+        # the 8-byte protocol tag this transport speaks (ref: the
+        # ConnectPacket's protocolVersion). A server answers a
+        # mismatched-but-recognizable tag with ITS OWN tag before
+        # closing, so a MultiVersion client can discover the cluster's
+        # protocol and select the matching versioned library
+        # (ref: MultiVersionApi / getServerProtocol)
+        self.protocol = protocol or PROTOCOL_VERSION
+        assert len(self.protocol) == len(PROTOCOL_VERSION)
         # contexts built once and shared by every connection (cert files
         # are read at transport creation, not per reconnect)
         self._tls_server_ctx = tls.server_context() if tls else None

@@ -43,14 +43,62 @@ async def _read_replica(rep, begin: bytes, end, version: int, process):
         cursor = rows[-1][0] + b"\x00"
 
 
-async def check_consistency(cluster, quiesce: bool = True) -> dict:
+async def _quiesce_via_status(db, max_wait: float = 60.0) -> None:
+    """Client-surface settling: poll the status document until the
+    cluster is recovered and every replica has caught up to the log's
+    durable frontier (ref: QuietDatabase's caught-up checks, but
+    through StatusClient so a remote tool can run the sweep over TCP —
+    the in-sim quiet_database reaches into role objects instead). A
+    fully EMPTY log queue is not required: background traffic (the
+    latency probe) keeps the tail entry pinned on a live cluster; the
+    sweep reads at a GRV, so zero replica lag is the property that
+    matters."""
+    deadline = flow.now() + max_wait
+    while True:
+        try:
+            st = (await db.get_status())["cluster"]
+        except flow.FdbError:
+            st = {}
+        logs = st.get("logs", [])
+        reps = [r for s in st.get("storages", []) for r in s["replicas"]]
+        frontier = max((l.get("durable_version", 0) for l in logs),
+                       default=0)
+        if st.get("recovery_state") == "fully_recovered" and logs \
+                and reps \
+                and all(r.get("version", -1) >= frontier for r in reps):
+            return
+        if flow.now() > deadline:
+            raise error("timed_out")
+        await flow.delay(flow.SERVER_KNOBS.quiet_database_poll,
+                         TaskPriority.DEFAULT_ENDPOINT)
+
+
+async def check_consistency(target, quiesce: bool = True) -> dict:
     """Sweep every shard from every replica; raise ConsistencyError on
     any divergence. Returns accounting: shards checked, replicas read,
-    total rows (ref: ConsistencyCheck's performQuiescentChecks)."""
+    total rows (ref: ConsistencyCheck's performQuiescentChecks).
+
+    `target` is a Database — in-sim or a RemoteDatabase over TCP: the
+    sweep uses only the client surface (broadcast shard refs, GRVs,
+    storage range reads, status), so `consistencycheck` works against
+    a tools.server cluster the same as in simulation. A SimCluster is
+    also accepted (the test harness shape), which additionally enables
+    the stronger in-sim quiesce."""
+    cluster = None
+    db = target
+    if not hasattr(target, "create_transaction"):
+        cluster = target
+        db = getattr(cluster, "_consistency_db", None)
+        if db is None:
+            db = cluster._consistency_db = \
+                cluster.client("consistency-check")
     if quiesce:
-        await cluster.quiet_database()
-    info = cluster.cc.dbinfo.get()
-    proc = cluster.cc.process
+        if cluster is not None:
+            await cluster.quiet_database()
+        else:
+            await _quiesce_via_status(db)
+    info = await db.info()
+    proc = db.process
     # shard accounting: the shard map must partition [b"", +inf)
     # exactly — no gaps, no overlaps, ordered boundaries
     shards = info.storages
@@ -67,8 +115,11 @@ async def check_consistency(cluster, quiesce: bool = True) -> dict:
         raise ConsistencyError(
             f"last shard ends at {shards[-1].end!r}, not +inf")
 
-    # quiesced read point: the log frontier every replica has reached
-    version = max(t.version.get() for t in cluster.cc.tlog_objs())
+    # read point: a GRV from the commit pipeline — after quiescence it
+    # IS the log frontier every replica has reached; replicas slightly
+    # behind it block (bounded by the read timeout) rather than serve
+    # stale rows (ref: the workload's reads at a transaction version)
+    version, _seq = await db.batched_grv()
 
     n_replicas = 0
     n_rows = 0

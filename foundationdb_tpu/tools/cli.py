@@ -228,12 +228,13 @@ class Cli:
             return f"Coordination state moved to {n} new coordinators"
         if cmd == "consistencycheck":
             # (ref: `fdbserver -r consistencycheck` / the post-test
-            # sweep, tester.actor.cpp:741)
-            if self.cluster is None:
-                return ("ERROR: consistencycheck requires cluster "
-                        "access (in-sim cli)")
+            # sweep, tester.actor.cpp:741). Runs over the client
+            # surface, so it works identically in-sim and --connect'ed
+            # to a tools.server cluster over TCP; in-sim, the cluster
+            # handle enables the stronger quiesce.
             from ..server.consistency import check_consistency
-            stats = self._run(check_consistency(self.cluster))
+            target = self.cluster if self.cluster is not None else self.db
+            stats = self._run(check_consistency(target))
             return (f"Consistency check passed: {stats['shards']} shards,"
                     f" {stats['replicas']} replicas, {stats['rows']} rows"
                     f" at version {stats['version']}")

@@ -28,8 +28,9 @@ pytestmark = pytest.mark.filterwarnings("ignore::pytest.PytestUnhandledThreadExc
 class GatewayedCluster:
     """Wall-clock SimCluster + TcpGateway on a background thread."""
 
-    def __init__(self, **kw):
+    def __init__(self, gateway_protocol: bytes = None, **kw):
         self.kw = kw
+        self.gateway_protocol = gateway_protocol
         self.q: queue.Queue = queue.Queue()
         self.stop = threading.Event()
         self.thread = threading.Thread(target=self._main, daemon=True)
@@ -64,7 +65,7 @@ class GatewayedCluster:
         try:
             c = SimCluster(virtual=False, **self.kw)
             db = c.client("gateway-host")
-            gw = TcpGateway(db)
+            gw = TcpGateway(db, protocol=self.gateway_protocol)
 
             async def main():
                 gw.start()
