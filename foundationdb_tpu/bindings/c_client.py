@@ -173,7 +173,8 @@ class CDatabase:
                 host.encode(), port, ctypes.byref(handle))
             if code == 0:
                 break
-            if code not in (1100, 1004) or time.monotonic() > deadline:
+            if (not self.lib.fdb_tpu_error_retryable(code)
+                    or time.monotonic() > deadline):
                 _check(self.lib, code)
             time.sleep(0.1)
         self._h = handle

@@ -26,6 +26,7 @@ from __future__ import annotations
 import socket
 from typing import Dict, Optional
 
+from ..rpc.tcp import _read_exact
 from .c_client import CDatabase, load_library_at
 
 #: a tag with the right magic but a version no release ever shipped:
@@ -42,16 +43,8 @@ def probe_cluster_protocol(host: str, port: int,
     with socket.create_connection((host, port), timeout=timeout) as s:
         s.sendall(PROBE_TAG)
         s.settimeout(timeout)
-        got = b""
-        try:
-            while len(got) < len(PROBE_TAG):
-                chunk = s.recv(len(PROBE_TAG) - len(got))
-                if not chunk:
-                    break
-                got += chunk
-        except OSError:
-            return None
-    return got if len(got) == len(PROBE_TAG) else None
+        got = _read_exact(s, len(PROBE_TAG))
+    return got
 
 
 class MultiVersionClient:
@@ -64,9 +57,19 @@ class MultiVersionClient:
         build of bindings/c). Tags are read from the libraries
         themselves via fdb_tpu_get_protocol()."""
         self.libs: Dict[bytes, object] = {}
+        #: (path, reason) for libraries that could not be versioned —
+        #: a pre-versioning build has no discoverable protocol, so it
+        #: can never be route target; keep the evidence for errors
+        self.skipped: list = []
         for path in library_paths:
             lib = load_library_at(path)
-            tag = lib.fdb_tpu_get_protocol()
+            try:
+                tag = lib.fdb_tpu_get_protocol()
+            except AttributeError:
+                self.skipped.append(
+                    (path, "predates protocol versioning "
+                           "(no fdb_tpu_get_protocol export)"))
+                continue
             self.libs[tag] = lib
 
     def protocols(self):
@@ -84,7 +87,9 @@ class MultiVersionClient:
                 "probe with nothing)")
         lib = self.libs.get(tag)
         if lib is None:
+            extra = "".join(f"; skipped {p} ({why})"
+                            for p, why in self.skipped)
             raise RuntimeError(
                 f"no client library for cluster protocol {tag!r}; "
-                f"loaded: {self.protocols()}")
+                f"loaded: {self.protocols()}{extra}")
         return CDatabase(host, port, lib=lib)

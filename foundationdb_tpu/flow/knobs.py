@@ -121,6 +121,7 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # -- proxy / GRV (ref: START_TRANSACTION_* knobs) ------------------
     init("GRV_RATE_POLL_INTERVAL", 0.1)
     init("GRV_CONFIRM_TIMEOUT", 2.0)
+    init("GRV_PEER_SUSPECT_DURATION", 1.0, lambda: 0.01)
     init("GRV_BURST_INTERVALS", 10, lambda: 1)
     init("RATEKEEPER_POLL_TIMEOUT", 1.0)
 

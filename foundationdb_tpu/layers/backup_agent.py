@@ -55,6 +55,8 @@ class BackupAgent:
         self._tailed_to = 0
         self._stop = False
         self._replica_rr = 0
+        # identity token for container-held incremental upload state
+        self._upload_token = object()
 
     # -- lifecycle -------------------------------------------------------
     async def _tagging_recovery(self, active: bool) -> None:
@@ -233,8 +235,11 @@ class BackupAgent:
         # agent): it dies with the container, and a fresh container can
         # never inherit another's consumed-record counters
         st = getattr(container, "_agent_upload_state", None)
-        if st is None or st.get("agent") is not self:
-            st = {"agent": self, "snap": False, "n": 0,
+        if st is None or st.get("agent") is not self._upload_token:
+            # keyed by a per-agent token, NOT the agent itself: a
+            # container outliving the agent must not pin the agent's
+            # whole mutation-log history in memory
+            st = {"agent": self._upload_token, "snap": False, "n": 0,
                   "end": self.base_version}
             container._agent_upload_state = st
         if not st["snap"]:

@@ -66,26 +66,24 @@ class BackupDriver:
     async def _read_rows(self) -> dict:
         return await read_backup_rows(self.db, max_retries=10000)
 
-    async def _write_rows(self, expect_state=None, **rows) -> bool:
+    async def _write_rows(self, expect_state=None, **rows) -> None:
         """Commit status rows. With `expect_state`, the write happens
         only if the state row still matches — an operator command
         (abort, resubmit) committed while the driver was mid-transition
         must win, not be clobbered by the driver's stale intention (the
-        read rides the same transaction, so the check is atomic)."""
-        skipped = []
+        read rides the same transaction, so the check is atomic). A
+        skipped write needs no signal: every caller converges on the
+        next poll round by re-reading the rows."""
 
         async def body(tr):
-            skipped.clear()   # a retried attempt re-decides from scratch
             tr.set_option("access_system_keys")
             if expect_state is not None:
                 cur = await tr.get(BACKUP_PREFIX + b"state")
                 if cur != expect_state:
-                    skipped.append(cur)
                     return
             for k, v in rows.items():
                 tr.set(BACKUP_PREFIX + k.encode(), v)
         await run_transaction(self.db, body, max_retries=10000)
-        return not skipped
 
     # -- the state machine ----------------------------------------------
     async def _run(self) -> None:
@@ -112,6 +110,7 @@ class BackupDriver:
                     # reference agent RESUMES from container state —
                     # resumable backups are out of this slice's scope)
                     await self._write_rows(
+                        expect_state=BACKUP_STATE_RUNNING,
                         state=BACKUP_STATE_ERROR,
                         error=b"backup driver restarted mid-backup; "
                               b"abort is not needed, resubmit")
@@ -135,7 +134,13 @@ class BackupDriver:
                     self.agent = None
                 self._container = None
                 try:
-                    await self._write_rows(state=BACKUP_STATE_ERROR,
+                    # compare-and-set against the state this iteration
+                    # acted on: an operator command (abort/resubmit)
+                    # that committed while we were tearing down wins —
+                    # the next poll acts on it instead of finding our
+                    # ERROR stamped over it
+                    await self._write_rows(expect_state=state,
+                                           state=BACKUP_STATE_ERROR,
                                            error=repr(e).encode())
                 except flow.FdbError:
                     pass   # cluster unhealthy: rows update next round

@@ -57,7 +57,12 @@ async def _quiesce_via_status(db, max_wait: float = 60.0) -> None:
     while True:
         try:
             st = (await db.get_status())["cluster"]
-        except flow.FdbError:
+        except flow.FdbError as e:
+            if e.name == "client_invalid_operation":
+                # no status endpoint on this connection at all —
+                # polling for 60s cannot fix that; fail immediately
+                # with the real cause instead of a generic timeout
+                raise
             st = {}
         logs = st.get("logs", [])
         reps = [r for s in st.get("storages", []) for r in s["replicas"]]

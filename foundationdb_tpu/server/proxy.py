@@ -212,6 +212,7 @@ class Proxy:
         self._batch_rate = 1e9         # batch-priority budget (<= _rate)
         self._grv_queue = []           # waiting GRV replies
         self._grv_inflight = []        # batch being confirmed right now
+        self._suspect_peers = {}       # id(ref) -> suspect-until time
         # (ref: ProxyStats — txn admission/commit counters for status)
         self.stats = flow.CounterCollection("proxy")
         # banded request latencies (ref: LatencyBandConfig applied to
@@ -230,6 +231,7 @@ class Proxy:
         """Raw-committed-version endpoints of the OTHER proxies (ref:
         getLiveCommittedVersion asking all proxies)."""
         self._peers = list(raw_refs)
+        self._suspect_peers.clear()
 
     def start(self) -> None:
         self._actors.add(flow.spawn(self._batcher(),
@@ -352,17 +354,47 @@ class Proxy:
         is the max committed version across ALL of them, so a client
         never reads below its own acknowledged commit through a
         different proxy (ref: getLiveCommittedVersion,
-        MasterProxyServer.actor.cpp:1019 — asks all other proxies; a
-        dead peer fails the batch and the clients retry after
-        recovery)."""
+        MasterProxyServer.actor.cpp:1019 — asks all other proxies).
+
+        A dead peer must NOT error the batch: the reference degrades by
+        recruitment, not by failing clients. When a peer times out we
+        mark it suspect (skipped for GRV_PEER_SUSPECT_DURATION) and fall
+        back to the TLogs' durable frontier: a proxy only acks a commit
+        once ALL logs hold it durably, so min(frontier) across logs is
+        >= every acknowledged commit from every proxy — and, unlike the
+        master's last-assigned version, it is a version the storage
+        servers can actually reach (an assigned-but-never-pushed version
+        would leave readers blocked for the rest of the epoch). Clients
+        pay one frontier round-trip during the window until recovery
+        rotates the peer set, instead of seeing errors."""
         try:
             version = self.committed_version.get()
             if self._peers:
+                now = flow.now()
+                live = [p for p in self._peers
+                        if self._suspect_peers.get(id(p), 0.0) <= now]
+                degraded = len(live) < len(self._peers)
                 futs = [flow.timeout_error(p.get_reply(None, self.process),
                                            SERVER_KNOBS.grv_confirm_timeout)
-                        for p in self._peers]
-                others = await flow.all_of(futs)
-                version = max([version] + list(others))
+                        for p in live]
+                for p, f in zip(live, futs):
+                    try:
+                        version = max(version, await f)
+                    except flow.FdbError as e:
+                        if e.name == "operation_cancelled":
+                            raise
+                        degraded = True
+                        self._suspect_peers[id(p)] = (
+                            flow.now()
+                            + SERVER_KNOBS.grv_peer_suspect_duration)
+                if degraded:
+                    self.stats.counter("grv_degraded").add(1)
+                    frontiers = await flow.all_of([
+                        flow.timeout_error(
+                            ref.get_reply(None, self.process),
+                            SERVER_KNOBS.grv_confirm_timeout)
+                        for ref in self.tlog_refs])
+                    version = max(version, min(frontiers))
             self.stats.counter("transactions_started").add(
                 sum(e[1] for e in batch))
             now = flow.now()

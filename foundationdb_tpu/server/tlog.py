@@ -136,6 +136,14 @@ class TLog:
         # reordered pair (same per-request tolerance as the resolver).
         while True:
             req, reply = await self.commits.pop()
+            if req is None:
+                # durable-frontier probe (degraded GRV): every commit a
+                # proxy has EVER acked is durable on all logs, so the
+                # min of these frontiers across logs is a committed,
+                # readable read-version floor. Answers even while
+                # stopped — a locked log still knows what it holds.
+                reply.send(self.version.get())
+                continue
             assert isinstance(req, TLogCommitRequest)
             flow.spawn(self._handle_commit(req, reply),
                        TaskPriority.TLOG_COMMIT)

@@ -166,3 +166,53 @@ def test_sharded_and_durable_with_kill():
         assert c.run(main(), timeout_time=600)
     finally:
         c.shutdown()
+
+
+def test_grv_degrades_on_dead_peer_without_erroring():
+    """A dead GRV-confirmation peer must not error the batch: the proxy
+    marks the peer suspect and falls back to the TLogs' durable
+    frontier — min(frontier) across logs is >= every acknowledged commit
+    and is reachable by storage — so clients see a valid read version,
+    never an error (ref: the reference degrading
+    by recruitment, MasterProxyServer.actor.cpp:1019)."""
+    from foundationdb_tpu.rpc import RequestStream
+
+    c = SimCluster(seed=311, n_proxies=2)
+    try:
+        db = c.client()
+
+        async def main():
+            async def wbody(tr):
+                tr.set(b"k", b"v")
+            await run_transaction(db, wbody)
+
+            proxies = c.cc._current_proxies()
+            assert len(proxies) == 2
+            a, b = proxies
+            floor = max(p.committed_version.get() for p in proxies)
+
+            # replace a's view of its peer with an endpoint that never
+            # answers (peer process dead, recovery not yet rotated)
+            dead = RequestStream(db.process)
+            a.set_peers([dead.ref()])
+
+            t0 = flow.now()
+            reply = await a.grvs.ref().get_reply(None, db.process)
+            assert reply.version >= floor, (reply.version, floor)
+            assert a.stats.counter("grv_degraded").value >= 1
+
+            # suspect cache: the next batch skips the dead peer and
+            # answers well inside one confirm-timeout
+            t1 = flow.now()
+            reply2 = await a.grvs.ref().get_reply(None, db.process)
+            assert reply2.version >= reply.version
+            assert flow.now() - t1 < flow.SERVER_KNOBS.grv_confirm_timeout, (
+                flow.now() - t1)
+            # the first, suspect-discovering batch pays at most one
+            # confirm-timeout plus the fallback round-trip
+            assert flow.now() - t0 < 3 * flow.SERVER_KNOBS.grv_confirm_timeout
+            return True
+
+        assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
