@@ -154,7 +154,7 @@ class Database:
         backend) runs client-side, like the reference's changeConfig."""
         updates = {k: v for k, v in kwargs.items() if v is not None}
         names = {"n_proxies", "n_resolvers", "n_logs",
-                 "conflict_backend"}
+                 "conflict_backend", "usable_regions"}
         if not set(updates) <= names:
             raise error("invalid_option_value")
         ints = {k: v for k, v in updates.items() if k != "conflict_backend"}
@@ -164,9 +164,13 @@ class Database:
                 updates["conflict_backend"] not in (
                     "python", "native", "tpu", "tpu-point"):
             raise error("invalid_option_value")
-        if ints:
+        if updates.get("usable_regions") not in (None, 1, 2):
+            raise error("invalid_option_value")
+        role_counts = {k: v for k, v in ints.items()
+                       if k != "usable_regions"}
+        if role_counts:
             live = await self._live_workers()
-            if any(v > live for v in ints.values()):
+            if any(v > live for v in role_counts.values()):
                 raise error("invalid_option_value")
         if not updates:
             return

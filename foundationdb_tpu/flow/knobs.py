@@ -74,6 +74,10 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # -- master / recovery (ref: fdbserver/Knobs.cpp recovery family) --
     init("MAX_VERSION_ADVANCE", 5_000_000, lambda: 50_000)
     init("RECOVERY_WAIT_FOR_LOGS_DELAY", 0.5, lambda: 2.0)
+    # straggler window for region-takeover lock acquisition (NOT
+    # buggified smaller: a too-short window re-admits the data loss
+    # the satellite path exists to prevent)
+    init("REGION_LOCK_GRACE", 5.0)
     init("RESOLUTION_BALANCING_INTERVAL", 2.0, lambda: 0.3)
     init("RESOLUTION_METRICS_TIMEOUT", 2.0)
     init("RESOLUTION_BALANCING_MIN_WORK", 100, lambda: 5)

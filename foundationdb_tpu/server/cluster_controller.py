@@ -39,6 +39,11 @@ class ClusterConfig(NamedTuple):
     conflict_backend: str = "python"
     durable: bool = False
     storage_engine: str = "memory"   # memory | btree (ref: ssd engine)
+    # 1 = single region; 2 = a remote region may attach (ref:
+    # DatabaseConfiguration usable_regions — the fearless gate). The
+    # region OBJECT still comes from the attach seam (cc.region);
+    # this row is the committed operator intent that recruitment obeys.
+    usable_regions: int = 1
     # explicit storage-team placement policy (a ReplicationPolicy over
     # processid/machineid/zoneid/dcid localities). None = the default
     # Across(storage_replicas, zoneid, One()). When set explicitly,
@@ -418,8 +423,14 @@ class ClusterController:
         consecutive recruitments spread roles the way the reference's
         fitness ranking does."""
         from .replication_policy import PolicyAcross, PolicyOne
+        # recruitment is DC-local (ref: clusterRecruitFromConfiguration
+        # recruiting the transaction subsystem in the primary DC):
+        # satellite log workers register for lock/rejoin visibility but
+        # must never be handed proxy/resolver/storage roles
+        my_dc = getattr(self.process, "dc", "dc0")
         live = [wi for name, wi in self.workers.items()
-                if wi.worker.process.alive and name not in self.excluded]
+                if wi.worker.process.alive and name not in self.excluded
+                and wi.dc == my_dc]
         if not live:
             raise error("no_more_servers")
         rot = self._rr % len(live)
@@ -620,6 +631,7 @@ class ClusterController:
             if (cand.n_proxies < 1 or cand.n_resolvers < 1
                     or cand.n_logs < 1 or cand.n_logs > live
                     or cand.n_resolvers > live or cand.n_proxies > live
+                    or cand.usable_regions not in (1, 2)
                     or cand.conflict_backend not in (
                         "python", "native", "tpu", "tpu-point")):
                 flow.cover("cc.metadata.config_unrecruitable")
@@ -695,13 +707,11 @@ class ClusterController:
                 except ValueError:
                     repairs[key] = live
         cand = self.config._replace(**updates)
-        live_workers = [name for name, wi in self.workers.items()
-                        if wi.worker.process.alive]
-        n_live = sum(1 for name in live_workers
-                     if name not in self.excluded)
+        n_live = self._live_included_workers()
         if (cand.n_proxies < 1 or cand.n_resolvers < 1
                 or cand.n_logs < 1 or cand.n_logs > n_live
                 or cand.n_resolvers > n_live or cand.n_proxies > n_live
+                or cand.usable_regions not in (1, 2)
                 or cand.conflict_backend not in (
                     "python", "native", "tpu", "tpu-point")):
             flow.cover("cc.metadata.sync_repair_config")
@@ -821,9 +831,14 @@ class ClusterController:
             for c in old_set])
 
     def _live_included_workers(self, without: str = None) -> int:
+        # same DC filter as pick_workers: cross-DC satellite workers
+        # can hold log replicas but never transaction roles, so a
+        # recruitable-shape check counting them would approve configs
+        # the primary DC cannot actually host
+        my_dc = getattr(self.process, "dc", "dc0")
         return sum(1 for name, wi in self.workers.items()
                    if wi.worker.process.alive and name not in self.excluded
-                   and name != without)
+                   and name != without and wi.dc == my_dc)
 
     def _hosts_current_txn_role(self, worker_name: str) -> bool:
         """Does the worker host a CURRENT-epoch transaction role?

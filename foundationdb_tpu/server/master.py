@@ -163,8 +163,31 @@ class MasterRecovery:
             # primary: their undrained remainder is part of that loss.
             self._set_state(dbi.LOCKING_CSTATE)
             recovery_version, locked = await self._epoch_end_region(prev)
-            old_log_sets = (LogSetInfo(prev.epoch, 0, recovery_version,
-                                       locked, stores=prev.region_logs),)
+            old_log_sets = (LogSetInfo(
+                prev.epoch, 0, recovery_version, locked,
+                stores=tuple(prev.region_logs) + tuple(prev.logs)),)
+            # older generations may still matter: a router lagging
+            # across an epoch boundary reads the gap from that epoch's
+            # satellite replicas, which survive the blackout. A
+            # generation with NO surviving store died whole with the
+            # primary — in a takeover that is part of the admitted
+            # loss, and carrying it would wedge every reader behind a
+            # generation that can never answer (pre-attach data is
+            # absent from the region by the attach contract anyway)
+            for oe, ob, oend, stores in prev.old_logs:
+                refs = tuple(r for r in (self.cc.log_stores.get(s)
+                                         for s, _m in stores)
+                             if r is not None)
+                if not refs:
+                    flow.TraceEvent(
+                        "RegionTakeoverAbandonedGeneration",
+                        self.process.name,
+                        severity=flow.trace.SevWarnAlways).detail(
+                        Epoch=oe, Begin=ob, End=oend,
+                        Stores=",".join(s for s, _m in stores)).log()
+                    continue
+                old_log_sets += (LogSetInfo(oe, ob, oend, refs,
+                                            stores=tuple(stores)),)
         elif prev is not None:
             self._set_state(dbi.LOCKING_CSTATE)
             recovery_version, locked = await self._epoch_end(prev)
@@ -189,16 +212,51 @@ class MasterRecovery:
         self.master = Master(self.process, recovery_version=recovery_version)
         self.master.start()
         self.critical_procs = {self.process}
+        # capture ONCE, before recruitment: the epoch is recruited
+        # consistently even if the flags flip mid-recovery (the
+        # config-dirty recovery after such a flip re-publishes)
+        backup_on = self.cc.backup_active
+        region = getattr(self.cc, "region", None)
+        # the committed \xff/conf/usable_regions row is the operator
+        # intent recruitment obeys: an attached region object with
+        # usable_regions=1 is ignored (ref: DatabaseConfiguration
+        # usable_regions gating the fearless log topology)
+        if getattr(cfg, "usable_regions", 1) < 2:
+            region = None
         log_workers = self.cc.pick_workers(cfg.n_logs, role="tlog")
         new_logs = []
         new_log_stores = []
+        log_recruits = []       # (worker, store) incl. satellites
         for i, w in enumerate(log_workers):
             store = f"tlog-e{self.epoch}-{i}"
             refs = w.recruit_tlog(store, recovery_version)
             self.cc.log_stores[store] = refs
             new_logs.append(refs)
             new_log_stores.append((store, w.process.machine))
+            log_recruits.append((w, store))
             self.critical_procs.add(w.process)
+        # satellite log replicas (ref: satelliteTagLocations — one more
+        # full replica of the stream per satellite DC, so the acked
+        # tail survives a primary-DC blackout). Full log-set members:
+        # pushed to, locked, rotated onto, popped like any replica.
+        if region is not None and region.satellite_workers:
+            live_sats = [sw for sw in region.satellite_workers
+                         if sw.process.alive]
+            if not live_sats:
+                flow.TraceEvent(
+                    "RecoverySatellitesUnavailable", self.process.name,
+                    severity=flow.trace.SevWarnAlways).detail(
+                    Epoch=self.epoch).log()
+            for i, sw in enumerate(region.satellite_workers):
+                if not sw.process.alive:
+                    continue
+                store = f"tlog-e{self.epoch}-sat{i}"
+                refs = sw.recruit_tlog(store, recovery_version)
+                self.cc.log_stores[store] = refs
+                new_logs.append(refs)
+                new_log_stores.append((store, sw.process.machine))
+                log_recruits.append((sw, store))
+                self.critical_procs.add(sw.process)
         res_workers = self.cc.pick_workers(cfg.n_resolvers, role="resolver")
         resolver_refs = []
         resolver_metrics = []
@@ -217,12 +275,6 @@ class MasterRecovery:
         for name, (tag, _b, _e) in self.cc.shard_map.items():
             expected.setdefault(tag, []).append(name)
         expected = {t: tuple(ns) for t, ns in expected.items()}
-        # capture ONCE: the epoch is recruited consistently and the
-        # broadcast advertises exactly what was recruited, even if the
-        # flags flip mid-recovery (the config-dirty recovery that
-        # follows such a flip re-publishes the corrected picture)
-        backup_on = self.cc.backup_active
-        region = getattr(self.cc, "region", None)
         if backup_on:
             from .proxy import BACKUP_TAG
             from ..layers.backup_agent import AGENT_NAME
@@ -230,9 +282,8 @@ class MasterRecovery:
         if region is not None:
             from .proxy import REGION_TAG
             expected[REGION_TAG] = (region.router_name,)
-        for i, w in enumerate(log_workers):
-            w.roles[f"tlog-e{self.epoch}-{i}"].set_expected_replicas(
-                expected)
+        for w, store in log_recruits:
+            w.roles[store].set_expected_replicas(expected)
         storage_splits = self.cc.storage_splits()
         rk_worker = self.cc.pick_workers(1, role="ratekeeper")[0]
         rk_ref = rk_worker.recruit_ratekeeper(
@@ -354,25 +405,49 @@ class MasterRecovery:
         promoted epoch preserves (ref: epochEnd over the remote log
         set; the lock doubles as the fence the old promote() faked with
         a quiesce poll)."""
+        grace = flow.now() + flow.SERVER_KNOBS.region_lock_grace
         while True:
-            refs = [self.cc.log_stores.get(store)
-                    for store, _m in prev.region_logs]
-            refs = [r for r in refs if r is not None]
-            if refs:
+            # the remote log PLUS whatever survives of the primary
+            # epoch's log set — in a primary blackout that is exactly
+            # the satellite replicas, which hold the complete acked
+            # stream (push waits on every replica), so locking them
+            # recovers to the acked frontier: zero data loss instead
+            # of the router's shipped frontier (ref: epochEnd preferring
+            # the satellite-backed recovery when remote logs lag)
+            stores = tuple(prev.region_logs) + tuple(prev.logs)
+            refs = {store: self.cc.log_stores.get(store)
+                    for store, _m in stores}
+            known = [(s, r) for s, r in refs.items() if r is not None]
+            locked = []
+            if known:
                 futs = [flow.catch_errors(flow.timeout_error(
                     r.locks.get_reply(TLogLockRequest(), self.process),
                     flow.SERVER_KNOBS.tlog_lock_timeout))
-                    for r in refs]
+                    for _s, r in known]
                 settled = await flow.all_of(futs)
-                locked = [(r, f.get()) for r, f in zip(refs, settled)
+                locked = [(r, f.get()) for (_s, r), f in zip(known, settled)
                           if not f.is_error]
-                if locked:
-                    flow.cover("master.region_takeover")
-                    recovery_version = max(rep.end_version
-                                           for _r, rep in locked)
-                    return recovery_version, tuple(r for r, _ in locked)
+            # don't settle for the first lockable subset: worker
+            # registrations with the freshly promoted controller race
+            # this loop, and returning before the satellite stores land
+            # would silently recover at the router's lagging frontier.
+            # Proceed only once every store either has a locked ref or
+            # the grace window for stragglers has passed (blacked-out
+            # primary stores never register — they are what the grace
+            # window exists to stop waiting for).
+            unresolved = len(stores) - len(locked)
+            if locked and (unresolved == 0 or flow.now() >= grace):
+                if unresolved:
+                    flow.TraceEvent(
+                        "RegionTakeoverPartialLock", self.process.name,
+                        severity=flow.trace.SevWarnAlways).detail(
+                        Locked=len(locked), Total=len(stores)).log()
+                flow.cover("master.region_takeover")
+                recovery_version = max(rep.end_version
+                                       for _r, rep in locked)
+                return recovery_version, tuple(r for r, _ in locked)
             self._trace("MasterRecoveryWaitingForRegionLogs",
-                        Stores=",".join(s for s, _m in prev.region_logs))
+                        Stores=",".join(s for s, _m in stores))
             await flow.delay(flow.SERVER_KNOBS.recovery_wait_for_logs_delay,
                              TaskPriority.CLUSTER_CONTROLLER)
 

@@ -45,10 +45,12 @@ BACKUP_STATE_ERROR = b"error"
 # informational rows.
 CONF_ROWS = {"proxies": "n_proxies", "resolvers": "n_resolvers",
              "logs": "n_logs", "conflict_backend": "conflict_backend",
+             "usable_regions": "usable_regions",
              "storage_shards": "n_storage", "durable": "durable",
              "storage_replicas": "storage_replicas",
              "storage_engine": "storage_engine"}
-CONF_MUTABLE = ("proxies", "resolvers", "logs", "conflict_backend")
+CONF_MUTABLE = ("proxies", "resolvers", "logs", "conflict_backend",
+                "usable_regions")
 CONF_ROW_BY_FIELD = {f: row for row, f in CONF_ROWS.items()
                      if row in CONF_MUTABLE}
 

@@ -233,3 +233,106 @@ def test_router_survives_primary_recovery():
         assert c.run(main(), timeout_time=900)
     finally:
         c.shutdown()
+
+
+def test_satellite_failover_loses_nothing():
+    """Satellite log replicas (ref: satelliteTagLocations,
+    TagPartitionedLogSystem.actor.cpp:156-220): with satellites, a
+    primary blackout loses NO acked commit even when the region router
+    has shipped nothing — promotion locks the surviving satellite
+    replicas, which hold the complete acked stream, and recovers at
+    their frontier (the fearless guarantee, not just the async one)."""
+    c = SimCluster(seed=811, durable=True, auto_reboot=False,
+                   n_coordinators=5, n_storage=2)
+    try:
+        db = c.client()
+
+        async def main():
+            region = RemoteRegion(c, n_satellites=2)
+            await region.start()
+            # attach recruited satellite replicas into the log set
+            info = c.cc.dbinfo.get()
+            sat_stores = [s for s, _m in (info.logs.stores or ())
+                          if "-sat" in s]
+            assert len(sat_stores) == 2, info.logs.stores
+
+            # model maximum router lag: the remote DC receives nothing
+            region._router_task.cancel()
+
+            committed = {}
+            for i in range(24):
+                key = (b"k%03d" if i % 2 else b"\xc8%03d") % i
+                tr = db.create_transaction()
+                tr.set(key, b"v%d" % i)
+                committed[key] = await tr.commit()
+            assert region._pushed_to < max(committed.values())
+
+            old_epoch = c.cc.dbinfo.get().epoch
+            _blackout_primary(c, keep_coordinators=(2, 3, 4))
+
+            promoted = await region.promote()
+            # zero loss: the recovery frontier covers EVERY acked commit
+            assert promoted.recovery_version >= max(committed.values()), (
+                promoted.recovery_version, max(committed.values()))
+            info2 = promoted.cc.dbinfo.get()
+            assert info2.epoch > old_epoch
+
+            pdb = promoted.client()
+
+            async def read_all(tr):
+                rows = dict(await tr.get_range(b"", b"\xff"))
+                for k in committed:
+                    assert rows.get(k) is not None, (k, len(rows))
+            await run_transaction(pdb, read_all, max_retries=500)
+            return True
+
+        assert c.run(main(), timeout_time=900)
+    finally:
+        c.shutdown()
+
+
+def test_satellite_death_recovers_and_commits_resume():
+    """A satellite replica is a critical process: its death ends the
+    epoch; the next recovery recruits replicas on the surviving
+    satellite workers and commits resume (ref: recruitment degrading
+    across satellite failures rather than wedging the push)."""
+    c = SimCluster(seed=813, durable=True, n_coordinators=3)
+    try:
+        db = c.client()
+
+        async def main():
+            region = RemoteRegion(c, n_satellites=2)
+            await region.start()
+            epoch0 = c.cc.dbinfo.get().epoch
+
+            async def w(tr):
+                tr.set(b"a", b"1")
+            await run_transaction(db, w)
+
+            # kill one satellite worker outright
+            c.net.kill(region.satellite_workers[0].process)
+
+            # commits keep working across the triggered recovery
+            for i in range(5):
+                async def body(tr, i=i):
+                    tr.set(b"k%d" % i, b"v%d" % i)
+                await run_transaction(db, body, max_retries=1000)
+
+            deadline = flow.now() + 60
+            while c.cc.dbinfo.get().epoch == epoch0:
+                assert flow.now() < deadline, "no recovery after sat death"
+                await flow.delay(0.2)
+            info = c.cc.dbinfo.get()
+            sat_stores = [s for s, _m in (info.logs.stores or ())
+                          if "-sat" in s]
+            # the dead satellite is gone from the set; the survivor
+            # carries the replica
+            assert len(sat_stores) == 1, info.logs.stores
+
+            tr = db.create_transaction()
+            assert await tr.get(b"k4") == b"v4"
+            return True
+
+        assert c.run(main(), timeout_time=900)
+    finally:
+        c.shutdown()
