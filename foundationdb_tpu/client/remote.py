@@ -74,7 +74,9 @@ class RemoteDatabase(Database):
 
     async def _describe(self, min_seq: int) -> None:
         ref = self._transport.ref(self._host, self._port, DESCRIBE_TOKEN)
-        d = await flow.timeout_error(ref.get_reply(int(min_seq)), 30.0)
+        d = await flow.timeout_error(
+            ref.get_reply(int(min_seq)),
+            flow.SERVER_KNOBS.remote_client_request_timeout)
         self._status_token = d.get("status", 0)
         self._management_token = d.get("management", 0)
         self._info = _build_info(d, self._transport, self._host, self._port)
@@ -94,7 +96,9 @@ class RemoteDatabase(Database):
             raise flow.error("client_invalid_operation")
         ref = self._transport.ref(self._host, self._port,
                                   self._status_token)
-        return await flow.timeout_error(ref.get_reply(None), 30.0)
+        return await flow.timeout_error(
+            ref.get_reply(None),
+            flow.SERVER_KNOBS.remote_client_request_timeout)
 
     # configure/exclude ride the inherited Database implementations —
     # ordinary \xff/conf//\xff/excluded transactions over the same
@@ -162,7 +166,8 @@ class RemoteCluster:
                     try:
                         coro, box, done = self._submissions.get_nowait()
                     except queue.Empty:
-                        await flow.delay(0.005)
+                        await flow.delay(
+                            flow.SERVER_KNOBS.remote_client_poll_delay)
                         continue
                     flow.spawn(self._run_one(coro, box, done))
 

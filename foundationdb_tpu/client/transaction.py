@@ -298,7 +298,8 @@ class Database:
                 except flow.FdbError as e2:
                     if e2.name == "operation_cancelled":
                         raise
-                await flow.delay(0.5, TaskPriority.DEFAULT_ENDPOINT)
+                await flow.delay(flow.SERVER_KNOBS.client_rediscover_delay,
+                                 TaskPriority.DEFAULT_ENDPOINT)
 
     def close(self) -> None:
         """Stop the standing dbinfo watcher (sim Databases are

@@ -121,6 +121,12 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # -- tlog (ref: TLOG_* knobs) --------------------------------------
     init("TLOG_STALLED_PEEK_DELAY", 1.0)
     init("TLOG_FSYNC_DELAY", 0.0005, lambda: 0.01)
+    # BUGGIFY-injected commit reordering window (the durable-path race
+    # stressor; 0 disables even the buggify branch)
+    init("BUGGIFY_TLOG_COMMIT_DELAY_MAX", 0.01, lambda: 0.1)
+    # fetchKeys streaming chunk (ref: FETCH_BLOCK_BYTES — rows here,
+    # shard moves stream in bounded chunks)
+    init("FETCH_BLOCK_ROWS", 64, lambda: 3)
 
     # -- proxy / GRV (ref: START_TRANSACTION_* knobs) ------------------
     init("GRV_RATE_POLL_INTERVAL", 0.1)
@@ -176,6 +182,12 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("CLIENT_RETRY_BACKOFF_MIN", 0.001)
     init("CLIENT_RETRY_BACKOFF_JITTER", 0.01, lambda: 0.1)
     init("CLIENT_DEFAULT_MAX_RETRIES", 100)
+    # poll pace while re-finding the controller through coordinators
+    # (ref: MonitorLeader's COORDINATOR_RECONNECTION_DELAY)
+    init("CLIENT_REDISCOVER_DELAY", 0.5, lambda: 2.0)
+    # remote (TCP gateway) client request timeout + reply-poll pace
+    init("REMOTE_CLIENT_REQUEST_TIMEOUT", 30.0)
+    init("REMOTE_CLIENT_POLL_DELAY", 0.005)
 
     # -- consistency check (ref: ConsistencyCheck workload knobs) ------
     init("CONSISTENCY_CHECK_PAGE_ROWS", 10_000, lambda: 7)
@@ -198,6 +210,15 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
 
     # -- layers (ref: TaskBucket timeout + backup chunking) ------------
     init("TASKBUCKET_LEASE_SECONDS", 10.0, lambda: 0.5)
+    init("BACKUP_AGENT_POLL_DELAY", 0.1, lambda: 1.0)
+    init("BACKUP_TOOL_POLL_DELAY", 0.25, lambda: 2.0)
+    init("SERVER_STATUS_POLL_DELAY", 0.5)
+    # workload harness pacing (ref: Attrition/watch workload params)
+    init("WORKLOAD_KILL_DELAY_MIN", 0.05)
+    init("WORKLOAD_KILL_DELAY_SPAN", 0.2, lambda: 2.0)
+    init("WORKLOAD_WATCH_TIMEOUT", 30.0)
+    # real-TCP reactor inbox poll pace (wall-clock)
+    init("TCP_REACTOR_POLL_DELAY", 0.001)
     init("BACKUP_LOG_CHUNK_RECORDS", 500, lambda: 3)
     init("BLOBSTORE_REQUEST_TIMEOUT", 10.0)
     init("METRIC_LOGGER_INTERVAL", 1.0)

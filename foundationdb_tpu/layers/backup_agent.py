@@ -446,7 +446,8 @@ class DrAgent(BackupAgent):
                 # (empty nudge commits) must still become waitable
                 self.applied_version = max(self.applied_version,
                                            self._tailed_to)
-                await flow.delay(0.1, TaskPriority.DEFAULT_ENDPOINT)
+                await flow.delay(flow.SERVER_KNOBS.backup_agent_poll_delay,
+                                 TaskPriority.DEFAULT_ENDPOINT)
                 continue
             i = self._applied_idx
             v, mutations = self.log_records[i]

@@ -128,15 +128,20 @@ class PointConflictSet(TpuConflictSet):
         init_off = int(np.clip(self._init_version - self._base, 0,
                                SNAP_CLAMP + 1))
 
-        from ..ops.point_kernel import make_point_resolve_fn
-        fn = make_point_resolve_fn(self._cap, npad, nrp, nwp, self._n_words)
+        from ..ops.point_kernel import (make_point_resolve_packed_fn,
+                                        pack_point_batch)
+        fn = make_point_resolve_packed_fn(self._cap, npad, nrp, nwp,
+                                          self._n_words)
+        # ONE host->device transfer per batch: the per-transfer latency
+        # (not bandwidth) dominates the streamed path on a
+        # remote-attached chip, so the eight logical inputs ride one
+        # contiguous buffer and unpack inside the jit
+        buf = pack_point_batch(
+            snap_p, tooold_p, self._pad_keys(rb, nrp),
+            self._pad_idx(rt, nrp, npad), rvalid,
+            self._pad_keys(wb, nwp), self._pad_idx(wt, nwp, npad), wvalid)
         self._hk, self._hv, count, conflict = fn(
-            self._hk, self._hv,
-            jnp.asarray(snap_p), jnp.asarray(tooold_p),
-            jnp.asarray(self._pad_keys(rb, nrp)),
-            jnp.asarray(self._pad_idx(rt, nrp, npad)), jnp.asarray(rvalid),
-            jnp.asarray(self._pad_keys(wb, nwp)),
-            jnp.asarray(self._pad_idx(wt, nwp, npad)), jnp.asarray(wvalid),
+            self._hk, self._hv, jnp.asarray(buf),
             jnp.int32(commit_off), jnp.int32(oldest_off),
             jnp.int32(init_off))
         self._apply_fixup(fixup)

@@ -57,7 +57,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .keys import next_pow2, searchsorted_rows, searchsorted_rows_mixed
 from .rmq import VDEAD, build_range_max_table, range_max
 
 SNAP_CLAMP = (1 << 30) + 1  # above any storable version offset
@@ -86,9 +85,6 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
     bit-identical to the single-shard one.
     """
     assert all(x & (x - 1) == 0 for x in (cap, n_txns, n_reads, n_writes))
-    # batch-rank table: the union {rb, wb, we} order-embeds every compare
-    # the overlap test needs (re is EXCLUDED — see the proof at its use)
-    mb = next_pow2(n_reads + 2 * n_writes + 1)
     width = n_words + 1
     # overlap-matrix bit-packing: 32 write slots per uint32 lane — the
     # fixpoint rounds then move 32x fewer bytes than a bool matrix
@@ -106,13 +102,32 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         inf_row = jnp.full((width,), 0xFFFFFFFF, jnp.uint32)
 
         # ---- 1. external check against history --------------------------
-        # one fused binary search for both bounds (per-query side)
-        ext_q = jnp.concatenate([rb, re], axis=0)
-        ext_side = jnp.concatenate([
-            jnp.ones((rb.shape[0],), bool), jnp.zeros((re.shape[0],), bool)])
-        ext_pos = searchsorted_rows_mixed(hk, ext_q, ext_side)
-        lo = ext_pos[:rb.shape[0]] - 1
-        hi = ext_pos[rb.shape[0]:]
+        # Rank the read bounds against the history by SORT-MERGE, not
+        # binary search: measured on v5e, a multi-column lax.sort of
+        # cap+queries rows costs ~5ms while logn sequential gather
+        # rounds of searchsorted cost ~22ms (the dependent-gather chain
+        # is latency-bound). Tie order encodes the side: re (left)
+        # sorts before equal history rows, rb (right) after.
+        nq = rb.shape[0] + re.shape[0]
+        tie_e = jnp.concatenate([
+            jnp.full((cap,), 1, jnp.int32),
+            jnp.full((rb.shape[0],), 2, jnp.int32),
+            jnp.zeros((re.shape[0],), jnp.int32)])
+        qid_e = jnp.concatenate([
+            jnp.full((cap,), nq, jnp.int32),
+            jnp.arange(nq, dtype=jnp.int32)])
+        rows_e = jnp.concatenate([hk, rb, re], axis=0)
+        sorted_e = lax.sort(
+            tuple(rows_e[:, w] for w in range(width)) + (tie_e, qid_e),
+            num_keys=width + 1)
+        is_q = sorted_e[width] != 1
+        cq = jnp.cumsum(is_q.astype(jnp.int32))
+        # for a query at sorted index i: #history rows before it
+        ranks_e = jnp.arange(cap + nq, dtype=jnp.int32) - cq + 1
+        pos_q = jnp.zeros((nq,), jnp.int32).at[sorted_e[width + 1]].set(
+            ranks_e, mode="drop")
+        lo = pos_q[:rb.shape[0]] - 1
+        hi = pos_q[rb.shape[0]:]
         vmax = range_max(build_range_max_table(hv), lo, hi)
         snap_pad = jnp.concatenate([snap, jnp.full((1,), SNAP_CLAMP, jnp.int32)])
         ext_r = rvalid & (vmax > snap_pad[rtxn])
@@ -128,20 +143,47 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         #   r_lo < w_hi  (<=> rb < we: rb itself is in A)
         # so A = {rb, wb, we} suffices — re ranks against A but need not
         # be in it, cutting the sort input by n_reads rows.
+        # One sort ranks all four endpoint groups against A (side=left
+        # for everyone): sort {A rows, re queries} together; the rank
+        # of EVERY row in an equal-key run is the A-count at the run's
+        # first row (#A strictly less), carried forward by a segmented
+        # keep-first scan — no searchsorted, no tie bookkeeping.
         endpoints = jnp.concatenate([rb, wb, we], axis=0)
         ep_valid = jnp.concatenate([rvalid, wvalid, wvalid])
         endpoints = jnp.where(ep_valid[:, None], endpoints, inf_row[None, :])
-        pad = jnp.broadcast_to(inf_row, (mb - endpoints.shape[0], width))
-        cols = tuple(jnp.concatenate([endpoints, pad], axis=0)[:, w]
-                     for w in range(width))
-        ranked = jnp.stack(lax.sort(cols, num_keys=width), axis=1)
+        na = endpoints.shape[0]
+        nall = na + re.shape[0]
+        rows_r = jnp.concatenate([endpoints, re], axis=0)
+        is_a = (jnp.arange(nall, dtype=jnp.int32) < na).astype(jnp.int32)
+        qid_r = jnp.concatenate([
+            jnp.arange(na, dtype=jnp.int32),
+            jnp.arange(re.shape[0], dtype=jnp.int32) + na])
+        sorted_r = lax.sort(
+            tuple(rows_r[:, w] for w in range(width)) + (is_a, qid_r),
+            num_keys=width)
+        a_s = sorted_r[width]
+        rank_a = jnp.cumsum(a_s) - a_s          # #A rows strictly before i
+        prev_ne = jnp.zeros((nall,), bool)
+        for w in range(width):
+            col = sorted_r[w]
+            prev_ne = prev_ne | jnp.concatenate(
+                [jnp.ones((1,), bool), col[1:] != col[:-1]])
 
-        rank_q = jnp.concatenate([rb, re, wb, we], axis=0)
-        rank_pos = searchsorted_rows(ranked, rank_q)  # all side=left
-        r_lo = rank_pos[:n_reads]
-        r_hi = rank_pos[n_reads:2 * n_reads]
-        w_lo = rank_pos[2 * n_reads:2 * n_reads + n_writes]
-        w_hi = rank_pos[2 * n_reads + n_writes:]
+        def keep_first(vals, seg_start):
+            def op(a, b):
+                av, af = a
+                bv, bf = b
+                return jnp.where(bf, bv, av), af | bf
+            out, _ = lax.associative_scan(op, (vals, seg_start))
+            return out
+
+        rank_run = keep_first(rank_a, prev_ne)
+        pos_r = jnp.zeros((nall,), jnp.int32).at[sorted_r[width + 1]].set(
+            rank_run, mode="drop")
+        r_lo = pos_r[:n_reads]
+        w_lo = pos_r[n_reads:n_reads + n_writes]
+        w_hi = pos_r[n_reads + n_writes:na]
+        r_hi = pos_r[na:]
         ov = ((w_lo[None, :] < r_hi[:, None]) & (r_lo[:, None] < w_hi[None, :])
               & rvalid[:, None] & wvalid[None, :]
               & (wtxn[None, :] < rtxn[:, None]))  # [n_reads, n_writes]
@@ -181,61 +223,72 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         conflict = conflict_pad[:n]
 
         # ---- 3. merge surviving writes into the history -----------------
+        # One sort does the whole merge: history rows and the surviving
+        # writes' boundary rows ride together, with the covering
+        # version, the coverage counter, and the dedup logic all
+        # expressed as scans over the sorted order. (The previous
+        # design paid two logn-round binary searches plus six
+        # cap-sized scatters here — the dependent-gather chains were
+        # the kernel's dominant cost.)
         surv = wvalid & ~jnp.take(conflict_pad, wtxn)
-        ins = jnp.concatenate([wb, we], axis=0)
         ins_valid = jnp.concatenate([surv, surv])
+        ins = jnp.concatenate([wb, we], axis=0)
         ins = jnp.where(ins_valid[:, None], ins, inf_row[None, :])
-        # one pre-sort search serves both the covering version AND the
-        # merge rank: both are pure functions of the key value, so they
-        # ride the sort as carried columns (equal keys carry equal
-        # values — any permutation among ties is safe)
-        ins_pos = searchsorted_rows(hk, ins, side="right")
-        cover = jnp.take(hv, ins_pos - 1)
-        cover = jnp.where(ins_valid, cover, jnp.int32(VDEAD))
-        sorted_ops = lax.sort(
-            tuple(ins[:, w] for w in range(width)) + (cover, ins_pos),
-            num_keys=width)
-        ins_sorted = jnp.stack(sorted_ops[:width], axis=1)
-        ins_cover = sorted_ops[width]
+        mi = ins.shape[0]
+        mtot = cap + mi
+        rows_m = jnp.concatenate([hk, ins], axis=0)
+        # tie: history before equal-key ins rows (the covering version
+        # of a boundary equal to a history key is that row's version —
+        # searchsorted side=right semantics)
+        tie_m = jnp.concatenate([jnp.zeros((cap,), jnp.int32),
+                                 jnp.ones((mi,), jnp.int32)])
+        vcol = jnp.concatenate([hv, jnp.full((mi,), VDEAD, jnp.int32)])
+        delta = jnp.concatenate([
+            jnp.zeros((cap,), jnp.int32),
+            jnp.where(surv, 1, 0), jnp.where(surv, -1, 0)])
+        sm = lax.sort(
+            tuple(rows_m[:, w] for w in range(width)) + (tie_m, vcol, delta),
+            num_keys=width + 1)
+        is_ins = sm[width] == 1
+        merged_k = jnp.stack(sm[:width], axis=1)
+        mv_raw = sm[width + 1]
+        delta_s = sm[width + 2]
 
-        # Stable two-way merge positions. The small side (2*n_writes ins
-        # rows) binary-searches the big side; the big side's shifts are
-        # recovered from a scatter+cumsum of those positions — O(cap)
-        # elementwise instead of cap binary searches.
-        mi = ins_sorted.shape[0]
-        ins_live = ins_sorted[:, -1] != jnp.uint32(0xFFFFFFFF)
-        ins_ub = sorted_ops[width + 1]                       # hist<=ins
-        u = jnp.where(ins_live, ins_ub, jnp.int32(cap))
-        shifts = jnp.cumsum(jnp.zeros(cap, jnp.int32).at[u].add(
-            1, mode="drop", indices_are_sorted=True))
-        pos_h = jnp.arange(cap, dtype=jnp.int32) + shifts
-        pos_i = jnp.arange(mi, dtype=jnp.int32) + ins_ub
-        sorted_unique = dict(mode="drop", unique_indices=True,
-                             indices_are_sorted=True)
-        merged_k = jnp.broadcast_to(inf_row, (cap, width))
-        merged_k = merged_k.at[pos_h].set(hk, **sorted_unique)
-        merged_k = merged_k.at[pos_i].set(ins_sorted, **sorted_unique)
-        merged_v = jnp.full((cap,), VDEAD, jnp.int32)
-        merged_v = merged_v.at[pos_h].set(hv, **sorted_unique)
-        merged_v = merged_v.at[pos_i].set(ins_cover, **sorted_unique)
+        # covering version: last history version at or before each row
+        def carry_last(vals, present):
+            def op(a, b):
+                av, af = a
+                bv, bf = b
+                return jnp.where(bf, bv, av), af | bf
+            out, _ = lax.associative_scan(op, (vals, present))
+            return out
 
-        # coverage: +1 at each surviving write begin, -1 at its end
-        o_pos = searchsorted_rows(
-            merged_k, jnp.concatenate([wb, we], axis=0), side="left")
-        o_lo = o_pos[:n_writes]
-        o_hi = o_pos[n_writes:]
-        s32 = surv.astype(jnp.int32)
-        delta = (jnp.zeros(cap + 1, jnp.int32)
-                 .at[o_lo].add(s32).at[o_hi].add(-s32))
-        covered = jnp.cumsum(delta)[:cap] > 0
-        merged_v = jnp.where(covered, jnp.maximum(merged_v, commit), merged_v)
+        lhv = carry_last(mv_raw, ~is_ins)
+        merged_v = jnp.where(is_ins, lhv, mv_raw)
 
-        # ---- 4. GC window + dedup/compaction ----------------------------
+        # coverage with searchsorted(side=left) semantics: a boundary's
+        # delta applies from the FIRST row of its equal-key run, so a
+        # row is covered iff the inclusive delta cumsum at its run's
+        # LAST row is positive
+        prev_ne_m = jnp.zeros((mtot,), bool)
+        for w in range(width):
+            col = sm[w]
+            prev_ne_m = prev_ne_m | jnp.concatenate(
+                [jnp.ones((1,), bool), col[1:] != col[:-1]])
+        run_end = jnp.concatenate([prev_ne_m[1:], jnp.ones((1,), bool)])
+        dtot = jnp.cumsum(delta_s)
+        # value at the run's last row, carried backward over the run
+        rev, _ = lax.associative_scan(
+            lambda a, b: (jnp.where(b[1], b[0], a[0]), a[1] | b[1]),
+            (dtot[::-1], run_end[::-1]))
+        run_end_tot = rev[::-1]
+        covered = run_end_tot > 0
+        merged_v = jnp.where(covered, jnp.maximum(merged_v, commit),
+                             merged_v)
+
+        # ---- 4. GC window + dedup, compacted by one more sort -----------
         oldest2 = jnp.maximum(oldest, jnp.int32(0))
-        nxt_eq = jnp.concatenate([
-            jnp.all(merged_k[:-1] == merged_k[1:], axis=1),
-            jnp.zeros((1,), bool)])
-        keep1 = ~nxt_eq  # keep last of each duplicate-key run
+        keep1 = run_end  # keep last of each duplicate-key run
         dead = merged_v < oldest2
         prev_keep = jnp.concatenate([jnp.zeros((1,), bool), keep1[:-1]])
         prev_v = jnp.concatenate([jnp.full((1,), VDEAD, jnp.int32),
@@ -245,20 +298,15 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         redundant = redundant.at[0].set(False)
         keep = keep1 & ~redundant
         is_real = ~jnp.all(merged_k == inf_row[None, :], axis=1)
-        # Stable-partition targets: kept rows pack left in order, dropped
-        # rows (overwritten with +inf/dead values) fill the tail — every
-        # target unique, so XLA lowers the scatter without collision
-        # handling.
-        csum = jnp.cumsum(keep.astype(jnp.int32))
-        nkeep = csum[cap - 1]
-        iota = jnp.arange(cap, dtype=jnp.int32)
-        tgt = jnp.where(keep, csum - 1, nkeep + iota - csum)
+        # dropped rows mask to +inf and one final key sort packs the
+        # kept rows left; the slice back to cap drops only the masked
+        # tail (overflow past cap is caught by the host count audit)
         val_k = jnp.where(keep[:, None], merged_k, inf_row[None, :])
         val_v = jnp.where(keep, merged_v, jnp.int32(VDEAD))
-        out_k = jnp.broadcast_to(inf_row, (cap, width))
-        out_k = out_k.at[tgt].set(val_k, mode="drop", unique_indices=True)
-        out_v = jnp.full((cap,), VDEAD, jnp.int32)
-        out_v = out_v.at[tgt].set(val_v, mode="drop", unique_indices=True)
+        sc = lax.sort(tuple(val_k[:, w] for w in range(width)) + (val_v,),
+                      num_keys=width)
+        out_k = jnp.stack(sc[:width], axis=1)[:cap]
+        out_v = sc[width][:cap]
         count = jnp.sum((keep & is_real).astype(jnp.int32))
         return out_k, out_v, count, conflict
 

@@ -204,3 +204,62 @@ def make_point_resolve_fn(cap: int, n_txns: int, n_reads: int,
     """Jitted point-mode resolve step (see make_point_resolve_core)."""
     return jax.jit(
         make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words))
+
+
+def pack_point_batch(snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid):
+    """Pack one batch's host arrays into a single contiguous uint32
+    buffer for make_point_resolve_packed_fn. One host->device transfer
+    per batch instead of eight: on a remote-attached accelerator the
+    per-transfer latency dominates the streamed resolve path, and the
+    unpack on device is free (fused slices/bitcasts)."""
+    import numpy as np
+    npad = snap.shape[0]
+    nrp, width = rk.shape
+    nwp = wk.shape[0]
+    buf = np.empty(2 * npad + (nrp + nwp) * (width + 2), np.uint32)
+    o = 0
+    for a, n in ((snap.astype(np.int32).view(np.uint32), npad),
+                 (too_old.astype(np.uint32), npad),
+                 (np.ascontiguousarray(rk, np.uint32).reshape(-1),
+                  nrp * width),
+                 (np.asarray(rtxn, np.int32).view(np.uint32), nrp),
+                 (rvalid.astype(np.uint32), nrp),
+                 (np.ascontiguousarray(wk, np.uint32).reshape(-1),
+                  nwp * width),
+                 (np.asarray(wtxn, np.int32).view(np.uint32), nwp),
+                 (wvalid.astype(np.uint32), nwp)):
+        buf[o:o + n] = a
+        o += n
+    return buf
+
+
+@functools.lru_cache(maxsize=None)
+def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
+                                 n_writes: int, n_words: int):
+    """Jitted point resolve taking the pack_point_batch buffer; the
+    unpack happens inside the jit so the eight logical arrays never
+    exist as separate device buffers."""
+    core = make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words)
+    width = n_words + 1
+
+    def packed(sk, sv, buf, commit, oldest, init_off):
+        o = 0
+
+        def take(n):
+            nonlocal o
+            part = buf[o:o + n]
+            o += n
+            return part
+
+        snap = lax.bitcast_convert_type(take(n_txns), jnp.int32)
+        too_old = take(n_txns) != 0
+        rk = take(n_reads * width).reshape(n_reads, width)
+        rtxn = lax.bitcast_convert_type(take(n_reads), jnp.int32)
+        rvalid = take(n_reads) != 0
+        wk = take(n_writes * width).reshape(n_writes, width)
+        wtxn = lax.bitcast_convert_type(take(n_writes), jnp.int32)
+        wvalid = take(n_writes) != 0
+        return core(sk, sv, snap, too_old, rk, rtxn, rvalid,
+                    wk, wtxn, wvalid, commit, oldest, init_off)
+
+    return jax.jit(packed)

@@ -335,7 +335,8 @@ class TcpTransport:
                         "TcpDispatchError", f"tcp:{self.port}",
                         severity=flow.trace.SevWarnAlways).detail(
                         Error=repr(e)).log()
-            await flow.delay(0.001, TaskPriority.READ_SOCKET)
+            await flow.delay(flow.SERVER_KNOBS.tcp_reactor_poll_delay,
+                             TaskPriority.READ_SOCKET)
 
     def _handle(self, item) -> None:
         if item[0] == "dead":

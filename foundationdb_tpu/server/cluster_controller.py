@@ -707,7 +707,11 @@ class ClusterController:
                 except ValueError:
                     repairs[key] = live
         cand = self.config._replace(**updates)
-        n_live = self._live_included_workers()
+        my_dc = getattr(self.process, "dc", "dc0")
+        live_workers = [name for name, wi in self.workers.items()
+                        if wi.worker.process.alive and wi.dc == my_dc]
+        n_live = sum(1 for name in live_workers
+                     if name not in self.excluded)
         if (cand.n_proxies < 1 or cand.n_resolvers < 1
                 or cand.n_logs < 1 or cand.n_logs > n_live
                 or cand.n_resolvers > n_live or cand.n_proxies > n_live

@@ -370,7 +370,7 @@ class VersionedMap:
             for k in win:
                 yield k, _NO_HINT
             return
-        CHUNK = 64
+        CHUNK = int(SERVER_KNOBS.fetch_block_rows)
         pending: List[Tuple[bytes, bytes]] = []
         pi = 0
         done_base = False

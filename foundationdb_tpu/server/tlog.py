@@ -196,7 +196,8 @@ class TLog:
         version = req.version
         if self._dq is None:
             if flow.buggify("tlog/slow_fsync"):
-                await flow.delay(flow.g_random.random01() * 0.01,
+                await flow.delay(flow.g_random.random01()
+                           * flow.SERVER_KNOBS.buggify_tlog_commit_delay_max,
                                  TaskPriority.TLOG_COMMIT_REPLY)
             await flow.delay(self.fsync_delay, TaskPriority.TLOG_COMMIT_REPLY)
             # variable delays must not reorder durability acks
@@ -209,7 +210,8 @@ class TLog:
                     # durable window (stresses lock + recovery races).
                     # INSIDE the FIFO lock: records must still land on
                     # disk in version order (code review r3)
-                    await flow.delay(flow.g_random.random01() * 0.01,
+                    await flow.delay(flow.g_random.random01()
+                           * flow.SERVER_KNOBS.buggify_tlog_commit_delay_max,
                                      TaskPriority.TLOG_COMMIT_REPLY)
                 seq = await self._dq.push(
                     encode_log_entry(version, req.mutations))

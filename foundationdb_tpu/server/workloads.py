@@ -26,7 +26,8 @@ from typing import Dict, List, Optional, Tuple
 from .. import flow
 from ..client.transaction import _ATOMIC_APPLY, run_transaction
 from .types import (ADD_VALUE, AND_V2, APPEND_IF_FITS, BYTE_MAX, BYTE_MIN,
-                    COMPARE_AND_CLEAR, KeySelector, MAX, MIN_V2, OR, XOR)
+                    COMPARE_AND_CLEAR, KeySelector, MAX, MIN_V2, OR,
+                    SET_VERSIONSTAMPED_VALUE, XOR)
 
 _ATOMIC_CHOICES = (ADD_VALUE, AND_V2, OR, XOR, MAX, MIN_V2, BYTE_MIN,
                    BYTE_MAX, APPEND_IF_FITS, COMPARE_AND_CLEAR)
@@ -208,7 +209,10 @@ class WriteDuringRead:
                     if e.name not in RETRYABLE:
                         raise
                     self.stats["retries"] += 1
-                    await flow.delay(0.05 + self.rng.random01() * 0.2)
+                    await flow.delay(
+                        flow.SERVER_KNOBS.workload_kill_delay_min
+                        + self.rng.random01()
+                        * flow.SERVER_KNOBS.workload_kill_delay_span)
             self.stats["txns"] += 1
         if self.check_watches:
             await self._check_watches()
@@ -222,7 +226,8 @@ class WriteDuringRead:
             if self.model.get(key) == val_at_arm:
                 continue  # may legitimately stay parked
             try:
-                await flow.timeout_error(fut, 30.0)
+                await flow.timeout_error(
+                    fut, flow.SERVER_KNOBS.workload_watch_timeout)
                 self.stats["watches_fired"] += 1
             except flow.FdbError as e:
                 if e.name in ("timed_out",):
@@ -230,3 +235,208 @@ class WriteDuringRead:
                         ("watch never fired", key, val_at_arm,
                          self.model.get(key))) from e
                 self.stats["watches_fired"] += 1  # woke with an error
+
+
+class Serializability:
+    """External-consistency checker (ref: Serializability.actor.cpp):
+    concurrent clients run random read-then-write transactions; every
+    committed attempt records its observed reads, its writes, and its
+    10-byte versionstamp (commit version + intra-batch index — a TOTAL
+    commit order). Afterwards the attempts are replayed in stamp order
+    against a model: every recorded read must equal the model state at
+    that point, or the history was not serializable in commit order.
+
+    Attempts whose outcome the client could not learn
+    (commit_unknown_result and friends) are settled exactly: each
+    attempt writes a unique marker key with a VERSIONSTAMPED value, so
+    a final scan of the marker subspace decides both whether the
+    attempt landed and where it sits in the commit order — the checker
+    never guesses (every committed attempt is its own transaction as
+    far as serializability is concerned, including double-landings
+    from retried unknowns)."""
+
+    def __init__(self, dbs, rng, prefix: bytes = b"ser/",
+                 keyspace: int = 16):
+        import struct as _struct
+        self.dbs = dbs
+        self.rng = rng
+        self.prefix = prefix
+        self.keyspace = keyspace
+        self._struct = _struct
+        #: (marker_key, reads [(k, v)], writes [(kind, ...)],
+        #:  stamp or None — None means "resolve via the marker")
+        self.attempts: list = []
+        self.stats = {"committed": 0, "aborted": 0, "unknown": 0}
+
+    def _key(self) -> bytes:
+        return self.prefix + b"k%02d" % self.rng.random_int(
+            0, self.keyspace - 1)
+
+    async def _one_txn(self, db, marker: bytes) -> None:
+        while True:
+            tr = db.create_transaction()
+            reads = []
+            writes = []
+            try:
+                for _ in range(self.rng.random_int(1, 3)):
+                    k = self._key()
+                    reads.append((k, await tr.get(k)))
+                for _ in range(self.rng.random_int(1, 2)):
+                    k = self._key()
+                    kind = self.rng.random_int(0, 2)
+                    if kind == 0:
+                        v = b"v%d" % self.rng.random_int(0, 9999)
+                        tr.set(k, v)
+                        writes.append(("set", k, v))
+                    elif kind == 1:
+                        tr.clear(k)
+                        writes.append(("clear", k))
+                    else:
+                        p = self._struct.Struct("<q").pack(
+                            self.rng.random_int(1, 100))
+                        tr.atomic_op(k, p, ADD_VALUE)
+                        writes.append(("add", k, p))
+                # the attempt's identity + commit-order witness
+                val = b"\x00" * 10 + self._struct.Struct("<I").pack(0)
+                tr.atomic_op(marker, val, SET_VERSIONSTAMPED_VALUE)
+                await tr.commit()
+            except flow.FdbError as e:
+                if e.name in UNKNOWN_OUTCOME:
+                    self.stats["unknown"] += 1
+                    self.attempts.append((marker, reads, writes, None))
+                    marker = marker + b"r"   # next attempt: fresh marker
+                    continue
+                if e.name in RETRYABLE:
+                    self.stats["aborted"] += 1
+                    continue
+                raise
+            self.stats["committed"] += 1
+            self.attempts.append(
+                (marker, reads, writes, tr.get_versionstamp()))
+            return
+
+    async def run(self, txns_per_client: int = 20) -> dict:
+        async def client(db, ci):
+            for i in range(txns_per_client):
+                await self._one_txn(
+                    db, self.prefix + b"\xfem/%d/%d/" % (ci, i))
+
+        await flow.wait_for_all([
+            flow.spawn(client(db, ci), name=f"ser-client-{ci}")
+            for ci, db in enumerate(self.dbs)])
+
+        # settle unknown-outcome attempts from their markers
+        async def read_markers(tr):
+            return dict(await tr.get_range(
+                self.prefix + b"\xfem/", self.prefix + b"\xfem0",
+                limit=1 << 20))
+        markers = await run_transaction(self.dbs[0], read_markers,
+                                        max_retries=500)
+        ordered = []
+        for marker, reads, writes, stamp in self.attempts:
+            if stamp is None:
+                got = markers.get(marker)
+                if got is None:
+                    continue           # provably never landed
+                stamp = got
+            ordered.append((stamp, marker, reads, writes))
+        assert len({s for s, *_ in ordered}) == len(ordered), \
+            "versionstamps must totally order committed attempts"
+        ordered.sort()
+
+        # replay in commit order: every observed read must match
+        model: Dict[bytes, bytes] = {}
+        for stamp, marker, reads, writes in ordered:
+            for k, v in reads:
+                assert model.get(k) == v, (
+                    "serializability violation", marker, k, v, model.get(k))
+            for w in writes:
+                if w[0] == "set":
+                    model[w[1]] = w[2]
+                elif w[0] == "clear":
+                    model.pop(w[1], None)
+                else:
+                    folded = _ATOMIC_APPLY[ADD_VALUE](model.get(w[1]), w[2])
+                    model[w[1]] = folded
+        self.stats["replayed"] = len(ordered)
+        return self.stats
+
+
+class FuzzApiCorrectness:
+    """API-misuse fuzz (ref: FuzzApiCorrectness.actor.cpp): drive the
+    client surface with invalid inputs — oversized keys/values,
+    oversized transactions, system-keyspace access without the option,
+    extreme selector offsets — and assert the EXACT error every time,
+    interleaved with valid operations proving the transaction object
+    stays usable afterwards (an invalid argument raises; it must not
+    poison the transaction or the process)."""
+
+    def __init__(self, db, rng, prefix: bytes = b"fuzz/"):
+        self.db = db
+        self.rng = rng
+        self.prefix = prefix
+        self.stats = {"invalid_ops": 0, "valid_commits": 0}
+
+    def _expect(self, name: str, fn) -> None:
+        try:
+            fn()
+        except flow.FdbError as e:
+            assert e.name == name, (e.name, name)
+            self.stats["invalid_ops"] += 1
+            return
+        raise AssertionError(f"expected {name}, got success")
+
+    async def _expect_async(self, name: str, coro) -> None:
+        try:
+            await coro
+        except flow.FdbError as e:
+            assert e.name == name, (e.name, name)
+            self.stats["invalid_ops"] += 1
+            return
+        raise AssertionError(f"expected {name}, got success")
+
+    async def run(self, rounds: int = 30) -> dict:
+        key_limit = int(flow.SERVER_KNOBS.key_size_limit)
+        value_limit = int(flow.SERVER_KNOBS.value_size_limit)
+        for i in range(rounds):
+            tr = self.db.create_transaction()
+            kind = self.rng.random_int(0, 5)
+            k = self.prefix + b"k%d" % self.rng.random_int(0, 9)
+            if kind == 0:
+                big = b"K" * (key_limit + 1 + self.rng.random_int(0, 64))
+                self._expect("key_too_large", lambda: tr.set(big, b"v"))
+            elif kind == 1:
+                big = b"V" * (value_limit + 1 + self.rng.random_int(0, 64))
+                self._expect("value_too_large", lambda: tr.set(k, big))
+            elif kind == 2:
+                # overflow the per-transaction byte budget with legal
+                # individual writes
+                chunk = b"C" * value_limit
+                def overflow():
+                    for j in range(
+                            2 + int(flow.SERVER_KNOBS.transaction_size_limit)
+                            // value_limit):
+                        tr.set(self.prefix + b"big%d" % j, chunk)
+                self._expect("transaction_too_large", overflow)
+            elif kind == 3:
+                self._expect("key_outside_legal_range",
+                             lambda: tr.set(b"\xff/illegal", b"v"))
+            elif kind == 4:
+                await self._expect_async(
+                    "key_outside_legal_range", tr.get(b"\xff/conf/x"))
+            else:
+                # extreme selector offsets resolve to the keyspace
+                # bounds, never crash or escape the legal range
+                sel = KeySelector(k, bool(self.rng.random_int(0, 1)),
+                                  self.rng.random_int(500, 4000)
+                                  * (1 if self.rng.random_int(0, 1) else -1))
+                got = await tr.get_key(sel)
+                assert got == b"" or got <= b"\xff", got
+                self.stats["invalid_ops"] += 1
+            # the transaction (or a fresh one, if the failed op poisoned
+            # the byte budget) still works end-to-end
+            tr2 = self.db.create_transaction()
+            tr2.set(k, b"ok%d" % i)
+            await tr2.commit()
+            self.stats["valid_commits"] += 1
+        return self.stats

@@ -100,7 +100,7 @@ async def backup_wait(db, version: Optional[int] = None,
             raise TimeoutError(
                 f"backup not restorable to {version} after {max_wait}s "
                 f"(state={state.decode()}, restorable={restorable})")
-        await flow.delay(0.25)
+        await flow.delay(flow.SERVER_KNOBS.backup_tool_poll_delay)
 
 
 async def backup_abort(db, max_wait: float = 120.0) -> dict:
@@ -119,7 +119,7 @@ async def backup_abort(db, max_wait: float = 120.0) -> dict:
                         int(rows.get(b"restorable_version", b"-1"))}
         if flow.now() > deadline:
             raise TimeoutError("abort did not finalize in time")
-        await flow.delay(0.25)
+        await flow.delay(flow.SERVER_KNOBS.backup_tool_poll_delay)
 
 
 async def backup_restore(db, url: str,

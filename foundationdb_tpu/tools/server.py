@@ -51,7 +51,7 @@ def serve(port: int = 0, seed: int = 0, n_storage: int = 2,
                     (("127.0.0.1", gw.port),)))
             announce(f"LISTENING {gw.port}", flush=True)
             while True:
-                await flow.delay(0.5)
+                await flow.delay(flow.SERVER_KNOBS.server_status_poll_delay)
 
         c.run(main())
     except KeyboardInterrupt:

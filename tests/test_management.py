@@ -94,3 +94,46 @@ def test_exclude_worker_moves_roles_off_it():
         assert c.run(main(), timeout_time=600)
     finally:
         c.shutdown()
+
+
+def test_conf_sync_survives_committed_exclusion_rows():
+    """Regression: the conf-sync reconcile loop must keep running with
+    committed \\xff/excluded/ rows present (a crash there permanently
+    stops config adoption) — proven by excluding a worker, letting
+    several sync rounds pass, then committing a config change and
+    seeing it adopted."""
+    from foundationdb_tpu import flow
+
+    c = SimCluster(seed=907, n_workers=4)
+    try:
+        db = c.client()
+
+        async def main():
+            async def body(tr):
+                tr.set(b"k", b"v")
+            await run_transaction(db, body)
+            st = await db.get_status()
+            workers = st["cluster"]["workers"]
+            # prefer a role-less worker; any worker is excludable here
+            victim = min((w for w, info in workers.items()
+                          if not info["roles"]), default=max(workers))
+            await db.exclude(victim)
+            # several sync intervals with the row present
+            await flow.delay(3 * flow.SERVER_KNOBS.conf_sync_interval)
+            # the sync actor must still adopt config changes
+            await db.configure(n_proxies=2)
+            deadline = flow.now() + 60
+            while True:
+                st = await db.get_status()
+                cfg = st["cluster"]["configuration"]
+                if cfg.get("proxies") == 2 and \
+                        st["cluster"]["recovery_state"] == "fully_recovered":
+                    break
+                assert flow.now() < deadline, cfg
+                await flow.delay(0.5)
+            assert victim in set(cfg.get("excluded", ()))
+            return True
+
+        assert c.run(main(), timeout_time=300)
+    finally:
+        c.shutdown()
