@@ -206,8 +206,10 @@ def bench_tpu(n_txns, n_batches, keyspace):
     window_batches = MWTLV // VERSION_STEP
     cap = max(1 << 17, next_pow2(3 * window_batches * n_txns))
     n_words = N_WORDS
-    nr = next_pow2(n_txns * READS_PER_TXN + 1)
-    nw = next_pow2(n_txns + 1)
+    # exact power-of-two slot counts: a single extra slot doubles
+    # every padded dimension (and quadruples the overlap matrix)
+    nr = next_pow2(n_txns * READS_PER_TXN)
+    nw = next_pow2(n_txns)
     core = make_resolve_core(cap, n_txns, nr, nw, n_words)
 
     def gen_keys(key, slots):

@@ -213,14 +213,24 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("BACKUP_AGENT_POLL_DELAY", 0.1, lambda: 1.0)
     init("BACKUP_TOOL_POLL_DELAY", 0.25, lambda: 2.0)
     init("SERVER_STATUS_POLL_DELAY", 0.5)
-    # workload harness pacing (ref: Attrition/watch workload params)
-    init("WORKLOAD_KILL_DELAY_MIN", 0.05)
-    init("WORKLOAD_KILL_DELAY_SPAN", 0.2, lambda: 2.0)
+    # model-checker workload retry backoff + watch budget
+    init("WORKLOAD_RETRY_DELAY_MIN", 0.05)
+    init("WORKLOAD_RETRY_DELAY_SPAN", 0.2, lambda: 2.0)
     init("WORKLOAD_WATCH_TIMEOUT", 30.0)
     # real-TCP reactor inbox poll pace (wall-clock)
     init("TCP_REACTOR_POLL_DELAY", 0.001)
     init("BACKUP_LOG_CHUNK_RECORDS", 500, lambda: 3)
     init("BLOBSTORE_REQUEST_TIMEOUT", 10.0)
+    # ref: BlobStore.actor.cpp knobs — request retry budget with
+    # exponential backoff (wall-clock: the client is host-side IO),
+    # multipart threshold/part sizing, and the signed-date replay
+    # window for request authentication
+    init("BLOBSTORE_REQUEST_TRIES", 5)
+    init("BLOBSTORE_BACKOFF_MIN", 0.05)
+    init("BLOBSTORE_BACKOFF_MAX", 2.0)
+    init("BLOBSTORE_MULTIPART_THRESHOLD", 256 * 1024)
+    init("BLOBSTORE_MULTIPART_PART_BYTES", 128 * 1024)
+    init("BLOBSTORE_AUTH_WINDOW", 300.0)
     init("METRIC_LOGGER_INTERVAL", 1.0)
 
     # -- conflict-set backends (ref: resolver window GC cadence) -------

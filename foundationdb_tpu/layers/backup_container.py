@@ -25,9 +25,13 @@ snapshots/logs/ directory split):
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import socket
 import threading
+import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import quote, unquote
@@ -207,64 +211,168 @@ class _BlobHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     store: Dict[str, bytes] = {}
     lock = threading.Lock()
+    #: access-key -> secret; empty dict = unauthenticated server
+    secrets: Dict[str, str] = {}
+    uploads: Dict[str, Dict[int, bytes]] = {}
+    upload_names: Dict[str, str] = {}
+    completed_uploads: Dict[str, str] = {}   # uploadId -> object name
 
     def log_message(self, *a):   # no stderr noise in tests
         pass
 
-    def _name(self) -> str:
-        return unquote(self.path.lstrip("/"))
+    def _split(self) -> Tuple[str, Dict[str, str]]:
+        path, _, query = self.path.partition("?")
+        q = {}
+        for part in query.split("&"):
+            if part:
+                k, _, v = part.partition("=")
+                q[k] = unquote(v)
+        return unquote(path.lstrip("/")), q
 
-    def do_PUT(self):
+    def _authorized(self, verb: str) -> bool:
+        """HMAC request auth (ref: BlobStore.actor.cpp setAuthHeaders —
+        S3 V2 shape: sign (verb, date, resource) with the account
+        secret; a date outside the replay window is rejected even with
+        a valid signature)."""
+        if not self.secrets:
+            return True
+        auth = self.headers.get("Authorization", "")
+        date = self.headers.get("X-FDBTPU-Date", "")
+        if not auth.startswith("FDBTPU ") or ":" not in auth[7:]:
+            return False
+        key, _, sig = auth[7:].partition(":")
+        secret = self.secrets.get(key)
+        if secret is None:
+            return False
+        try:
+            then = float(date)
+        except ValueError:
+            return False
+        from ..flow import SERVER_KNOBS
+        if abs(time.time() - then) > SERVER_KNOBS.blobstore_auth_window:
+            return False
+        want = _sign(secret, verb, date, self.path)
+        return hmac.compare_digest(sig, want)
+
+    def _deny(self) -> None:
+        # drain the request body first: HTTP/1.1 keep-alive parses the
+        # unread body as the next request line otherwise
         length = int(self.headers.get("Content-Length", 0))
-        data = self.rfile.read(length)
-        with self.lock:
-            self.store[self._name()] = data
-        self.send_response(200)
+        if length:
+            self.rfile.read(length)
+        self.send_response(403)
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def _ok(self, body: bytes = b"", status: int = 200,
+            ctype: str = "application/octet-stream") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def do_PUT(self):
+        if not self._authorized("PUT"):
+            return self._deny()
+        name, q = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(length)
+        if "uploadId" in q and "partNumber" in q:
+            # one part of a multipart upload (ref: S3 UploadPart)
+            with self.lock:
+                parts = self.uploads.get(q["uploadId"])
+                if parts is None or self.upload_names.get(
+                        q["uploadId"]) != name:
+                    return self._ok(status=404)
+                parts[int(q["partNumber"])] = data
+            return self._ok()
+        with self.lock:
+            self.store[name] = data
+        self._ok()
+
+    def do_POST(self):
+        if not self._authorized("POST"):
+            return self._deny()
+        name, q = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)
+        if "uploads" in q:
+            # initiate multipart (ref: S3 CreateMultipartUpload)
+            uid = uuid.uuid4().hex
+            with self.lock:
+                self.uploads[uid] = {}
+                self.upload_names[uid] = name
+            return self._ok(json.dumps({"uploadId": uid}).encode(),
+                            ctype="application/json")
+        if "uploadId" in q:
+            # complete: assemble parts in part-number order; the object
+            # appears atomically only now. IDEMPOTENT on retry: a
+            # client whose first complete succeeded but whose response
+            # was lost must get 200, not 404 (ref:
+            # CompleteMultipartUpload semantics the retry layer assumes)
+            with self.lock:
+                parts = self.uploads.pop(q["uploadId"], None)
+                self.upload_names.pop(q["uploadId"], None)
+                if parts is None:
+                    if self.completed_uploads.get(q["uploadId"]) == name:
+                        return self._ok()
+                    return self._ok(status=404)
+                self.store[name] = b"".join(
+                    parts[i] for i in sorted(parts))
+                self.completed_uploads[q["uploadId"]] = name
+            return self._ok()
+        self._ok(status=400)
+
     def do_GET(self):
-        name = self._name()
-        if name.startswith("?list="):
-            prefix = unquote(name[len("?list="):])
+        if not self._authorized("GET"):
+            return self._deny()
+        name, q = self._split()
+        if "list" in q:
+            prefix = q["list"]
             with self.lock:
                 names = sorted(n for n in self.store
                                if n.startswith(prefix))
-            body = json.dumps(names).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return
+            return self._ok(json.dumps(names).encode(),
+                            ctype="application/json")
         with self.lock:
             data = self.store.get(name)
         if data is None:
-            self.send_response(404)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
-            return
-        self.send_response(200)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+            return self._ok(status=404)
+        self._ok(data)
 
     def do_DELETE(self):
+        if not self._authorized("DELETE"):
+            return self._deny()
+        name, q = self._split()
         with self.lock:
-            self.store.pop(self._name(), None)
-        self.send_response(200)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+            if "uploadId" in q:     # abort multipart
+                self.uploads.pop(q["uploadId"], None)
+                self.upload_names.pop(q["uploadId"], None)
+            else:
+                self.store.pop(name, None)
+        self._ok()
+
+
+def _sign(secret: str, verb: str, date: str, resource: str) -> str:
+    msg = "\n".join((verb, date, resource)).encode()
+    return hmac.new(secret.encode(), msg, hashlib.sha256).hexdigest()
 
 
 class BlobStoreServer:
-    """A minimal S3-shaped object server on a real socket (the endpoint
-    the reference's BlobStore client would talk to). Each instance has
-    an isolated object namespace."""
+    """An S3-shaped object server on a real socket (the endpoint the
+    reference's BlobStore client talks to): per-request HMAC auth,
+    multipart uploads assembled atomically at completion, prefix
+    listing. Each instance has an isolated object namespace."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 secrets: Optional[Dict[str, str]] = None):
         handler = type("Handler", (_BlobHandler,),
-                       {"store": {}, "lock": threading.Lock()})
+                       {"store": {}, "lock": threading.Lock(),
+                        "secrets": dict(secrets or {}),
+                        "uploads": {}, "upload_names": {},
+                        "completed_uploads": {}})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(
@@ -279,67 +387,116 @@ class BlobStoreServer:
 
 class BlobStoreContainer(BackupContainer):
     """HTTP client side (ref: BlobStore.actor.cpp doRequest over
-    HTTP.actor.cpp — here stdlib http.client over the same wire
-    shapes: PUT/GET/DELETE an object, GET ?list= for a prefix)."""
+    HTTP.actor.cpp): every request retries transient failures
+    (connection errors, 5xx) with exponential backoff under a bounded
+    try budget; requests are HMAC-signed when credentials are given;
+    large objects upload in parts, each part retried independently,
+    and the object appears only at completion."""
 
-    def __init__(self, host: str, port: int, timeout: float = None):
+    def __init__(self, host: str, port: int, timeout: float = None,
+                 key: str = "", secret: str = ""):
+        from ..flow import SERVER_KNOBS
         if timeout is None:
-            from ..flow import SERVER_KNOBS
             timeout = SERVER_KNOBS.blobstore_request_timeout
         self.host, self.port, self.timeout = host, port, timeout
+        self.key, self.secret = key, secret
 
     def _conn(self):
         import http.client
         return http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
 
+    def _headers(self, verb: str, path: str) -> Dict[str, str]:
+        if not self.key:
+            return {}
+        date = repr(time.time())
+        return {"X-FDBTPU-Date": date,
+                "Authorization": "FDBTPU %s:%s" % (
+                    self.key, _sign(self.secret, verb, date, path))}
+
+    def _request(self, verb: str, path: str, body: bytes = b""):
+        """One logical request = up to BLOBSTORE_REQUEST_TRIES wire
+        attempts; connection failures and 5xx retry with exponential
+        backoff, 4xx and 404 do not (they are answers, not weather)."""
+        from ..flow import SERVER_KNOBS
+        tries = int(SERVER_KNOBS.blobstore_request_tries)
+        backoff = SERVER_KNOBS.blobstore_backoff_min
+        last = None
+        for attempt in range(tries):
+            c = self._conn()
+            try:
+                c.request(verb, path, body=body,
+                          headers=self._headers(verb, path))
+                r = c.getresponse()
+                data = r.read()
+                if r.status >= 500:
+                    last = IOError(f"{verb} {path}: HTTP {r.status}")
+                else:
+                    return r.status, data
+            except OSError as e:
+                last = e
+            finally:
+                c.close()
+            if attempt + 1 < tries:
+                time.sleep(backoff)
+                backoff = min(backoff * 2,
+                              SERVER_KNOBS.blobstore_backoff_max)
+        raise IOError(f"{verb} {path}: retries exhausted ({last})")
+
     def put_object(self, name: str, data: bytes) -> None:
-        c = self._conn()
+        from ..flow import SERVER_KNOBS
+        path = "/" + quote(name, safe="/,")
+        if len(data) > SERVER_KNOBS.blobstore_multipart_threshold:
+            return self._put_multipart(name, path, data)
+        status, _ = self._request("PUT", path, data)
+        if status != 200:
+            raise IOError(f"PUT {name}: HTTP {status}")
+
+    def _put_multipart(self, name: str, path: str, data: bytes) -> None:
+        from ..flow import SERVER_KNOBS
+        part_bytes = int(SERVER_KNOBS.blobstore_multipart_part_bytes)
+        status, body = self._request("POST", path + "?uploads")
+        if status != 200:
+            raise IOError(f"POST {name}?uploads: HTTP {status}")
+        uid = json.loads(body)["uploadId"]
         try:
-            c.request("PUT", "/" + quote(name, safe="/,"), body=data)
-            r = c.getresponse()
-            r.read()
-            if r.status != 200:
-                raise IOError(f"PUT {name}: HTTP {r.status}")
-        finally:
-            c.close()
+            for i in range(0, len(data), part_bytes):
+                status, _ = self._request(
+                    "PUT", "%s?partNumber=%d&uploadId=%s"
+                    % (path, i // part_bytes, uid),
+                    data[i:i + part_bytes])
+                if status != 200:
+                    raise IOError(f"PUT {name} part: HTTP {status}")
+            status, _ = self._request("POST",
+                                      "%s?uploadId=%s" % (path, uid))
+            if status != 200:
+                raise IOError(f"complete {name}: HTTP {status}")
+        except BaseException:
+            try:
+                self._request("DELETE", "%s?uploadId=%s" % (path, uid))
+            except IOError:
+                pass   # orphaned upload: server-side garbage, not data
+            raise
 
     def get_object(self, name: str) -> Optional[bytes]:
-        c = self._conn()
-        try:
-            c.request("GET", "/" + quote(name, safe="/,"))
-            r = c.getresponse()
-            data = r.read()
-            if r.status == 404:
-                return None
-            if r.status != 200:
-                raise IOError(f"GET {name}: HTTP {r.status}")
-            return data
-        finally:
-            c.close()
+        status, data = self._request("GET", "/" + quote(name, safe="/,"))
+        if status == 404:
+            return None
+        if status != 200:
+            raise IOError(f"GET {name}: HTTP {status}")
+        return data
 
     def list_objects(self, prefix: str = "") -> List[str]:
-        c = self._conn()
-        try:
-            c.request("GET", "/?list=" + quote(prefix, safe=""))
-            r = c.getresponse()
-            data = r.read()
-            if r.status != 200:
-                raise IOError(f"LIST {prefix}: HTTP {r.status}")
-            return json.loads(data)
-        finally:
-            c.close()
+        status, data = self._request("GET",
+                                     "/?list=" + quote(prefix, safe=""))
+        if status != 200:
+            raise IOError(f"LIST {prefix}: HTTP {status}")
+        return json.loads(data)
 
     def delete_object(self, name: str) -> None:
-        c = self._conn()
-        try:
-            c.request("DELETE", "/" + quote(name, safe="/,"))
-            r = c.getresponse()
-            r.read()
-            if r.status != 200:
-                raise IOError(f"DELETE {name}: HTTP {r.status}")
-        finally:
-            c.close()
+        status, _ = self._request("DELETE", "/" + quote(name, safe="/,"))
+        if status != 200:
+            raise IOError(f"DELETE {name}: HTTP {status}")
 
 
 def open_container(url: str) -> BackupContainer:
@@ -348,9 +505,13 @@ def open_container(url: str) -> BackupContainer:
     if url.startswith("file://"):
         return DirectoryContainer(url[len("file://"):])
     if url.startswith("blobstore://"):
-        hostport = url[len("blobstore://"):].split("/", 1)[0]
-        host, port = hostport.rsplit(":", 1)
-        return BlobStoreContainer(host, int(port))
+        rest = url[len("blobstore://"):].split("/", 1)[0]
+        key = secret = ""
+        if "@" in rest:
+            creds, rest = rest.rsplit("@", 1)
+            key, _, secret = creds.partition(":")
+        host, port = rest.rsplit(":", 1)
+        return BlobStoreContainer(host, int(port), key=key, secret=secret)
     if url == "memory:":
         return MemoryContainer()
     raise ValueError(f"unknown backup container url: {url}")

@@ -346,8 +346,9 @@ class TpuConflictSet(ConflictSetBase):
 
         nr, nw = rb.shape[0], wb.shape[0]
         npad = next_pow2(max(n, _KERNEL_MIN_TXNS))
-        nrp = next_pow2(max(nr + 1, _KERNEL_MIN_RANGES))
-        nwp = next_pow2(max(nw + 1, _KERNEL_MIN_RANGES))
+        # exact bucket: one extra slot would double both dimensions
+        nrp = next_pow2(max(nr, _KERNEL_MIN_RANGES))
+        nwp = next_pow2(max(nw, _KERNEL_MIN_RANGES))
         self._audit_capacity(2 * nw)
 
         snap_off = np.clip(snapshots - self._base, 0, SNAP_CLAMP).astype(np.int32)

@@ -30,17 +30,24 @@ Re-expresses one `ConflictBatch::detectConflicts` round
      practice a handful of fully-parallel rounds).
 
   3. history merge (ref addConflictRanges/mergeWriteConflictRanges,
-     SkipList.cpp:511-522,:1260-1318): surviving writes' endpoints are
-     merged into the boundary array by a searchsorted stable merge
-     (position = own index + cross-rank; no full re-sort), coverage is
-     applied as a +-1 delta cumsum, and commit-version assignment is a
-     masked maximum (commit versions are monotone, so assign == max).
+     SkipList.cpp:511-522,:1260-1318): ONE multi-column sort merges
+     history rows and surviving boundary rows; the covering version,
+     the +-1 coverage counter, and commit-version assignment are
+     segmented scans over the sorted order (commit versions are
+     monotone, so assign == max).
 
   4. window GC + compaction (ref removeBefore, SkipList.cpp:665):
      duplicate boundaries and equal-version / dead-dead neighbors are
-     dropped by a keep-mask + cumsum scatter. Intervals whose version
-     is below oldestVersion can never beat a live snapshot, so merging
-     them is verdict-invariant.
+     masked to +inf and one more key sort packs the survivors left.
+     Intervals whose version is below oldestVersion can never beat a
+     live snapshot, so merging them is verdict-invariant.
+
+  TPU cost model (measured on v5e through this kernel's rewrites):
+  multi-column `lax.sort` sustains ~200M rows/s; binary searches
+  (logn dependent gather rounds) and scatters run 10-50x slower, so
+  every rank/merge/route-back is expressed as a sort + scans, and
+  per-txn reductions ride the REQUIRED non-decreasing rtxn/wtxn slot
+  order as cumsum differences.
 
 Everything is int32/uint32 (versions are offsets from a host-tracked
 base, re-based long before overflow): no float, no atomics, fixed
@@ -57,6 +64,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .keys import searchsorted_i32
 from .rmq import VDEAD, build_range_max_table, range_max
 
 SNAP_CLAMP = (1 << 30) + 1  # above any storable version offset
@@ -72,6 +80,9 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
       fn(HK, HV, snap, too_old, rb, re, rtxn, rvalid,
          wb, we, wtxn, wvalid, commit, oldest)
         -> (HK', HV', count, conflict[n_txns] bool)
+    `rtxn`/`wtxn` must be NON-DECREASING with pad slots = n_txns (the
+    flattened-in-txn-order layout every marshaller produces): per-txn
+    reductions are segment sums over that order.
 
     With `axis_name` set, the step runs as one key-range shard of a
     multi-device resolver (ref: key-range sharded resolvers,
@@ -107,7 +118,9 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         # cap+queries rows costs ~5ms while logn sequential gather
         # rounds of searchsorted cost ~22ms (the dependent-gather chain
         # is latency-bound). Tie order encodes the side: re (left)
-        # sorts before equal history rows, rb (right) after.
+        # sorts before equal history rows, rb (right) after. (A single
+        # mega-sort folding the merge's boundary rows in here was
+        # measured SLOWER: the wider payload outweighs the saved sort.)
         nq = rb.shape[0] + re.shape[0]
         tie_e = jnp.concatenate([
             jnp.full((cap,), 1, jnp.int32),
@@ -124,16 +137,30 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         cq = jnp.cumsum(is_q.astype(jnp.int32))
         # for a query at sorted index i: #history rows before it
         ranks_e = jnp.arange(cap + nq, dtype=jnp.int32) - cq + 1
-        pos_q = jnp.zeros((nq,), jnp.int32).at[sorted_e[width + 1]].set(
-            ranks_e, mode="drop")
+        # route ranks back to query order by a 2-column sort (qids are
+        # unique; history rows carry qid=nq and sort to the tail) — a
+        # scatter here runs ~50x slower than the sort on TPU
+        pos_q = lax.sort((sorted_e[width + 1], ranks_e), num_keys=1)[1]
         lo = pos_q[:rb.shape[0]] - 1
-        hi = pos_q[rb.shape[0]:]
+        hi = pos_q[rb.shape[0]:nq]
         vmax = range_max(build_range_max_table(hv), lo, hi)
         snap_pad = jnp.concatenate([snap, jnp.full((1,), SNAP_CLAMP, jnp.int32)])
         ext_r = rvalid & (vmax > snap_pad[rtxn])
-        ext = (jnp.zeros(n + 1, jnp.int32).at[rtxn].max(ext_r.astype(jnp.int32))
-               [:n] > 0)
-        ext = _all_shards(ext)
+
+        # per-txn reductions ride rtxn's non-decreasing slot order as
+        # cumsum differences at the txn boundaries — the scatter-max
+        # formulation was the fixpoint's dominant cost (measured ~6ms
+        # per round for a 32k-slot scatter vs sub-ms for the cumsum)
+        r_starts = searchsorted_i32(rtxn, jnp.arange(n + 2,
+                                                     dtype=jnp.int32))
+
+        def seg_any(flags):
+            cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(flags.astype(jnp.int32))])
+            at = jnp.take(cum, r_starts)
+            return (at[1:] - at[:-1])[:n] > 0
+
+        ext = _all_shards(seg_any(ext_r))
 
         # ---- 2. intra-batch fixpoint ------------------------------------
         # Rank space: searchsorted(A, x, left) is an order embedding that
@@ -178,8 +205,9 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
             return out
 
         rank_run = keep_first(rank_a, prev_ne)
-        pos_r = jnp.zeros((nall,), jnp.int32).at[sorted_r[width + 1]].set(
-            rank_run, mode="drop")
+        # qids are a permutation of arange: the 2-col sort IS the
+        # inverse permutation (scatters are ~50x slower here)
+        pos_r = lax.sort((sorted_r[width + 1], rank_run), num_keys=1)[1]
         r_lo = pos_r[:n_reads]
         w_lo = pos_r[n_reads:n_reads + n_writes]
         w_hi = pos_r[n_reads + n_writes:na]
@@ -204,10 +232,9 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
                               .astype(jnp.uint32) * bits[None, :],
                               axis=1, dtype=jnp.uint32)
             hit_r = jnp.any((ovp & alive_p[None, :]) != 0, axis=1)
-            hit = (jnp.zeros(n + 1, jnp.int32)
-                   .at[rtxn].max(hit_r.astype(jnp.int32)) > 0)
-            hit = _all_shards(hit)
-            return (base_c | hit).at[n].set(True)
+            hit = _all_shards(seg_any(hit_r))
+            return jnp.concatenate(
+                [base_c[:n] | hit, jnp.ones((1,), bool)])
 
         def cond(carry):
             prev, cur, i = carry
@@ -224,12 +251,9 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
 
         # ---- 3. merge surviving writes into the history -----------------
         # One sort does the whole merge: history rows and the surviving
-        # writes' boundary rows ride together, with the covering
-        # version, the coverage counter, and the dedup logic all
-        # expressed as scans over the sorted order. (The previous
-        # design paid two logn-round binary searches plus six
-        # cap-sized scatters here — the dependent-gather chains were
-        # the kernel's dominant cost.)
+        # writes' boundary rows ride together; the covering version,
+        # the coverage counter, and the dedup logic are scans over the
+        # sorted order (no binary searches, no big scatters).
         surv = wvalid & ~jnp.take(conflict_pad, wtxn)
         ins_valid = jnp.concatenate([surv, surv])
         ins = jnp.concatenate([wb, we], axis=0)
@@ -237,22 +261,23 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         mi = ins.shape[0]
         mtot = cap + mi
         rows_m = jnp.concatenate([hk, ins], axis=0)
-        # tie: history before equal-key ins rows (the covering version
-        # of a boundary equal to a history key is that row's version —
-        # searchsorted side=right semantics)
-        tie_m = jnp.concatenate([jnp.zeros((cap,), jnp.int32),
-                                 jnp.ones((mi,), jnp.int32)])
+        # one combined tie column carries both the merge order and the
+        # coverage delta: history rows (1) sort before equal-key ins
+        # rows (the covering version of a boundary equal to a history
+        # key is that row's version — side=right semantics); among ins
+        # rows we (4) vs wb (6) order is irrelevant (coverage is a
+        # cumsum at the run's last row either way)
+        tie_m = jnp.concatenate([
+            jnp.full((cap,), 1, jnp.int32),
+            jnp.where(surv, 6, 1), jnp.where(surv, 4, 1)])
         vcol = jnp.concatenate([hv, jnp.full((mi,), VDEAD, jnp.int32)])
-        delta = jnp.concatenate([
-            jnp.zeros((cap,), jnp.int32),
-            jnp.where(surv, 1, 0), jnp.where(surv, -1, 0)])
         sm = lax.sort(
-            tuple(rows_m[:, w] for w in range(width)) + (tie_m, vcol, delta),
+            tuple(rows_m[:, w] for w in range(width)) + (tie_m, vcol),
             num_keys=width + 1)
-        is_ins = sm[width] == 1
+        is_ins = sm[width] >= 4
         merged_k = jnp.stack(sm[:width], axis=1)
         mv_raw = sm[width + 1]
-        delta_s = sm[width + 2]
+        delta_s = jnp.where(is_ins, sm[width] - 5, 0)
 
         # covering version: last history version at or before each row
         def carry_last(vals, present):
@@ -266,10 +291,6 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         lhv = carry_last(mv_raw, ~is_ins)
         merged_v = jnp.where(is_ins, lhv, mv_raw)
 
-        # coverage with searchsorted(side=left) semantics: a boundary's
-        # delta applies from the FIRST row of its equal-key run, so a
-        # row is covered iff the inclusive delta cumsum at its run's
-        # LAST row is positive
         prev_ne_m = jnp.zeros((mtot,), bool)
         for w in range(width):
             col = sm[w]
@@ -277,12 +298,12 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
                 [jnp.ones((1,), bool), col[1:] != col[:-1]])
         run_end = jnp.concatenate([prev_ne_m[1:], jnp.ones((1,), bool)])
         dtot = jnp.cumsum(delta_s)
-        # value at the run's last row, carried backward over the run
-        rev, _ = lax.associative_scan(
-            lambda a, b: (jnp.where(b[1], b[0], a[0]), a[1] | b[1]),
-            (dtot[::-1], run_end[::-1]))
-        run_end_tot = rev[::-1]
-        covered = run_end_tot > 0
+        # searchsorted(side=left) coverage semantics require the value
+        # at each run's LAST row — but a row's coverage (and version)
+        # is only ever read where the row survives dedup, and dedup
+        # keeps exactly the run-end rows, where the plain inclusive
+        # cumsum IS the run-end value. No backward scan needed.
+        covered = dtot > 0
         merged_v = jnp.where(covered, jnp.maximum(merged_v, commit),
                              merged_v)
 

@@ -707,9 +707,7 @@ class ClusterController:
                 except ValueError:
                     repairs[key] = live
         cand = self.config._replace(**updates)
-        my_dc = getattr(self.process, "dc", "dc0")
-        live_workers = [name for name, wi in self.workers.items()
-                        if wi.worker.process.alive and wi.dc == my_dc]
+        live_workers = self._live_worker_names()
         n_live = sum(1 for name in live_workers
                      if name not in self.excluded)
         if (cand.n_proxies < 1 or cand.n_resolvers < 1
@@ -834,15 +832,19 @@ class ClusterController:
             flow.SERVER_KNOBS.coordinator_forward_timeout))
             for c in old_set])
 
-    def _live_included_workers(self, without: str = None) -> int:
-        # same DC filter as pick_workers: cross-DC satellite workers
-        # can hold log replicas but never transaction roles, so a
-        # recruitable-shape check counting them would approve configs
-        # the primary DC cannot actually host
+    def _live_worker_names(self) -> list:
+        """Alive workers in THIS controller's DC — the same filter
+        pick_workers applies: cross-DC satellite workers can hold log
+        replicas but never transaction roles, so recruitable-shape
+        checks counting them would approve configs the primary DC
+        cannot actually host."""
         my_dc = getattr(self.process, "dc", "dc0")
-        return sum(1 for name, wi in self.workers.items()
-                   if wi.worker.process.alive and name not in self.excluded
-                   and name != without and wi.dc == my_dc)
+        return [name for name, wi in self.workers.items()
+                if wi.worker.process.alive and wi.dc == my_dc]
+
+    def _live_included_workers(self, without: str = None) -> int:
+        return sum(1 for name in self._live_worker_names()
+                   if name not in self.excluded and name != without)
 
     def _hosts_current_txn_role(self, worker_name: str) -> bool:
         """Does the worker host a CURRENT-epoch transaction role?

@@ -210,9 +210,9 @@ class WriteDuringRead:
                         raise
                     self.stats["retries"] += 1
                     await flow.delay(
-                        flow.SERVER_KNOBS.workload_kill_delay_min
+                        flow.SERVER_KNOBS.workload_retry_delay_min
                         + self.rng.random01()
-                        * flow.SERVER_KNOBS.workload_kill_delay_span)
+                        * flow.SERVER_KNOBS.workload_retry_delay_span)
             self.stats["txns"] += 1
         if self.check_watches:
             await self._check_watches()
