@@ -47,16 +47,16 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("MAX_COMMIT_BATCH_INTERVAL", 0.5, lambda: 2.0)
     init("COMMIT_TRANSACTION_BATCH_INTERVAL_MIN", 0.001)
     init("COMMIT_TRANSACTION_BATCH_COUNT_MAX", 32768, lambda: 1000)
-    init("COMMIT_TRANSACTION_BATCH_BYTES_MAX", 8 << 20)
+    init("COMMIT_TRANSACTION_BATCH_BYTES_MAX", 8 << 20, lambda: 4096)
     init("RESOLVER_STATE_MEMORY_LIMIT", 1 << 20)
-    init("GRV_BATCH_INTERVAL", 0.0005)
-    init("DESIRED_TOTAL_BYTES", 150000)
+    init("GRV_BATCH_INTERVAL", 0.0005, lambda: 0.01)
+    init("DESIRED_TOTAL_BYTES", 150000, lambda: 200)
     init("STORAGE_DURABILITY_LAG", 5.0)
     init("TLOG_SPILL_THRESHOLD", 1500 << 20)
     init("TRANSACTION_SIZE_LIMIT", 10_000_000)
     init("KEY_SIZE_LIMIT", 10_000)
     init("VALUE_SIZE_LIMIT", 100_000)
-    init("RESOLVER_REPLY_CACHE_SIZE", 256)
+    init("RESOLVER_REPLY_CACHE_SIZE", 256, lambda: 4)
     init("LOAD_BALANCE_BACKUP_DELAY", 0.005, lambda: 0.0005)
     # DD shard sizing on SAMPLED BYTES and write bandwidth (ref:
     # SHARD_MAX_BYTES / SHARD_MIN_BYTES_PER_KSEC family, Knobs.cpp;
@@ -79,19 +79,19 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # the satellite path exists to prevent)
     init("REGION_LOCK_GRACE", 5.0)
     init("RESOLUTION_BALANCING_INTERVAL", 2.0, lambda: 0.3)
-    init("RESOLUTION_METRICS_TIMEOUT", 2.0)
+    init("RESOLUTION_METRICS_TIMEOUT", 2.0, lambda: 0.2)
     init("RESOLUTION_BALANCING_MIN_WORK", 100, lambda: 5)
     init("OLD_LOG_CLEANUP_INTERVAL", 1.0, lambda: 0.1)
     init("TLOG_LOCK_TIMEOUT", 2.0, lambda: 0.5)
 
     # -- cluster controller (ref: CC_* / FAILURE_* knobs) --------------
-    init("CC_WORKER_POLL_DELAY", 0.05)
+    init("CC_WORKER_POLL_DELAY", 0.05, lambda: 0.5)
     init("FAILURE_DETECTION_INTERVAL", 0.1, lambda: 0.5)
-    init("FAILURE_MONITOR_PING_TIMEOUT", 0.5)
-    init("LATENCY_PROBE_INTERVAL", 5.0)
-    init("METRIC_SAMPLE_INTERVAL", 1.0)
+    init("FAILURE_MONITOR_PING_TIMEOUT", 0.5, lambda: 0.05)
+    init("LATENCY_PROBE_INTERVAL", 5.0, lambda: 0.5)
+    init("METRIC_SAMPLE_INTERVAL", 1.0, lambda: 0.1)
     init("DD_POLL_INTERVAL", 2.0, lambda: 0.3)
-    init("DD_MOVE_NUDGE_INTERVAL", 0.1)
+    init("DD_MOVE_NUDGE_INTERVAL", 0.1, lambda: 0.5)
     # how long a team may stay degraded before DD rebuilds the missing
     # replica. Must exceed SIM_REBOOT_DELAY under EVERY knob combination
     # (default 7.5 > buggified reboot 5.0; buggified 15.0 likewise) so
@@ -104,22 +104,22 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # rebuilt like a dead one (ref: the reference removing storage
     # servers that cannot catch up)
     init("DD_REPLICA_STUCK_VERSIONS", 100_000)
-    init("STORAGE_RECRUIT_RECOVERY_TIMEOUT", 30.0)
-    init("COORDINATOR_FORWARD_TIMEOUT", 2.0)
+    init("STORAGE_RECRUIT_RECOVERY_TIMEOUT", 30.0, lambda: 3.0)
+    init("COORDINATOR_FORWARD_TIMEOUT", 2.0, lambda: 0.2)
 
     # -- coordination / election (ref: POLLING_FREQUENCY etc.) ---------
     init("CANDIDACY_POLL_INTERVAL", 0.05, lambda: 0.3)
     init("COORDINATOR_FORWARD_HOPS_MAX", 8)
 
     # -- storage (ref: STORAGE_* / FETCH_* knobs) ----------------------
-    init("STORAGE_PULL_IDLE_DELAY", 0.2)
-    init("STORAGE_PEEK_TIMEOUT", 5.0)
-    init("STORAGE_ROLLBACK_DELAY", 0.05)
+    init("STORAGE_PULL_IDLE_DELAY", 0.2, lambda: 1.0)
+    init("STORAGE_PEEK_TIMEOUT", 5.0, lambda: 0.5)
+    init("STORAGE_ROLLBACK_DELAY", 0.05, lambda: 0.5)
     init("STORAGE_COMMIT_INTERVAL", 0.05, lambda: 0.5)
     init("WATCH_EXPIRY_SWEEP_INTERVAL", 30.0, lambda: 1.0)
 
     # -- tlog (ref: TLOG_* knobs) --------------------------------------
-    init("TLOG_STALLED_PEEK_DELAY", 1.0)
+    init("TLOG_STALLED_PEEK_DELAY", 1.0, lambda: 0.05)
     init("TLOG_FSYNC_DELAY", 0.0005, lambda: 0.01)
     # BUGGIFY-injected commit reordering window (the durable-path race
     # stressor; 0 disables even the buggify branch)
@@ -129,14 +129,14 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("FETCH_BLOCK_ROWS", 64, lambda: 3)
 
     # -- proxy / GRV (ref: START_TRANSACTION_* knobs) ------------------
-    init("GRV_RATE_POLL_INTERVAL", 0.1)
+    init("GRV_RATE_POLL_INTERVAL", 0.1, lambda: 1.0)
     init("GRV_CONFIRM_TIMEOUT", 2.0)
     init("GRV_PEER_SUSPECT_DURATION", 1.0, lambda: 0.01)
     init("GRV_BURST_INTERVALS", 10, lambda: 1)
-    init("RATEKEEPER_POLL_TIMEOUT", 1.0)
+    init("RATEKEEPER_POLL_TIMEOUT", 1.0, lambda: 0.1)
 
     # -- ratekeeper (ref: Ratekeeper.actor.cpp knobs) ------------------
-    init("RK_UPDATE_INTERVAL", 0.1)
+    init("RK_UPDATE_INTERVAL", 0.1, lambda: 0.02)
     init("RK_MIN_RATE", 10.0)
     init("RK_MAX_RATE", 1e9)
     init("RK_TLOG_BACKLOG_LIMIT", 10_000, lambda: 500)
@@ -150,16 +150,16 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("RK_SMOOTHING_SECONDS", 1.0)
 
     # -- region / log router (ref: LOG_ROUTER_* knobs) -----------------
-    init("LOG_ROUTER_PEEK_TIMEOUT", 2.0)
-    init("LOG_ROUTER_IDLE_DELAY", 0.2)
-    init("LOG_ROUTER_RETRY_DELAY", 0.1)
-    init("REGION_SETTLE_DELAY", 0.05)
+    init("LOG_ROUTER_PEEK_TIMEOUT", 2.0, lambda: 0.2)
+    init("LOG_ROUTER_IDLE_DELAY", 0.2, lambda: 1.0)
+    init("LOG_ROUTER_RETRY_DELAY", 0.1, lambda: 0.5)
+    init("REGION_SETTLE_DELAY", 0.05, lambda: 0.5)
 
     # -- backup agent (ref: BACKUP_* knobs) ----------------------------
-    init("BACKUP_TAIL_IDLE_DELAY", 0.1)
-    init("BACKUP_PEEK_TIMEOUT", 2.0)
-    init("BACKUP_SOURCE_RETRY_DELAY", 0.2)
-    init("BACKUP_NUDGE_INTERVAL", 0.05)
+    init("BACKUP_TAIL_IDLE_DELAY", 0.1, lambda: 0.5)
+    init("BACKUP_PEEK_TIMEOUT", 2.0, lambda: 0.2)
+    init("BACKUP_SOURCE_RETRY_DELAY", 0.2, lambda: 1.0)
+    init("BACKUP_NUDGE_INTERVAL", 0.05, lambda: 0.5)
     # the cluster-side driver polling the \xff\x02/backup/ control rows
     # (ref: the backup agent's task poll delay)
     init("BACKUP_DRIVER_POLL_INTERVAL", 0.25, lambda: 0.05)

@@ -221,13 +221,10 @@ class _BlobHandler(BaseHTTPRequestHandler):
         pass
 
     def _split(self) -> Tuple[str, Dict[str, str]]:
+        from urllib.parse import parse_qsl
         path, _, query = self.path.partition("?")
-        q = {}
-        for part in query.split("&"):
-            if part:
-                k, _, v = part.partition("=")
-                q[k] = unquote(v)
-        return unquote(path.lstrip("/")), q
+        return (unquote(path.lstrip("/")),
+                dict(parse_qsl(query, keep_blank_values=True)))
 
     def _authorized(self, verb: str) -> bool:
         """HMAC request auth (ref: BlobStore.actor.cpp setAuthHeaders —
@@ -313,6 +310,9 @@ class _BlobHandler(BaseHTTPRequestHandler):
             # was lost must get 200, not 404 (ref:
             # CompleteMultipartUpload semantics the retry layer assumes)
             with self.lock:
+                owner = self.upload_names.get(q["uploadId"])
+                if owner is not None and owner != name:
+                    return self._ok(status=404)   # wrong object name
                 parts = self.uploads.pop(q["uploadId"], None)
                 self.upload_names.pop(q["uploadId"], None)
                 if parts is None:
@@ -322,6 +322,11 @@ class _BlobHandler(BaseHTTPRequestHandler):
                 self.store[name] = b"".join(
                     parts[i] for i in sorted(parts))
                 self.completed_uploads[q["uploadId"]] = name
+                # retry memory, bounded: only recent completions need
+                # the idempotent answer
+                while len(self.completed_uploads) > 256:
+                    self.completed_uploads.pop(
+                        next(iter(self.completed_uploads)))
             return self._ok()
         self._ok(status=400)
 
@@ -347,9 +352,10 @@ class _BlobHandler(BaseHTTPRequestHandler):
             return self._deny()
         name, q = self._split()
         with self.lock:
-            if "uploadId" in q:     # abort multipart
-                self.uploads.pop(q["uploadId"], None)
-                self.upload_names.pop(q["uploadId"], None)
+            if "uploadId" in q:     # abort multipart (name must match)
+                if self.upload_names.get(q["uploadId"]) == name:
+                    self.uploads.pop(q["uploadId"], None)
+                    self.upload_names.pop(q["uploadId"], None)
             else:
                 self.store.pop(name, None)
         self._ok()
