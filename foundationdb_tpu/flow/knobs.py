@@ -197,6 +197,10 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # module constant — an on-disk format, not a runtime tunable)
     init("DISK_QUEUE_FILE_SIZE", 1 << 20, lambda: 4096)
 
+    # worker threads for blocking real-disk IO (wall-clock only;
+    # ref: the EIO pool size behind AsyncFileEIO)
+    init("DISK_IO_THREADS", 4)
+
     # -- real TCP transport (wall-clock; never BUGGIFY-distorted) ------
     init("TCP_HANDSHAKE_TIMEOUT", 5.0)
     init("TCP_CONNECT_TIMEOUT", 5.0)

@@ -163,19 +163,30 @@ class SimDisk:
                 f._power_loss(rng)
 
 
+def _fsync_handle(fh) -> None:
+    """Pool-side fsync via the handle (fileno() on a closed file raises
+    ValueError, never returns a stale — possibly reused — fd)."""
+    import os
+    os.fsync(fh.fileno())
+
+
 class RealFile:
     """One ON-DISK file behind the SimFile async interface (ref:
     AsyncFileKAIO/AsyncFileCached — the production IAsyncFile). Writes
     go to the OS immediately; sync() is a real fsync, so acknowledged
     durability survives an actual process restart."""
 
-    __slots__ = ("path", "name", "owner", "_fh", "_open")
+    __slots__ = ("path", "name", "owner", "_fh", "_open", "pool")
 
-    def __init__(self, path: str, name: str, owner=None):
+    def __init__(self, path: str, name: str, owner=None, pool=None):
         import os
         self.path = path
         self.name = name
         self.owner = owner
+        # IThreadPool for the blocking fsync (ref: AsyncFileEIO —
+        # the reference never lets a blocking syscall run on the
+        # event loop); None = inline (sim tests, tiny tools)
+        self.pool = pool
         mode = "r+b" if os.path.exists(path) else "w+b"
         # unbuffered: writes reach the OS immediately, so a finalizer
         # flush can never resurrect stale bytes after a successor
@@ -191,7 +202,16 @@ class RealFile:
     async def sync(self) -> None:
         import os
         self._check_open()
-        os.fsync(self._fh.fileno())
+        if self.pool is not None:
+            # a real fsync takes ms to tens of ms: on the pool it
+            # stalls one worker thread, not every actor in the process.
+            # The worker resolves the fd AT EXECUTION TIME from the
+            # handle: a file closed while the fsync was queued raises
+            # (io_error) instead of fsyncing a reused fd number
+            await self.pool.run(_fsync_handle, self._fh)
+            self._check_open()   # may have closed while waiting
+        else:
+            os.fsync(self._fh.fileno())
 
     async def read(self, offset: int, length: int) -> bytes:
         self._check_open()
@@ -233,11 +253,12 @@ class RealDisk:
 
     LOCKFILE = ".fdbtpu-lock"
 
-    def __init__(self, root: str, machine: str = ""):
+    def __init__(self, root: str, machine: str = "", pool=None):
         import fcntl
         import os
         self.root = root
         self.machine = machine
+        self.pool = pool   # shared IThreadPool for blocking file IO
         os.makedirs(root, exist_ok=True)
         # exclusive directory lock (ref: fdbserver flocking its data
         # dir): two processes interleaving writes into the same stores
@@ -251,7 +272,8 @@ class RealDisk:
         self.files: Dict[str, RealFile] = {}
         for name in sorted(os.listdir(root)):
             if name != self.LOCKFILE:
-                self.files[name] = RealFile(os.path.join(root, name), name)
+                self.files[name] = RealFile(os.path.join(root, name),
+                                            name, pool=self.pool)
 
     def _path(self, name: str) -> str:
         import os
@@ -261,7 +283,7 @@ class RealDisk:
     def open(self, name: str, owner=None) -> RealFile:
         f = self.files.get(name)
         if f is None or not f._open:
-            f = RealFile(self._path(name), name, owner)
+            f = RealFile(self._path(name), name, owner, pool=self.pool)
             self.files[name] = f
         elif owner is not None:
             f.owner = owner

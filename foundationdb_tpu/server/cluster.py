@@ -59,6 +59,7 @@ class SimCluster:
                     "data_dir on a share_with secondary is not supported: "
                     "it would silently run on the primary's sim disks")
             share_with._peer_clusters.append(self)
+        self._io_pool = None   # IThreadPool for real-disk fsync offload
         if share_with is not None:
             # a second cluster INSIDE the same deterministic simulation
             # (multi-cluster tests: DR, cross-cluster tooling) — shares
@@ -83,8 +84,18 @@ class SimCluster:
                 import os
 
                 from ..rpc.disk import RealDisk
+                if not virtual:
+                    # wall-clock deployment: fsyncs run on an
+                    # IThreadPool so a slow disk stalls one worker,
+                    # never the whole event loop (ref: AsyncFileEIO's
+                    # eio pool; flow/IThreadPool.h)
+                    from ..flow.threadpool import ThreadPool
+                    self._io_pool = ThreadPool(
+                        n_threads=int(flow.SERVER_KNOBS.disk_io_threads),
+                        name="diskio")
+                    self._io_pool.start()
                 self.net.disk_factory = lambda m: RealDisk(
-                    os.path.join(data_dir, m), m)
+                    os.path.join(data_dir, m), m, pool=self._io_pool)
         self.durable = durable
         self.auto_reboot = auto_reboot
         self.conflict_backend = conflict_backend
@@ -334,6 +345,8 @@ class SimCluster:
         # only the cluster that created the scheduler tears it down — a
         # share_with secondary must not pull it from under the primary
         if self._owns_scheduler:
+            if self._io_pool is not None:
+                self._io_pool.close()
             for d in self.net.disks.values():
                 if hasattr(d, "close_all"):
                     d.close_all()   # release real-file handles
