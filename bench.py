@@ -466,6 +466,11 @@ def main():
     needs_device = backend_env in ("all", "tpu", "tpu-point",
                                    "tpu-streamed", "tpu-streamed-interval")
     _enable_compile_cache()
+    # the periodic kernel-profiling fence (KERNEL_PROFILE_EVERY) drains
+    # the async dispatch pipeline the streamed path depends on — the
+    # bench measures the unfenced pipeline, so profiling stays off here
+    from foundationdb_tpu.flow.knobs import SERVER_KNOBS
+    SERVER_KNOBS.set("KERNEL_PROFILE_EVERY", 0)
     n_txns = int(os.environ.get("FDBTPU_BENCH_TXNS", 16384))
     n_batches = int(os.environ.get("FDBTPU_BENCH_BATCHES", 100))
     keyspace = int(os.environ.get("FDBTPU_BENCH_KEYS", 4_000_000))

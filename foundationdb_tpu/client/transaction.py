@@ -675,8 +675,30 @@ class Transaction:
             return val
         version = await self.get_read_version()
         shard = await self._shard(key)
-        return await self._storage_rpc(shard, lambda rep: rep.gets.get_reply(
-            StorageGetRequest(key, version), self.db.process))
+        debug_id = getattr(self, "_debug_id", None)
+        if debug_id is not None:
+            # sampled-read stitching (ref: the GetValueDebug stations,
+            # NativeAPI getValue Before/After around the storage leg)
+            flow.g_trace_batch.add_event("GetValueDebug", debug_id,
+                                         "NativeAPI.getValue.Before")
+        ok = False
+        try:
+            val = await self._storage_rpc(
+                shard, lambda rep: rep.gets.get_reply(
+                    StorageGetRequest(key, version, debug_id),
+                    self.db.process))
+            ok = True
+        finally:
+            if debug_id is not None:
+                # every exit path — success, FdbError, cancellation —
+                # closes the Before station: a duration-pairing
+                # consumer must never see a dangling Before (ref: the
+                # getValue error station)
+                flow.g_trace_batch.add_event(
+                    "GetValueDebug", debug_id,
+                    "NativeAPI.getValue.After" if ok
+                    else "NativeAPI.getValue.Error")
+        return val
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
         if key.startswith(SYSTEM_PREFIX):
@@ -1014,9 +1036,14 @@ class Transaction:
             return self.committed_version
         snapshot = await self.get_read_version()
         debug_id = getattr(self, "_debug_id", None)
+        span = None
         if debug_id is not None:
             flow.g_trace_batch.add_event("CommitDebug", debug_id,
                                          "NativeAPI.commit.Before")
+            # root of the commit span tree: every server leg opened
+            # while this is in flight parents (transitively) onto it
+            span = flow.g_trace_batch.begin_span(debug_id,
+                                                 "NativeAPI.commit")
         req = CommitRequest(snapshot, tuple(self._read_conflicts),
                             tuple(self._write_conflicts),
                             tuple(self._mutations), debug_id=debug_id)
@@ -1028,7 +1055,16 @@ class Transaction:
             for _k, f in self._watches:
                 if not f.is_ready:
                     f.send_error(error("transaction_cancelled"))
+            if debug_id is not None:
+                # close the Before station on failure (conflict,
+                # unknown result, ...): no dangling Before, same
+                # invariant as every other leg
+                flow.g_trace_batch.add_event("CommitDebug", debug_id,
+                                             "NativeAPI.commit.Error")
             raise e
+        finally:
+            if span is not None:
+                span.finish()
         self.committed_version = reply.version
         self.committed_batch_index = reply.batch_index
         if debug_id is not None:

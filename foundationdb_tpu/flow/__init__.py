@@ -20,8 +20,10 @@ from .actors import (
 )
 from .rng import DeterministicRandom, buggify, g_random, set_seed
 from .knobs import SERVER_KNOBS, Knobs, make_server_knobs, reset_server_knobs
-from .stats import Counter, CounterCollection, LatencyBands, TimeSeries
-from .trace import g_trace_batch
+from .stats import Counter, CounterCollection, TimeSeries
+from .latency import (DEFAULT_BANDS, LatencyBands, LatencySample,
+                      RequestLatency)
+from .trace import Span, g_trace_batch
 from .trace import TraceEvent, g_trace, reset_trace
 from .coverage import cover, declare
 from . import coverage, trace
@@ -37,6 +39,7 @@ __all__ = [
     "DeterministicRandom", "buggify", "g_random", "set_seed",
     "SERVER_KNOBS", "Knobs", "make_server_knobs", "reset_server_knobs",
     "TraceEvent", "g_trace", "reset_trace",
-    "Counter", "CounterCollection", "LatencyBands", "TimeSeries",
-    "g_trace_batch",
+    "Counter", "CounterCollection", "TimeSeries",
+    "DEFAULT_BANDS", "LatencyBands", "LatencySample", "RequestLatency",
+    "Span", "g_trace_batch",
 ]

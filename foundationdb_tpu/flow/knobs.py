@@ -90,6 +90,18 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("FAILURE_MONITOR_PING_TIMEOUT", 0.5, lambda: 0.05)
     init("LATENCY_PROBE_INTERVAL", 5.0, lambda: 0.5)
     init("METRIC_SAMPLE_INTERVAL", 1.0, lambda: 0.1)
+    # -- observability (ref: Trace.cpp suppression + traceCounters) ----
+    # events below this severity never materialize (0 keeps everything;
+    # sim tests assert on SevDebug-level stitching, so the floor is an
+    # operator knob, not a default)
+    init("TRACE_SEVERITY_MIN", 0)
+    # cadence of the per-role *Metrics counter rollup TraceEvents
+    init("TRACE_COUNTERS_INTERVAL", 1.0, lambda: 0.1)
+    # time 1-in-N kernel dispatches with a block_until_ready fence
+    # (first call per shape bucket is always timed: that's the compile);
+    # 0 disables the periodic fence entirely so the streamed bench can
+    # keep its async pipeline
+    init("KERNEL_PROFILE_EVERY", 64, lambda: 1)
     init("DD_POLL_INTERVAL", 2.0, lambda: 0.3)
     init("DD_MOVE_NUDGE_INTERVAL", 0.1, lambda: 0.5)
     # how long a team may stay degraded before DD rebuilds the missing

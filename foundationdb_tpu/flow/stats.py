@@ -1,29 +1,34 @@
 """Counters: rate-tracked event counters per role.
 
 Reference: flow/Stats.actor.cpp — `Counter` (value + rolling rate +
-roughness) grouped in a `CounterCollection`, traced periodically and
-folded into the status document. The sim reads them directly for
-status; a trace loop would emit them as TraceEvents in production.
+roughness) grouped in a `CounterCollection`, traced periodically via
+`traceCounters` and folded into the status document. The sim reads
+them directly for status; the cluster controller's trace-counters loop
+rolls every role's collection into periodic `*Metrics` TraceEvents
+with per-interval rates (see CounterCollection.trace).
 """
 
 from __future__ import annotations
 
-from bisect import bisect_left
 from collections import deque
-from typing import Dict, Tuple
+from typing import Dict, Optional
 
 class Counter:
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "gauge")
 
     def __init__(self, name: str):
         self.name = name
         self.value = 0
+        self.gauge = False
 
     def add(self, n: int = 1) -> None:
         self.value += n
 
     def set(self, n: int) -> None:
-        """Gauge semantics (ref: TDMetric gauges beside counters)."""
+        """Gauge semantics (ref: TDMetric gauges beside counters).
+        Marks the counter as a gauge: a level, not a flow — the
+        trace-counters rollup must not derive a *_per_sec from it."""
+        self.gauge = True
         self.value = n
 
 
@@ -43,42 +48,35 @@ class CounterCollection:
     def snapshot(self) -> Dict[str, int]:
         return {n: c.value for n, c in self.counters.items()}
 
-
-# thresholds in seconds (ref: LatencyBandConfig's default band edges —
-# status reports how many requests finished within each band)
-DEFAULT_BANDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-                 0.25, 0.5, 1.0)
-
-
-class LatencyBands:
-    """Banded latency histogram (ref: fdbserver/LatencyBandConfig.cpp +
-    the latency_band_included counters in status): each recorded
-    latency increments every band whose threshold it fits under, plus
-    a total — so a consumer reads "fraction under X seconds" directly.
-    """
-
-    __slots__ = ("name", "bands", "counts", "total", "max_seen")
-
-    def __init__(self, name: str, bands: Tuple[float, ...] = DEFAULT_BANDS):
-        self.name = name
-        self.bands = tuple(bands)
-        self.counts = [0] * len(self.bands)
-        self.total = 0
-        self.max_seen = 0.0
-
-    def record(self, seconds: float) -> None:
-        self.total += 1
-        if seconds > self.max_seen:
-            self.max_seen = seconds
-        for i in range(bisect_left(self.bands, seconds),
-                       len(self.bands)):
-            self.counts[i] += 1
-
-    def snapshot(self) -> dict:
-        return {"total": self.total,
-                "max_seconds": round(self.max_seen, 6),
-                "bands": {f"<={t:g}s": c
-                          for t, c in zip(self.bands, self.counts)}}
+    def trace(self, id: str = "", elapsed: Optional[float] = None,
+              prev: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+        """Roll this collection into one TraceEvent (ref: traceCounters,
+        flow/Stats.actor.cpp — "ProxyMetrics"/"TLogMetrics"/... events
+        carrying every counter plus its per-interval rate). `prev` is
+        the previous interval's snapshot and `elapsed` the seconds since
+        it was taken; returns the fresh snapshot for the caller's next
+        round, so rates need no state inside the counters themselves."""
+        from .trace import TraceEvent
+        snap = self.snapshot()
+        # role -> event prefix; "tlog".capitalize() would diverge from
+        # the reference's TLogMetrics spelling
+        prefix = {"tlog": "TLog"}.get(self.role, self.role.capitalize())
+        ev = TraceEvent(f"{prefix}Metrics", id)
+        details = dict(snap)
+        if prev is not None and elapsed:
+            for n, v in snap.items():
+                # gauges are levels, not flows: no rate. For true
+                # counters, a value below its baseline means a reset
+                # (role restarted under the same name): emit no rate
+                # this interval and let the fresh snapshot re-baseline,
+                # instead of a large negative rate
+                if self.counters[n].gauge:
+                    continue
+                p = prev.get(n, 0)
+                if v >= p:
+                    details[f"{n}_per_sec"] = round((v - p) / elapsed, 3)
+        ev.detail(**details).log()
+        return snap
 
 
 class TimeSeries:

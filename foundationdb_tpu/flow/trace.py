@@ -3,11 +3,16 @@
 Reference: flow/Trace.h:140 (`TraceEvent(severity, name, id).detail(...)`),
 FileTraceLogWriter / JsonTraceLogFormatter. Events are structured dicts
 collected in-memory (for tests/simulation) and optionally streamed to a
-JSON-lines file (the reference's JSON trace format).
+JSON-lines file (the reference's JSON trace format). `TraceBatch` keeps
+the cross-role commit-debug stitching for sampled transactions, and the
+span layer on top of it (`Span` / `begin_span`) reassembles one sampled
+commit's full proxy -> resolver -> tlog path as a parented tree (ref:
+flow/Tracing.h Span + the g_traceBatch commit-debug locations).
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 from typing import Any, Optional
 
@@ -18,12 +23,52 @@ SevWarnAlways = 30
 SevError = 40
 
 
+def _now() -> float:
+    try:  # time is the scheduler's virtual clock when one is running
+        from .scheduler import g
+        return g().now()
+    except Exception:
+        return 0.0
+
+
+_knobs = None    # cached knobs handle: suppression must not pay the
+                 # import machinery per event in hot loops
+
+
+def _severity_floor() -> int:
+    """Events below this severity are dropped at construction — the
+    cheap filter hot loops rely on (ref: the trace file's minimum
+    severity, flow/Trace.cpp suppression). The knob is read live (tests
+    and operators flip it at runtime); only the module lookup is
+    cached."""
+    global _knobs
+    if _knobs is None:
+        try:
+            from .knobs import SERVER_KNOBS
+        except Exception:
+            return 0
+        _knobs = SERVER_KNOBS
+    return int(_knobs.trace_severity_min)
+
+
 class TraceCollector:
     def __init__(self, path: Optional[str] = None, keep_in_memory: int = 10000):
         self.events: list[dict] = []
         self.keep = keep_in_memory
-        self._fh = open(path, "a") if path else None
         self.counts: dict[str, int] = {}
+        self._fh = None
+        self._set_file(path)
+
+    def _set_file(self, path: Optional[str]) -> None:
+        # line-buffered: every emitted event line reaches the OS without
+        # waiting for a close that __del__-era code never guaranteed.
+        # The atexit hook (registered only while a file is open, and
+        # unregistered on close so short-lived collectors aren't pinned
+        # for process lifetime) covers whatever the OS still buffers
+        # when the interpreter goes down.
+        if path:
+            self._fh = open(path, "a", buffering=1)
+            atexit.register(self.close)
 
     def emit(self, ev: dict) -> None:
         self.counts[ev["Type"]] = self.counts.get(ev["Type"], 0) + 1
@@ -34,10 +79,22 @@ class TraceCollector:
         if self._fh:
             self._fh.write(json.dumps(ev) + "\n")
 
+    def flush(self) -> None:
+        if self._fh:
+            self._fh.flush()
+
     def close(self) -> None:
         if self._fh:
+            self._fh.flush()
             self._fh.close()
             self._fh = None
+            atexit.unregister(self.close)
+
+    def __enter__(self) -> "TraceCollector":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def reset(self, path: Optional[str] = None) -> None:
         """Clear state and retarget the output file, in place (the ambient
@@ -45,7 +102,7 @@ class TraceCollector:
         self.close()
         self.events.clear()
         self.counts.clear()
-        self._fh = open(path, "a") if path else None
+        self._set_file(path)
 
 
 g_trace = TraceCollector()
@@ -58,28 +115,45 @@ def reset_trace(path: Optional[str] = None) -> TraceCollector:
 
 
 class TraceEvent:
-    """``TraceEvent("Name", id).detail(Key=value)...`` — emits on __del__ or .log()."""
+    """``TraceEvent("Name", id).detail(Key=value)...`` — emits on
+    ``.log()``, on ``__del__``, or at ``with`` exit. Events below the
+    ``trace_severity_min`` knob are dropped at construction: ``detail``
+    and ``log`` become no-ops, so a SevDebug event in a hot loop costs
+    one knob read and a compare — no timestamp, no dict work."""
 
     __slots__ = ("_ev", "_logged")
 
     def __init__(self, name: str, id: str = "", severity: int = SevInfo):
-        t = None
-        try:  # time is the scheduler's virtual clock when one is running
-            from .scheduler import g
-            t = g().now()
-        except Exception:
-            t = 0.0
-        self._ev = {"Severity": severity, "Time": t, "Type": name, "ID": id}
+        if severity < _severity_floor():
+            self._ev = None
+            self._logged = True   # suppressed: nothing to emit, ever
+            return
+        self._ev = {"Severity": severity, "Time": _now(),
+                    "Type": name, "ID": id}
         self._logged = False
 
     def detail(self, **kwargs: Any) -> "TraceEvent":
-        self._ev.update(kwargs)
+        if self._ev is not None:
+            self._ev.update(kwargs)
         return self
 
     def log(self) -> None:
         if not self._logged:
             self._logged = True
             g_trace.emit(self._ev)
+
+    def __enter__(self) -> "TraceEvent":
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        # the explicit form the __del__ fallback can't guarantee: emit
+        # deterministically at scope exit, recording a failure if one
+        # ended the scope (ref: TraceEvent::~TraceEvent logging errors).
+        # An event already emitted inside the block is left untouched —
+        # mutating it would diverge the in-memory copy from the file
+        if exc is not None and self._ev is not None and not self._logged:
+            self._ev.setdefault("Error", repr(exc))
+        self.log()
 
     def __del__(self):
         try:
@@ -88,13 +162,48 @@ class TraceEvent:
             pass
 
 
+class Span:
+    """One timed leg of a sampled transaction's path (ref: flow/Tracing.h
+    `Span` — begin/end timestamps plus a parent link; the commit-debug
+    locations mark instants, spans mark extents). Created through
+    ``TraceBatch.begin_span``; ``finish()`` (or ``with``) stamps the end
+    time and files the span for ``span_chain`` reassembly."""
+
+    __slots__ = ("batch", "debug_id", "location", "span_id", "parent_id",
+                 "begin", "end")
+
+    def __init__(self, batch: "TraceBatch", debug_id, location: str,
+                 span_id: int, parent_id: Optional[int]):
+        self.batch = batch
+        self.debug_id = debug_id
+        self.location = location
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.begin = _now()
+        self.end: Optional[float] = None
+
+    def finish(self) -> None:
+        if self.end is not None:
+            return
+        self.end = _now()
+        self.batch._finish_span(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+
 class TraceBatch:
     """Cross-role latency stitching for SAMPLED transactions (ref:
     g_traceBatch, flow/Trace.h:107 — attach/event pairs with a shared
     debug id let a tool reassemble one transaction's path across the
     client, proxy, resolver, and log). Events buffer here (bounded —
     the oldest spill into the trace stream, like the reference's
-    periodic dump) and can be flushed or queried by id."""
+    periodic dump) and can be flushed or queried by id. Spans ride the
+    same buffer discipline: roles open parented spans around their leg
+    of a commit, and `span_chain` rebuilds the tree."""
 
     MAX_BUFFERED = 4096
 
@@ -102,16 +211,14 @@ class TraceBatch:
         self._events: list = []
         self._seq = 0   # insertion order: same-tick events must stitch
                         # causally, not alphabetically by location
+        self._spans: list = []            # finished spans
+        self._open: dict = {}             # debug_id -> stack of open Spans
+        self._span_seq = 0
 
     def add_event(self, event_type: str, debug_id, location: str) -> None:
-        t = 0.0
-        try:
-            from .scheduler import g
-            t = g().now()
-        except Exception:
-            pass
         self._seq += 1
-        self._events.append((t, self._seq, event_type, debug_id, location))
+        self._events.append((_now(), self._seq, event_type, debug_id,
+                             location))
         if len(self._events) > self.MAX_BUFFERED:
             # spill the OLDEST half only: in-flight stitches keep their
             # recent legs queryable in memory
@@ -127,19 +234,107 @@ class TraceBatch:
         return [(t, et, loc) for t, seq, et, d, loc
                 in sorted(e for e in self._events if e[3] == debug_id)]
 
+    # -- spans ----------------------------------------------------------
+    def begin_span(self, debug_id, location: str,
+                   parent: Optional["Span"] = None) -> Span:
+        """Open a parented span for one debug id. With no explicit
+        parent, the innermost still-open span of the same debug id is
+        the parent — in the deterministic sim a commit's legs nest
+        (client > proxy > {resolver, tlog}), so auto-parenting rebuilds
+        the reference's trace tree without threading span tokens
+        through every RPC type. Same-location open spans are SIBLINGS,
+        not ancestors: with two tlogs (or a txn split across
+        resolvers), leg B begins while leg A's identical-location span
+        is still open, and both must parent onto the proxy span."""
+        self._span_seq += 1
+        stack = self._open.setdefault(debug_id, [])
+        if parent is not None:
+            pid = parent.span_id
+        else:
+            pid = None
+            for s in reversed(stack):
+                if s.location != location:
+                    pid = s.span_id
+                    break
+        span = Span(self, debug_id, location, self._span_seq, pid)
+        stack.append(span)
+        return span
+
+    def begin_spans(self, debug_ids, location: str) -> list:
+        return [self.begin_span(d, location) for d in debug_ids]
+
+    @staticmethod
+    def finish_spans(spans) -> None:
+        for s in spans:
+            s.finish()
+
+    def _finish_span(self, span: Span) -> None:
+        stack = self._open.get(span.debug_id)
+        if stack and span in stack:
+            stack.remove(span)
+            if not stack:
+                del self._open[span.debug_id]
+        self._spans.append(span)
+        if len(self._spans) > self.MAX_BUFFERED:
+            self._dump_spans(self._spans[:self.MAX_BUFFERED // 2])
+            del self._spans[:self.MAX_BUFFERED // 2]
+
+    def spans(self, debug_id) -> list:
+        """Finished spans for one debug id, ordered by (begin, open
+        order) — the monotonic virtual clock makes this the causal
+        order of the legs."""
+        return sorted((s for s in self._spans if s.debug_id == debug_id),
+                      key=lambda s: (s.begin, s.span_id))
+
+    def span_chain(self, debug_id) -> list:
+        """The reassembled tree for one sampled transaction: dicts with
+        location/begin/end/parent/depth in causal order. `parent` is
+        the parent span's location (None at the root); `depth` is the
+        distance to the root, so a test can assert the exact
+        client->proxy->resolver/tlog shape."""
+        spans = self.spans(debug_id)
+        by_id = {s.span_id: s for s in spans}
+        out = []
+        for s in spans:
+            depth = 0
+            p = s.parent_id
+            while p is not None and p in by_id:
+                depth += 1
+                p = by_id[p].parent_id
+            parent = by_id.get(s.parent_id)
+            out.append({"location": s.location,
+                        "begin": s.begin, "end": s.end,
+                        "parent": parent.location if parent else None,
+                        "depth": depth})
+        return out
+
     def clear(self) -> None:
         self._events.clear()
+        self._spans.clear()
+        self._open.clear()
 
     def dump(self, events=None) -> None:
         """Flush events as TraceEvents (ref: TraceBatch::dump); with no
-        argument, flushes and clears the whole buffer."""
+        argument, flushes and clears the whole buffer (finished spans
+        included)."""
         batch = self._events if events is None else events
         for t, _seq, et, d, loc in batch:
             ev = TraceEvent(et, str(d))
-            ev._ev["Time"] = t
+            if ev._ev is not None:
+                ev._ev["Time"] = t
             ev.detail(Location=loc).log()
         if events is None:
+            self._dump_spans(self._spans)
+            self._spans.clear()
             self._events.clear()
+
+    def _dump_spans(self, spans) -> None:
+        for s in spans:
+            ev = TraceEvent("Span", str(s.debug_id))
+            if ev._ev is not None:
+                ev._ev["Time"] = s.begin
+            ev.detail(Location=s.location, Begin=s.begin, End=s.end,
+                      SpanID=s.span_id, ParentID=s.parent_id).log()
 
 
 g_trace_batch = TraceBatch()

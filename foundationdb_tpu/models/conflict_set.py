@@ -56,6 +56,8 @@ class ConflictSetBase:
     """Interface all backends implement; parity across backends is the
     north-star acceptance criterion."""
 
+    BACKEND = "base"
+
     def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
                 new_oldest_version: int) -> list[int]:
         raise NotImplementedError
@@ -64,9 +66,17 @@ class ConflictSetBase:
     def oldest_version(self) -> int:
         raise NotImplementedError
 
+    def kernel_stats(self) -> dict:
+        """Device-kernel profile for status; non-device backends have
+        none (the TPU backends override with pad/occupancy/compile
+        accounting)."""
+        return {}
+
 
 class PyConflictSet(ConflictSetBase):
     """Pure-Python step-function baseline (sorted boundary list + bisect)."""
+
+    BACKEND = "python"
 
     def __init__(self, init_version: int = 0):
         # Invariant: _keys[0] == b"" always; _vals[i] covers [_keys[i], _keys[i+1}).

@@ -30,6 +30,8 @@ _POINT_KEY_BYTES = 8  # max key length the point bucket stores
 class PointConflictSet(TpuConflictSet):
     """Latest-version-per-key map on device; single-sort merge step."""
 
+    BACKEND = "tpu-point"
+
     def __init__(self, init_version: int = 0, key_bytes: int = _POINT_KEY_BYTES,
                  capacity: int = _MIN_CAP):
         self._init_version = init_version  # read by _initial_state hooks
@@ -116,6 +118,7 @@ class PointConflictSet(TpuConflictSet):
         nrp = next_pow2(max(nr, _KERNEL_MIN_RANGES))
         nwp = next_pow2(max(nw, _KERNEL_MIN_RANGES))
         self._audit_capacity(nw)  # one state row per point write
+        self._note_occupancy(n, npad, nr, nrp, nw, nwp)
 
         snap_off = np.clip(snapshots - self._base, 0, SNAP_CLAMP).astype(np.int32)
         snap_p = np.zeros(npad, np.int32)

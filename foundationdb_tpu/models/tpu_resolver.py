@@ -27,6 +27,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..flow.stats import CounterCollection
 from .conflict_set import (COMMITTED, CONFLICT, TOO_OLD, ConflictSetBase,
                            ResolverTransaction)
 
@@ -38,6 +39,8 @@ _MIN_CAP = 1 << 10
 
 
 class TpuConflictSet(ConflictSetBase):
+    BACKEND = "tpu"
+
     def __init__(self, init_version: int = 0, key_bytes: int = 32,
                  capacity: int = _MIN_CAP):
         if key_bytes % 4:
@@ -61,6 +64,11 @@ class TpuConflictSet(ConflictSetBase):
         # the dominant stall of the streamed bench)
         self._count_async: list = []
         self._rows_since_async = 0
+        # per-backend-instance occupancy profile (ref: the reference's
+        # ProxyStats-style accounting, here for the device batch shape:
+        # real rows vs padded slots is THE quantity the shape-bucketing
+        # trades against recompiles)
+        self.profile = CounterCollection(f"{self.BACKEND}_kernel")
         self._hk, self._hv = self._to_device(*self._initial_state(init_version))
 
     def _initial_state(self, init_version: int):
@@ -326,6 +334,44 @@ class TpuConflictSet(ConflictSetBase):
             self._grow(self._count_hint + new_rows)
         self._count_hint = min(self._cap - 1, self._count_hint + new_rows)
 
+    def _note_occupancy(self, n, npad, nr, nrp, nw, nwp) -> None:
+        """Per-batch pad-shape accounting: real rows vs padded slots per
+        dimension. Occupancy = rows/slots over a window; chronically low
+        ratios mean the bucket floors are wasting device time, chronic
+        recompiles (ops counters) mean they're too tight."""
+        p = self.profile
+        p.counter("batches").add(1)
+        p.counter("txns").add(int(n))
+        p.counter("txn_slots").add(int(npad))
+        p.counter("reads").add(int(nr))
+        p.counter("read_slots").add(int(nrp))
+        p.counter("writes").add(int(nw))
+        p.counter("write_slots").add(int(nwp))
+
+    def kernel_stats(self) -> dict:
+        """This backend INSTANCE's status-ready profile: pad sizes,
+        occupancy, backend + platform name, state rows. The jitted
+        compile/execute counters are per-process (the lru-cached
+        kernels are shared across instances), so they are reported ONCE
+        at cluster level by the status assembler — folding them here
+        would attribute every instance's compiles to every resolver."""
+        import jax
+        snap = self.profile.snapshot()
+        occ = {}
+        for dim in ("txn", "read", "write"):
+            rows = snap.get(f"{dim}s", 0)
+            slots = snap.get(f"{dim}_slots", 0)
+            occ[dim] = round(rows / slots, 4) if slots else None
+        return {"backend": self.BACKEND,
+                "platform": jax.default_backend(),
+                "capacity": self._cap,
+                "state_rows": self._count_hint,
+                "batches": snap.get("batches", 0),
+                "occupancy": occ,
+                # raw real-row and padded-slot totals per dimension
+                "counts": {k: v for k, v in snap.items()
+                           if k != "batches"}}
+
     def _call_kernel(self, npad, nrp, nwp, args):
         """Run one padded batch through the single-shard jitted kernel.
 
@@ -350,6 +396,7 @@ class TpuConflictSet(ConflictSetBase):
         nrp = next_pow2(max(nr, _KERNEL_MIN_RANGES))
         nwp = next_pow2(max(nw, _KERNEL_MIN_RANGES))
         self._audit_capacity(2 * nw)
+        self._note_occupancy(n, npad, nr, nrp, nw, nwp)
 
         snap_off = np.clip(snapshots - self._base, 0, SNAP_CLAMP).astype(np.int32)
         snap_p = np.zeros(npad, np.int32)

@@ -59,16 +59,78 @@ simulator can replay identical verdicts vs the CPU baselines
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..flow.stats import CounterCollection
 from .keys import searchsorted_i32
 from .rmq import VDEAD, build_range_max_table, range_max
 
 SNAP_CLAMP = (1 << 30) + 1  # above any storable version offset
 REBASE_THRESHOLD = 1 << 30
+
+# Per-process kernel profile (ref: the reference's --knob_profiling
+# GetHistogram metrics around the conflict batch): every jitted resolve
+# family accounts compiles, compile time, and sampled execute time here;
+# the resolver role folds the snapshot into status/trace rollups.
+g_kernel_counters = CounterCollection("conflict_kernel")
+
+
+def profile_kernel(fn, kernel: str,
+                   counters: CounterCollection = g_kernel_counters):
+    """Wrap a jitted kernel with compile/execute accounting.
+
+    The FIRST call per wrapped function (one shape bucket each, thanks
+    to the lru_caches below) is always fenced with block_until_ready —
+    that delta is dominated by XLA compilation, the single most
+    important number when an interval/streamed ratio regresses
+    (recompiles show up as `compiles` climbing past the bucket count).
+    Afterward only 1-in-KERNEL_PROFILE_EVERY dispatches are fenced, so
+    the async dispatch pipeline the streamed bench depends on stays
+    intact; 0 disables the periodic fence entirely."""
+    from ..flow.knobs import SERVER_KNOBS
+    state = {"compiled": False, "calls": 0}
+    # counter objects and name strings are invariant per wrapped
+    # kernel: bind them once so the unfenced hot path (the streamed
+    # pipeline with KERNEL_PROFILE_EVERY=0) pays one increment and one
+    # knob read per dispatch, not f-string builds and dict lookups
+    calls_c = counters.counter(f"{kernel}.calls")
+
+    def call(*args):
+        state["calls"] += 1
+        first = not state["compiled"]
+        if not first:
+            every = int(SERVER_KNOBS.kernel_profile_every)
+            if not every or state["calls"] % every:
+                calls_c.add(1)
+                return fn(*args)
+        # drain already-queued async device work first (the inputs are
+        # the producer chain): the fenced delta must time THIS dispatch,
+        # not the pipeline backlog behind it
+        jax.block_until_ready(args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        calls_c.add(1)
+        if first:
+            state["compiled"] = True
+            counters.counter(f"{kernel}.compiles").add(1)
+            counters.counter(f"{kernel}.compile_us").add(int(dt * 1e6))
+            from ..flow.trace import SevDebug, TraceEvent
+            TraceEvent("KernelCompile", kernel,
+                       severity=SevDebug).detail(
+                Backend=jax.default_backend(),
+                Seconds=round(dt, 6)).log()
+        else:
+            counters.counter(f"{kernel}.timed_calls").add(1)
+            counters.counter(f"{kernel}.execute_us").add(int(dt * 1e6))
+        return out
+
+    return call
 
 
 def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
@@ -338,7 +400,9 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
 def make_resolve_fn(cap: int, n_txns: int, n_reads: int, n_writes: int,
                     n_words: int):
     """Jitted single-shard resolve step (see make_resolve_core)."""
-    return jax.jit(make_resolve_core(cap, n_txns, n_reads, n_writes, n_words))
+    fn = jax.jit(make_resolve_core(cap, n_txns, n_reads, n_writes, n_words))
+    return profile_kernel(
+        fn, f"resolve[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w]")
 
 
 @functools.lru_cache(maxsize=None)

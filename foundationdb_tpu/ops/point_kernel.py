@@ -58,11 +58,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .conflict_kernel import SNAP_CLAMP
+from ..flow.stats import CounterCollection
+from .conflict_kernel import SNAP_CLAMP, profile_kernel
 from .keys import searchsorted_i32, searchsorted_rows
 
 VMASK = SNAP_CLAMP + 1  # version column for masked rows (sorts, never read)
 INF = 0xFFFFFFFF
+
+# point-kernel compile/execute accounting, separate from the interval
+# family so the fast path's recompiles are visible on their own
+g_kernel_counters = CounterCollection("point_kernel")
 
 
 def _seg_or_scan(vals, seg_start):
@@ -202,8 +207,11 @@ def make_point_resolve_core(cap: int, n_txns: int, n_reads: int,
 def make_point_resolve_fn(cap: int, n_txns: int, n_reads: int,
                           n_writes: int, n_words: int):
     """Jitted point-mode resolve step (see make_point_resolve_core)."""
-    return jax.jit(
+    fn = jax.jit(
         make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words))
+    return profile_kernel(
+        fn, f"point[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w]",
+        g_kernel_counters)
 
 
 def pack_point_batch(snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid):
@@ -262,4 +270,7 @@ def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
         return core(sk, sv, snap, too_old, rk, rtxn, rvalid,
                     wk, wtxn, wvalid, commit, oldest, init_off)
 
-    return jax.jit(packed)
+    return profile_kernel(
+        jax.jit(packed),
+        f"point_packed[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w]",
+        g_kernel_counters)

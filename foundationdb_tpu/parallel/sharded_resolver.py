@@ -72,6 +72,7 @@ class ShardedTpuConflictSet(TpuConflictSet):
     """
 
     AXIS = "resolvers"
+    BACKEND = "sharded-tpu"
 
     def __init__(self, init_version: int = 0, key_bytes: int = 32,
                  capacity: int = _MIN_CAP, mesh=None,
@@ -155,7 +156,7 @@ class ShardedTpuConflictSet(TpuConflictSet):
         import jax
         from jax.sharding import PartitionSpec as P
 
-        from ..ops.conflict_kernel import make_resolve_core
+        from ..ops.conflict_kernel import make_resolve_core, profile_kernel
 
         try:
             from jax import shard_map
@@ -167,13 +168,25 @@ class ShardedTpuConflictSet(TpuConflictSet):
         wrapped = _clip_and_resolve(core)
         sharded = P(self.AXIS)
         repl = P()
-        fn = jax.jit(shard_map(
-            wrapped, mesh=self._mesh,
+        specs = dict(
+            mesh=self._mesh,
             in_specs=(sharded, sharded, sharded, sharded,
                       repl, repl, repl, repl, repl, repl,
                       repl, repl, repl, repl, repl, repl),
-            out_specs=(sharded, sharded, sharded, sharded),
-            check_vma=False))
+            out_specs=(sharded, sharded, sharded, sharded))
+        # the replication-check kwarg was renamed check_rep -> check_vma
+        # across jax releases; disable it under whichever name this
+        # jax accepts (the psum'd fixpoint is deliberately mixed
+        # replicated/sharded)
+        try:
+            fn = jax.jit(shard_map(wrapped, check_vma=False, **specs))
+        except TypeError:
+            fn = jax.jit(shard_map(wrapped, check_rep=False, **specs))
+        # same compile/execute accounting as the single-shard families:
+        # the sharded kernels have the most expensive compiles, so
+        # bucket churn must be visible in the process-wide profile too
+        fn = profile_kernel(
+            fn, f"sharded[{self._cap}c/{npad}t/{nrp}r/{nwp}w]")
         self._shard_fns[key] = fn
         return fn
 

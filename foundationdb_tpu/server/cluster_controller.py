@@ -28,6 +28,20 @@ from .types import CLEAR_RANGE, SET_VALUE, MetadataMutations
 from .worker import RegisterWorkerRequest
 
 
+def _global_kernel_counters() -> dict:
+    """Process-wide jitted-kernel profile for status. Guarded through
+    sys.modules: a python-backend cluster never imported the ops layer,
+    and status must not be the thing that drags jax in."""
+    import sys
+    out: dict = {}
+    for mod in ("foundationdb_tpu.ops.conflict_kernel",
+                "foundationdb_tpu.ops.point_kernel"):
+        m = sys.modules.get(mod)
+        if m is not None:
+            out.update(m.g_kernel_counters.snapshot())
+    return out
+
+
 class ClusterConfig(NamedTuple):
     """(ref: DatabaseConfiguration — the subset this slice understands)"""
 
@@ -131,6 +145,7 @@ class ClusterController:
         self._storage_objs: dict = {}      # name -> StorageServer (registry)
         # (instance name, counter) -> TimeSeries (ref: TDMetric levels)
         self.metrics: dict = {}
+        self._metric_gauges: set = set()   # (rn, cn) sampled via set()
         self._rr = 0                       # recruitment round-robin
         self._seq = 0                      # dbinfo broadcast counter
         self._actors = flow.ActorCollection()
@@ -152,6 +167,7 @@ class ClusterController:
                            (self._dd_loop(), "dataDistribution"),
                            (self._failure_monitor_loop(), "failureMonitor"),
                            (self._metric_sampler_loop(), "metricSampler"),
+                           (self._trace_counters_loop(), "traceCounters"),
                            (self._latency_probe_loop(), "latencyProbe"),
                            (self._conf_sync_loop(), "confSync")):
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
@@ -213,17 +229,56 @@ class ClusterController:
                     stats = getattr(role, "stats", None)
                     if stats is None:
                         continue
-                    for cname, value in stats.snapshot().items():
+                    for cname, c in stats.counters.items():
                         ts = self.metrics.get((rn, cname))
                         if ts is None:
                             ts = self.metrics[(rn, cname)] = \
                                 flow.TimeSeries()
-                        ts.append(now, value)
+                        if c.gauge:
+                            self._metric_gauges.add((rn, cname))
+                        ts.append(now, c.value)
             # prune series of retired roles (old epochs, vacated
             # replicas): unbounded growth and stale 'latest' values
             # otherwise leak into every status document
             for key in [k for k in self.metrics if k[0] not in known]:
                 del self.metrics[key]
+                self._metric_gauges.discard(key)
+
+    async def _trace_counters_loop(self) -> None:
+        """Roll every live role's CounterCollection into a periodic
+        `*Metrics` TraceEvent with per-interval rates (ref:
+        traceCounters, flow/Stats.actor.cpp — the reference's roles each
+        run their own loop; the sim's registry lets one loop cover all
+        of them). This is what turns the raw counters into rates an
+        operator — or a later BENCH round — can actually read."""
+        prev: dict = {}          # role name -> (snapshot, taken_at)
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.trace_counters_interval,
+                             TaskPriority.LOW_PRIORITY)
+            now = flow.now()
+            known: set = set()
+            for wi in self.workers.values():
+                # a rebooting worker's rate baselines survive the
+                # reboot window (same rule as the metric sampler): a
+                # missed tick must not blank the very rates an operator
+                # reads around a fault. Only registry-departed roles
+                # are pruned. Rates divide by each baseline's own age,
+                # so a role that missed ticks reports a correct average
+                # instead of an inflated one.
+                known.update(wi.worker.roles.keys())
+                if not wi.worker.process.alive:
+                    continue
+                for rn, role in wi.worker.roles.items():
+                    stats = getattr(role, "stats", None)
+                    if stats is None:
+                        continue
+                    p = prev.get(rn)
+                    snap = stats.trace(
+                        id=rn, elapsed=now - p[1] if p else None,
+                        prev=p[0] if p else None)
+                    prev[rn] = (snap, now)
+            for key in [k for k in prev if k not in known]:
+                del prev[key]
 
     async def _failure_monitor_loop(self) -> None:
         """Heartbeat every registered worker over the network and PUSH
@@ -941,7 +996,9 @@ class ClusterController:
                     entry.update(
                         durable_version=obj.version.get(),
                         queue_length=len(obj.entries),
-                        counters=obj.stats.snapshot())
+                        counters=obj.stats.snapshot(),
+                        latency_bands={
+                            "commit": obj.commit_bands.snapshot()})
             logs.append(entry)
         storages = []
         for s in info.storages:
@@ -965,7 +1022,9 @@ class ClusterController:
             storages.append(entry)
         from .proxy import Proxy
         from .ratekeeper import Ratekeeper
+        from .resolver_role import Resolver
         proxies = []
+        resolvers = []
         rate = None
         for wi in self.workers.values():
             for rn, role in wi.worker.roles.items():
@@ -977,6 +1036,17 @@ class ClusterController:
                         "latency_bands": {
                             "grv": role.grv_bands.snapshot(),
                             "commit": role.commit_bands.snapshot()}})
+                elif isinstance(role, Resolver) and \
+                        f"-e{info.epoch}-" in rn:
+                    resolvers.append({
+                        "name": rn,
+                        "version": role.version.get(),
+                        "counters": role.stats.snapshot(),
+                        "latency_bands": {
+                            "resolve": role.resolve_bands.snapshot()},
+                        # device-kernel profile: pad occupancy +
+                        # compile/execute accounting ({} off-device)
+                        "kernel": role.kernel_stats()})
                 elif isinstance(role, Ratekeeper) and \
                         rn.endswith(f"-e{info.epoch}"):
                     rate = role.rate
@@ -990,6 +1060,12 @@ class ClusterController:
                 "logs": logs,
                 "storages": storages,
                 "proxies": proxies,
+                "resolvers": resolvers,
+                # process-global jitted-kernel accounting (compiles,
+                # compile-vs-execute time per shape bucket): reported
+                # once — the compiled kernels are shared across every
+                # backend instance in this process
+                "kernels": _global_kernel_counters(),
                 "qos": {"transactions_per_second_limit": rate},
                 "latency_probe": getattr(self, "_latency_probe", {}),
                 # multi-resolution counter time series (ref: TDMetric):
@@ -999,6 +1075,7 @@ class ClusterController:
                         "latest": ts.latest(),
                         "tail": ts.series(0)[-5:],
                         "levels": [len(lv) for lv in ts.levels],
+                        "gauge": (rn, cn) in self._metric_gauges,
                     }
                     for (rn, cn), ts in sorted(self.metrics.items())},
                 # run-loop profiler (ref: Net2 slow-task sampling /

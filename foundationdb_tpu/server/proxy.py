@@ -215,10 +215,11 @@ class Proxy:
         self._suspect_peers = {}       # id(ref) -> suspect-until time
         # (ref: ProxyStats — txn admission/commit counters for status)
         self.stats = flow.CounterCollection("proxy")
-        # banded request latencies (ref: LatencyBandConfig applied to
-        # GRV and commit in status)
-        self.grv_bands = flow.LatencyBands("grv")
-        self.commit_bands = flow.LatencyBands("commit")
+        # banded request latencies + recent-latency reservoirs (ref:
+        # LatencyBandConfig applied to GRV and commit in status, plus
+        # the LatencySample percentile surface)
+        self.grv_bands = flow.RequestLatency("grv")
+        self.commit_bands = flow.RequestLatency("commit")
         self.commits = RequestStream(process)
         self.grvs = RequestStream(process)
         self.raw_committed = RequestStream(process)
@@ -551,6 +552,11 @@ class Proxy:
         replies = [p for _, p in batch]
         dbg = self._debug_ids(reqs)
         self._mark(dbg, "MasterProxyServer.commitBatch.Before")
+        # span per sampled txn: the proxy leg of the commit tree; the
+        # resolver/tlog legs opened downstream auto-parent onto it
+        # while it stays open (ref: Span commit tracing, flow/Tracing.h)
+        spans = flow.g_trace_batch.begin_spans(
+            dbg, "MasterProxyServer.commitBatch")
         try:
             # phase 1: version assignment, ordered with this proxy's
             # earlier batches by local batch number (the finally below
@@ -634,7 +640,8 @@ class Proxy:
             await self.batch_logging.when_at_least(local - 1)
             creq = TLogCommitRequest(ver.prev_version, ver.version,
                                      tuple(mutations),
-                                     self.committed_version.get())
+                                     self.committed_version.get(),
+                                     debug_ids=dbg)
             log_done = flow.all_of([ref.get_reply(creq, self.process)
                                     for ref in self.tlog_refs])
             self._advance(self.batch_logging, local)
@@ -690,6 +697,7 @@ class Proxy:
             for reply in replies:
                 reply.send_error(e)
         finally:
+            flow.g_trace_batch.finish_spans(spans)
             self._advance(self.batch_resolving, local)
             self._advance(self.batch_logging, local)
 

@@ -36,6 +36,9 @@ class Resolver:
         self.key_hist = [0] * 256
         self.metrics = RequestStream(process)
         self.stats = flow.CounterCollection("resolver")
+        # banded + sampled batch-resolve latency (the resolver stage of
+        # the commit pipeline; ref: LatencyBands in status)
+        self.resolve_bands = flow.RequestLatency("resolve")
         self._pressure_traced = False
         self._actors = flow.ActorCollection()
         # reply cache for duplicate delivery (proxy retry after a broken
@@ -80,7 +83,7 @@ class Resolver:
                        TaskPriority.PROXY_RESOLVER_REPLY)
 
     async def _resolve_batch(self, req: ResolveRequest, reply):
-        self._mark(req, "Resolver.resolveBatch.Before")
+        t0 = flow.now()
         # order batches by version, whatever the arrival order
         await self.version.when_at_least(req.prev_version)
         if self.version.get() >= req.version:
@@ -94,37 +97,62 @@ class Resolver:
             reply.send(cached if cached is not None
                        else [0] * len(req.transactions))
             return
-        txns = [ResolverTransaction(t.read_snapshot, t.read_conflict_ranges,
-                                    t.write_conflict_ranges)
-                for t in req.transactions]
-        for t in txns:
-            for b, _e in t.read_ranges:
-                self.key_hist[b[0] if b else 0] += 1
-            for b, _e in t.write_ranges:
-                self.key_hist[b[0] if b else 0] += 1
-            self.work_units += len(t.read_ranges) + len(t.write_ranges)
-        new_oldest = max(0, req.version - self._mwtlv)
+        # resolver-leg stations + spans fire only on ACCEPTED first
+        # deliveries (after the duplicate check): a proxy retry must
+        # not file a phantom second resolver leg — or an unpaired
+        # opening station — into the sampled stitching. Named for
+        # where it sits (ref: the reference's post-version-ordering
+        # AfterQueueSorted station) so a prev_version stall reads as
+        # in-resolver ordering wait, not proxy->resolver network time.
+        # Spans auto-parent onto the proxy's open commitBatch span.
+        self._mark(req, "Resolver.resolveBatch.AfterQueueSorted")
+        spans = flow.g_trace_batch.begin_spans(
+            getattr(req, "debug_ids", ()), "Resolver.resolveBatch")
         try:
-            verdicts = self.conflict_set.resolve(txns, req.version, new_oldest)
-        except (ValueError, OverflowError) as e:
-            # A malformed batch (e.g. a key wider than the backend's key
-            # bucket) must not wedge the pipeline: conflict the whole
-            # batch — clients see not_committed and retry — and still
-            # advance the version so later batches proceed.
-            flow.cover("resolver.batch.rejected")
-            flow.TraceEvent("ResolverBatchRejected", self.process.name,
-                            severity=flow.trace.SevWarnAlways).detail(
-                Version=req.version, Error=str(e)).log()
-            verdicts = [0] * len(req.transactions)
-            self.conflict_set.resolve([], req.version, new_oldest)
-        self._reply_cache[req.version] = verdicts
-        self._reply_order.append(req.version)
-        while len(self._reply_order) > self._cache_cap:
-            self._reply_cache.pop(self._reply_order.popleft(), None)
-        self.version.set(req.version)
-        self._mark(req, "Resolver.resolveBatch.After")
-        reply.send(verdicts)
-        self._check_state_pressure(req.version)
+            txns = [ResolverTransaction(t.read_snapshot,
+                                        t.read_conflict_ranges,
+                                        t.write_conflict_ranges)
+                    for t in req.transactions]
+            for t in txns:
+                for b, _e in t.read_ranges:
+                    self.key_hist[b[0] if b else 0] += 1
+                for b, _e in t.write_ranges:
+                    self.key_hist[b[0] if b else 0] += 1
+                self.work_units += len(t.read_ranges) + len(t.write_ranges)
+            new_oldest = max(0, req.version - self._mwtlv)
+            try:
+                verdicts = self.conflict_set.resolve(txns, req.version,
+                                                     new_oldest)
+            except (ValueError, OverflowError) as e:
+                # A malformed batch (e.g. a key wider than the backend's key
+                # bucket) must not wedge the pipeline: conflict the whole
+                # batch — clients see not_committed and retry — and still
+                # advance the version so later batches proceed.
+                flow.cover("resolver.batch.rejected")
+                flow.TraceEvent("ResolverBatchRejected", self.process.name,
+                                severity=flow.trace.SevWarnAlways).detail(
+                    Version=req.version, Error=str(e)).log()
+                verdicts = [0] * len(req.transactions)
+                self.conflict_set.resolve([], req.version, new_oldest)
+            self._reply_cache[req.version] = verdicts
+            self._reply_order.append(req.version)
+            while len(self._reply_order) > self._cache_cap:
+                self._reply_cache.pop(self._reply_order.popleft(), None)
+            self.version.set(req.version)
+            self._mark(req, "Resolver.resolveBatch.After")
+            self.stats.counter("batches_resolved").add(1)
+            self.stats.counter("transactions_resolved").add(len(txns))
+            self.resolve_bands.record(flow.now() - t0)
+            reply.send(verdicts)
+            self._check_state_pressure(req.version)
+        finally:
+            flow.g_trace_batch.finish_spans(spans)
+
+    def kernel_stats(self) -> dict:
+        """The conflict backend's device-kernel profile (occupancy,
+        compile/execute accounting) for the status document; {} for
+        host-only backends."""
+        return self.conflict_set.kernel_stats()
 
     def state_size(self) -> int:
         """Conflict-history row estimate across backends (boundary rows

@@ -537,7 +537,9 @@ class StorageServer:
         self._watch_map: Dict[bytes, list] = {}
         # (ref: StorageServer::counters — query/mutation accounting)
         self.stats = flow.CounterCollection("storage")
-        self.read_bands = flow.LatencyBands("read")
+        # banded + sampled point-read latency (ref: LatencyBandConfig's
+        # read bands in status)
+        self.read_bands = flow.RequestLatency("read")
         # byte sample + write bandwidth for DD sizing decisions
         self.metrics = StorageMetrics()
         self._actors = flow.ActorCollection()
@@ -1133,14 +1135,35 @@ class StorageServer:
 
     async def _serve_get(self, req: StorageGetRequest, reply):
         t0 = flow.now()
+        dbg = getattr(req, "debug_id", None)
+        admitted = False
         try:
             self.stats.counter("get_queries").add(1)
             self._check_owned(req.key, None)
             await self._wait_version(req.version)
+            if dbg is not None:
+                # the storage leg of a sampled read (ref: the
+                # GetValueDebug stations in storageserver.actor.cpp
+                # getValueQ). Emitted only once the read is actually
+                # admitted — a wrong-shard/too-old rejection must not
+                # file an unpaired DoRead into the stitching
+                flow.g_trace_batch.add_event(
+                    "GetValueDebug", dbg,
+                    "StorageServer.getValue.DoRead")
+                admitted = True
             value = self.data.get(req.key, req.version)
             self.read_bands.record(flow.now() - t0)
+            if dbg is not None:
+                flow.g_trace_batch.add_event(
+                    "GetValueDebug", dbg,
+                    "StorageServer.getValue.AfterRead")
             reply.send(value)
         except flow.FdbError as e:
+            if admitted:
+                # pair-closing error station — only when a DoRead
+                # opened the pair (ref: getValueQ's error path tracing)
+                flow.g_trace_batch.add_event(
+                    "GetValueDebug", dbg, "StorageServer.getValue.Error")
             reply.send_error(e)
 
     async def _range_loop(self):

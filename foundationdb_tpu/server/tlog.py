@@ -82,6 +82,8 @@ class TLog:
         self._dq_lock = FlowLock()
         # (ref: TLogData counters: commits/bytes for status + ratekeeper)
         self.stats = flow.CounterCollection("tlog")
+        # banded + sampled commit durability latency (accept -> fsync ack)
+        self.commit_bands = flow.RequestLatency("commit")
         self._recovered = flow.Future()
         self._actors = flow.ActorCollection()
 
@@ -176,6 +178,17 @@ class TLog:
             flow.cover("tlog.commit.stopped")
             reply.send_error(error("tlog_stopped"))
             return
+        # the log-leg stations fire only on ACCEPTED first deliveries:
+        # a stopped rejection or a duplicate proxy retry must not file
+        # a phantom extra tlog leg into a sampled commit's stitching
+        # (same invariant as the resolver's duplicate-delivery guard).
+        # Named for where it actually sits — after the version-ordering
+        # wait, before the fsync — so a stitched timeline attributes a
+        # prev_version stall to the gap before this station, not to
+        # the fsync leg
+        flow.g_trace_batch.add_events(
+            getattr(req, "debug_ids", ()), "CommitDebug",
+            "TLog.tLogCommit.AfterWaitForVersion")
         self.queue_version.set(req.version)
         self.stats.counter("commits").add(1)
         self.stats.counter("mutations").add(len(req.mutations))
@@ -189,10 +202,30 @@ class TLog:
                    TaskPriority.TLOG_COMMIT_REPLY)
 
     async def _make_durable(self, req: TLogCommitRequest, reply):
+        t0 = flow.now()
+        dbg = getattr(req, "debug_ids", ())
+        # the log leg of the commit span tree: spans open at fsync
+        # start and close at the durability ack, parented onto the
+        # proxy's still-open commitBatch span for each sampled txn
+        spans = flow.g_trace_batch.begin_spans(dbg, "TLog.tLogCommit")
+        try:
+            await self._do_durable(req)
+        finally:
+            flow.g_trace_batch.finish_spans(spans)
+        version = req.version
+        if self.version.get() < version:
+            self.version.set(version)
+        flow.g_trace_batch.add_events(
+            dbg, "CommitDebug", "TLog.tLogCommit.AfterTLogCommit")
+        self.commit_bands.record(flow.now() - t0)
+        reply.send(version)
+
+    async def _do_durable(self, req: TLogCommitRequest):
         """Durability: DiskQueue push+commit (ref: doQueueCommit), or the
         simulated fsync delay in memory mode. The FlowLock is FIFO and
         durable actors are spawned in version order, so log records land
-        on disk in version order."""
+        on disk in version order. The caller (_make_durable) advances
+        the durable version and acks."""
         version = req.version
         if self._dq is None:
             if flow.buggify("tlog/slow_fsync"):
@@ -223,9 +256,6 @@ class TLog:
                 e = self.entries[i]
                 self.entries[i] = (e[0], e[1], seq)
             self._maybe_spill()
-        if self.version.get() < version:
-            self.version.set(version)
-        reply.send(version)
 
     def _maybe_spill(self) -> None:
         """Spill the oldest durable entries once in-memory payload bytes

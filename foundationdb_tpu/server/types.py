@@ -140,6 +140,9 @@ class ResolveRequest(NamedTuple):
 class StorageGetRequest(NamedTuple):
     key: bytes
     version: int
+    # sampled-read stitching token (ref: the debugID on GetValueRequest
+    # driving the GetValueDebug trace-batch stations)
+    debug_id: Optional[int] = None
 
 
 class StorageGetRangeRequest(NamedTuple):
@@ -183,6 +186,9 @@ class TLogCommitRequest(NamedTuple):
     version: int
     mutations: Tuple[TaggedMutation, ...]
     known_committed: int = 0
+    # sampled txns in the batch (ref: the debugID on TLogCommitRequest
+    # driving the TLog commit-debug stations)
+    debug_ids: Tuple[int, ...] = ()
 
 
 class TLogPeekRequest(NamedTuple):

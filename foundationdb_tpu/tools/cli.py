@@ -27,7 +27,10 @@ Commands (ref: fdbcli):
   getrange <begin> <end> [limit]   read a range
   getkey <sel> <key> [offset]      resolve a key selector
                              (sel: lt | le | gt | ge)
-  status [json]              cluster status
+  status [json|details]      cluster status (details: per-stage
+                             latency bands, percentiles, kernel
+                             profile)
+  metrics                    counter time series (latest + rates)
   configure <k>=<v> ...      change the cluster shape (proxies,
                              resolvers, logs, conflict_backend)
   exclude <worker>           bar a worker from hosting roles
@@ -62,6 +65,98 @@ def _unescape(tok: str) -> bytes:
 def _printable(b: bytes) -> str:
     return "".join(chr(c) if 32 <= c < 127 and c != 92 else f"\\x{c:02x}"
                    for c in b)
+
+
+def _band_line(who: str, kind: str, b: dict) -> str:
+    """One latency-surface row: totals, reservoir percentiles, and the
+    fraction under a mid/wide band (the numbers an operator scans for
+    'where does a commit's time go')."""
+    total = b.get("total", 0)
+    bands = b.get("bands", {})
+
+    def frac(th):
+        return f"{bands[th] / total:.0%}" if total and th in bands else "-"
+    return (f"  {who:<26} {kind:<8} n={total:<7}"
+            f" p50={b.get('p50', 0):<9g} p90={b.get('p90', 0):<9g}"
+            f" p99={b.get('p99', 0):<9g} max={b.get('max_seconds', 0):<9g}"
+            f" <=5ms:{frac('<=0.005s'):<5} <=100ms:{frac('<=0.1s')}")
+
+
+def _render_details(cl: dict) -> str:
+    """`status details`: the per-stage latency + kernel-profile view
+    (ref: fdbcli `status details` folding LatencyBands and role
+    metrics)."""
+    lines = [f"Epoch {cl['epoch']} — {cl['recovery_state']}",
+             "Latency (seconds):"]
+    for p in cl.get("proxies", ()):
+        for kind in ("grv", "commit"):
+            lines.append(_band_line(p["name"], kind,
+                                    p["latency_bands"][kind]))
+    for r in cl.get("resolvers", ()):
+        lines.append(_band_line(r["name"], "resolve",
+                                r["latency_bands"]["resolve"]))
+    for lg in cl.get("logs", ()):
+        if "latency_bands" in lg:
+            lines.append(_band_line(lg["store"], "logfsync",
+                                    lg["latency_bands"]["commit"]))
+    seen_reps: set = set()
+    for s in cl.get("storages", ()):
+        for rep in s["replicas"]:
+            # the storages list is per SHARD; one server hosting many
+            # shards carries the same snapshot in each — render each
+            # server once
+            if "latency_bands" in rep and rep["name"] not in seen_reps:
+                seen_reps.add(rep["name"])
+                lines.append(_band_line(rep["name"], "read",
+                                        rep["latency_bands"]["read"]))
+    kern = [(r["name"], r["kernel"]) for r in cl.get("resolvers", ())
+            if r.get("kernel")]
+    if kern:
+        lines.append("Resolver kernels:")
+        for name, k in kern:
+            occ = ", ".join(f"{d}={v if v is not None else '-'}"
+                            for d, v in k.get("occupancy", {}).items())
+            lines.append(
+                f"  {name:<26} backend={k['backend']} "
+                f"platform={k['platform']} batches={k['batches']} "
+                f"rows={k['state_rows']}/{k['capacity']} occ[{occ}]")
+    if cl.get("kernels"):
+        lines.append("Kernel compile/execute (process-wide):")
+        for kn, v in sorted(cl["kernels"].items()):
+            lines.append(f"  {kn} = {v}")
+    rl = cl.get("run_loop", {})
+    if rl:
+        lines.append(f"Run loop: tasks={rl.get('tasks_run')} "
+                     f"busy={rl.get('busy_seconds')}s")
+        for t in rl.get("slow_tasks", ()):
+            lines.append(f"  slow: {t['seconds']:<8} {t['task']}")
+    probe = cl.get("latency_probe") or {}
+    if probe:
+        lines.append("Probe: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(probe.items())))
+    return "\n".join(lines)
+
+
+def _render_metrics(cl: dict) -> str:
+    """`metrics`: the TDMetric-style counter series — latest value plus
+    a rate computed over the fine-grained tail."""
+    lines = ["metric                                            "
+             "latest      rate/s"]
+    for name, s in sorted(cl.get("metrics", {}).items()):
+        latest = s.get("latest")
+        tail = s.get("tail") or []
+        rate = ""
+        # same semantics as the *Metrics rollup: gauges are levels
+        # (no derivative), and a negative delta is a role restart
+        # (re-baseline), not a rate
+        if s.get("gauge"):
+            rate = "(gauge)"
+        elif len(tail) >= 2 and tail[-1][0] > tail[0][0] and \
+                tail[-1][1] >= tail[0][1]:
+            rate = f"{(tail[-1][1] - tail[0][1]) / (tail[-1][0] - tail[0][0]):.2f}"
+        val = latest[1] if latest else "-"
+        lines.append(f"{name:<48}  {val:<10}  {rate}")
+    return "\n".join(lines)
 
 
 class Cli:
@@ -162,12 +257,18 @@ class Cli:
                 return "ERROR: writemode requires `on' or `off'"
             self.writemode = raw[0] == "on"
             return ""
+        if cmd == "metrics":
+            async def mt():
+                return await self.db.get_status()
+            return _render_metrics(self._run(mt())["cluster"])
         if cmd == "status":
             async def st():
                 return await self.db.get_status()
             doc = self._run(st())
             if raw and raw[0] == "json":
                 return json.dumps(doc, indent=2, sort_keys=True)
+            if raw and raw[0] == "details":
+                return _render_details(doc["cluster"])
             cl = doc["cluster"]
             lines = [
                 f"Epoch {cl['epoch']} — {cl['recovery_state']}",

@@ -254,7 +254,7 @@ def test_trace_batch_stitches_commit_path():
             for expect in ("NativeAPI.commit.Before",
                            "MasterProxyServer.commitBatch.Before",
                            "MasterProxyServer.commitBatch.GotCommitVersion",
-                           "Resolver.resolveBatch.Before",
+                           "Resolver.resolveBatch.AfterQueueSorted",
                            "Resolver.resolveBatch.After",
                            "MasterProxyServer.commitBatch.AfterResolution",
                            "MasterProxyServer.commitBatch.AfterLogPush",
@@ -264,7 +264,7 @@ def test_trace_batch_stitches_commit_path():
             idx = [locations.index(l) for l in (
                 "NativeAPI.commit.Before",
                 "MasterProxyServer.commitBatch.Before",
-                "Resolver.resolveBatch.Before",
+                "Resolver.resolveBatch.AfterQueueSorted",
                 "MasterProxyServer.commitBatch.AfterLogPush",
                 "NativeAPI.commit.After")]
             assert idx == sorted(idx), locations

@@ -1,0 +1,249 @@
+"""Trace plumbing: collector flushing, TraceEvent severity floor and
+context-manager form, TraceBatch spill ordering, parented commit spans,
+and the latency band/sample primitives (ref: flow/Trace.h TraceBatch,
+flow/Tracing.h Span, fdbserver/LatencyBandConfig.cpp)."""
+
+import json
+import os
+
+from foundationdb_tpu import flow
+from foundationdb_tpu.flow import trace as trace_mod
+from foundationdb_tpu.flow.latency import LatencyBands, LatencySample
+
+
+def test_trace_collector_flushes_per_emit(tmp_path):
+    """File output is line-buffered: every emitted event reaches the
+    file without an explicit close (the old handle leaked on interpreter
+    exit and buffered writes were lost)."""
+    path = str(tmp_path / "trace.json")
+    tc = trace_mod.TraceCollector(path=path, keep_in_memory=10)
+    tc.emit({"Type": "A", "Severity": 10, "Time": 0.0, "ID": ""})
+    tc.emit({"Type": "B", "Severity": 10, "Time": 1.0, "ID": ""})
+    tc.flush()
+    with open(path) as fh:
+        rows = [json.loads(l) for l in fh.read().splitlines()]
+    assert [r["Type"] for r in rows] == ["A", "B"]
+    # close is idempotent and final
+    tc.close()
+    tc.close()
+    assert tc._fh is None
+
+
+def test_trace_collector_context_manager(tmp_path):
+    path = str(tmp_path / "t.json")
+    with trace_mod.TraceCollector(path=path) as tc:
+        tc.emit({"Type": "X", "Severity": 10, "Time": 0.0, "ID": ""})
+    assert tc._fh is None
+    assert os.path.getsize(path) > 0
+
+
+def test_trace_event_context_manager_logs_once():
+    n0 = flow.g_trace.counts.get("CtxEvent", 0)
+    with flow.TraceEvent("CtxEvent", "t1") as ev:
+        ev.detail(K=1)
+    assert flow.g_trace.counts.get("CtxEvent", 0) == n0 + 1
+    # a second .log() on the same event is a no-op
+    ev.log()
+    assert flow.g_trace.counts.get("CtxEvent", 0) == n0 + 1
+
+
+def test_trace_event_context_manager_records_error():
+    try:
+        with flow.TraceEvent("CtxFail", "t2"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    ev = [e for e in flow.g_trace.events if e["Type"] == "CtxFail"][-1]
+    assert "boom" in ev["Error"]
+
+
+def test_trace_severity_floor_drops_cheaply():
+    """trace_severity_min filters events at construction: a suppressed
+    event allocates no dict and never reaches the collector."""
+    flow.SERVER_KNOBS.set("TRACE_SEVERITY_MIN", flow.trace.SevInfo)
+    try:
+        before = dict(flow.g_trace.counts)
+        ev = flow.TraceEvent("HotLoopDebug", "x",
+                             severity=flow.trace.SevDebug)
+        assert ev._ev is None          # nothing materialized
+        ev.detail(Huge="payload").log()
+        assert flow.g_trace.counts.get("HotLoopDebug", 0) == \
+            before.get("HotLoopDebug", 0)
+        # at-or-above the floor still logs
+        flow.TraceEvent("StillLogged", "x",
+                        severity=flow.trace.SevInfo).log()
+        assert flow.g_trace.counts.get("StillLogged", 0) == \
+            before.get("StillLogged", 0) + 1
+    finally:
+        flow.SERVER_KNOBS.set("TRACE_SEVERITY_MIN", 0)
+
+
+def test_trace_batch_spill_oldest_half_in_order():
+    """Events past MAX_BUFFERED spill OLDEST-HALF-FIRST into the trace
+    stream (in-flight stitches keep recent legs queryable), and the
+    spilled TraceEvents preserve insertion order."""
+    tb = trace_mod.TraceBatch()
+    n0 = flow.g_trace.counts.get("SpillDebug", 0)
+    total = tb.MAX_BUFFERED + 1
+    for i in range(total):
+        tb.add_event("SpillDebug", i, f"loc-{i}")
+    spilled = tb.MAX_BUFFERED // 2
+    assert flow.g_trace.counts.get("SpillDebug", 0) == n0 + spilled
+    # the newest events are still queryable in memory...
+    assert tb.events(total - 1) == [(0.0, "SpillDebug",
+                                     f"loc-{total - 1}")]
+    # ...the oldest are not (they spilled)
+    assert tb.events(0) == []
+    # and the spilled ids are exactly the oldest half, in order
+    ids = [e["ID"] for e in flow.g_trace.events
+           if e["Type"] == "SpillDebug"][-spilled:]
+    assert ids == [str(i) for i in range(spilled)]
+
+
+def test_trace_batch_same_tick_stitches_in_insertion_order():
+    """Same-virtual-tick events must stitch causally (by _seq), not
+    alphabetically by location: 'Z' before 'A' if Z happened first."""
+    tb = trace_mod.TraceBatch()
+    tb.add_event("CommitDebug", 7, "Zeta.first")
+    tb.add_event("CommitDebug", 7, "Alpha.second")
+    tb.add_event("CommitDebug", 7, "Mid.third")
+    locs = [loc for _t, _et, loc in tb.events(7)]
+    assert locs == ["Zeta.first", "Alpha.second", "Mid.third"]
+
+
+def test_span_parenting_and_chain_reassembly():
+    """Nested spans auto-parent on the innermost open span of the same
+    debug id; span_chain rebuilds the tree with depths."""
+    tb = trace_mod.TraceBatch()
+    root = tb.begin_span(42, "client")
+    child = tb.begin_span(42, "proxy")
+    leaf = tb.begin_span(42, "resolver")
+    leaf.finish()
+    with tb.begin_span(42, "tlog"):       # sibling of resolver
+        pass
+    child.finish()
+    root.finish()
+    chain = tb.span_chain(42)
+    assert [(s["location"], s["parent"], s["depth"]) for s in chain] == [
+        ("client", None, 0),
+        ("proxy", "client", 1),
+        ("resolver", "proxy", 2),
+        ("tlog", "proxy", 2),
+    ]
+    # another debug id is untouched
+    assert tb.span_chain(43) == []
+    tb.clear()
+    assert tb.span_chain(42) == []
+
+
+def test_concurrent_same_location_spans_are_siblings():
+    """Two tlogs fsync the same sampled commit concurrently: leg B
+    begins while leg A's identical-location span is still open. They
+    must come out as SIBLINGS under the proxy span, not nested."""
+    tb = trace_mod.TraceBatch()
+    root = tb.begin_span(8, "proxy")
+    a = tb.begin_span(8, "tlog")
+    b = tb.begin_span(8, "tlog")       # a still open
+    b.finish()
+    a.finish()
+    root.finish()
+    chain = tb.span_chain(8)
+    assert [(s["location"], s["parent"], s["depth"]) for s in chain] == [
+        ("proxy", None, 0),
+        ("tlog", "proxy", 1),
+        ("tlog", "proxy", 1),
+    ]
+
+
+def test_latency_bands_bucket_known_distribution():
+    lb = LatencyBands("t", bands=(0.001, 0.01, 0.1))
+    for s in (0.0005, 0.0009, 0.005, 0.05, 0.5):
+        lb.record(s)
+    snap = lb.snapshot()
+    assert snap["total"] == 5
+    assert snap["bands"] == {"<=0.001s": 2, "<=0.01s": 3, "<=0.1s": 4}
+    assert snap["max_seconds"] == 0.5
+    # an exact-threshold latency counts inside its band (<=)
+    lb.record(0.01)
+    assert lb.snapshot()["bands"]["<=0.01s"] == 4
+    # reconfiguring the thresholds resets the histogram
+    lb.add_threshold(0.025)
+    snap2 = lb.snapshot()
+    assert snap2["total"] == 0
+    assert "<=0.025s" in snap2["bands"]
+
+
+def test_latency_sample_percentiles():
+    ls = LatencySample("t", size=100)
+    for i in range(1, 101):                 # 1ms .. 100ms
+        ls.record(i / 1000.0)
+    snap = ls.snapshot()
+    assert snap["count"] == 100
+    assert abs(snap["p50"] - 0.051) < 0.005
+    assert abs(snap["p90"] - 0.091) < 0.005
+    assert snap["max_seconds"] == 0.1
+    # the reservoir slides: after 100 more fast samples the old tail
+    # is forgotten but count/max persist
+    for _ in range(100):
+        ls.record(0.001)
+    snap = ls.snapshot()
+    assert snap["count"] == 200
+    assert snap["p99"] == 0.001
+    assert snap["max_seconds"] == 0.1
+
+
+def test_simulated_commit_emits_full_span_chain():
+    """A sampled commit through the simulated cluster produces the
+    complete client -> proxy -> {resolver, tlog} span tree with
+    monotonic virtual-clock timestamps (the tentpole acceptance
+    criterion), alongside the classic commit-debug stations."""
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=91)
+    try:
+        db = c.client()
+
+        async def main():
+            tr = db.create_transaction()
+            tr.set_option("debug_transaction_identifier", 5150)
+            await tr.get(b"span-k")
+            tr.set(b"span-k", b"v")
+            await tr.commit()
+            return True
+
+        assert c.run(main(), timeout_time=120)
+        chain = flow.g_trace_batch.span_chain(5150)
+        by_loc = {s["location"]: s for s in chain}
+        assert set(by_loc) == {"NativeAPI.commit",
+                               "MasterProxyServer.commitBatch",
+                               "Resolver.resolveBatch",
+                               "TLog.tLogCommit"}
+        root = by_loc["NativeAPI.commit"]
+        proxy = by_loc["MasterProxyServer.commitBatch"]
+        res = by_loc["Resolver.resolveBatch"]
+        tlog = by_loc["TLog.tLogCommit"]
+        assert root["parent"] is None and root["depth"] == 0
+        assert proxy["parent"] == "NativeAPI.commit" and proxy["depth"] == 1
+        assert res["parent"] == "MasterProxyServer.commitBatch"
+        assert tlog["parent"] == "MasterProxyServer.commitBatch"
+        assert res["depth"] == tlog["depth"] == 2
+        # virtual-clock sanity: begins are causally ordered and every
+        # span closed at/after it opened, inside its parent's extent
+        assert root["begin"] <= proxy["begin"] <= res["begin"] \
+            <= tlog["begin"]
+        for s in chain:
+            assert s["end"] is not None and s["end"] >= s["begin"]
+        assert proxy["end"] <= root["end"]
+        assert res["end"] <= proxy["end"] and tlog["end"] <= proxy["end"]
+        # resolution happens before the log fsync completes
+        assert res["end"] <= tlog["end"]
+        # the sampled read hit the storage stations too
+        locs = [l for _t, _et, l in flow.g_trace_batch.events(5150)]
+        assert "NativeAPI.getValue.Before" in locs
+        assert "StorageServer.getValue.DoRead" in locs
+        assert "StorageServer.getValue.AfterRead" in locs
+        # an unsampled commit opens no spans
+        assert flow.g_trace_batch.span_chain(None) == []
+    finally:
+        flow.g_trace_batch.clear()
+        c.shutdown()
