@@ -130,7 +130,11 @@ def bench_tpu_point(n_txns, n_batches, keyspace):
     # steady state: one write row per txn per batch, live for
     # WINDOW_BATCHES batches (+1 pending prune, + merge slack)
     cap = next_pow2((WINDOW_BATCHES + 2) * n_txns + 2)
-    core = make_point_resolve_core(cap, n_txns, nr, nw, n_words)
+    # verdict-only variant: the bench never reads attribution, and a
+    # jitted output is never DCE'd — opting out statically keeps the
+    # measured ratios free of the attribution pass
+    core = make_point_resolve_core(cap, n_txns, nr, nw, n_words,
+                                   attribute=False)
 
     def gen_keys(key, slots):
         idx = jax.random.randint(key, (slots,), 0, keyspace, dtype=jnp.int32)
@@ -210,7 +214,8 @@ def bench_tpu(n_txns, n_batches, keyspace):
     # every padded dimension (and quadruples the overlap matrix)
     nr = next_pow2(n_txns * READS_PER_TXN)
     nw = next_pow2(n_txns)
-    core = make_resolve_core(cap, n_txns, nr, nw, n_words)
+    core = make_resolve_core(cap, n_txns, nr, nw, n_words,
+                             attribute=False)   # verdict-only bench
 
     def gen_keys(key, slots):
         idx = jax.random.randint(key, (slots,), 0, keyspace, dtype=jnp.int32)

@@ -470,6 +470,12 @@ class Transaction:
             # sampled-transaction stitching (ref: the TransactionDebug
             # attach + per-station events through the commit path)
             self._debug_id = value
+        elif option == "report_conflicting_keys":
+            # a conflicted commit surfaces WHICH read ranges aborted it
+            # (ref: the REPORT_CONFLICTING_KEYS option + the
+            # \xff\xff/transaction/conflicting_keys/ special keyspace);
+            # read back via get_conflicting_ranges() after not_committed
+            self._report_conflicting = True
         elif option == "priority_batch":
             self._grv_priority = PRIORITY_BATCH
         elif option == "priority_system_immediate":
@@ -518,6 +524,8 @@ class Transaction:
         self._read_system = False
         self._debug_id = None
         self._grv_priority = None     # ...including the priority class
+        self._report_conflicting = False
+        self._conflicting_ranges = None   # last conflicted commit's causes
         # timeout/retry OPTIONS survive an explicit reset, but their
         # spent budgets re-arm — a reused object starts a fresh logical
         # transaction (ref: fdb reset semantics)
@@ -1035,6 +1043,7 @@ class Transaction:
             self._arm_watches(self.committed_version)
             return self.committed_version
         snapshot = await self.get_read_version()
+        self._conflicting_ranges = None   # a fresh attempt's outcome only
         debug_id = getattr(self, "_debug_id", None)
         span = None
         if debug_id is not None:
@@ -1046,11 +1055,20 @@ class Transaction:
                                                  "NativeAPI.commit")
         req = CommitRequest(snapshot, tuple(self._read_conflicts),
                             tuple(self._write_conflicts),
-                            tuple(self._mutations), debug_id=debug_id)
+                            tuple(self._mutations), debug_id=debug_id,
+                            report_conflicting_keys=getattr(
+                                self, "_report_conflicting", False))
         try:
             proxy = await self._proxy()
             reply = await self._rpc(
                 proxy.commits.get_reply(req, self.db.process))
+            from ..server.types import CommitConflictReply
+            if isinstance(reply, CommitConflictReply):
+                # a reported conflict arrives as a VALUE carrying the
+                # attributed ranges; record them and raise the same
+                # retryable error a non-reporting commit would see
+                self._conflicting_ranges = tuple(reply.conflicting_ranges)
+                raise error("not_committed")
         except flow.FdbError as e:
             for _k, f in self._watches:
                 if not f.is_ready:
@@ -1072,6 +1090,15 @@ class Transaction:
                                          "NativeAPI.commit.After")
         self._arm_watches(reply.version)
         return reply.version
+
+    def get_conflicting_ranges(self):
+        """The key ranges that aborted the last conflicted commit, or
+        None when no reported conflict happened (requires the
+        report_conflicting_keys option; ref: reading
+        \\xff\\xff/transaction/conflicting_keys/ after not_committed).
+        Survives on_error's reset so the retry attempt can inspect
+        what went wrong."""
+        return getattr(self, "_conflicting_ranges", None)
 
     def get_versionstamp(self) -> bytes:
         """The committed transaction's 10-byte versionstamp."""
@@ -1140,12 +1167,19 @@ class Transaction:
         retries = getattr(self, "_retries_used", 0)
         prio = getattr(self, "_grv_priority", None)
         debug_id = getattr(self, "_debug_id", None)
+        report = getattr(self, "_report_conflicting", False)
+        conflicting = getattr(self, "_conflicting_ranges", None)
         self.reset()
         self._retries_used = retries
         self._grv_priority = prio
         # the RETRY attempt is usually the interesting one (it hit a
         # conflict/failure) — keep it sampled
         self._debug_id = debug_id
+        # keep reporting armed AND the failed attempt's attribution
+        # readable (ref: the conflicting-keys special keys being read
+        # in the retry loop's next attempt)
+        self._report_conflicting = report
+        self._conflicting_ranges = conflicting
         if deadline is not None:
             self._timeout_deadline = deadline
 

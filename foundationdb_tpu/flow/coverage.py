@@ -57,6 +57,7 @@ def reset_hits() -> None:
 declare(
     "proxy.commit.conflict",
     "proxy.commit.too_old",
+    "proxy.commit.report_conflicting",
     "resolver.reply_cache.hit",
     "resolver.reply_cache.aged_out",
     "resolver.batch.rejected",

@@ -95,8 +95,22 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # sim tests assert on SevDebug-level stitching, so the floor is an
     # operator knob, not a default)
     init("TRACE_SEVERITY_MIN", 0)
+    # roll the trace file once it exceeds this many bytes (ref: the
+    # reference's 10 MB trace_roll_size / FileTraceLogWriter rolls);
+    # 0 disables rolling
+    init("TRACE_ROLL_SIZE", 10 << 20, lambda: 4096)
     # cadence of the per-role *Metrics counter rollup TraceEvents
     init("TRACE_COUNTERS_INTERVAL", 1.0, lambda: 0.1)
+    # conflict hot-spot table (resolver-side attribution aggregation):
+    # score half-life seconds, table capacity, rows surfaced in status
+    init("HOT_SPOT_HALF_LIFE", 10.0, lambda: 0.5)
+    init("HOT_SPOT_MAX_ENTRIES", 64, lambda: 4)
+    init("HOT_SPOT_TOP_K", 10)
+    # health rollup thresholds (the status `messages` array): conflict
+    # fraction of recently-resolved txns that reads as pathological,
+    # and how many versions storage may trail the log frontier
+    init("HEALTH_CONFLICT_RATE", 0.25)
+    init("HEALTH_STORAGE_LAG_VERSIONS", 2_000_000)
     # time 1-in-N kernel dispatches with a block_until_ready fence
     # (first call per shape bucket is always timed: that's the compile);
     # 0 disables the periodic fence entirely so the streamed bench can

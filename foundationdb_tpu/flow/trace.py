@@ -51,12 +51,35 @@ def _severity_floor() -> int:
     return int(_knobs.trace_severity_min)
 
 
+def _roll_size_knob() -> int:
+    """Max trace-file bytes before a roll (ref: FDB's trace_roll_size,
+    10 MB by default — FileTraceLogWriter renames the full file and
+    starts a fresh one). Same cached-handle live read as the severity
+    floor; 0 disables rolling."""
+    global _knobs
+    if _knobs is None:
+        try:
+            from .knobs import SERVER_KNOBS
+        except Exception:
+            return 0
+        _knobs = SERVER_KNOBS
+    return int(_knobs.trace_roll_size)
+
+
 class TraceCollector:
-    def __init__(self, path: Optional[str] = None, keep_in_memory: int = 10000):
+    def __init__(self, path: Optional[str] = None, keep_in_memory: int = 10000,
+                 roll_size: Optional[int] = None):
         self.events: list[dict] = []
         self.keep = keep_in_memory
         self.counts: dict[str, int] = {}
+        #: None = follow the trace_roll_size knob; explicit value wins
+        self.roll_size = roll_size
+        self.rolled_files: list[str] = []
         self._fh = None
+        self._path: Optional[str] = None
+        self._bytes = 0
+        self._rolls = 0
+        self._roll_broken = False   # a failed rename disables rolling
         self._set_file(path)
 
     def _set_file(self, path: Optional[str]) -> None:
@@ -66,9 +89,37 @@ class TraceCollector:
         # unregistered on close so short-lived collectors aren't pinned
         # for process lifetime) covers whatever the OS still buffers
         # when the interpreter goes down.
+        self._path = path
+        self._bytes = 0
         if path:
             self._fh = open(path, "a", buffering=1)
+            try:
+                import os
+                self._bytes = os.fstat(self._fh.fileno()).st_size
+            except OSError:
+                pass   # appending to an unstattable stream: size 0
             atexit.register(self.close)
+
+    def _roll(self) -> None:
+        """Rotate the full trace file aside and start a fresh one,
+        keeping the flush/atexit semantics (the atexit hook stays
+        registered — it closes whichever file is current at exit)."""
+        import os
+        self._rolls += 1
+        rolled = f"{self._path}.{self._rolls}"
+        self._fh.flush()
+        self._fh.close()
+        atexit.unregister(self.close)   # _set_file re-registers
+        try:
+            os.replace(self._path, rolled)
+            self.rolled_files.append(rolled)
+        except OSError:
+            # un-renamable target (directory went read-only, file held
+            # elsewhere): stop trying — retrying would turn EVERY emit
+            # into open/close/failed-rename churn against the same
+            # over-limit file
+            self._roll_broken = True
+        self._set_file(self._path)
 
     def emit(self, ev: dict) -> None:
         self.counts[ev["Type"]] = self.counts.get(ev["Type"], 0) + 1
@@ -77,7 +128,13 @@ class TraceCollector:
             if len(self.events) > self.keep:
                 del self.events[: self.keep // 2]
         if self._fh:
-            self._fh.write(json.dumps(ev) + "\n")
+            line = json.dumps(ev) + "\n"
+            self._fh.write(line)
+            self._bytes += len(line)
+            limit = (self.roll_size if self.roll_size is not None
+                     else _roll_size_knob())
+            if limit and self._bytes >= limit and not self._roll_broken:
+                self._roll()
 
     def flush(self) -> None:
         if self._fh:
@@ -102,6 +159,9 @@ class TraceCollector:
         self.close()
         self.events.clear()
         self.counts.clear()
+        self.rolled_files.clear()
+        self._rolls = 0
+        self._roll_broken = False
         self._set_file(path)
 
 

@@ -15,14 +15,25 @@ DEFAULT_SPACE = Subspace(("\x02metrics",))
 
 
 async def log_counters(db, collections, space: Subspace = DEFAULT_SPACE,
-                       max_retries: int = 100) -> int:
-    """Write one timestamped sample per counter; returns rows written."""
+                       max_retries: int = 100, extra: dict = None) -> int:
+    """Write one timestamped sample per counter; returns rows written.
+
+    `extra` persists series that have no CounterCollection behind them
+    — the latency-probe readings and the conflict hot-spot scores the
+    cluster controller assembles for status: a mapping
+    {series_role: {counter_name: int_value}} written under the same
+    (role, counter, ms_timestamp) tuple keys, so `read_series` replays
+    probe and conflict history exactly like any role counter."""
     now = flow.now()
     rows = []
     for col in collections:
         for name, value in col.snapshot().items():
             rows.append((space.pack((col.role, name, int(now * 1000))),
                          b"%d" % value))
+    for role, counters in (extra or {}).items():
+        for name, value in counters.items():
+            rows.append((space.pack((role, name, int(now * 1000))),
+                         b"%d" % int(value)))
 
     async def body(tr):
         for k, v in rows:
@@ -32,19 +43,35 @@ async def log_counters(db, collections, space: Subspace = DEFAULT_SPACE,
 
 
 async def read_series(db, role: str, counter: str,
-                      space: Subspace = DEFAULT_SPACE):
-    """All samples for one counter: [(ms_timestamp, value)]."""
-    b, e = space.range((role, counter))
+                      space: Subspace = DEFAULT_SPACE,
+                      start: int = None, end: int = None):
+    """Samples for one counter: [(ms_timestamp, value)], optionally
+    bounded to start <= ms_timestamp < end (tuple-encoded bounds ride
+    the ordinary range read, so the cut happens server-side — the
+    whole-history fetch was the round-1 shape; a dashboard asking for
+    the last minute must not page years of samples)."""
+    if start is None and end is None:
+        b, e = space.range((role, counter))
+    else:
+        full_b, full_e = space.range((role, counter))
+        b = space.pack((role, counter, int(start))) if start is not None \
+            else full_b
+        e = space.pack((role, counter, int(end))) if end is not None \
+            else full_e
     tr = db.create_transaction()
     rows = await tr.get_range(b, e)
     return [(space.unpack(k)[-1], int(v)) for k, v in rows]
 
 
 async def metric_logger(db, collections, interval: float = None,
-                        space: Subspace = DEFAULT_SPACE):
-    """Periodic flush actor (ref: runMetrics)."""
+                        space: Subspace = DEFAULT_SPACE,
+                        extra_fn=None):
+    """Periodic flush actor (ref: runMetrics). `extra_fn`, when given,
+    is called each round for the `extra` sample dict (the probe /
+    hot-spot series a status assembler exposes)."""
     if interval is None:
         interval = flow.SERVER_KNOBS.metric_logger_interval
     while True:
         await flow.delay(interval)
-        await log_counters(db, collections, space)
+        await log_counters(db, collections, space,
+                           extra=extra_fn() if extra_fn else None)

@@ -62,6 +62,27 @@ class ConflictSetBase:
                 new_oldest_version: int) -> list[int]:
         raise NotImplementedError
 
+    def resolve_with_attribution(self, txns: Sequence[ResolverTransaction],
+                                 commit_version: int,
+                                 new_oldest_version: int):
+        """Like `resolve`, but additionally attributes each conflicted
+        transaction to the read-range indices that CAUSED the conflict
+        (ref: report_conflicting_keys — fdbclient grew the option so
+        operators can see which keys abort transactions).
+
+        Returns (verdicts, attributions) where attributions[t] is a
+        sorted tuple of indices into txns[t].read_ranges, or None in
+        place of the whole list when the backend cannot attribute (the
+        caller then degrades to verdicts-only). Attribution semantics,
+        identical across every backend: a read range is a cause iff it
+        conflicts against the pre-batch history at the transaction's
+        snapshot, OR it overlaps a write range of an earlier
+        NON-conflicted transaction in the same batch — evaluated for
+        every non-tooOld transaction, including externally-conflicted
+        ones, so the set is order-insensitive. tooOld transactions
+        attribute nothing (they contribute no ranges at all)."""
+        return self.resolve(txns, commit_version, new_oldest_version), None
+
     @property
     def oldest_version(self) -> int:
         raise NotImplementedError
@@ -135,6 +156,19 @@ class PyConflictSet(ConflictSetBase):
     # -- the resolve step ---------------------------------------------------
     def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
                 new_oldest_version: int) -> list[int]:
+        return self._resolve(txns, commit_version, new_oldest_version, None)
+
+    def resolve_with_attribution(self, txns: Sequence[ResolverTransaction],
+                                 commit_version: int,
+                                 new_oldest_version: int):
+        collect: list[list[int]] = [[] for _ in txns]
+        verdicts = self._resolve(txns, commit_version, new_oldest_version,
+                                 collect)
+        return verdicts, [tuple(sorted(set(c))) for c in collect]
+
+    def _resolve(self, txns: Sequence[ResolverTransaction],
+                 commit_version: int, new_oldest_version: int,
+                 collect) -> list[int]:
         n = len(txns)
         too_old = [False] * n
         conflict = [False] * n
@@ -143,27 +177,40 @@ class PyConflictSet(ConflictSetBase):
             if tr.read_snapshot < self._oldest and len(tr.read_ranges):
                 too_old[t] = True
 
-        # (1) external check against history
+        # (1) external check against history. Attribution mode checks
+        # EVERY range (the short-circuit would under-report causes).
         for t, tr in enumerate(txns):
             if too_old[t]:
                 continue
-            for b, e in tr.read_ranges:
+            for ri, (b, e) in enumerate(tr.read_ranges):
                 if b < e and self._range_max(b, e) > tr.read_snapshot:
                     conflict[t] = True
-                    break
+                    if collect is None:
+                        break
+                    collect[t].append(ri)
 
-        # (2) intra-batch, sequential in batch order
+        # (2) intra-batch, sequential in batch order. Attribution mode
+        # also checks the reads of already-conflicted transactions
+        # against the written set at their turn (their writes still
+        # never join it), so the attributed set covers intra causes of
+        # externally-conflicted transactions too.
         written: list[tuple[bytes, bytes]] = []  # sorted by begin, disjoint
         wkeys: list[bytes] = []  # begins, for bisect
         for t, tr in enumerate(txns):
             if conflict[t]:
+                if collect is not None and not too_old[t]:
+                    for ri, (b, e) in enumerate(tr.read_ranges):
+                        if b < e and _overlaps_any(written, wkeys, b, e):
+                            collect[t].append(ri)
                 continue
             c = too_old[t]
             if not c:
-                for b, e in tr.read_ranges:
+                for ri, (b, e) in enumerate(tr.read_ranges):
                     if b < e and _overlaps_any(written, wkeys, b, e):
                         c = True
-                        break
+                        if collect is None:
+                            break
+                        collect[t].append(ri)
             conflict[t] = c
             if not c:
                 for b, e in tr.write_ranges:
@@ -228,6 +275,17 @@ class BruteForceConflictSet(ConflictSetBase):
         return self._oldest
 
     def resolve(self, txns, commit_version, new_oldest_version):
+        return self._resolve(txns, commit_version, new_oldest_version,
+                             None)
+
+    def resolve_with_attribution(self, txns, commit_version,
+                                 new_oldest_version):
+        collect: list[list[int]] = [[] for _ in txns]
+        verdicts = self._resolve(txns, commit_version, new_oldest_version,
+                                 collect)
+        return verdicts, [tuple(sorted(set(c))) for c in collect]
+
+    def _resolve(self, txns, commit_version, new_oldest_version, collect):
         n = len(txns)
         verdicts = [COMMITTED] * n
         added: list[tuple[bytes, bytes]] = []
@@ -236,20 +294,17 @@ class BruteForceConflictSet(ConflictSetBase):
                 verdicts[t] = TOO_OLD
                 continue
             bad = False
-            for b, e in tr.read_ranges:
+            for ri, (b, e) in enumerate(tr.read_ranges):
                 if b >= e:
                     continue
-                for wb, we, wv in self._writes:
-                    if wb < e and b < we and wv > tr.read_snapshot:
-                        bad = True
+                hit = any(wb < e and b < we and wv > tr.read_snapshot
+                          for wb, we, wv in self._writes)
+                hit = hit or any(wb < e and b < we for wb, we in added)
+                if hit:
+                    bad = True
+                    if collect is None:
                         break
-                if not bad:
-                    for wb, we in added:
-                        if wb < e and b < we:
-                            bad = True
-                            break
-                if bad:
-                    break
+                    collect[t].append(ri)
             if bad:
                 verdicts[t] = CONFLICT
             else:

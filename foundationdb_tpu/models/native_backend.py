@@ -63,6 +63,15 @@ def load_native_library(build_if_missing: bool = True) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64),   # write_ranges
         ctypes.POINTER(ctypes.c_uint8),   # verdicts_out
     ]
+    try:
+        # attribution entry point (report_conflicting_keys): absent
+        # only from a pre-existing stale .so built before the symbol
+        # existed — callers degrade to verdicts-only then
+        lib.fdbtpu_conflictset_resolve_attributed.argtypes = \
+            lib.fdbtpu_conflictset_resolve.argtypes + [
+                ctypes.POINTER(ctypes.c_uint8)]   # read_hits_out
+    except AttributeError:
+        pass
     _lib = lib
     return lib
 
@@ -148,6 +157,38 @@ class NativeConflictSet(ConflictSetBase):
             p(rr, ctypes.c_int64), p(wr, ctypes.c_int64),
             p(out, ctypes.c_uint8))
         return out.tolist()
+
+    def resolve_with_attribution(self, txns: Sequence[ResolverTransaction],
+                                 commit_version: int,
+                                 new_oldest_version: int):
+        """Verdicts + conflicting read-range indices via the attributed
+        C entry point (same union semantics as every other backend); a
+        stale .so without the symbol degrades to verdicts-only."""
+        if not hasattr(self._lib, "fdbtpu_conflictset_resolve_attributed"):
+            return ConflictSetBase.resolve_with_attribution(
+                self, txns, commit_version, new_oldest_version)
+        n = len(txns)
+        if n == 0:
+            return [], []
+        snapshots, rc, wc, blob, rr, wr = _marshal(txns)
+        out = np.empty(n, dtype=np.uint8)
+        n_reads = int(rc.sum())
+        hits = np.zeros(max(n_reads, 1), dtype=np.uint8)
+        p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))  # noqa: E731
+        self._lib.fdbtpu_conflictset_resolve_attributed(
+            self._handle, commit_version, new_oldest_version, n,
+            p(snapshots, ctypes.c_int64), p(rc, ctypes.c_int32),
+            p(wc, ctypes.c_int32), p(blob, ctypes.c_uint8),
+            p(rr, ctypes.c_int64), p(wr, ctypes.c_int64),
+            p(out, ctypes.c_uint8), p(hits, ctypes.c_uint8))
+        attr: list[tuple] = []
+        off = 0
+        for t in range(n):
+            cnt = int(rc[t])
+            attr.append(tuple(
+                ri for ri in range(cnt) if hits[off + ri]))
+            off += cnt
+        return out.tolist(), attr
 
 
 def create_conflict_set(backend: str = "python", init_version: int = 0) -> ConflictSetBase:

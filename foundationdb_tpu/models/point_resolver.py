@@ -49,20 +49,23 @@ class PointConflictSet(TpuConflictSet):
     def _marshal_ranges(self, txns: Sequence[ResolverTransaction], too_old):
         """Point marshalling: end keys are never encoded (they are
         begin+'\\x00', one byte past the bucket width); each range is
-        validated to be a point instead."""
+        validated to be a point instead. Same ((arrays), read_map)
+        contract as the interval backend."""
         read_k: list[bytes] = []
         read_t: list[int] = []
+        read_map: list[tuple] = []
         write_k: list[bytes] = []
         write_t: list[int] = []
         for t, tr in enumerate(txns):
             if too_old[t]:
                 continue
-            for b, e in tr.read_ranges:
+            for ri, (b, e) in enumerate(tr.read_ranges):
                 if b >= e:
                     continue
                 self._check_point(b, e)
                 read_k.append(b)
                 read_t.append(t)
+                read_map.append((t, ri))
             for b, e in tr.write_ranges:
                 if b >= e:
                     continue
@@ -73,8 +76,8 @@ class PointConflictSet(TpuConflictSet):
         from ..ops.keys import encode_keys
         keys = encode_keys(read_k + write_k, self._key_bytes)
         nr = len(read_t)
-        return (keys[:nr], None, np.asarray(read_t, np.int32),
-                keys[nr:], None, np.asarray(write_t, np.int32))
+        return ((keys[:nr], None, np.asarray(read_t, np.int32),
+                 keys[nr:], None, np.asarray(write_t, np.int32)), read_map)
 
     def _check_point(self, b: bytes, e: bytes) -> None:
         if e != b + b"\x00":
@@ -105,7 +108,7 @@ class PointConflictSet(TpuConflictSet):
                                       new_oldest_version)
 
     def _dispatch(self, n, snapshots, too_old, rb, re, rt, wb, we, wt,
-                  offsets):
+                  offsets, attribute: bool = False):
         commit_off, oldest_off, fixup = offsets
         import jax.numpy as jnp
 
@@ -135,7 +138,8 @@ class PointConflictSet(TpuConflictSet):
         from ..ops.point_kernel import (make_point_resolve_packed_fn,
                                         pack_point_batch)
         fn = make_point_resolve_packed_fn(self._cap, npad, nrp, nwp,
-                                          self._n_words)
+                                          self._n_words,
+                                          attribute=attribute)
         # ONE host->device transfer per batch: the per-transfer latency
         # (not bandwidth) dominates the streamed path on a
         # remote-attached chip, so the eight logical inputs ride one
@@ -144,10 +148,17 @@ class PointConflictSet(TpuConflictSet):
             snap_p, tooold_p, self._pad_keys(rb, nrp),
             self._pad_idx(rt, nrp, npad), rvalid,
             self._pad_keys(wb, nwp), self._pad_idx(wt, nwp, npad), wvalid)
-        self._hk, self._hv, count, conflict = fn(
-            self._hk, self._hv, jnp.asarray(buf),
-            jnp.int32(commit_off), jnp.int32(oldest_off),
-            jnp.int32(init_off))
+        read_hit = None
+        if attribute:
+            self._hk, self._hv, count, conflict, read_hit = fn(
+                self._hk, self._hv, jnp.asarray(buf),
+                jnp.int32(commit_off), jnp.int32(oldest_off),
+                jnp.int32(init_off))
+        else:
+            self._hk, self._hv, count, conflict = fn(
+                self._hk, self._hv, jnp.asarray(buf),
+                jnp.int32(commit_off), jnp.int32(oldest_off),
+                jnp.int32(init_off))
         self._apply_fixup(fixup)
         self._note_count(count, nw)
-        return conflict
+        return conflict, read_hit

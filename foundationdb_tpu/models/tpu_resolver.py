@@ -180,19 +180,45 @@ class TpuConflictSet(ConflictSetBase):
     # -- resolve --------------------------------------------------------
     def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
                 new_oldest_version: int) -> list[int]:
-        conflict, too_old, n = self._resolve_flags(
-            txns, commit_version, new_oldest_version)
+        conflict, too_old, n, _hit, _rmap = self._resolve_flags(
+            txns, commit_version, new_oldest_version, attribute=False)
         if n == 0:
             return []
         return self.finalize_verdicts(conflict, too_old)
 
-    def _resolve_flags(self, txns, commit_version, new_oldest_version):
-        """Dispatch one batch; returns (device conflict flags, too_old, n).
+    def resolve_with_attribution(self, txns: Sequence[ResolverTransaction],
+                                 commit_version: int,
+                                 new_oldest_version: int):
+        """Verdicts + per-txn conflicting read-range indices (see
+        ConflictSetBase.resolve_with_attribution). The kernel computes
+        per-read-slot cause flags in the same dispatch as the verdicts;
+        the host routes flagged slots back through the marshalling map
+        (slot -> (txn, original range index))."""
+        conflict, too_old, n, read_hit, read_map = self._resolve_flags(
+            txns, commit_version, new_oldest_version, attribute=True)
+        if n == 0:
+            return [], []
+        verdicts = self.finalize_verdicts(conflict, too_old)
+        attr: list[list[int]] = [[] for _ in range(n)]
+        if read_map:
+            hits = np.asarray(read_hit)[:len(read_map)]
+            for slot in np.nonzero(hits)[0]:
+                t, ri = read_map[slot]
+                attr[t].append(ri)
+        return verdicts, [tuple(a) for a in attr]
+
+    def _resolve_flags(self, txns, commit_version, new_oldest_version,
+                       attribute: bool = False):
+        """Dispatch one batch; returns (device conflict flags, too_old,
+        n, device per-read-slot cause flags — None unless `attribute` —
+        read slot -> (txn, range index) map).
 
         Kept separate from `resolve` so callers that can overlap host and
         device work (the proxy pipeline / bench) can defer the readback.
         The per-range encoding is delegated to `_marshal_ranges` so the
-        point backend can share everything else.
+        point backend can share everything else. `attribute` selects the
+        kernel variant compiled WITH the attribution pass — a static
+        property of the compiled program, not a runtime switch.
         """
         if commit_version < self._last_commit:
             raise ValueError("commit versions must be non-decreasing "
@@ -202,7 +228,7 @@ class TpuConflictSet(ConflictSetBase):
         if n == 0:
             self._last_commit = commit_version
             self._oldest = max(self._oldest, new_oldest_version)
-            return None, None, 0
+            return None, None, 0, None, []
         live_snaps = [tr.read_snapshot for tr in txns
                       if len(tr.read_ranges) and tr.read_snapshot >= self._oldest]
         offsets = self._prepare_versions(
@@ -216,33 +242,37 @@ class TpuConflictSet(ConflictSetBase):
             if tr.read_snapshot < self._oldest and len(tr.read_ranges):
                 too_old[t] = True
 
-        conflict = self._dispatch(
-            n, snapshots, too_old, *self._marshal_ranges(txns, too_old),
-            offsets)
+        arrays, read_map = self._marshal_ranges(txns, too_old)
+        conflict, read_hit = self._dispatch(
+            n, snapshots, too_old, *arrays, offsets, attribute=attribute)
         self._last_commit = commit_version  # only after a successful batch
         self._oldest = max(self._oldest, new_oldest_version)
-        return conflict, too_old, n
+        return conflict, too_old, n, read_hit, read_map
 
     def _marshal_ranges(self, txns, too_old):
         """Flatten and encode the batch's conflict ranges in txn order.
 
-        Returns the 6-tuple (rb, re, rt, wb, we, wt) handed to
-        `_dispatch`. tooOld txns contribute no ranges at all (ref:
-        SkipList.cpp:979 addTransaction)."""
+        Returns ((rb, re, rt, wb, we, wt), read_map) — the arrays handed
+        to `_dispatch` plus, per read slot, the (txn index, ORIGINAL
+        read_ranges index) pair attribution routes hits back through.
+        tooOld txns contribute no ranges at all (ref: SkipList.cpp:979
+        addTransaction)."""
         read_b: list[bytes] = []
         read_e: list[bytes] = []
         read_t: list[int] = []
+        read_map: list[tuple] = []
         write_b: list[bytes] = []
         write_e: list[bytes] = []
         write_t: list[int] = []
         for t, tr in enumerate(txns):
             if too_old[t]:
                 continue
-            for b, e in tr.read_ranges:
+            for ri, (b, e) in enumerate(tr.read_ranges):
                 if b < e:
                     read_b.append(b)
                     read_e.append(e)
                     read_t.append(t)
+                    read_map.append((t, ri))
             for b, e in tr.write_ranges:
                 if b < e:
                     write_b.append(b)
@@ -253,9 +283,9 @@ class TpuConflictSet(ConflictSetBase):
         nr, nw = len(read_t), len(write_t)
         keys = encode_keys(read_b + read_e + write_b + write_e,
                            self._key_bytes)
-        return (keys[:nr], keys[nr:2 * nr], np.asarray(read_t, np.int32),
-                keys[2 * nr:2 * nr + nw], keys[2 * nr + nw:],
-                np.asarray(write_t, np.int32))
+        return ((keys[:nr], keys[nr:2 * nr], np.asarray(read_t, np.int32),
+                 keys[2 * nr:2 * nr + nw], keys[2 * nr + nw:],
+                 np.asarray(write_t, np.int32)), read_map)
 
     def resolve_arrays(self, snapshots: np.ndarray, has_reads: np.ndarray,
                        rb: np.ndarray, re: np.ndarray, rt: np.ndarray,
@@ -275,7 +305,7 @@ class TpuConflictSet(ConflictSetBase):
                     max(self._oldest, new_oldest_version))
         offsets = self._prepare_versions(commit_version, new_oldest_version,
                                          floor)
-        conflict = self._dispatch(
+        conflict, _read_hit = self._dispatch(
             snapshots.shape[0], snapshots, too_old, rb, re,
             np.asarray(rt, np.int32), wb, we, np.asarray(wt, np.int32),
             offsets)
@@ -372,18 +402,25 @@ class TpuConflictSet(ConflictSetBase):
                 "counts": {k: v for k, v in snap.items()
                            if k != "batches"}}
 
-    def _call_kernel(self, npad, nrp, nwp, args):
+    def _call_kernel(self, npad, nrp, nwp, args, attribute: bool):
         """Run one padded batch through the single-shard jitted kernel.
 
         Subclasses (the sharded resolver) override this to dispatch the
         same padded batch across a device mesh."""
         from ..ops.conflict_kernel import make_resolve_fn
-        fn = make_resolve_fn(self._cap, npad, nrp, nwp, self._n_words)
-        self._hk, self._hv, count, conflict = fn(self._hk, self._hv, *args)
-        return count, conflict
+        fn = make_resolve_fn(self._cap, npad, nrp, nwp, self._n_words,
+                             attribute=attribute)
+        read_hit = None
+        if attribute:
+            self._hk, self._hv, count, conflict, read_hit = fn(
+                self._hk, self._hv, *args)
+        else:
+            self._hk, self._hv, count, conflict = fn(
+                self._hk, self._hv, *args)
+        return count, conflict, read_hit
 
     def _dispatch(self, n, snapshots, too_old, rb, re, rt, wb, we, wt,
-                  offsets):
+                  offsets, attribute: bool = False):
         commit_off, oldest_off, fixup = offsets
         import jax.numpy as jnp
 
@@ -408,7 +445,7 @@ class TpuConflictSet(ConflictSetBase):
         wvalid = np.zeros(nwp, bool)
         wvalid[:nw] = True
 
-        count, conflict = self._call_kernel(npad, nrp, nwp, (
+        count, conflict, read_hit = self._call_kernel(npad, nrp, nwp, (
             jnp.asarray(snap_p), jnp.asarray(tooold_p),
             jnp.asarray(self._pad_keys(rb, nrp)),
             jnp.asarray(self._pad_keys(re, nrp)),
@@ -416,7 +453,7 @@ class TpuConflictSet(ConflictSetBase):
             jnp.asarray(self._pad_keys(wb, nwp)),
             jnp.asarray(self._pad_keys(we, nwp)),
             jnp.asarray(self._pad_idx(wt, nwp, npad)), jnp.asarray(wvalid),
-            jnp.int32(commit_off), jnp.int32(oldest_off)))
+            jnp.int32(commit_off), jnp.int32(oldest_off)), attribute)
         self._apply_fixup(fixup)
         self._note_count(count, 2 * nw)
-        return conflict
+        return conflict, read_hit

@@ -134,14 +134,27 @@ def profile_kernel(fn, kernel: str,
 
 
 def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
-                      n_words: int, axis_name=None):
+                      n_words: int, axis_name=None, attribute: bool = True):
     """Build the (unjitted) resolve step for one static shape bucket.
 
     Shapes: cap history slots, n_txns txn slots, n_reads / n_writes flat
     conflict-range slots (each a power of two). Returns
       fn(HK, HV, snap, too_old, rb, re, rtxn, rvalid,
          wb, we, wtxn, wvalid, commit, oldest)
-        -> (HK', HV', count, conflict[n_txns] bool)
+        -> (HK', HV', count, conflict[n_txns] bool, read_hit[n_reads] bool)
+    `read_hit[i]` marks read slot i as a CAUSE of its transaction's
+    conflict (ref: report_conflicting_keys, fdbclient/NativeAPI — the
+    conflicting key ranges surfaced to the client): it conflicted
+    against the history (external check) or, at the final intra-batch
+    fixpoint, overlaps a surviving write of an earlier transaction.
+    The union of both is evaluated for EVERY transaction — including
+    externally-conflicted ones — so attribution is order-insensitive
+    and bit-comparable across the CPU baselines and device backends.
+
+    `attribute=False` compiles WITHOUT the attribution pass (a 4-tuple,
+    no read_hit): outputs of a jitted function are never dead-code
+    eliminated, so verdict-only callers — the bench hot paths — must
+    opt out statically rather than discard the extra output.
     `rtxn`/`wtxn` must be NON-DECREASING with pad slots = n_txns (the
     flattened-in-txn-order layout every marshaller produces): per-txn
     reductions are segment sums over that order.
@@ -311,6 +324,20 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
             cond, body, (base_c, first, jnp.int32(1)))
         conflict = conflict_pad[:n]
 
+        read_hit = None
+        if attribute:
+            # per-read attribution at the settled fixpoint: a read slot
+            # is a conflict CAUSE iff it hit the history (ext_r) or
+            # overlaps a write that survived (earlier txn, not
+            # conflicted) — one more masked pass over the packed
+            # overlap matrix, no extra sorts
+            alive_final = ~jnp.take(conflict_pad, wtxn)
+            alive_fp = jnp.sum(alive_final.reshape(n_lanes, pack_w)
+                               .astype(jnp.uint32) * bits[None, :],
+                               axis=1, dtype=jnp.uint32)
+            intra_r = jnp.any((ovp & alive_fp[None, :]) != 0, axis=1)
+            read_hit = _all_shards(ext_r | intra_r)
+
         # ---- 3. merge surviving writes into the history -----------------
         # One sort does the whole merge: history rows and the surviving
         # writes' boundary rows ride together; the covering version,
@@ -391,18 +418,24 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
         out_k = jnp.stack(sc[:width], axis=1)[:cap]
         out_v = sc[width][:cap]
         count = jnp.sum((keep & is_real).astype(jnp.int32))
-        return out_k, out_v, count, conflict
+        if not attribute:
+            return out_k, out_v, count, conflict
+        return out_k, out_v, count, conflict, read_hit
 
     return step
 
 
 @functools.lru_cache(maxsize=None)
 def make_resolve_fn(cap: int, n_txns: int, n_reads: int, n_writes: int,
-                    n_words: int):
-    """Jitted single-shard resolve step (see make_resolve_core)."""
-    fn = jax.jit(make_resolve_core(cap, n_txns, n_reads, n_writes, n_words))
+                    n_words: int, attribute: bool = True):
+    """Jitted single-shard resolve step (see make_resolve_core).
+    `attribute` is part of the compile cache key: the attributing and
+    verdict-only variants are distinct programs."""
+    fn = jax.jit(make_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
+                                   attribute=attribute))
+    tag = "" if attribute else "/noattr"
     return profile_kernel(
-        fn, f"resolve[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w]")
+        fn, f"resolve[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]")
 
 
 @functools.lru_cache(maxsize=None)

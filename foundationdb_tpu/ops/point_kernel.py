@@ -81,7 +81,8 @@ def _seg_or_scan(vals, seg_start):
 
 
 def make_point_resolve_core(cap: int, n_txns: int, n_reads: int,
-                            n_writes: int, n_words: int):
+                            n_writes: int, n_words: int,
+                            attribute: bool = True):
     """Build the point-mode resolve step for one static shape bucket.
 
     Shapes: `cap` state rows, `n_txns` txn slots, `n_reads`/`n_writes`
@@ -89,7 +90,15 @@ def make_point_resolve_core(cap: int, n_txns: int, n_reads: int,
     rows (ops.keys.encode_keys layout: big-endian words + length word).
     Returns
       fn(sk, sv, snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid,
-         commit, oldest, init_off) -> (sk', sv', count, conflict[n_txns])
+         commit, oldest, init_off)
+        -> (sk', sv', count, conflict[n_txns], read_hit[n_reads])
+    `read_hit` is the point restriction of the interval kernel's
+    conflict attribution (see conflict_kernel.make_resolve_core): slot
+    i conflicted against the state map, against the whole-keyspace
+    init baseline, or against a surviving earlier write in the batch.
+    `attribute=False` compiles without the attribution pass and
+    returns a 4-tuple (jitted outputs are never DCE'd, so verdict-only
+    hot paths opt out statically).
     `rtxn`/`wtxn` must be non-decreasing with pad slots = n_txns.
     `count` is the total real-row count BEFORE the slice to cap — the
     host overflow audit compares it against cap. `init_off` is the
@@ -181,6 +190,23 @@ def make_point_resolve_core(cap: int, n_txns: int, n_reads: int,
             cond, body, (base_c, first, jnp.int32(1)))
         conflict = conflict_pad[:n]
 
+        read_hit = None
+        if attribute:
+            # per-read attribution at the settled fixpoint (the
+            # interval kernel's read_hit, restricted to points): re-run
+            # the alive-write-before-me scan once against the final
+            # verdicts and route the hits back to flat read order
+            alive_f = isw_s & ~jnp.take(conflict_pad, txn_s)
+            shifted_f = jnp.concatenate(
+                [jnp.zeros((1,), bool), alive_f[:-1]])
+            shifted_f = shifted_f & ~seg_start
+            pref_f = _seg_or_scan(shifted_f, seg_start)
+            hit_row_f = isr_s & pref_f
+            _, hit_flat_f = lax.sort(
+                (meta_s, hit_row_f.astype(jnp.int32)), num_keys=1)
+            init_r = rvalid & (jnp.take(snap_pad, rtxn) < init_off)
+            read_hit = ext_r | init_r | (hit_flat_f[:n_reads] > 0)
+
         # ---- 3. merge + GC: one sort, pre-masked ------------------------
         surv = wvalid & ~jnp.take(conflict_pad, wtxn)
         live = sv >= jnp.maximum(oldest, jnp.int32(0))
@@ -198,19 +224,24 @@ def make_point_resolve_core(cap: int, n_txns: int, n_reads: int,
         out_v = sorted_ops[width][:cap]
         count = (jnp.sum(live.astype(jnp.int32)) +
                  jnp.sum(surv.astype(jnp.int32)))
-        return out_k, out_v, count, conflict
+        if not attribute:
+            return out_k, out_v, count, conflict
+        return out_k, out_v, count, conflict, read_hit
 
     return step
 
 
 @functools.lru_cache(maxsize=None)
 def make_point_resolve_fn(cap: int, n_txns: int, n_reads: int,
-                          n_writes: int, n_words: int):
+                          n_writes: int, n_words: int,
+                          attribute: bool = True):
     """Jitted point-mode resolve step (see make_point_resolve_core)."""
     fn = jax.jit(
-        make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words))
+        make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
+                                attribute=attribute))
+    tag = "" if attribute else "/noattr"
     return profile_kernel(
-        fn, f"point[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w]",
+        fn, f"point[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]",
         g_kernel_counters)
 
 
@@ -243,11 +274,13 @@ def pack_point_batch(snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid):
 
 @functools.lru_cache(maxsize=None)
 def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
-                                 n_writes: int, n_words: int):
+                                 n_writes: int, n_words: int,
+                                 attribute: bool = True):
     """Jitted point resolve taking the pack_point_batch buffer; the
     unpack happens inside the jit so the eight logical arrays never
     exist as separate device buffers."""
-    core = make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words)
+    core = make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
+                                   attribute=attribute)
     width = n_words + 1
 
     def packed(sk, sv, buf, commit, oldest, init_off):
@@ -270,7 +303,8 @@ def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
         return core(sk, sv, snap, too_old, rk, rtxn, rvalid,
                     wk, wtxn, wvalid, commit, oldest, init_off)
 
+    tag = "" if attribute else "/noattr"
     return profile_kernel(
         jax.jit(packed),
-        f"point_packed[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w]",
+        f"point_packed[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]",
         g_kernel_counters)

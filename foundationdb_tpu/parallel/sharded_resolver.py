@@ -33,7 +33,7 @@ def default_split_keys(n_shards: int) -> list[bytes]:
     return [bytes([(i * 256) // n_shards]) for i in range(1, n_shards)]
 
 
-def _clip_and_resolve(core):
+def _clip_and_resolve(core, attribute: bool):
     """Wrap the resolve core with per-shard range clipping."""
     import jax.numpy as jnp
 
@@ -55,10 +55,17 @@ def _clip_and_resolve(core):
         wb2, we2 = rows_max(wb, shard_lo), rows_min(we, shard_hi)
         rvalid2 = rvalid & lt_rows(rb2, re2)
         wvalid2 = wvalid & lt_rows(wb2, we2)
-        hk2, hv2, count, conflict = core(
-            hk, hv, snap, too_old, rb2, re2, rtxn, rvalid2,
-            wb2, we2, wtxn, wvalid2, commit, oldest)
-        return (hk2[None], hv2[None], count[None], conflict[None])
+        out = core(hk, hv, snap, too_old, rb2, re2, rtxn, rvalid2,
+                   wb2, we2, wtxn, wvalid2, commit, oldest)
+        if not attribute:
+            hk2, hv2, count, conflict = out
+            return (hk2[None], hv2[None], count[None], conflict[None])
+        # read_hit comes back psum-combined across shards (the core
+        # unions each shard's clipped-local attribution), so any
+        # shard's copy is the global per-slot answer
+        hk2, hv2, count, conflict, read_hit = out
+        return (hk2[None], hv2[None], count[None], conflict[None],
+                read_hit[None])
 
     return fn
 
@@ -148,8 +155,8 @@ class ShardedTpuConflictSet(TpuConflictSet):
         self._shard_fns.clear()
 
     # -- sharded kernel dispatch ---------------------------------------
-    def _get_shard_fn(self, npad, nrp, nwp):
-        key = (self._cap, npad, nrp, nwp)
+    def _get_shard_fn(self, npad, nrp, nwp, attribute: bool):
+        key = (self._cap, npad, nrp, nwp, attribute)
         fn = self._shard_fns.get(key)
         if fn is not None:
             return fn
@@ -164,16 +171,17 @@ class ShardedTpuConflictSet(TpuConflictSet):
             from jax.experimental.shard_map import shard_map
 
         core = make_resolve_core(self._cap, npad, nrp, nwp, self._n_words,
-                                 axis_name=self.AXIS)
-        wrapped = _clip_and_resolve(core)
+                                 axis_name=self.AXIS, attribute=attribute)
+        wrapped = _clip_and_resolve(core, attribute)
         sharded = P(self.AXIS)
         repl = P()
+        n_out = 5 if attribute else 4
         specs = dict(
             mesh=self._mesh,
             in_specs=(sharded, sharded, sharded, sharded,
                       repl, repl, repl, repl, repl, repl,
                       repl, repl, repl, repl, repl, repl),
-            out_specs=(sharded, sharded, sharded, sharded))
+            out_specs=tuple([sharded] * n_out))
         # the replication-check kwarg was renamed check_rep -> check_vma
         # across jax releases; disable it under whichever name this
         # jax accepts (the psum'd fixpoint is deliberately mixed
@@ -185,14 +193,21 @@ class ShardedTpuConflictSet(TpuConflictSet):
         # same compile/execute accounting as the single-shard families:
         # the sharded kernels have the most expensive compiles, so
         # bucket churn must be visible in the process-wide profile too
+        tag = "" if attribute else "/noattr"
         fn = profile_kernel(
-            fn, f"sharded[{self._cap}c/{npad}t/{nrp}r/{nwp}w]")
+            fn, f"sharded[{self._cap}c/{npad}t/{nrp}r/{nwp}w{tag}]")
         self._shard_fns[key] = fn
         return fn
 
-    def _call_kernel(self, npad, nrp, nwp, args):
-        fn = self._get_shard_fn(npad, nrp, nwp)
+    def _call_kernel(self, npad, nrp, nwp, args, attribute: bool):
+        fn = self._get_shard_fn(npad, nrp, nwp, attribute)
         lows, highs = self._shard_bounds
-        self._hk, self._hv, count, conflict = fn(
-            lows, highs, self._hk, self._hv, *args)
-        return count, conflict[0]
+        read_hit = None
+        if attribute:
+            self._hk, self._hv, count, conflict, read_hit = fn(
+                lows, highs, self._hk, self._hv, *args)
+            read_hit = read_hit[0]
+        else:
+            self._hk, self._hv, count, conflict = fn(
+                lows, highs, self._hk, self._hv, *args)
+        return count, conflict[0], read_hit

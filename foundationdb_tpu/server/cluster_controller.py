@@ -143,6 +143,13 @@ class ClusterController:
         self._recovery_task = None
         self._cstate: Optional[CoordinatedState] = None  # set once elected
         self._storage_objs: dict = {}      # name -> StorageServer (registry)
+        # latest probe round + banded history per probe stage (ref: the
+        # latencyProbe section of clusterGetStatus, Status.actor.cpp:983
+        # — probes are real transactions, so the bands measure what a
+        # client would actually experience)
+        self._latency_probe: dict = {}
+        self._probe_bands = {k: flow.RequestLatency(f"probe_{k}")
+                             for k in ("grv", "read", "commit")}
         # (instance name, counter) -> TimeSeries (ref: TDMetric levels)
         self.metrics: dict = {}
         self._metric_gauges: set = set()   # (rn, cn) sampled via set()
@@ -920,8 +927,8 @@ class ClusterController:
         operators read these, and the bands feed alerting)."""
         from ..client import Database
         db = Database(self.process, self.open_db.ref())
-        self._latency_probe = {}
         probe_seen_committed = -1
+        rounds = 0
         while True:
             await flow.delay(flow.SERVER_KNOBS.latency_probe_interval,
                              TaskPriority.LOW_PRIORITY)
@@ -938,10 +945,14 @@ class ClusterController:
                 t1 = flow.now()
                 await tr.get(probe_key)
                 read_s = flow.now() - t1
+                self._probe_bands["grv"].record(grv_s)
+                self._probe_bands["read"].record(read_s)
+                rounds += 1
                 probe = {
                     "transaction_start_seconds": round(grv_s, 6),
                     "read_seconds": round(read_s, 6),
                     "probed_at": round(flow.now(), 3),
+                    "rounds": rounds,
                 }
                 # the COMMIT probe only runs while the cluster is
                 # seeing commits: an idle cluster must be able to go
@@ -956,6 +967,7 @@ class ClusterController:
                     tr2.set(probe_key, b"%d" % int(flow.now() * 1000))
                     t2 = flow.now()
                     probe_seen_committed = await tr2.commit()
+                    self._probe_bands["commit"].record(flow.now() - t2)
                     probe["commit_seconds"] = round(flow.now() - t2, 6)
                 elif "commit_seconds" in self._latency_probe:
                     probe["commit_seconds"] = \
@@ -963,6 +975,84 @@ class ClusterController:
                 self._latency_probe = probe
             except flow.FdbError:
                 pass  # a probe racing a recovery just skips a round
+
+    def _health_messages(self, info) -> list:
+        """Event-driven health rollup: the status document's `messages`
+        array (ref: the messages JSON clusterGetStatus assembles —
+        operators and alerting read these, not raw counters). Each
+        entry: name, severity, human description, plus the numbers
+        behind the judgment. Conditions surfaced: a resolver holding
+        more conflict-history rows than its memory limit (the window GC
+        is losing to the write rate), a pathological conflict fraction
+        over the recent metric-sample window, and storage trailing the
+        log frontier by more than a healthy MVCC window."""
+        msgs: list = []
+        from .resolver_role import Resolver
+        ep = info.epoch
+        limit = flow.SERVER_KNOBS.resolver_state_memory_limit
+        for wi in self.workers.values():
+            if not wi.worker.process.alive:
+                continue
+            for rn, role in wi.worker.roles.items():
+                if isinstance(role, Resolver) and f"-e{ep}-" in rn:
+                    size = role.state_size()
+                    if size > limit:
+                        msgs.append({
+                            "name": "saturated_resolver",
+                            "severity": flow.trace.SevWarnAlways,
+                            "description":
+                                f"Resolver {rn} holds {size} conflict-"
+                                f"history rows (limit {limit})",
+                            "resolver": rn, "state_rows": size,
+                            "limit": limit})
+        # conflict fraction over the sampled tail (the metric sampler is
+        # the event source; status just reads the window)
+        conflicted = committed = 0.0
+        sampled = False
+        for (rn, cn), ts in self.metrics.items():
+            if not rn.startswith("proxy"):
+                continue
+            tail = ts.series(0)
+            if len(tail) < 2:
+                continue
+            delta = tail[-1][1] - tail[0][1]
+            if cn == "transactions_conflicted":
+                conflicted += max(delta, 0)
+                sampled = True
+            elif cn == "transactions_committed":
+                committed += max(delta, 0)
+        total = conflicted + committed
+        if sampled and total >= 10 and \
+                conflicted / total > flow.SERVER_KNOBS.health_conflict_rate:
+            msgs.append({
+                "name": "high_conflict_rate",
+                "severity": flow.trace.SevWarnAlways,
+                "description":
+                    f"{conflicted / total:.0%} of recent transactions "
+                    "aborted on conflicts (see conflict_hot_spots)",
+                "conflict_rate": round(conflicted / total, 4),
+                "window_transactions": int(total)})
+        frontier = max((t.version.get() for t in self.tlog_objs()),
+                       default=0)
+        lag_limit = flow.SERVER_KNOBS.health_storage_lag_versions
+        behind = []
+        for s in info.storages:
+            for rep in s.replicas:
+                obj = self._storage_objs.get(rep.name)
+                if obj is None or not obj.process.alive:
+                    continue
+                lag = frontier - obj.version.get()
+                if lag > lag_limit:
+                    behind.append((rep.name, lag))
+        for name, lag in behind:
+            msgs.append({
+                "name": "storage_behind_tlog",
+                "severity": flow.trace.SevWarnAlways,
+                "description":
+                    f"Storage {name} trails the log frontier by "
+                    f"{lag} versions",
+                "storage": name, "lag_versions": lag})
+        return msgs
 
     # -- status ----------------------------------------------------------
     async def _status_loop(self):
@@ -1044,12 +1134,40 @@ class ClusterController:
                         "counters": role.stats.snapshot(),
                         "latency_bands": {
                             "resolve": role.resolve_bands.snapshot()},
+                        # decaying conflict-attribution table: which
+                        # key ranges are aborting transactions HERE
+                        "hot_spots": role.hot_spots.top(),
                         # device-kernel profile: pad occupancy +
                         # compile/execute accounting ({} off-device)
                         "kernel": role.kernel_stats()})
                 elif isinstance(role, Ratekeeper) and \
                         rn.endswith(f"-e{info.epoch}"):
                     rate = role.rate
+        # cluster-level hot-spot view: merge every resolver's table by
+        # range (keyspace-sharded resolvers each see disjoint causes)
+        merged_hot: dict = {}
+        for r in resolvers:
+            for row in r["hot_spots"]:
+                ent = merged_hot.setdefault(
+                    (row["begin"], row["end"]), {"score": 0.0, "total": 0})
+                ent["score"] += row["score"]
+                ent["total"] += row["total"]
+        hot_rows = [{"begin": b, "end": e,
+                     "score": round(v["score"], 4), "total": v["total"]}
+                    for (b, e), v in merged_hot.items()]
+        hot_rows.sort(key=lambda r: (-r["score"], r["begin"]))
+        from ..flow import coverage as _coverage
+        cov = _coverage.report()
+        probe = dict(self._latency_probe)
+        if probe:
+            # banded history only beside a published round: consumers
+            # key on the scalar fields ("if probe: probe[...]"), so a
+            # mid-round bands-only dict must not make probe truthy
+            bands = {k: v.snapshot()
+                     for k, v in self._probe_bands.items()
+                     if v.bands.total}
+            if bands:
+                probe["bands"] = bands
         return {
             "cluster": {
                 "epoch": info.epoch,
@@ -1067,7 +1185,19 @@ class ClusterController:
                 # backend instance in this process
                 "kernels": _global_kernel_counters(),
                 "qos": {"transactions_per_second_limit": rate},
-                "latency_probe": getattr(self, "_latency_probe", {}),
+                "latency_probe": probe,
+                # hottest conflict-causing key ranges, cluster-wide
+                # (per-resolver tables under resolvers[*].hot_spots)
+                "conflict_hot_spots": hot_rows[
+                    :int(flow.SERVER_KNOBS.hot_spot_top_k)],
+                # event-driven health rollup (ref: the status document's
+                # messages array operators alert on)
+                "messages": self._health_messages(info),
+                # TEST() coverage summary (ref: the coverage tool over
+                # annotated rare paths; full dump rides the CI artifact)
+                "coverage": {"declared": len(cov["declared"]),
+                             "hit": len(cov["hit"]),
+                             "unhit": cov["unhit"]},
                 # multi-resolution counter time series (ref: TDMetric):
                 # newest sample + a short fine-grained tail per metric
                 "metrics": {

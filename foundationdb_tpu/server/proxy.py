@@ -29,9 +29,10 @@ from ..rpc import NetworkRef, RequestStream, SimProcess
 from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS, PRIORITY_BATCH,
                     PRIORITY_DEFAULT, PRIORITY_IMMEDIATE, SET_VALUE,
                     SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
-                    CommitReply, CommitRequest, GetReadVersionReply,
-                    MetadataMutations, MutationRef, ResolveRequest,
-                    TLogCommitRequest, TaggedMutation, mutation_bytes)
+                    CommitConflictReply, CommitReply, CommitRequest,
+                    GetReadVersionReply, MetadataMutations, MutationRef,
+                    ResolveReply, ResolveRequest, TLogCommitRequest,
+                    TaggedMutation, mutation_bytes)
 
 from .systemkeys import is_management_mutation as _is_management_mutation
 
@@ -608,7 +609,8 @@ class Proxy:
                 vf = flow.spawn(self._resolve_split(ver, reqs),
                                 TaskPriority.PROXY_COMMIT)
             self._advance(self.batch_resolving, local)
-            verdicts = await vf
+            verdicts, conflict_ranges = self._norm_verdicts(
+                await vf, len(reqs))
             self._mark(dbg,
                        "MasterProxyServer.commitBatch.AfterResolution")
 
@@ -680,7 +682,17 @@ class Proxy:
                 else:
                     flow.cover("proxy.commit.conflict")
                     st.counter("transactions_conflicted").add(1)
-                    reply.send_error(error("not_committed"))
+                    if getattr(reqs[idx], "report_conflicting_keys",
+                               False):
+                        # a reporting client gets the attributed key
+                        # ranges as a VALUE reply and raises
+                        # not_committed itself (errors carry no payload
+                        # across the wire)
+                        flow.cover("proxy.commit.report_conflicting")
+                        reply.send(CommitConflictReply(
+                            tuple(conflict_ranges[idx])))
+                    else:
+                        reply.send_error(error("not_committed"))
         except flow.FdbError as e:
             # a dead or locked downstream role means this proxy's epoch
             # is over; the batch may or may not have reached a log, so
@@ -705,6 +717,16 @@ class Proxy:
     def _advance(nv: NotifiedVersion, to: int) -> None:
         if nv.get() < to:
             nv.set(to)
+
+    @staticmethod
+    def _norm_verdicts(r, n):
+        """Resolver replies are a bare verdict list on the common path,
+        a ResolveReply (verdicts + attributed ranges) when some txn in
+        the batch asked for report_conflicting_keys — normalize to
+        (verdicts, ranges_per_txn)."""
+        if isinstance(r, ResolveReply):
+            return list(r.verdicts), list(r.conflicting_ranges)
+        return list(r), [()] * n
 
     async def _resolve_split(self, ver, reqs):
         """Send each transaction's ranges clipped per resolver via the
@@ -739,8 +761,17 @@ class Proxy:
             for ref, plist in zip(self.resolver_refs, per)]
         results = await flow.all_of(futs)
         combined = [COMMITTED] * len(reqs)
-        for plist, verdicts in zip(per, results):
-            for (idx, _), v in zip(plist, verdicts):
+        ranges: list = [()] * len(reqs)
+        for plist, result in zip(per, results):
+            verdicts, rngs = self._norm_verdicts(result, len(plist))
+            for (idx, _), v, rs in zip(plist, verdicts, rngs):
                 combined[idx] = min(combined[idx], v)
-        return combined
+                if rs:
+                    # union of each resolver's attribution: the clipped
+                    # pieces are disjoint per resolver, dedup only the
+                    # double-delivery window after a move
+                    seen = set(ranges[idx])
+                    ranges[idx] = tuple(ranges[idx]) + tuple(
+                        r for r in rs if r not in seen)
+        return ResolveReply(tuple(combined), tuple(ranges))
 

@@ -18,7 +18,63 @@ from .. import flow
 from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority
 from ..models import ResolverTransaction, create_conflict_set
 from ..rpc import RequestStream, SimProcess
-from .types import ResolutionMetricsReply, ResolveRequest
+from .types import ResolutionMetricsReply, ResolveReply, ResolveRequest
+
+
+class ConflictHotSpots:
+    """Decaying top-K table of conflict-causing key ranges (ref: the
+    per-range busyness tracking behind FDB's hot-key/hot-shard
+    telemetry — TransactionTagCounter / StorageMetrics byteSample style
+    exponential decay, applied here to attributed conflict ranges).
+
+    Each attributed range accumulates a score that halves every
+    `half_life` seconds of simulated time, so a burst of aborts shows
+    up immediately and ages out instead of pinning the table forever.
+    Bounded at `max_entries` (lowest decayed score evicted); `top(k)`
+    is the status/CLI surface."""
+
+    __slots__ = ("half_life", "max_entries", "_entries")
+
+    def __init__(self, half_life: float = None, max_entries: int = None):
+        self.half_life = (half_life if half_life is not None
+                          else SERVER_KNOBS.hot_spot_half_life)
+        self.max_entries = (max_entries if max_entries is not None
+                            else int(SERVER_KNOBS.hot_spot_max_entries))
+        # (begin, end) -> [decayed score, raw total, last update time]
+        self._entries: dict = {}
+
+    def _decayed(self, score: float, since: float, now: float) -> float:
+        if now <= since or self.half_life <= 0:
+            return score
+        return score * 0.5 ** ((now - since) / self.half_life)
+
+    def record(self, begin: bytes, end: bytes, weight: float = 1.0) -> None:
+        now = flow.now()
+        ent = self._entries.get((begin, end))
+        if ent is None:
+            self._entries[(begin, end)] = [float(weight), 1, now]
+        else:
+            ent[0] = self._decayed(ent[0], ent[2], now) + weight
+            ent[1] += 1
+            ent[2] = now
+        if len(self._entries) > self.max_entries:
+            worst = min(self._entries,
+                        key=lambda k: self._decayed(
+                            self._entries[k][0], self._entries[k][2], now))
+            del self._entries[worst]
+
+    def top(self, k: int = None) -> list:
+        """Status-ready rows, hottest first: decayed rate score + raw
+        total per attributed range."""
+        if k is None:
+            k = int(SERVER_KNOBS.hot_spot_top_k)
+        now = flow.now()
+        rows = [(self._decayed(s, t, now), total, b, e)
+                for (b, e), (s, total, t) in self._entries.items()]
+        rows.sort(key=lambda r: (-r[0], r[2], r[3]))
+        return [{"begin": b.hex(), "end": e.hex(),
+                 "score": round(score, 4), "total": total}
+                for score, total, b, e in rows[:k]]
 
 
 class Resolver:
@@ -39,6 +95,12 @@ class Resolver:
         # banded + sampled batch-resolve latency (the resolver stage of
         # the commit pipeline; ref: LatencyBands in status)
         self.resolve_bands = flow.RequestLatency("resolve")
+        # decaying top-K table of conflict-causing key ranges, fed by
+        # the backend's attribution on every batch (ref: the conflict
+        # telemetry report_conflicting_keys exists to provide; the
+        # conflict-aware scheduling literature presupposes exactly this
+        # per-range signal)
+        self.hot_spots = ConflictHotSpots()
         self._pressure_traced = False
         self._actors = flow.ActorCollection()
         # reply cache for duplicate delivery (proxy retry after a broken
@@ -119,10 +181,15 @@ class Resolver:
                 for b, _e in t.write_ranges:
                     self.key_hist[b[0] if b else 0] += 1
                 self.work_units += len(t.read_ranges) + len(t.write_ranges)
+            want_report = any(
+                getattr(t, "report_conflicting_keys", False)
+                for t in req.transactions)
             new_oldest = max(0, req.version - self._mwtlv)
+            attributions = None
             try:
-                verdicts = self.conflict_set.resolve(txns, req.version,
-                                                     new_oldest)
+                verdicts, attributions = \
+                    self.conflict_set.resolve_with_attribution(
+                        txns, req.version, new_oldest)
             except (ValueError, OverflowError) as e:
                 # A malformed batch (e.g. a key wider than the backend's key
                 # bucket) must not wedge the pipeline: conflict the whole
@@ -134,7 +201,26 @@ class Resolver:
                     Version=req.version, Error=str(e)).log()
                 verdicts = [0] * len(req.transactions)
                 self.conflict_set.resolve([], req.version, new_oldest)
-            self._reply_cache[req.version] = verdicts
+            # attribution -> actual key ranges: feed the hot-spot table
+            # every batch, and build the per-txn reply payload when a
+            # txn asked for report_conflicting_keys
+            ranges_per_txn = [()] * len(txns)
+            if attributions is not None:
+                n_attr = 0
+                for t, idxs in enumerate(attributions):
+                    if not idxs:
+                        continue
+                    rs = tuple(txns[t].read_ranges[i] for i in idxs)
+                    ranges_per_txn[t] = rs
+                    n_attr += len(rs)
+                    for b, e in rs:
+                        self.hot_spots.record(b, e)
+                if n_attr:
+                    self.stats.counter("conflict_ranges_attributed") \
+                        .add(n_attr)
+            payload = (ResolveReply(tuple(verdicts), tuple(ranges_per_txn))
+                       if want_report else verdicts)
+            self._reply_cache[req.version] = payload
             self._reply_order.append(req.version)
             while len(self._reply_order) > self._cache_cap:
                 self._reply_cache.pop(self._reply_order.popleft(), None)
@@ -143,7 +229,7 @@ class Resolver:
             self.stats.counter("batches_resolved").add(1)
             self.stats.counter("transactions_resolved").add(len(txns))
             self.resolve_bands.record(flow.now() - t0)
-            reply.send(verdicts)
+            reply.send(payload)
             self._check_state_pressure(req.version)
         finally:
             flow.g_trace_batch.finish_spans(spans)

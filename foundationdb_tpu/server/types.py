@@ -88,12 +88,27 @@ class CommitRequest(NamedTuple):
     # sampled-transaction stitching token (ref: debugTransaction /
     # the debugID riding CommitTransactionRequest)
     debug_id: Optional[int] = None
+    # surface the conflicting key ranges on abort (ref: the
+    # REPORT_CONFLICTING_KEYS transaction option,
+    # fdbclient/CommitTransaction.h report_conflicting_keys flag)
+    report_conflicting_keys: bool = False
 
 
 class CommitReply(NamedTuple):
     version: int       # the commit version
     batch_index: int   # transaction's index within the commit batch
                        # (second half of the versionstamp)
+
+
+class CommitConflictReply(NamedTuple):
+    """Reply to a CONFLICTED transaction that asked for
+    report_conflicting_keys: the proxy answers with the attributed key
+    ranges instead of a bare not_committed error, and the client raises
+    not_committed itself after recording them (ref: the conflicting-keys
+    special keyspace \\xff\\xff/transaction/conflicting_keys/ the
+    reference exposes after a reported conflict)."""
+
+    conflicting_ranges: Tuple[Range, ...]
 
 
 class MetadataMutations(NamedTuple):
@@ -135,6 +150,18 @@ class ResolveRequest(NamedTuple):
     version: int
     transactions: Tuple[CommitRequest, ...]
     debug_ids: Tuple[int, ...] = ()
+
+
+class ResolveReply(NamedTuple):
+    """Resolver reply when the batch carried a report_conflicting_keys
+    request: verdicts plus, per transaction, the read conflict ranges
+    attributed as the conflict's cause (empty for committed/tooOld).
+    Batches with no reporting request reply a bare verdict list — the
+    common path stays a flat array (ref: ResolveTransactionBatchReply
+    growing conflictingKeyRangeMap for this feature)."""
+
+    verdicts: Tuple[int, ...]
+    conflicting_ranges: Tuple[Tuple[Range, ...], ...]
 
 
 class StorageGetRequest(NamedTuple):

@@ -29,8 +29,11 @@ Commands (ref: fdbcli):
                              (sel: lt | le | gt | ge)
   status [json|details]      cluster status (details: per-stage
                              latency bands, percentiles, kernel
-                             profile)
+                             profile, conflict hot spots, latency
+                             probe, health messages)
   metrics                    counter time series (latest + rates)
+  top                        hottest conflict ranges + role rates
+                             (the conflict-attribution view)
   configure <k>=<v> ...      change the cluster shape (proxies,
                              resolvers, logs, conflict_backend)
   exclude <worker>           bar a worker from hosting roles
@@ -130,10 +133,69 @@ def _render_details(cl: dict) -> str:
                      f"busy={rl.get('busy_seconds')}s")
         for t in rl.get("slow_tasks", ()):
             lines.append(f"  slow: {t['seconds']:<8} {t['task']}")
+    lines.append("Latency probe:")
     probe = cl.get("latency_probe") or {}
-    if probe:
-        lines.append("Probe: " + "  ".join(
-            f"{k}={v}" for k, v in sorted(probe.items())))
+    scalars = {k: v for k, v in probe.items() if k != "bands"}
+    if scalars:
+        lines.append("  " + "  ".join(
+            f"{k}={v}" for k, v in sorted(scalars.items())))
+    else:
+        lines.append("  (no probe round yet)")
+    for stage, snap in sorted((probe.get("bands") or {}).items()):
+        lines.append(_band_line("cluster-probe", stage, snap))
+    lines.extend(_hot_spot_and_message_lines(cl))
+    return "\n".join(lines)
+
+
+def _hot_spot_and_message_lines(cl: dict) -> List[str]:
+    """The conflict-hot-spot table + health messages — shared by
+    `status details` and `top`."""
+    lines = ["Conflict hot spots (decaying score):"]
+    hot = cl.get("conflict_hot_spots") or ()
+    for row in hot:
+        lines.append(f"  [{row['begin']}, {row['end']})  "
+                     f"score={row['score']:<10g} total={row['total']}")
+    if not hot:
+        lines.append("  (none attributed)")
+    for m in cl.get("messages", ()):
+        lines.append(f"Message [{m.get('severity')}] {m.get('name')}: "
+                     f"{m.get('description')}")
+    return lines
+
+
+def _tail_rate(series: dict) -> str:
+    tail = series.get("tail") or []
+    if series.get("gauge"):
+        return "(gauge)"
+    if len(tail) >= 2 and tail[-1][0] > tail[0][0] and \
+            tail[-1][1] >= tail[0][1]:
+        return f"{(tail[-1][1] - tail[0][1]) / (tail[-1][0] - tail[0][0]):.2f}"
+    return ""
+
+
+def _render_top(cl: dict) -> str:
+    """`top`: the conflict-attribution view — hottest key ranges first
+    (what an operator looks at when high_conflict_rate fires), then the
+    busiest role counters by sampled rate."""
+    lines = _hot_spot_and_message_lines(cl)
+    watch = ("transactions_committed", "transactions_conflicted",
+             "transactions_started", "batches_resolved",
+             "transactions_resolved", "conflict_ranges_attributed",
+             "commits", "get_queries")
+    rows = []
+    for name, s in sorted((cl.get("metrics") or {}).items()):
+        rn, _, cn = name.partition("/")
+        if cn not in watch:
+            continue
+        rate = _tail_rate(s)
+        if not rate or rate == "(gauge)":
+            continue
+        rows.append((float(rate), rn, cn))
+    rows.sort(reverse=True)
+    if rows:
+        lines.append("Busiest counters (rate/s over the sampled tail):")
+        for rate, rn, cn in rows[:12]:
+            lines.append(f"  {rate:>10.2f}/s  {rn}/{cn}")
     return "\n".join(lines)
 
 
@@ -144,16 +206,10 @@ def _render_metrics(cl: dict) -> str:
              "latest      rate/s"]
     for name, s in sorted(cl.get("metrics", {}).items()):
         latest = s.get("latest")
-        tail = s.get("tail") or []
-        rate = ""
         # same semantics as the *Metrics rollup: gauges are levels
         # (no derivative), and a negative delta is a role restart
         # (re-baseline), not a rate
-        if s.get("gauge"):
-            rate = "(gauge)"
-        elif len(tail) >= 2 and tail[-1][0] > tail[0][0] and \
-                tail[-1][1] >= tail[0][1]:
-            rate = f"{(tail[-1][1] - tail[0][1]) / (tail[-1][0] - tail[0][0]):.2f}"
+        rate = _tail_rate(s)
         val = latest[1] if latest else "-"
         lines.append(f"{name:<48}  {val:<10}  {rate}")
     return "\n".join(lines)
@@ -261,6 +317,10 @@ class Cli:
             async def mt():
                 return await self.db.get_status()
             return _render_metrics(self._run(mt())["cluster"])
+        if cmd == "top":
+            async def tp():
+                return await self.db.get_status()
+            return _render_top(self._run(tp())["cluster"])
         if cmd == "status":
             async def st():
                 return await self.db.get_status()

@@ -1,0 +1,341 @@
+"""Prometheus exporter: the cluster status document as scrape text.
+
+Reference: the reference cluster is scraped by parsing `status json`
+(the community fdb-exporter pattern); here the status document the
+ClusterController assembles (server/cluster_controller.py get_status)
+is rendered directly into the Prometheus text exposition format —
+every role's counters, the per-stage latency-band histograms, the
+TPU-kernel profile gauges, the latency-probe readings, the conflict
+hot-spot table, and the health messages — so one scrape covers the
+whole commit pipeline.
+
+Use in-process (`render_prometheus(status)`), or serve over HTTP:
+`python -m foundationdb_tpu.tools.exporter --connect host:port
+[--listen-port 9090]` attaches to a tools.server cluster and serves
+GET /metrics.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Callable, List, Optional, Tuple
+
+_PREFIX = "fdbtpu"
+
+
+def _esc(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class _Families:
+    """Accumulate samples grouped by metric family so each family
+    renders one # HELP/# TYPE header (the format requires grouping)."""
+
+    def __init__(self):
+        self._fams: dict = {}   # name -> (type, help, [(suffix, labels, value)])
+        self._order: List[str] = []
+
+    def add(self, name: str, mtype: str, help_text: str,
+            labels: dict, value, suffix: str = "") -> None:
+        """`suffix` names histogram children (`_bucket`, `_count`):
+        the TYPE/HELP header goes on the FAMILY name and the samples on
+        name+suffix, the grouping strict OpenMetrics parsers require."""
+        if value is None:
+            return
+        fam = self._fams.get(name)
+        if fam is None:
+            fam = self._fams[name] = (mtype, help_text, [])
+            self._order.append(name)
+        fam[2].append((suffix, labels, value))
+
+    def render(self) -> str:
+        out: List[str] = []
+        for name in self._order:
+            mtype, help_text, samples = self._fams[name]
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} {mtype}")
+            for suffix, labels, value in samples:
+                if labels:
+                    lab = ",".join(f'{k}="{_esc(v)}"'
+                                   for k, v in labels.items())
+                    out.append(f"{name}{suffix}{{{lab}}} {value}")
+                else:
+                    out.append(f"{name}{suffix} {value}")
+        return "\n".join(out) + "\n"
+
+
+def _band_seconds(band_key: str) -> str:
+    # "<=0.005s" -> "0.005"
+    return band_key[2:].rstrip("s")
+
+
+def _add_latency(f: _Families, kind: str, role: str, request: str,
+                 snap: dict) -> None:
+    """One RequestLatency snapshot -> histogram buckets + count + max +
+    quantile gauges (the reservoir percentiles ride a separate family:
+    a summary and a histogram may not share a metric name)."""
+    base = f"{_PREFIX}_request_latency_seconds"
+    help_text = "Request latency bands per pipeline stage"
+    labels = {"kind": kind, "role": role, "request": request}
+    for bk, count in snap.get("bands", {}).items():
+        f.add(base, "histogram", help_text,
+              {**labels, "le": _band_seconds(bk)}, count, suffix="_bucket")
+    f.add(base, "histogram", help_text,
+          {**labels, "le": "+Inf"}, snap.get("total", 0),
+          suffix="_bucket")
+    f.add(base, "histogram", help_text, labels, snap.get("total", 0),
+          suffix="_count")
+    f.add(f"{_PREFIX}_request_latency_max_seconds", "gauge",
+          "Largest latency ever observed per stage", labels,
+          snap.get("max_seconds"))
+    for q in ("p50", "p90", "p99"):
+        if q in snap:
+            f.add(f"{_PREFIX}_request_latency_quantile_seconds", "gauge",
+                  "Recent-reservoir latency percentiles per stage",
+                  {**labels, "quantile": "0." + q[1:]}, snap[q])
+
+
+def _add_counters(f: _Families, kind: str, role: str, counters: dict) -> None:
+    for cname, value in sorted((counters or {}).items()):
+        f.add(f"{_PREFIX}_role_counter", "counter",
+              "Role counters (flow/Stats CounterCollection values)",
+              {"kind": kind, "role": role, "counter": cname}, value)
+
+
+def render_prometheus(status: dict) -> str:
+    """The status document as Prometheus text exposition format."""
+    cl = status.get("cluster", status) or {}
+    f = _Families()
+    f.add(f"{_PREFIX}_cluster_epoch", "gauge",
+          "Current recovery epoch", {}, cl.get("epoch"))
+    f.add(f"{_PREFIX}_cluster_recovered", "gauge",
+          "1 when recovery_state is fully_recovered", {},
+          int(cl.get("recovery_state") == "fully_recovered"))
+    tps = (cl.get("qos") or {}).get("transactions_per_second_limit")
+    f.add(f"{_PREFIX}_qos_transactions_per_second_limit", "gauge",
+          "Ratekeeper transaction budget", {}, tps)
+
+    for p in cl.get("proxies", ()):
+        _add_counters(f, "proxy", p["name"], p.get("counters"))
+        for req, snap in (p.get("latency_bands") or {}).items():
+            _add_latency(f, "proxy", p["name"], req, snap)
+    for r in cl.get("resolvers", ()):
+        _add_counters(f, "resolver", r["name"], r.get("counters"))
+        for req, snap in (r.get("latency_bands") or {}).items():
+            _add_latency(f, "resolver", r["name"], req, snap)
+        kern = r.get("kernel") or {}
+        if kern:
+            f.add(f"{_PREFIX}_resolver_state_rows", "gauge",
+                  "Conflict-history rows held by the resolver backend",
+                  {"role": r["name"]}, kern.get("state_rows"))
+            f.add(f"{_PREFIX}_resolver_state_capacity", "gauge",
+                  "Device history capacity (rows)",
+                  {"role": r["name"]}, kern.get("capacity"))
+            f.add(f"{_PREFIX}_resolver_kernel_batches", "counter",
+                  "Batches dispatched through the device kernel",
+                  {"role": r["name"]}, kern.get("batches"))
+            for dim, occ in (kern.get("occupancy") or {}).items():
+                if occ is not None:
+                    f.add(f"{_PREFIX}_resolver_kernel_occupancy", "gauge",
+                          "Real rows / padded slots per batch dimension",
+                          {"role": r["name"], "dim": dim}, occ)
+    for lg in cl.get("logs", ()):
+        _add_counters(f, "tlog", lg.get("store", "?"), lg.get("counters"))
+        f.add(f"{_PREFIX}_tlog_queue_length", "gauge",
+              "Unpopped log entries", {"role": lg.get("store", "?")},
+              lg.get("queue_length"))
+        for req, snap in (lg.get("latency_bands") or {}).items():
+            _add_latency(f, "tlog", lg.get("store", "?"), req, snap)
+    seen_reps: set = set()
+    for s in cl.get("storages", ()):
+        for rep in s.get("replicas", ()):
+            # the storages list is per SHARD; a server hosting several
+            # shards carries the same snapshot in each entry
+            if rep["name"] in seen_reps or "counters" not in rep:
+                continue
+            seen_reps.add(rep["name"])
+            _add_counters(f, "storage", rep["name"], rep.get("counters"))
+            for req, snap in (rep.get("latency_bands") or {}).items():
+                _add_latency(f, "storage", rep["name"], req, snap)
+
+    # process-wide jitted-kernel profile: "family[shape].counter" keys
+    for key, value in sorted((cl.get("kernels") or {}).items()):
+        kernel, _, counter = key.rpartition(".")
+        f.add(f"{_PREFIX}_kernel_profile", "counter",
+              "Jitted-kernel compile/execute accounting per shape bucket",
+              {"kernel": kernel or key, "counter": counter}, value)
+
+    probe = cl.get("latency_probe") or {}
+    for field, stage in (("transaction_start_seconds", "grv"),
+                         ("read_seconds", "read"),
+                         ("commit_seconds", "commit")):
+        f.add(f"{_PREFIX}_latency_probe_seconds", "gauge",
+              "Last cluster-controller probe transaction latencies",
+              {"stage": stage}, probe.get(field))
+    f.add(f"{_PREFIX}_latency_probe_rounds", "counter",
+          "Probe rounds completed", {}, probe.get("rounds"))
+    for stage, snap in (probe.get("bands") or {}).items():
+        _add_latency(f, "probe", "cluster_controller", stage, snap)
+
+    for i, row in enumerate(cl.get("conflict_hot_spots", ())):
+        labels = {"rank": str(i), "begin": row["begin"],
+                  "end": row["end"]}
+        f.add(f"{_PREFIX}_conflict_hot_spot_score", "gauge",
+              "Decayed conflict-attribution score per key range", labels,
+              row["score"])
+        f.add(f"{_PREFIX}_conflict_hot_spot_total", "counter",
+              "Raw attributed-conflict count per key range", labels,
+              row["total"])
+
+    msgs = cl.get("messages", ())
+    f.add(f"{_PREFIX}_health_messages", "gauge",
+          "Active health messages in the status rollup", {}, len(msgs))
+    # aggregate per (name, severity): two lagging storages would
+    # otherwise emit identical label sets, which a real Prometheus
+    # server rejects as duplicate samples — failing the whole scrape
+    # exactly when the cluster is unhealthy
+    by_kind: dict = {}
+    for m in msgs:
+        key = (m.get("name", "?"), str(m.get("severity", 0)))
+        by_kind[key] = by_kind.get(key, 0) + 1
+    for (name, severity), count in sorted(by_kind.items()):
+        f.add(f"{_PREFIX}_health_message", "gauge",
+              "Active conditions per health-message kind",
+              {"name": name, "severity": severity}, count)
+
+    rl = cl.get("run_loop") or {}
+    f.add(f"{_PREFIX}_run_loop_tasks", "counter",
+          "Scheduler tasks executed", {}, rl.get("tasks_run"))
+    f.add(f"{_PREFIX}_run_loop_busy_seconds", "counter",
+          "Scheduler busy time", {}, rl.get("busy_seconds"))
+    return f.render()
+
+
+def parse_prometheus(text: str) -> List[Tuple[str, dict, float]]:
+    """Minimal exposition-format parser: [(name, labels, value)].
+    Raises ValueError on a malformed line — the CI smoke and the tests
+    use it as the well-formedness check."""
+    out: List[Tuple[str, dict, float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        rest = line
+        labels: dict = {}
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            body, _, rest = rest.partition("}")
+            for part in body.split(","):
+                if not part:
+                    continue
+                k, _, v = part.partition("=")
+                if not (v.startswith('"') and v.endswith('"')):
+                    raise ValueError(f"unquoted label value: {line!r}")
+                labels[k] = v[1:-1]
+            value = rest.strip()
+        else:
+            name, _, value = line.partition(" ")
+            value = value.strip()
+        if not name or not name.replace("_", "").replace(":", "") \
+                .isalnum():
+            raise ValueError(f"bad metric name: {line!r}")
+        out.append((name, labels, float(value)))
+    return out
+
+
+class ExporterServer:
+    """Tiny threaded HTTP server for GET /metrics. `get_text` runs on
+    the serving thread — pass something thread-safe (for a live
+    cluster, a RemoteCluster-backed closure; in tests, a canned
+    string)."""
+
+    def __init__(self, get_text: Callable[[], str], host: str = "127.0.0.1",
+                 port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = exporter.get_text().encode()
+                except Exception as e:  # noqa: BLE001 — scrape fails, server lives
+                    self.send_error(500, explain=str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.get_text = get_text
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    connect = None
+    listen_port = 9090
+    once = False
+    while argv:
+        a = argv.pop(0)
+        if a == "--connect":
+            connect = argv.pop(0)
+        elif a == "--listen-port":
+            listen_port = int(argv.pop(0))
+        elif a == "--once":
+            once = True   # print one scrape and exit (smoke / cron)
+    if connect is None:
+        print("usage: exporter --connect host:port [--listen-port N] "
+              "[--once]", file=sys.stderr)
+        return 2
+    from ..client.remote import RemoteCluster
+    host, _, port = connect.partition(":")
+    remote = RemoteCluster(host or "127.0.0.1", int(port))
+
+    def scrape() -> str:
+        return render_prometheus(remote.call(remote.db.get_status()))
+
+    try:
+        if once:
+            print(scrape(), end="")
+            return 0
+        server = ExporterServer(scrape, port=listen_port)
+        server.start()
+        print(f"serving /metrics on :{server.port}", flush=True)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.close()
+        return 0
+    finally:
+        remote.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

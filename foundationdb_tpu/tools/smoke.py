@@ -1,0 +1,91 @@
+"""CI smoke: boot an in-process cluster, run a conflicting workload and
+one latency-probe round, then assert the operator surfaces are
+well-formed — `status details` (conflict hot spots + latency probe
+sections), `top`, and the Prometheus exporter text.
+
+`python -m foundationdb_tpu.tools.smoke` exits 0 on success; the
+tier-1 workflow runs it after the test suite as an end-to-end guard
+that the observability stack assembles outside pytest too."""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def run_smoke(out=print) -> int:
+    from .. import flow
+    from ..client import run_transaction
+    from ..server import SimCluster
+    from .cli import Cli
+    from .exporter import parse_prometheus, render_prometheus
+
+    cluster = SimCluster(seed=4242, durable=True)
+    cli = Cli.for_cluster(cluster)
+    try:
+        db = cluster.client("smoke")
+
+        async def workload():
+            async def seed(tr):
+                tr.set(b"hot", b"0")
+            await run_transaction(db, seed)
+            for _ in range(6):
+                tr = db.create_transaction()
+                tr.set_option("report_conflicting_keys")
+                await tr.get(b"hot")
+                tr.set(b"mine", b"v")
+
+                async def bump(t2):
+                    t2.set(b"hot", b"x")
+                await run_transaction(db, bump)
+                try:
+                    await tr.commit()
+                    raise AssertionError("expected a conflict")
+                except flow.FdbError as e:
+                    assert e.name == "not_committed", e.name
+                assert tr.get_conflicting_ranges() == \
+                    ((b"hot", b"hot\x00"),), tr.get_conflicting_ranges()
+            # one probe round: past LATENCY_PROBE_INTERVAL (5s) + the
+            # metric sampler tick
+            await flow.delay(7.0)
+            return await db.get_status()
+
+        status = cluster.run(workload(), timeout_time=300)
+        cl = status["cluster"]
+        assert cl["conflict_hot_spots"], "no hot spots attributed"
+        assert cl["conflict_hot_spots"][0]["begin"] == b"hot".hex()
+        assert cl["latency_probe"].get("rounds", 0) >= 1, \
+            "latency probe never ran"
+
+        details = cli.execute("status details")
+        for section in ("Latency (seconds):", "Conflict hot spots",
+                        "Latency probe:", b"hot".hex()):
+            assert str(section) in details, f"missing {section!r}"
+        top = cli.execute("top")
+        assert b"hot".hex() in top
+
+        text = render_prometheus(status)
+        samples = parse_prometheus(text)   # raises on malformed lines
+        kinds = {l.get("kind") for n, l, _ in samples
+                 if n == "fdbtpu_role_counter"}
+        missing = {"proxy", "resolver", "tlog", "storage"} - kinds
+        assert not missing, f"exporter missing role kinds: {missing}"
+        names = {n for n, _, _ in samples}
+        for need in ("fdbtpu_conflict_hot_spot_score",
+                     "fdbtpu_latency_probe_seconds",
+                     "fdbtpu_request_latency_seconds_bucket"):
+            assert need in names, f"exporter missing {need}"
+        out(f"SMOKE OK: {len(samples)} exporter samples, "
+            f"{len(cl['conflict_hot_spots'])} hot spots, "
+            f"{cl['latency_probe']['rounds']} probe rounds")
+        return 0
+    finally:
+        cluster.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run_smoke()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,106 @@ struct IntervalSet {
     }
 };
 
+// Shared batch pipeline. `read_hits_out` (nullable) is one byte per
+// FLATTENED read range in txn order; attribution semantics match
+// models/conflict_set.py resolve_with_attribution: a range is a cause
+// iff it conflicts against the pre-batch history OR overlaps a write
+// of an earlier non-conflicted transaction — evaluated for every
+// non-tooOld transaction (externally-conflicted ones included), with
+// no short-circuiting, so the set is identical across backends.
+static void resolve_impl(ConflictSet& cs, int64_t commit_version,
+                         int64_t new_oldest_version, int32_t txn_count,
+                         const int64_t* snapshots,
+                         const int32_t* read_counts,
+                         const int32_t* write_counts,
+                         const uint8_t* key_blob,
+                         const int64_t* read_ranges,
+                         const int64_t* write_ranges,
+                         uint8_t* verdicts_out,
+                         uint8_t* read_hits_out) {
+    auto key_at = [&](const int64_t* quad, int which) {
+        return Key(reinterpret_cast<const char*>(key_blob) + quad[which * 2],
+                   static_cast<size_t>(quad[which * 2 + 1]));
+    };
+
+    std::vector<uint8_t> too_old(txn_count, 0), conflict(txn_count, 0);
+
+    // tooOld pass (ref: addTransaction)
+    for (int32_t t = 0; t < txn_count; t++)
+        if (snapshots[t] < cs.oldest_version && read_counts[t] > 0)
+            too_old[t] = 1;
+
+    // (1) external check against history. Attribution checks EVERY
+    // range; verdict-only mode keeps the original short-circuit.
+    {
+        const int64_t* rr = read_ranges;
+        int64_t ri = 0;
+        for (int32_t t = 0; t < txn_count; t++) {
+            for (int32_t r = 0; r < read_counts[t]; r++, rr += 4, ri++) {
+                if (too_old[t]) continue;
+                if (conflict[t] && read_hits_out == nullptr) continue;
+                Key b = key_at(rr, 0), e = key_at(rr, 1);
+                if (b < e && cs.history.range_max(b, e) > snapshots[t]) {
+                    conflict[t] = 1;
+                    if (read_hits_out) read_hits_out[ri] = 1;
+                }
+            }
+        }
+    }
+
+    // (2) intra-batch, sequential in batch order; (3) collect surviving
+    // writes. Attribution also checks already-conflicted transactions'
+    // reads against the written set at their turn (their writes still
+    // never join it).
+    IntervalSet written;
+    {
+        const int64_t* rr = read_ranges;
+        const int64_t* wr = write_ranges;
+        int64_t ri = 0;
+        for (int32_t t = 0; t < txn_count; t++) {
+            if (conflict[t] || too_old[t]) {
+                if (read_hits_out && conflict[t] && !too_old[t]) {
+                    for (int32_t r = 0; r < read_counts[t]; r++, rr += 4, ri++) {
+                        Key b = key_at(rr, 0), e = key_at(rr, 1);
+                        if (b < e && written.overlaps(b, e))
+                            read_hits_out[ri] = 1;
+                    }
+                } else {
+                    rr += 4 * static_cast<int64_t>(read_counts[t]);
+                    ri += read_counts[t];
+                }
+                if (!conflict[t]) conflict[t] = 1;  // tooOld: writes dropped
+                wr += 4 * static_cast<int64_t>(write_counts[t]);
+                continue;
+            }
+            bool c = false;
+            for (int32_t r = 0; r < read_counts[t]; r++, rr += 4, ri++) {
+                if (c && read_hits_out == nullptr) continue;
+                Key b = key_at(rr, 0), e = key_at(rr, 1);
+                if (b < e && written.overlaps(b, e)) {
+                    c = true;
+                    if (read_hits_out) read_hits_out[ri] = 1;
+                }
+            }
+            conflict[t] = c ? 1 : 0;
+            for (int32_t w = 0; w < write_counts[t]; w++, wr += 4) {
+                if (c) continue;
+                Key b = key_at(wr, 0), e = key_at(wr, 1);
+                if (b < e) written.add(std::move(b), std::move(e));
+            }
+        }
+    }
+
+    for (const auto& [b, e] : written.iv) cs.history.assign(b, e, commit_version);
+
+    // (4) window GC
+    if (new_oldest_version > cs.oldest_version) cs.oldest_version = new_oldest_version;
+    if (++cs.batches % 16 == 0) cs.history.compact(cs.oldest_version);
+
+    for (int32_t t = 0; t < txn_count; t++)
+        verdicts_out[t] = too_old[t] ? 1 : (conflict[t] ? 0 : 2);
+}
+
 }  // namespace
 
 extern "C" {
@@ -144,68 +244,27 @@ void fdbtpu_conflictset_resolve(void* cs_, int64_t commit_version,
                                 const int64_t* read_ranges,
                                 const int64_t* write_ranges,
                                 uint8_t* verdicts_out) {
-    ConflictSet& cs = *static_cast<ConflictSet*>(cs_);
-    auto key_at = [&](const int64_t* quad, int which) {
-        return Key(reinterpret_cast<const char*>(key_blob) + quad[which * 2],
-                   static_cast<size_t>(quad[which * 2 + 1]));
-    };
+    resolve_impl(*static_cast<ConflictSet*>(cs_), commit_version,
+                 new_oldest_version, txn_count, snapshots, read_counts,
+                 write_counts, key_blob, read_ranges, write_ranges,
+                 verdicts_out, nullptr);
+}
 
-    std::vector<uint8_t> too_old(txn_count, 0), conflict(txn_count, 0);
-
-    // tooOld pass (ref: addTransaction)
-    {
-        for (int32_t t = 0; t < txn_count; t++)
-            if (snapshots[t] < cs.oldest_version && read_counts[t] > 0)
-                too_old[t] = 1;
-    }
-
-    // (1) external check against history
-    {
-        const int64_t* rr = read_ranges;
-        for (int32_t t = 0; t < txn_count; t++) {
-            for (int32_t r = 0; r < read_counts[t]; r++, rr += 4) {
-                if (too_old[t] || conflict[t]) continue;
-                Key b = key_at(rr, 0), e = key_at(rr, 1);
-                if (b < e && cs.history.range_max(b, e) > snapshots[t])
-                    conflict[t] = 1;
-            }
-        }
-    }
-
-    // (2) intra-batch, sequential in batch order; (3) collect surviving writes
-    IntervalSet written;
-    {
-        const int64_t* rr = read_ranges;
-        const int64_t* wr = write_ranges;
-        for (int32_t t = 0; t < txn_count; t++) {
-            if (conflict[t]) {
-                rr += 4 * static_cast<int64_t>(read_counts[t]);
-                wr += 4 * static_cast<int64_t>(write_counts[t]);
-                continue;
-            }
-            bool c = too_old[t] != 0;
-            for (int32_t r = 0; r < read_counts[t]; r++, rr += 4) {
-                if (c) continue;
-                Key b = key_at(rr, 0), e = key_at(rr, 1);
-                if (b < e && written.overlaps(b, e)) c = true;
-            }
-            conflict[t] = c ? 1 : 0;
-            for (int32_t w = 0; w < write_counts[t]; w++, wr += 4) {
-                if (c) continue;
-                Key b = key_at(wr, 0), e = key_at(wr, 1);
-                if (b < e) written.add(std::move(b), std::move(e));
-            }
-        }
-    }
-
-    for (const auto& [b, e] : written.iv) cs.history.assign(b, e, commit_version);
-
-    // (4) window GC
-    if (new_oldest_version > cs.oldest_version) cs.oldest_version = new_oldest_version;
-    if (++cs.batches % 16 == 0) cs.history.compact(cs.oldest_version);
-
-    for (int32_t t = 0; t < txn_count; t++)
-        verdicts_out[t] = too_old[t] ? 1 : (conflict[t] ? 0 : 2);
+// Resolve + conflict attribution (ref: report_conflicting_keys).
+//   read_hits_out: one byte per flattened read range (txn order);
+//   set to 1 when that range caused its transaction's conflict.
+//   Caller zero-initializes.
+void fdbtpu_conflictset_resolve_attributed(
+    void* cs_, int64_t commit_version, int64_t new_oldest_version,
+    int32_t txn_count, const int64_t* snapshots,
+    const int32_t* read_counts, const int32_t* write_counts,
+    const uint8_t* key_blob, const int64_t* read_ranges,
+    const int64_t* write_ranges, uint8_t* verdicts_out,
+    uint8_t* read_hits_out) {
+    resolve_impl(*static_cast<ConflictSet*>(cs_), commit_version,
+                 new_oldest_version, txn_count, snapshots, read_counts,
+                 write_counts, key_blob, read_ranges, write_ranges,
+                 verdicts_out, read_hits_out);
 }
 
 }  // extern "C"

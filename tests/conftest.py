@@ -24,3 +24,18 @@ try:  # the platform may already be initialized via sitecustomize
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Dump the TEST() coverage report (flow/coverage.py) so CI can
+    archive it alongside /tmp/_t1.log — the suite-level record of which
+    annotated rare paths actually fired this run."""
+    import json
+
+    try:
+        from foundationdb_tpu.flow import coverage
+
+        with open("/tmp/_coverage.json", "w") as f:
+            json.dump(coverage.report(), f, indent=2, sort_keys=True)
+    except Exception:
+        pass  # a missing dump must never fail the suite

@@ -1,0 +1,162 @@
+"""Prometheus exporter: status -> text exposition format -> parse.
+
+Ref: the fdb-exporter pattern (scrape `status json`, re-emit as
+Prometheus metrics); here the render is first-party and must stay
+parseable — the parse_prometheus round trip is the same well-formedness
+gate the CI smoke runs against a live cluster."""
+
+import urllib.request
+
+import pytest
+
+from foundationdb_tpu.tools.exporter import (ExporterServer,
+                                             parse_prometheus,
+                                             render_prometheus)
+
+
+def _canned_status():
+    return {"cluster": {
+        "epoch": 3,
+        "recovery_state": "fully_recovered",
+        "qos": {"transactions_per_second_limit": 1000.0},
+        "proxies": [{
+            "name": "proxy-e3-0",
+            "counters": {"transactions_committed": 42,
+                         "transactions_conflicted": 7},
+            "latency_bands": {"commit": {
+                "total": 49, "max_seconds": 0.2,
+                "p50": 0.01, "p90": 0.05, "p99": 0.1,
+                "bands": {"<=0.005s": 10, "<=0.1s": 45}}}}],
+        "resolvers": [{
+            "name": "resolver-e3-0",
+            "counters": {"batches_resolved": 12},
+            "latency_bands": {"resolve": {"total": 12, "bands": {}}},
+            "hot_spots": [],
+            "kernel": {"backend": "tpu", "platform": "cpu",
+                       "capacity": 1024, "state_rows": 17, "batches": 12,
+                       "occupancy": {"txn": 0.5, "read": None}}}],
+        "logs": [{"store": "tlog-e3-0", "queue_length": 2,
+                  "counters": {"commits": 30},
+                  "latency_bands": {"commit": {"total": 30, "bands": {}}}}],
+        "storages": [
+            {"tag": 0, "replicas": [
+                {"name": "storage-0-r0", "counters": {"get_queries": 5},
+                 "latency_bands": {"read": {"total": 5, "bands": {}}}}]},
+            # same server under a second shard: must not double-emit
+            {"tag": 1, "replicas": [
+                {"name": "storage-0-r0", "counters": {"get_queries": 5},
+                 "latency_bands": {"read": {"total": 5, "bands": {}}}}]}],
+        "kernels": {"resolve[1024c/16t/32r/32w].compiles": 1,
+                    "resolve[1024c/16t/32r/32w].calls": 12},
+        "latency_probe": {"transaction_start_seconds": 0.001,
+                          "read_seconds": 0.002, "commit_seconds": 0.01,
+                          "rounds": 4, "probed_at": 12.0,
+                          "bands": {"grv": {"total": 4, "bands": {}}}},
+        "conflict_hot_spots": [
+            {"begin": "686f74", "end": "686f7400", "score": 2.5,
+             "total": 6}],
+        "messages": [{"name": "high_conflict_rate", "severity": 30,
+                      "description": "x"}],
+        "run_loop": {"tasks_run": 1000, "busy_seconds": 0.5},
+    }}
+
+
+def test_render_is_parseable_and_covers_roles():
+    text = render_prometheus(_canned_status())
+    samples = parse_prometheus(text)
+    names = {n for n, _, _ in samples}
+    for need in ("fdbtpu_cluster_epoch", "fdbtpu_role_counter",
+                 "fdbtpu_request_latency_seconds_bucket",
+                 "fdbtpu_request_latency_seconds_count",
+                 "fdbtpu_kernel_profile", "fdbtpu_latency_probe_seconds",
+                 "fdbtpu_conflict_hot_spot_score",
+                 "fdbtpu_health_message", "fdbtpu_resolver_state_rows"):
+        assert need in names, (need, sorted(names))
+    # one sample per (name, labelset): duplicates are a scrape error
+    keys = [(n, tuple(sorted(l.items()))) for n, l, _ in samples]
+    assert len(keys) == len(set(keys))
+    # roles from every section are labeled
+    roles = {l.get("role") for n, l, _ in samples
+             if n == "fdbtpu_role_counter"}
+    assert {"proxy-e3-0", "resolver-e3-0", "tlog-e3-0",
+            "storage-0-r0"} <= roles
+
+
+def test_histogram_buckets_are_cumulative_with_inf():
+    text = render_prometheus(_canned_status())
+    buckets = [(l["le"], v) for n, l, v in parse_prometheus(text)
+               if n == "fdbtpu_request_latency_seconds_bucket"
+               and l.get("role") == "proxy-e3-0"]
+    by_le = dict(buckets)
+    assert by_le["+Inf"] == 49
+    assert by_le["0.005"] == 10 and by_le["0.1"] == 45
+
+
+def test_value_escaping():
+    st = _canned_status()
+    st["cluster"]["proxies"][0]["name"] = 'weird"role\\name'
+    text = render_prometheus(st)
+    samples = parse_prometheus(text)
+    assert any(l.get("role", "").startswith("weird")
+               for _n, l, _v in samples)
+
+
+def test_duplicate_health_messages_aggregate():
+    """Two conditions of the same kind must not emit identical label
+    sets (a real Prometheus server rejects duplicate samples — exactly
+    when the cluster is unhealthy)."""
+    st = _canned_status()
+    st["cluster"]["messages"] = [
+        {"name": "storage_behind_tlog", "severity": 30, "storage": "a"},
+        {"name": "storage_behind_tlog", "severity": 30, "storage": "b"},
+        {"name": "saturated_resolver", "severity": 30}]
+    samples = parse_prometheus(render_prometheus(st))
+    keys = [(n, tuple(sorted(l.items()))) for n, l, _ in samples]
+    assert len(keys) == len(set(keys))
+    vals = {l["name"]: v for n, l, v in samples
+            if n == "fdbtpu_health_message"}
+    assert vals == {"storage_behind_tlog": 2, "saturated_resolver": 1}
+
+
+def test_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus('bad_metric{le=0.1} 4')   # unquoted label
+    with pytest.raises(ValueError):
+        parse_prometheus('name with spaces 4')
+
+
+def test_http_server_serves_metrics():
+    text = render_prometheus(_canned_status())
+    srv = ExporterServer(lambda: text)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        resp = urllib.request.urlopen(url, timeout=10)
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert resp.read().decode() == text
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/other", timeout=10)
+    finally:
+        srv.close()
+
+
+def test_http_server_survives_scrape_errors():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("status unavailable")
+        return "ok_metric 1\n"
+
+    srv = ExporterServer(flaky)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(url, timeout=10)
+        assert urllib.request.urlopen(
+            url, timeout=10).read() == b"ok_metric 1\n"
+    finally:
+        srv.close()

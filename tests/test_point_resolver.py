@@ -219,7 +219,7 @@ def test_kernel_direct_no_pad_last_txn_checked():
     rt = np.arange(n, dtype=np.int32)
     valid = np.ones(n, bool)
     # batch 1: txn i writes key i
-    sk2, sv2, _cnt, conflict = fn(
+    sk2, sv2, _cnt, conflict, _hit = fn(
         jnp.asarray(sk), jnp.asarray(sv),
         jnp.zeros(n, jnp.int32), jnp.zeros(n, bool),
         jnp.asarray(np.zeros((n, 3), np.uint32)),  # reads: all-zero keys
@@ -229,13 +229,15 @@ def test_kernel_direct_no_pad_last_txn_checked():
     assert not np.asarray(conflict).any()
     # batch 2: txn i reads key i at a pre-write snapshot -> ALL conflict,
     # including txn n-1 (the one a pad-free segment table would skip)
-    _sk3, _sv3, _c, conflict = fn(
+    _sk3, _sv3, _c, conflict, read_hit = fn(
         sk2, sv2, jnp.full(n, 50, jnp.int32), jnp.zeros(n, bool),
         jnp.asarray(keys), jnp.asarray(rt), jnp.asarray(valid),
         jnp.asarray(np.zeros((n, 3), np.uint32)), jnp.asarray(rt),
         jnp.asarray(np.zeros(n, bool)),
         jnp.int32(200), jnp.int32(0), jnp.int32(0))
     assert np.asarray(conflict).all(), np.asarray(conflict)
+    # every read slot is the cause of its txn's conflict
+    assert np.asarray(read_hit).all(), np.asarray(read_hit)
 
 
 def test_large_batch_parity():

@@ -71,23 +71,27 @@ def test_serializability_catches_seeded_bug():
 
     c = SimCluster(seed=1401, n_proxies=2)
     try:
-        orig = cs_mod.PyConflictSet.resolve
+        # patch the shared core (_resolve) — both the plain and the
+        # attribution entry points the resolver may use route through it
+        orig = cs_mod.PyConflictSet._resolve
 
         from foundationdb_tpu.models.conflict_set import COMMITTED, CONFLICT
 
-        def sabotage(self, txns, commit_version, new_oldest_version):
+        def sabotage(self, txns, commit_version, new_oldest_version,
+                     collect=None):
             # flip CONFLICT -> COMMITTED, but only for the workload's
             # keyspace and only genuine conflicts: forcing TooOld to
             # commit corrupts version-window invariants cluster-wide,
             # and touching system transactions wedges the control loops
             # — either would test the sabotage, not the checker
-            out = list(orig(self, txns, commit_version, new_oldest_version))
+            out = list(orig(self, txns, commit_version, new_oldest_version,
+                            collect))
             for i, t in enumerate(txns):
                 if out[i] == CONFLICT and t.write_ranges and all(
                         b.startswith(b"ser/") for b, _e in t.write_ranges):
                     out[i] = COMMITTED
             return out
-        cs_mod.PyConflictSet.resolve = sabotage
+        cs_mod.PyConflictSet._resolve = sabotage
         try:
             dbs = [c.client(f"cl{i}") for i in range(6)]
 
@@ -103,7 +107,7 @@ def test_serializability_catches_seeded_bug():
 
             assert c.run(main(), timeout_time=600)
         finally:
-            cs_mod.PyConflictSet.resolve = orig
+            cs_mod.PyConflictSet._resolve = orig
     finally:
         c.shutdown()
 
