@@ -119,6 +119,11 @@ class Database:
         #: per-alternative latency model, fdbrpc/LoadBalance.actor.h)
         self._latency_ema: Dict[str, float] = {}
         self._watch_task = None   # standing dbinfo long-poll
+        # sampled transaction profiling (client/profiling.py): the
+        # per-database transaction ordinal the deterministic sampling
+        # decision hashes, and its lazily-derived salt
+        self._txn_seq = 0
+        self._profile_salt: Optional[int] = None
 
     def note_latency(self, replica: str, seconds: float) -> None:
         prev = self._latency_ema.get(replica)
@@ -417,6 +422,32 @@ class Database:
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
 
+    def _maybe_sample(self):
+        """The PROFILE_SAMPLE_RATE sampling decision for one fresh
+        transaction (ref: NativeAPI's CSI sampling). Deterministic:
+        hashes this database's transaction ordinal with a salt derived
+        from the seeded RNG and the client's process name — no RNG
+        state is consumed, so sampling never perturbs the simulation's
+        event order. Only called when the rate knob is nonzero."""
+        from . import profiling
+        self._txn_seq += 1
+        rate = float(flow.SERVER_KNOBS.profile_sample_rate)
+        if self._profile_salt is None:
+            import zlib
+            # remote (TCP) clients have no sim process: a fixed name
+            # keeps the decision well-defined there too
+            name = (self.process.name if self.process is not None
+                    else "remote-client")
+            self._profile_salt = profiling._mix64(
+                flow.g_random.seed ^ zlib.crc32(name.encode()))
+        if not profiling.sample_decision(self._profile_salt,
+                                         self._txn_seq, rate):
+            return None
+        profiling.note_sampled()
+        rec_id = "%08x%016x" % (self._profile_salt & 0xFFFFFFFF,
+                                self._txn_seq)
+        return profiling.TransactionProfile(rec_id, flow.now())
+
 
 def _shard_index(storages, key: bytes) -> int:
     """Last shard whose begin <= key (storages sorted by begin)."""
@@ -436,9 +467,17 @@ def _overlapping_shards(storages, begin: bytes, end: bytes):
 
 
 class Transaction:
-    def __init__(self, db: Database):
+    def __init__(self, db: Database, sampled: bool = True):
         self.db = db
+        # sampled=False marks internal transactions (the profile flush
+        # writer) that must never themselves be profiled
         self.reset()
+        # the sampling decision runs ONCE per logical transaction, at
+        # creation: when the rate knob is 0 (the default) this is one
+        # attribute read and a falsy test — the provably-zero-overhead
+        # gate the bench relies on
+        if sampled and flow.SERVER_KNOBS.profile_sample_rate:
+            self._profile = db._maybe_sample()
 
     def set_option(self, option: str, value=None) -> None:
         """(ref: fdb_transaction_set_option — the subset with behavior
@@ -470,6 +509,25 @@ class Transaction:
             # sampled-transaction stitching (ref: the TransactionDebug
             # attach + per-station events through the commit path)
             self._debug_id = value
+        elif option == "transaction_logging_enable":
+            # force-sample THIS transaction regardless of the
+            # database-level rate (ref: TRANSACTION_LOGGING_ENABLE with
+            # an optional identifier). The identifier becomes the
+            # record id in \xff\x02/fdbClientInfo/client_latency/, so
+            # it may not contain the key schema's field separator.
+            if self._profile is None:
+                from . import profiling
+                self.db._txn_seq += 1
+                # the ordinal suffix keeps two transactions armed with
+                # the SAME identifier in the same sim tick from
+                # colliding on record keys (same start_ts + rec_id
+                # would silently overwrite)
+                ident = "%s-%08x" % (
+                    str(value).replace("/", "_") if value else "opt",
+                    self.db._txn_seq)
+                profiling.note_sampled()
+                self._profile = profiling.TransactionProfile(
+                    ident, flow.now())
         elif option == "report_conflicting_keys":
             # a conflicted commit surfaces WHICH read ranges aborted it
             # (ref: the REPORT_CONFLICTING_KEYS option + the
@@ -523,6 +581,7 @@ class Transaction:
         self._access_system = False   # options reset with the txn
         self._read_system = False
         self._debug_id = None
+        self._profile = None          # re-armed by __init__/set_option
         self._grv_priority = None     # ...including the priority class
         self._report_conflicting = False
         self._conflicting_ranges = None   # last conflicted commit's causes
@@ -626,6 +685,8 @@ class Transaction:
     # -- read version ---------------------------------------------------
     async def get_read_version(self) -> int:
         if self._read_version is None:
+            prof = self._profile
+            t0 = flow.now() if prof is not None else 0.0
             fut = self.db.batched_grv(getattr(self, "_grv_priority", None))
             deadline = getattr(self, "_timeout_deadline", None)
             if deadline is not None:
@@ -634,7 +695,22 @@ class Transaction:
                 fut = flow.timeout_error(
                     fut, max(deadline - flow.now(), 0.001),
                     "transaction_timed_out")
-            version, seq = await fut
+            try:
+                version, seq = await fut
+            except flow.FdbError as e:
+                if prof is not None:
+                    from .profiling import ErrorEvent
+                    prof.add(ErrorEvent(t0, "grv", e.name))
+                raise
+            if prof is not None:
+                from .profiling import GetVersionEvent
+                from ..server.types import PRIORITY_DEFAULT
+                prio = getattr(self, "_grv_priority", None)
+                # explicit None test: PRIORITY_BATCH is 0 and must not
+                # fall through to the default label
+                prof.add(GetVersionEvent(
+                    t0, flow.now() - t0,
+                    PRIORITY_DEFAULT if prio is None else prio))
             if seq > self._used_seq:
                 self._used_seq = seq
             self._read_version = version
@@ -709,6 +785,22 @@ class Transaction:
         return val
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        prof = self._profile
+        if prof is None:
+            return await self._get_impl(key, snapshot)
+        from .profiling import ErrorEvent, GetEvent
+        t0 = flow.now()
+        try:
+            val = await self._get_impl(key, snapshot)
+        except flow.FdbError as e:
+            prof.add(ErrorEvent(t0, "get", e.name))
+            raise
+        prof.add(GetEvent(t0, flow.now() - t0, key,
+                          -1 if val is None else len(val)))
+        return val
+
+    async def _get_impl(self, key: bytes,
+                        snapshot: bool = False) -> Optional[bytes]:
         if key.startswith(SYSTEM_PREFIX):
             # \xff reads need READ/ACCESS_SYSTEM_KEYS (ref: NativeAPI
             # validateKey — key_outside_legal_range without the option)
@@ -751,7 +843,7 @@ class Transaction:
             b = min(anchor, hi_bound)
             rows = []
             if b < hi_bound:
-                rows = await self.get_range(b, hi_bound,
+                rows = await self._get_range_impl(b, hi_bound,
                                             limit=selector.offset,
                                             snapshot=True)
             resolved = (rows[selector.offset - 1][0]
@@ -762,7 +854,7 @@ class Transaction:
             e = min(anchor, hi_bound)
             rows = []
             if e > b"":
-                rows = await self.get_range(b"", e, limit=needed,
+                rows = await self._get_range_impl(b"", e, limit=needed,
                                             snapshot=True, reverse=True)
             resolved = (rows[needed - 1][0] if len(rows) >= needed
                         else b"")
@@ -780,6 +872,28 @@ class Transaction:
     async def get_range(self, begin, end, limit: int = UNBOUNDED_ROW_LIMIT,
                         snapshot: bool = False,
                         reverse: bool = False) -> List[Tuple[bytes, bytes]]:
+        prof = self._profile
+        if prof is None:
+            return await self._get_range_impl(begin, end, limit,
+                                              snapshot, reverse)
+        from .profiling import ErrorEvent, GetRangeEvent
+        t0 = flow.now()
+        try:
+            rows = await self._get_range_impl(begin, end, limit,
+                                              snapshot, reverse)
+        except flow.FdbError as e:
+            prof.add(ErrorEvent(t0, "get_range", e.name))
+            raise
+        prof.add(GetRangeEvent(
+            t0, flow.now() - t0,
+            begin.key if isinstance(begin, KeySelector) else begin,
+            end.key if isinstance(end, KeySelector) else end, len(rows)))
+        return rows
+
+    async def _get_range_impl(self, begin, end,
+                              limit: int = UNBOUNDED_ROW_LIMIT,
+                              snapshot: bool = False,
+                              reverse: bool = False) -> List[Tuple[bytes, bytes]]:
         if isinstance(begin, KeySelector):
             begin = await self.get_key(begin, snapshot=snapshot)
         if isinstance(end, KeySelector):
@@ -798,9 +912,9 @@ class Transaction:
             # a scan crossing from user space into \xff must see the
             # SAME system rows an \xff-anchored scan serves (materialized
             # + stored) — split at the boundary and merge
-            rows = await self.get_range(begin, SYSTEM_PREFIX, limit=limit,
+            rows = await self._get_range_impl(begin, SYSTEM_PREFIX, limit=limit,
                                         snapshot=snapshot, reverse=reverse)
-            rows += await self.get_range(SYSTEM_PREFIX, end, limit=limit,
+            rows += await self._get_range_impl(SYSTEM_PREFIX, end, limit=limit,
                                          snapshot=snapshot, reverse=reverse)
             return sorted(rows, reverse=reverse)[:limit]
         if begin.startswith(SYSTEM_PREFIX) and (
@@ -816,7 +930,7 @@ class Transaction:
             for b2, e2 in ((lo, min(hi, KEY_SERVERS_PREFIX)),
                            (max(lo, KEY_SERVERS_END), hi)):
                 if b2 < e2:
-                    rows += await self.get_range(b2, e2,
+                    rows += await self._get_range_impl(b2, e2,
                                                  snapshot=snapshot)
             return sorted(rows, reverse=reverse)[:limit]
         version = await self.get_read_version()
@@ -1037,6 +1151,39 @@ class Transaction:
     # -- commit ---------------------------------------------------------
     async def commit(self) -> int:
         """(ref: Transaction::commit :2710 / tryCommit :2498)"""
+        prof = self._profile
+        if prof is None:
+            return await self._commit_impl()
+        # sampled: record the commit outcome — latency, payload size,
+        # and the conflict verdict (reusing the resolver's attribution
+        # when report_conflicting_keys is armed) — then drain the
+        # event stream into the \xff\x02/fdbClientInfo/ keyspace in
+        # the background (ref: the sampled-commit EventCommit /
+        # EventCommitError records)
+        from .profiling import (CommitEvent, ErrorEvent, flush_profile)
+        t0 = flow.now()
+        n_mut, n_bytes = len(self._mutations), self._txn_bytes
+        writes = tuple(self._write_conflicts)
+        try:
+            version = await self._commit_impl()
+        except flow.FdbError as e:
+            if e.name == "not_committed":
+                prof.add(CommitEvent(
+                    t0, flow.now() - t0, n_mut, n_bytes, writes,
+                    "conflicted", 0,
+                    tuple(self._conflicting_ranges or ())))
+            else:
+                prof.add(ErrorEvent(t0, "commit", e.name))
+            raise
+        finally:
+            flow.spawn(flush_profile(self.db, prof),
+                       TaskPriority.LOW_PRIORITY,
+                       name="client.profileFlush")
+        prof.add(CommitEvent(t0, flow.now() - t0, n_mut, n_bytes,
+                             writes, "committed", version, ()))
+        return version
+
+    async def _commit_impl(self) -> int:
         if not self._mutations:
             # read-only: succeeds at the read version without a round trip
             self.committed_version = self._read_version or 0
@@ -1167,6 +1314,7 @@ class Transaction:
         retries = getattr(self, "_retries_used", 0)
         prio = getattr(self, "_grv_priority", None)
         debug_id = getattr(self, "_debug_id", None)
+        profile = self._profile
         report = getattr(self, "_report_conflicting", False)
         conflicting = getattr(self, "_conflicting_ranges", None)
         self.reset()
@@ -1175,6 +1323,7 @@ class Transaction:
         # the RETRY attempt is usually the interesting one (it hit a
         # conflict/failure) — keep it sampled
         self._debug_id = debug_id
+        self._profile = profile
         # keep reporting armed AND the failed attempt's attribution
         # readable (ref: the conflicting-keys special keys being read
         # in the retry loop's next attempt)
@@ -1185,12 +1334,16 @@ class Transaction:
 
 
 async def run_transaction(db: Database, body,
-                          max_retries: Optional[int] = None):
+                          max_retries: Optional[int] = None,
+                          tr: Optional["Transaction"] = None):
     """The standard retry loop (ref: the `doTransaction` idiom / python
-    binding @fdb.transactional)."""
+    binding @fdb.transactional). Pass `tr` to loop over a specially
+    constructed transaction (the profiling machinery's unsampled
+    ones) instead of a fresh default."""
     if max_retries is None:
         max_retries = int(flow.SERVER_KNOBS.client_default_max_retries)
-    tr = db.create_transaction()
+    if tr is None:
+        tr = db.create_transaction()
     for _ in range(max_retries):
         try:
             result = await body(tr)

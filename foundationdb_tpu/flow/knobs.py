@@ -111,6 +111,23 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # and how many versions storage may trail the log frontier
     init("HEALTH_CONFLICT_RATE", 0.25)
     init("HEALTH_STORAGE_LAG_VERSIONS", 2_000_000)
+    # sampled transaction profiling (ref: the CSI_SAMPLING client knob
+    # + TRANSACTION_LOGGING_ENABLE): fraction of transactions whose
+    # ClientLogEvent stream persists into \xff\x02/fdbClientInfo/.
+    # 0 (the default) compiles the sampler out of the client hot path
+    # entirely — never buggified: sampling changes keyspace traffic.
+    init("PROFILE_SAMPLE_RATE", 0.0)
+    # chunk size for persisted profile records (buggified tiny so sim
+    # runs exercise multi-chunk reassembly)
+    init("PROFILE_CHUNK_BYTES", 4096, lambda: 64)
+    # profile-record retention + janitor cadence (the clientlog layer
+    # trims records older than the retention window)
+    init("PROFILE_RETENTION_SECONDS", 300.0, lambda: 5.0)
+    init("PROFILE_JANITOR_INTERVAL", 10.0, lambda: 0.5)
+    # run-loop steps longer than this (wall seconds) emit a SlowTask
+    # TraceEvent and enter the slow-task table (ref: Net2's
+    # SLOWTASK_PROFILING_LOG_INTERVAL family)
+    init("SLOW_TASK_THRESHOLD", 0.05)
     # time 1-in-N kernel dispatches with a block_until_ready fence
     # (first call per shape bucket is always timed: that's the compile);
     # 0 disables the periodic fence entirely so the streamed bench can

@@ -76,7 +76,8 @@ class LatencyBands:
     Thresholds are configurable; adding one resets the counts, exactly
     like the reference reacting to a LatencyBandConfig change."""
 
-    __slots__ = ("name", "bands", "counts", "total", "max_seen")
+    __slots__ = ("name", "bands", "counts", "total", "max_seen",
+                 "sum_seconds")
 
     def __init__(self, name: str, bands: Tuple[float, ...] = DEFAULT_BANDS):
         self.name = name
@@ -84,6 +85,7 @@ class LatencyBands:
         self.counts = [0] * len(self.bands)
         self.total = 0
         self.max_seen = 0.0
+        self.sum_seconds = 0.0
 
     def add_threshold(self, seconds: float) -> None:
         """(ref: LatencyBands::addThreshold — reconfiguring the band
@@ -99,9 +101,11 @@ class LatencyBands:
         self.counts = [0] * len(self.bands)
         self.total = 0
         self.max_seen = 0.0
+        self.sum_seconds = 0.0
 
     def record(self, seconds: float) -> None:
         self.total += 1
+        self.sum_seconds += seconds   # the histogram's _sum sample
         if seconds > self.max_seen:
             self.max_seen = seconds
         for i in range(bisect_left(self.bands, seconds),
@@ -111,6 +115,7 @@ class LatencyBands:
     def snapshot(self) -> dict:
         return {"total": self.total,
                 "max_seconds": round(self.max_seen, 6),
+                "sum_seconds": round(self.sum_seconds, 6),
                 "bands": {f"<={t:g}s": c
                           for t, c in zip(self.bands, self.counts)}}
 

@@ -62,6 +62,24 @@ class TaskPriority:
     ZERO = 0
 
 
+_knobs = None    # cached handle: the slow-task threshold is read per
+                 # step and must not pay the import machinery each time
+
+
+def _slow_task_threshold_knob() -> float:
+    """The SLOW_TASK_THRESHOLD knob, read live (operators flip it at
+    runtime); only the module lookup is cached — same idiom as the
+    trace severity floor."""
+    global _knobs
+    if _knobs is None:
+        try:
+            from .knobs import SERVER_KNOBS
+        except Exception:
+            return 0.05
+        _knobs = SERVER_KNOBS
+    return float(_knobs.slow_task_threshold)
+
+
 class Scheduler:
     """Single-threaded deterministic run loop (Net2 + sim2 in one).
 
@@ -84,9 +102,12 @@ class Scheduler:
         self.tasks_run = 0
         # run-loop profiler (ref: flow/Profiler.actor.cpp + Net2's slow-
         # task sampling): wall seconds spent executing steps, and the
-        # worst offenders over the threshold
+        # worst offenders over the threshold. None follows the
+        # SLOW_TASK_THRESHOLD knob live; an explicit value (tests, the
+        # cli) pins it for this scheduler.
         self.busy_seconds = 0.0
-        self.slow_task_threshold = 0.05
+        self.slow_task_threshold: Optional[float] = None
+        self.slow_task_count = 0       # total steps over the threshold
         self.slow_tasks: list = []     # (task name, seconds), worst kept
         # on-demand sampling profiler (ref: flow/Profiler.actor.cpp —
         # the SIGPROF stack sampler, expressed cooperatively: every
@@ -171,10 +192,14 @@ class Scheduler:
         task._step(value, exc)
         dt = _time.monotonic() - t0
         self.busy_seconds += dt
-        if dt >= self.slow_task_threshold:
+        thr = self.slow_task_threshold
+        if thr is None:
+            thr = _slow_task_threshold_knob()
+        if dt >= thr:
             # a step that hogs the loop starves every other actor — the
             # reference's slow-task profiler samples exactly this
             name = getattr(task, "name", "") or "?"
+            self.slow_task_count += 1
             self.slow_tasks.append((name, dt))
             if len(self.slow_tasks) > 32:
                 self.slow_tasks = sorted(
@@ -184,7 +209,8 @@ class Scheduler:
             _trace.g_trace.emit({
                 "Type": "SlowTask", "Severity": SevWarn,
                 "Machine": "runloop", "TaskName": name,
-                "Seconds": round(dt, 4)})
+                "Seconds": round(dt, 4),
+                "ElapsedUs": int(dt * 1e6)})
         return True
 
     def run(self, until: Optional[Future] = None, timeout_time: Optional[float] = None) -> Any:

@@ -66,6 +66,21 @@ def _roll_size_knob() -> int:
     return int(_knobs.trace_roll_size)
 
 
+def trace_json_escape(value):
+    """``json.dumps`` fallback for TraceEvent fields that are not JSON
+    types. Detail values routinely carry raw KEYS — arbitrary bytes,
+    not UTF-8 — and an event line that fails to serialize (or writes a
+    broken line) poisons the whole JSON-lines stream for every
+    downstream parser. Bytes render with the \\xNN convention the cli
+    uses for keys (printable ASCII stays readable); anything else
+    falls back to repr. Always returns a str, so every event line is
+    valid JSON no matter what a detail() call was handed."""
+    if isinstance(value, (bytes, bytearray)):
+        return "".join(chr(c) if 32 <= c < 127 and c != 0x5C
+                       else f"\\x{c:02x}" for c in bytes(value))
+    return repr(value)
+
+
 class TraceCollector:
     def __init__(self, path: Optional[str] = None, keep_in_memory: int = 10000,
                  roll_size: Optional[int] = None):
@@ -128,7 +143,10 @@ class TraceCollector:
             if len(self.events) > self.keep:
                 del self.events[: self.keep // 2]
         if self._fh:
-            line = json.dumps(ev) + "\n"
+            # ensure_ascii (the default) keeps lone surrogates and
+            # control characters escaped, so the line is pure ASCII;
+            # the default= hook covers bytes and foreign objects
+            line = json.dumps(ev, default=trace_json_escape) + "\n"
             self._fh.write(line)
             self._bytes += len(line)
             limit = (self.roll_size if self.roll_size is not None

@@ -38,7 +38,8 @@ class SimCluster:
                  share_with: "SimCluster" = None, name_prefix: str = "",
                  virtual: bool = True, data_dir: Optional[str] = None,
                  workers_per_machine: int = 1, n_zones: int = 0,
-                 storage_policy=None, backup_driver: bool = False):
+                 storage_policy=None, backup_driver: bool = False,
+                 profile_janitor: bool = False):
         if storage_policy is not None and \
                 storage_policy.replica_count() != max(1, storage_replicas):
             raise ValueError(
@@ -156,6 +157,15 @@ class SimCluster:
             from ..layers.backup_driver import BackupDriver
             self.backup_driver = BackupDriver(self)
             self.backup_driver.start()
+        # retention trimming for the sampled-transaction profiling
+        # keyspace (layers/clientlog.py) — opt-in, like the backup
+        # driver: a cluster running PROFILE_SAMPLE_RATE > 0 for long
+        # wants one
+        self.client_log_janitor = None
+        if profile_janitor:
+            from ..layers.clientlog import ClientLogJanitor
+            self.client_log_janitor = ClientLogJanitor(self)
+            self.client_log_janitor.start()
         self.workers: dict = {}
         for i in range(n_workers):
             if self.workers_per_machine > 1 or n_zones > 0:

@@ -42,6 +42,15 @@ def _global_kernel_counters() -> dict:
     return out
 
 
+def _client_profile_counters() -> dict:
+    """Process-wide sampled-transaction profiler counters. Same
+    sys.modules guard: a cluster that never sampled anything must not
+    import the profiling module just to report zeros."""
+    import sys
+    m = sys.modules.get("foundationdb_tpu.client.profiling")
+    return m.profiler_counters() if m is not None else {}
+
+
 class ClusterConfig(NamedTuple):
     """(ref: DatabaseConfiguration — the subset this slice understands)"""
 
@@ -1213,11 +1222,20 @@ class ClusterController:
                 "run_loop": {
                     "tasks_run": flow.g().tasks_run,
                     "busy_seconds": round(flow.g().busy_seconds, 3),
+                    "slow_task_count": flow.g().slow_task_count,
+                    "slow_task_threshold": (
+                        flow.g().slow_task_threshold
+                        if flow.g().slow_task_threshold is not None
+                        else float(flow.SERVER_KNOBS.slow_task_threshold)),
                     "slow_tasks": [
                         {"task": n, "seconds": round(s, 4)}
                         for n, s in sorted(flow.g().slow_tasks,
                                            key=lambda t: -t[1])[:5]],
                 },
+                # sampled-transaction profiler counters (process-wide,
+                # like the kernel profile: every client in this sim
+                # shares the sampler's CounterCollection)
+                "client_profile": _client_profile_counters(),
                 "configuration": {
                     "proxies": cfg.n_proxies,
                     "resolvers": cfg.n_resolvers,

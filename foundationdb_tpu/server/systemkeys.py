@@ -40,6 +40,57 @@ BACKUP_STATE_ABORT = b"abort"          # requested by the tool
 BACKUP_STATE_STOPPED = b"stopped"
 BACKUP_STATE_ERROR = b"error"
 
+# \xff\x02/fdbClientInfo/client_latency/ — sampled client transaction
+# profiling records (ref: fdbClientInfoPrefixRange in SystemData.cpp +
+# the client_latency key contract contrib/transaction_profiling_analyzer
+# parses). Each sampled transaction's ClientLogEvent stream is wire-
+# serialized and written in size-limited chunks:
+#
+#   <prefix><version>/<start_ts 16-hex us>/<rec_id 32-hex>/<chunk 4-dec>/<num 4-dec>
+#
+# Fixed-width ascii fields keep the keys ordered by (start time, record)
+# so retention trimming is one clear_range and the analyzer's range scan
+# reassembles chunk runs without sorting. `version` guards the record
+# encoding: an analyzer must skip versions it does not understand.
+CLIENT_LATENCY_PREFIX = STORED_SYSTEM_PREFIX + b"/fdbClientInfo/client_latency/"
+CLIENT_LATENCY_END = STORED_SYSTEM_PREFIX + b"/fdbClientInfo/client_latency0"
+CLIENT_LATENCY_VERSION = 1
+
+
+def client_latency_key(start_ts_us: int, rec_id: str, chunk: int,
+                       num_chunks: int,
+                       version: int = CLIENT_LATENCY_VERSION) -> bytes:
+    """One chunk's key. `chunk` is 1-based (like the reference's
+    chunk-number/num-chunks suffix pair)."""
+    return CLIENT_LATENCY_PREFIX + (
+        b"%d/%016x/%s/%04d/%04d"
+        % (version, start_ts_us, rec_id.encode(), chunk, num_chunks))
+
+
+def parse_client_latency_key(key: bytes):
+    """-> (version, start_ts_us, rec_id, chunk, num_chunks), or None for
+    a key that is not a well-formed client_latency chunk key (the
+    analyzer skips those rather than crashing on foreign rows)."""
+    if not key.startswith(CLIENT_LATENCY_PREFIX):
+        return None
+    parts = key[len(CLIENT_LATENCY_PREFIX):].split(b"/")
+    if len(parts) != 5:
+        return None
+    try:
+        return (int(parts[0]), int(parts[1], 16), parts[2].decode(),
+                int(parts[3]), int(parts[4]))
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+def client_latency_cutoff_key(start_ts_us: int,
+                              version: int = CLIENT_LATENCY_VERSION) -> bytes:
+    """First possible key at `start_ts_us` — the janitor's trim bound:
+    clear_range(CLIENT_LATENCY_PREFIX + version row, this) removes every
+    record that STARTED before the cutoff."""
+    return CLIENT_LATENCY_PREFIX + b"%d/%016x/" % (version, start_ts_us)
+
+
 # \xff/conf/<row> -> ClusterConfig field. The first four are
 # operator-mutable (what `configure` accepts); the rest are seeded
 # informational rows.

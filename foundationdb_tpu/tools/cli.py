@@ -15,6 +15,7 @@ import shlex
 import sys
 from typing import List, Optional
 
+from .. import flow
 from ..client import run_transaction
 from ..server import SimCluster
 
@@ -45,7 +46,11 @@ Commands (ref: fdbcli):
   coordinators <n>           move the coordination state to n fresh
                              coordinators (in-sim cli)
   consistencycheck           full-replica byte sweep (in-sim cli)
-  profile <on|off>           run-loop sampling profiler (in-sim cli)
+  profile on [rate]          run-loop sampler + sampled transaction
+                             logging at [rate] (in-sim cli)
+  profile off                stop both profilers (in-sim cli)
+  profile analyze [top]      analyze persisted transaction profiles
+                             (slowest txns, per-op latency, hot keys)
   help                       this text
   exit                       leave
 Keys/values support \\xNN escapes and quoting."""
@@ -400,19 +405,47 @@ class Cli:
                     f" {stats['replicas']} replicas, {stats['rows']} rows"
                     f" at version {stats['version']}")
         if cmd == "profile":
-            # (ref: fdbcli `profile` + ProfilerRequest)
+            # (ref: fdbcli `profile client` + ProfilerRequest): `on`
+            # arms BOTH profilers — the run-loop sampler and the
+            # sampled-transaction logger (PROFILE_SAMPLE_RATE, default
+            # 1.0 = every transaction); `analyze` runs the
+            # tools/profiler.py analyzer over the persisted records,
+            # so it works over a remote connection too
+            if raw and raw[0] == "analyze":
+                from . import profiler as _profiler
+                top = int(raw[1]) if len(raw) > 1 else 10
+
+                async def _analyze():
+                    if self.cluster is not None:
+                        # records flush in the background at low
+                        # priority: give in-flight ones a beat to land
+                        # so `profile analyze` right after a workload
+                        # sees it (remote analyzers scan whatever has
+                        # already committed)
+                        await flow.delay(1.0)
+                    return await _profiler.profile_analysis(
+                        self.db, top_n=top)
+                analysis, stats = self._run(_analyze())
+                return _profiler.format_report(analysis, stats)
             if self.cluster is None:
-                return "ERROR: profile requires cluster access"
+                return "ERROR: profile on/off requires cluster access"
             sched = self.cluster.sched
             if raw and raw[0] == "on":
+                try:
+                    rate = float(raw[1]) if len(raw) > 1 else 1.0
+                except ValueError:
+                    return "usage: profile on [rate]|off|analyze [top]"
+                flow.SERVER_KNOBS.set("profile_sample_rate",
+                                      min(max(rate, 0.0), 1.0))
                 sched.start_profiler()
                 return "Profiler on"
             if raw and raw[0] == "off":
+                flow.SERVER_KNOBS.set("profile_sample_rate", 0.0)
                 report = sched.stop_profiler()
                 lines = [f"{e['samples']:6d}  {e['task']}  {e['stack']}"
                          for e in report[:10]]
                 return "Profiler off\n" + "\n".join(lines)
-            return "usage: profile on|off"
+            return "usage: profile on [rate]|off|analyze [top]"
         if cmd == "backup":
             # (ref: fdbcli-adjacent fdbbackup verbs; the tool's row
             # protocol works over any Database, in-sim or remote)

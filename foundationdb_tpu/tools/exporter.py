@@ -73,13 +73,24 @@ def _band_seconds(band_key: str) -> str:
 
 def _add_latency(f: _Families, kind: str, role: str, request: str,
                  snap: dict) -> None:
-    """One RequestLatency snapshot -> histogram buckets + count + max +
+    """One RequestLatency snapshot -> a WELL-FORMED Prometheus
+    histogram (cumulative `_bucket` counts ordered by `le`, a final
+    `+Inf` bucket, and matching `_count`/`_sum` children) plus max and
     quantile gauges (the reservoir percentiles ride a separate family:
-    a summary and a histogram may not share a metric name)."""
+    a summary and a histogram may not share a metric name). The raw
+    per-band counters additionally ride a `*_band` series, so a
+    dashboard keyed on the LatencyBands thresholds keeps working."""
     base = f"{_PREFIX}_request_latency_seconds"
     help_text = "Request latency bands per pipeline stage"
     labels = {"kind": kind, "role": role, "request": request}
-    for bk, count in snap.get("bands", {}).items():
+    # LatencyBands.record increments EVERY band at or above the
+    # latency, so the snapshot counts are already cumulative — emit
+    # them in threshold order (dict order follows the sorted band
+    # tuple, but sort defensively: bucket monotonicity is a format
+    # invariant, not a hope)
+    bands = sorted(snap.get("bands", {}).items(),
+                   key=lambda kv: float(_band_seconds(kv[0])))
+    for bk, count in bands:
         f.add(base, "histogram", help_text,
               {**labels, "le": _band_seconds(bk)}, count, suffix="_bucket")
     f.add(base, "histogram", help_text,
@@ -87,6 +98,12 @@ def _add_latency(f: _Families, kind: str, role: str, request: str,
           suffix="_bucket")
     f.add(base, "histogram", help_text, labels, snap.get("total", 0),
           suffix="_count")
+    f.add(base, "histogram", help_text, labels,
+          snap.get("sum_seconds", 0.0), suffix="_sum")
+    for bk, count in bands:
+        f.add(f"{_PREFIX}_request_latency_band", "gauge",
+              "Raw per-band request counts (LatencyBands thresholds)",
+              {**labels, "band": _band_seconds(bk)}, count)
     f.add(f"{_PREFIX}_request_latency_max_seconds", "gauge",
           "Largest latency ever observed per stage", labels,
           snap.get("max_seconds"))
@@ -210,6 +227,27 @@ def render_prometheus(status: dict) -> str:
           "Scheduler tasks executed", {}, rl.get("tasks_run"))
     f.add(f"{_PREFIX}_run_loop_busy_seconds", "counter",
           "Scheduler busy time", {}, rl.get("busy_seconds"))
+    # run-loop slow-task profiler (flow/scheduler.py SlowTask events)
+    f.add(f"{_PREFIX}_run_loop_slow_tasks", "counter",
+          "Steps that exceeded SLOW_TASK_THRESHOLD", {},
+          rl.get("slow_task_count"))
+    f.add(f"{_PREFIX}_run_loop_slow_task_threshold_seconds", "gauge",
+          "Active slow-task threshold", {},
+          rl.get("slow_task_threshold"))
+    worst: dict = {}   # the same task label may recur: keep its worst
+    for t in rl.get("slow_tasks", ()):
+        worst[t["task"]] = max(worst.get(t["task"], 0.0), t["seconds"])
+    for task, seconds in sorted(worst.items()):
+        f.add(f"{_PREFIX}_run_loop_slow_task_seconds", "gauge",
+              "Worst run-loop steps by task label", {"task": task},
+              seconds)
+
+    # client transaction-profiling sampler (client/profiling.py,
+    # process-wide like the kernel profile)
+    for cname, value in sorted((cl.get("client_profile") or {}).items()):
+        f.add(f"{_PREFIX}_client_profile", "counter",
+              "Sampled-transaction profiler counters",
+              {"counter": cname}, value)
     return f.render()
 
 

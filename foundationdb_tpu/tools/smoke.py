@@ -5,12 +5,18 @@ sections), `top`, and the Prometheus exporter text.
 
 `python -m foundationdb_tpu.tools.smoke` exits 0 on success; the
 tier-1 workflow runs it after the test suite as an end-to-end guard
-that the observability stack assembles outside pytest too."""
+that the observability stack assembles outside pytest too.
+`--profile` runs the transaction-profiling smoke instead: sampling at
+100%, a conflicting workload, and the tools/profiler.py analyzer must
+find both a committed and a conflicted transaction; the report lands
+in /tmp/_profile_report.txt for the CI artifact."""
 
 from __future__ import annotations
 
 import sys
 from typing import List, Optional
+
+PROFILE_REPORT_PATH = "/tmp/_profile_report.txt"
 
 
 def run_smoke(out=print) -> int:
@@ -83,7 +89,93 @@ def run_smoke(out=print) -> int:
         cluster.shutdown()
 
 
+def run_smoke_profile(out=print,
+                      report_path: str = PROFILE_REPORT_PATH) -> int:
+    """The transaction-profiling end-to-end: sample EVERY transaction,
+    drive a workload with a guaranteed conflict, and require the
+    analyzer to read back ≥1 committed and ≥1 conflicted transaction
+    from the \\xff\\x02/fdbClientInfo/ keyspace."""
+    from .. import flow
+    from ..client import run_transaction
+    from ..client.profiling import profiler_counters
+    from ..server import SimCluster
+    from .cli import Cli
+    from .exporter import parse_prometheus, render_prometheus
+    from .profiler import format_report, profile_analysis
+
+    cluster = SimCluster(seed=2424, durable=True, profile_janitor=True)
+    flow.SERVER_KNOBS.set("profile_sample_rate", 1.0)
+    cli = Cli.for_cluster(cluster)
+    try:
+        db = cluster.client("psmoke")
+
+        async def workload():
+            async def seed(tr):
+                tr.set(b"hot", b"0")
+            await run_transaction(db, seed)
+            for i in range(4):
+                async def w(tr, i=i):
+                    await tr.get(b"hot")
+                    tr.set(b"k%d" % i, b"v")
+                await run_transaction(db, w)
+            # one transaction that conflicts and is NOT retried, so a
+            # "conflicted" verdict persists
+            tr = db.create_transaction()
+            tr.set_option("report_conflicting_keys")
+            await tr.get(b"hot")
+            tr.set(b"mine", b"v")
+
+            async def bump(t2):
+                t2.set(b"hot", b"x")
+            await run_transaction(db, bump)
+            try:
+                await tr.commit()
+                raise AssertionError("expected a conflict")
+            except flow.FdbError as e:
+                assert e.name == "not_committed", e.name
+            await flow.delay(2.0)   # let background flushes land
+            return await profile_analysis(db)
+
+        analysis, stats = cluster.run(workload(), timeout_time=300)
+        assert analysis["records"] >= 2, analysis
+        assert analysis["committed"] >= 1, analysis
+        assert analysis["conflicted"] >= 1, analysis
+        assert stats["skipped_missing_chunks"] == 0, stats
+        assert any(r["key"] == b"hot".hex()
+                   for r in analysis["hottest_keys"]), analysis
+
+        # the cli renders the same analysis
+        report = cli.execute("profile analyze")
+        assert "Slowest transactions:" in report, report
+        assert "conflicted" in report, report
+
+        # sampler counters reach status + the exporter
+        async def st():
+            return await db.get_status()
+        status = cluster.run(st(), timeout_time=60)
+        counters = status["cluster"].get("client_profile", {})
+        assert counters.get("transactions_sampled", 0) >= 2, counters
+        names = {n for n, _, _ in
+                 parse_prometheus(render_prometheus(status))}
+        assert "fdbtpu_client_profile" in names, sorted(names)
+
+        with open(report_path, "w") as f:
+            f.write(format_report(analysis, stats) + "\n")
+        out(f"PROFILE SMOKE OK: {analysis['records']} records "
+            f"({analysis['committed']} committed, "
+            f"{analysis['conflicted']} conflicted), "
+            f"{profiler_counters()['chunks_written']} chunks; "
+            f"report at {report_path}")
+        return 0
+    finally:
+        flow.SERVER_KNOBS.set("profile_sample_rate", 0.0)
+        cluster.shutdown()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--profile" in argv:
+        return run_smoke_profile()
     return run_smoke()
 
 

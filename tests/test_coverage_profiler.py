@@ -105,13 +105,18 @@ def test_coverage_sites_fire_in_simulation():
 
 
 def test_slow_task_profiler_samples_hogs():
-    """A step that hogs the loop appears in the slow-task profile and
-    in the status document's run_loop section."""
+    """A step that blocks past SLOW_TASK_THRESHOLD emits a SlowTask
+    TraceEvent carrying the task's label and elapsed µs, and rolls up
+    into the status document's run_loop section (count + threshold)
+    and the exporter."""
     import time
+
+    from foundationdb_tpu.tools.exporter import (parse_prometheus,
+                                                 render_prometheus)
 
     c = SimCluster(seed=42)
     try:
-        c.sched.slow_task_threshold = 0.01
+        c.sched.slow_task_threshold = 0.01   # pin over the knob
         db = c.client()
 
         async def main():
@@ -122,11 +127,57 @@ def test_slow_task_profiler_samples_hogs():
             rl = status["cluster"]["run_loop"]
             assert rl["tasks_run"] > 0
             assert rl["busy_seconds"] > 0
+            assert rl["slow_task_count"] >= 1, rl
+            assert rl["slow_task_threshold"] == 0.01, rl
             assert any(s["seconds"] >= 0.01 for s in rl["slow_tasks"]), rl
             assert flow.g_trace.counts.get("SlowTask", 0) > 0
+            evs = [e for e in flow.g_trace.events
+                   if e["Type"] == "SlowTask" and e["TaskName"] == "testHog"]
+            assert evs and evs[-1]["ElapsedUs"] >= 10_000, evs
+            samples = parse_prometheus(render_prometheus(status))
+            by_name = {n: v for n, l, v in samples if not l}
+            assert by_name["fdbtpu_run_loop_slow_tasks"] >= 1
+            assert by_name[
+                "fdbtpu_run_loop_slow_task_threshold_seconds"] == 0.01
+            assert any(n == "fdbtpu_run_loop_slow_task_seconds"
+                       and l.get("task") == "testHog"
+                       for n, l, v in samples)
             return True
 
         assert c.run(main(), timeout_time=120)
+    finally:
+        c.shutdown()
+
+
+def test_slow_task_threshold_follows_knob():
+    """Unpinned, the scheduler reads SLOW_TASK_THRESHOLD live; raising
+    it suppresses SlowTask sampling for the same hog."""
+    import time
+
+    c = SimCluster(seed=43)
+    try:
+        assert c.sched.slow_task_threshold is None   # knob-following
+        old = flow.SERVER_KNOBS.slow_task_threshold
+        db = c.client()
+
+        async def main():
+            flow.SERVER_KNOBS.set("slow_task_threshold", 0.01)
+
+            async def hog():
+                time.sleep(0.02)
+            await flow.spawn(hog(), name="knobHog")
+            count = c.sched.slow_task_count
+            assert count >= 1
+            # a sky-high threshold stops further sampling
+            flow.SERVER_KNOBS.set("slow_task_threshold", 10.0)
+            await flow.spawn(hog(), name="knobHog2")
+            assert c.sched.slow_task_count == count
+            st = await db.get_status()
+            assert st["cluster"]["run_loop"]["slow_task_threshold"] == 10.0
+            return True
+
+        assert c.run(main(), timeout_time=120)
+        flow.SERVER_KNOBS.set("slow_task_threshold", old)
     finally:
         c.shutdown()
 

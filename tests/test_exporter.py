@@ -92,6 +92,43 @@ def test_histogram_buckets_are_cumulative_with_inf():
     assert by_le["0.005"] == 10 and by_le["0.1"] == 45
 
 
+def test_histogram_well_formed_round_trip():
+    """The full Prometheus histogram contract, verified through a
+    parser round trip over a LIVE LatencyBands recording: buckets
+    cumulative and ordered by le, a final +Inf bucket equal to _count,
+    and a _sum sample; the raw per-band counts additionally ride the
+    *_band series."""
+    from foundationdb_tpu.flow.latency import RequestLatency
+    rl = RequestLatency("commit")
+    for s in (0.0001, 0.002, 0.004, 0.03, 0.2, 2.0):   # one past 1.0s
+        rl.record(s)
+    st = {"cluster": {"epoch": 1, "recovery_state": "fully_recovered",
+                      "proxies": [{"name": "p0", "counters": {},
+                                   "latency_bands": {
+                                       "commit": rl.snapshot()}}]}}
+    samples = parse_prometheus(render_prometheus(st))
+    buckets = [(float("inf") if l["le"] == "+Inf" else float(l["le"]), v)
+               for n, l, v in samples
+               if n == "fdbtpu_request_latency_seconds_bucket"]
+    assert buckets == sorted(buckets), buckets
+    counts = [v for _le, v in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert buckets[-1][0] == float("inf")
+    (count,) = [v for n, l, v in samples
+                if n == "fdbtpu_request_latency_seconds_count"]
+    assert buckets[-1][1] == count == 6
+    (total,) = [v for n, l, v in samples
+                if n == "fdbtpu_request_latency_seconds_sum"]
+    assert abs(total - 2.2361) < 1e-6, total
+    # the 2.0s sample fits no finite band: +Inf must exceed the last
+    # finite bucket
+    assert buckets[-1][1] > buckets[-2][1]
+    # per-band series preserved beside the histogram
+    band = {l["band"]: v for n, l, v in samples
+            if n == "fdbtpu_request_latency_band"}
+    assert band["0.005"] == 3 and band["1"] == 5, band
+
+
 def test_value_escaping():
     st = _canned_status()
     st["cluster"]["proxies"][0]["name"] = 'weird"role\\name'

@@ -29,6 +29,43 @@ def test_trace_collector_flushes_per_emit(tmp_path):
     assert tc._fh is None
 
 
+def test_trace_json_escape_fuzz(tmp_path):
+    """Every event line must be valid JSON no matter what detail()
+    was handed: raw non-UTF8 bytes (keys!), newlines, quotes,
+    backslashes, control chars, lone surrogates, foreign objects.
+    Fuzzes random byte payloads through a file-backed collector and
+    json.loads's every line back (ref: the JsonTraceLogFormatter
+    escaping Trace.cpp relies on)."""
+    path = str(tmp_path / "fuzz.json")
+    rng = flow.DeterministicRandom(4242)
+    payloads = [rng.random_bytes(rng.random_int(0, 64))
+                for _ in range(200)]
+    payloads += [b"\xff\xfe\x00\n\"\\'", b"\n\r\t", b'"}{',
+                 bytes(range(256))]
+    with trace_mod.TraceCollector(path=path, keep_in_memory=0) as tc:
+        old, trace_mod.g_trace = trace_mod.g_trace, tc
+        try:
+            for i, p in enumerate(payloads):
+                trace_mod.TraceEvent("Fuzz", str(i)).detail(
+                    Key=p, Note='line\nbreak "quoted" \\ back',
+                    Surrogate="bad\udc80str", Obj=object()).log()
+        finally:
+            trace_mod.g_trace = old
+    with open(path, "rb") as fh:
+        lines = fh.read().splitlines()
+    assert len(lines) == len(payloads)
+    for line in lines:
+        row = json.loads(line)        # raises on any malformed line
+        assert row["Type"] == "Fuzz"
+        assert isinstance(row["Key"], str)
+        assert row["Note"] == 'line\nbreak "quoted" \\ back'
+    # bytes render with the cli's \xNN convention (printable ASCII
+    # stays readable)
+    row = json.loads(lines[-1])
+    assert "\\x00" in row["Key"] and "\\xff" in row["Key"]
+    assert "A" in row["Key"]
+
+
 def test_trace_collector_context_manager(tmp_path):
     path = str(tmp_path / "t.json")
     with trace_mod.TraceCollector(path=path) as tc:
