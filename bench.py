@@ -23,7 +23,9 @@ Prints exactly one JSON line:
 Env overrides: FDBTPU_BENCH_TXNS (batch size), FDBTPU_BENCH_BATCHES
 (timed batches), FDBTPU_BENCH_KEYS (keyspace), FDBTPU_BENCH_READS
 (reads per txn), FDBTPU_BENCH_BACKEND (tpu-point|tpu|tpu-streamed|
-python|native — CPU baselines for comparison runs).
+tpu-pipelined|python|native — CPU baselines for comparison runs),
+FDBTPU_BENCH_PIPELINE_DEPTH (headline K for the tpu-pipelined
+submit/drain window; `all` mode sweeps K in {1,2,4,8}).
 """
 
 import json
@@ -309,6 +311,57 @@ def bench_tpu_streamed(n_txns, n_batches, keyspace, backend="point"):
     return n_batches * n_txns / elapsed, n_conflicts
 
 
+def bench_tpu_pipelined(n_txns, n_batches, keyspace, depth):
+    """Host-fed resolve through the split submit/drain pipeline at a
+    FIXED in-flight window of K = `depth` batches: submit batch i, then
+    once K tickets are pending drain the oldest before submitting the
+    next — exactly the resolver role's behavior after the pipelined
+    PR. K=1 is the serial role path (submit, block on the verdict, read
+    back, repeat: one dispatch round-trip paid per batch); larger K
+    amortizes that round-trip across the window, so on a remote-
+    attached chip throughput approaches min(compute ceiling,
+    K x serial ceiling). History chains on device across the window
+    (donated carry), so verdicts are bit-identical at every depth —
+    the sweep asserts equal conflict counts."""
+    from foundationdb_tpu.flow.knobs import SERVER_KNOBS
+    from foundationdb_tpu.models.point_resolver import PointConflictSet
+    from foundationdb_tpu.ops.keys import next_pow2
+
+    rng = np.random.default_rng(20260729)
+    cap = next_pow2((WINDOW_BATCHES + 2) * n_txns + 2)
+    # the backend's own backpressure must not cut the window short
+    SERVER_KNOBS.set("RESOLVE_PIPELINE_DEPTH", depth)
+    cs = PointConflictSet(key_bytes=KEY_BYTES, capacity=cap)
+    version = VERSION_STEP
+    warmup = 3
+
+    batches = [make_batch(rng, n_txns, keyspace, version + i * VERSION_STEP)
+               for i in range(warmup + n_batches)]
+
+    def submit(i):
+        v = version + i * VERSION_STEP
+        return cs.submit_arrays(*batches[i], commit_version=v,
+                                new_oldest_version=max(0, v - MWTLV))
+
+    for i in range(warmup):   # compile + settle, fully drained
+        cs.drain_arrays(submit(i))
+
+    from collections import deque
+    pending: deque = deque()
+    n_conflicts = 0
+    t0 = time.perf_counter()
+    for j in range(n_batches):
+        pending.append(submit(warmup + j))
+        if len(pending) >= depth:
+            conflict, _too_old = cs.drain_arrays(pending.popleft())
+            n_conflicts += int(conflict.sum())
+    while pending:   # tail drains stay inside the timed region
+        conflict, _too_old = cs.drain_arrays(pending.popleft())
+        n_conflicts += int(conflict.sum())
+    elapsed = time.perf_counter() - t0
+    return n_batches * n_txns / elapsed, n_conflicts
+
+
 def bench_cpu(backend, n_txns, n_batches, keyspace):
     """CPU baselines through the generic object API (for comparison)."""
     from foundationdb_tpu.models import ResolverTransaction, create_conflict_set
@@ -345,6 +398,10 @@ def bench_cpu(backend, n_txns, n_batches, keyspace):
     return n_batches * n_txns / (time.perf_counter() - t0), n_conflicts
 
 
+def _pipeline_depth() -> int:
+    return max(1, int(os.environ.get("FDBTPU_BENCH_PIPELINE_DEPTH", 4)))
+
+
 def _run_backend(backend, n_txns, n_batches, keyspace):
     if backend == "tpu-point":
         return bench_tpu_point(n_txns, n_batches, keyspace)
@@ -354,6 +411,9 @@ def _run_backend(backend, n_txns, n_batches, keyspace):
         return bench_tpu_streamed(n_txns, n_batches, keyspace)
     if backend == "tpu-streamed-interval":
         return bench_tpu_streamed(n_txns, n_batches, keyspace, "interval")
+    if backend == "tpu-pipelined":
+        return bench_tpu_pipelined(n_txns, n_batches, keyspace,
+                                   _pipeline_depth())
     return bench_cpu(backend, n_txns, n_batches, keyspace)
 
 
@@ -469,7 +529,8 @@ def _measure_transport() -> dict:
 def main():
     backend_env = os.environ.get("FDBTPU_BENCH_BACKEND", "all")
     needs_device = backend_env in ("all", "tpu", "tpu-point",
-                                   "tpu-streamed", "tpu-streamed-interval")
+                                   "tpu-streamed", "tpu-streamed-interval",
+                                   "tpu-pipelined")
     _enable_compile_cache()
     # the periodic kernel-profiling fence (KERNEL_PROFILE_EVERY) drains
     # the async dispatch pipeline the streamed path depends on — the
@@ -539,6 +600,33 @@ def main():
             sub[name] = {"txn_per_s": round(tps, 1),
                          "vs_baseline": round(tps / TARGET_TXN_PER_S, 4),
                          "conflicts": nc}
+        # pipelined submit/drain depth sweep: K=1 is the serial
+        # role path (one dispatch round-trip per batch); the ratio
+        # K=headline / K=1 is the pipelining win the PR claims, and
+        # identical conflict counts across depths are the correctness
+        # evidence (verdicts are order-chained on device regardless of K)
+        pdepth = _pipeline_depth()
+        by_depth = {}
+        conflicts_by_depth = {}
+        for k in sorted({1, 2, 4, 8} | {pdepth}):
+            tps, nc = bench_tpu_pipelined(n_txns, n_batches, keyspace, k)
+            by_depth[str(k)] = round(tps, 1)
+            conflicts_by_depth[str(k)] = nc
+        if len(set(conflicts_by_depth.values())) != 1:
+            raise RuntimeError(
+                f"pipelined conflict counts diverged across depths: "
+                f"{conflicts_by_depth}")
+        sub["tpu-pipelined"] = {
+            "txn_per_s": by_depth[str(pdepth)],
+            "vs_baseline": round(by_depth[str(pdepth)]
+                                 / TARGET_TXN_PER_S, 4),
+            "depth": pdepth,
+            "txn_per_s_by_depth": by_depth,
+            "conflicts": conflicts_by_depth[str(pdepth)],
+            "speedup_vs_serial": round(by_depth[str(pdepth)]
+                                       / by_depth["1"], 2)
+            if by_depth["1"] else None,
+        }
         sub["transport"] = _measure_transport()
         sub.update(cpu_sub_metrics())
         txn_per_s = sub["tpu-streamed"]["txn_per_s"]

@@ -133,6 +133,11 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # 0 disables the periodic fence entirely so the streamed bench can
     # keep its async pipeline
     init("KERNEL_PROFILE_EVERY", 64, lambda: 1)
+    # resolve pipeline: max conflict batches in flight between submit
+    # and drain (models/conflict_set.py ResolvePipeline). 1 degenerates
+    # to the fully synchronous submit-block-read path; buggified tiny
+    # so sim runs stress the backpressure/forced-drain machinery
+    init("RESOLVE_PIPELINE_DEPTH", 4, lambda: 1)
     init("DD_POLL_INTERVAL", 2.0, lambda: 0.3)
     init("DD_MOVE_NUDGE_INTERVAL", 0.1, lambda: 0.5)
     # how long a team may stay degraded before DD rebuilds the missing

@@ -7,6 +7,8 @@ from .conflict_set import (
     BruteForceConflictSet,
     ConflictSetBase,
     PyConflictSet,
+    ResolvePipeline,
+    ResolveTicket,
     ResolverTransaction,
 )
 from .native_backend import NativeConflictSet, create_conflict_set, native_available
@@ -14,6 +16,7 @@ from .native_backend import NativeConflictSet, create_conflict_set, native_avail
 __all__ = [
     "COMMITTED", "CONFLICT", "TOO_OLD",
     "BruteForceConflictSet", "ConflictSetBase", "PyConflictSet",
+    "ResolvePipeline", "ResolveTicket",
     "ResolverTransaction", "NativeConflictSet", "create_conflict_set",
     "native_available",
 ]

@@ -32,6 +32,7 @@ std::map in native C++, bisect lists here).
 
 from __future__ import annotations
 
+import time
 from bisect import bisect_left, bisect_right, insort
 from typing import Iterable, NamedTuple, Sequence
 
@@ -50,6 +51,123 @@ class ResolverTransaction(NamedTuple):
     read_snapshot: int
     read_ranges: tuple  # of (begin: bytes, end: bytes), half-open
     write_ranges: tuple  # of (begin: bytes, end: bytes), half-open
+
+
+class ResolveTicket:
+    """Handle for one submitted conflict batch (ConflictSetBase.submit).
+
+    Holds either the finished result or a `materialize` closure that
+    blocks only on THIS batch's verdict readback (the device serializes
+    batches, so materializing ticket k implicitly waits for k-1's
+    compute but never for k+1's). Draining is idempotent: the first
+    drain runs the closure, later drains return the cached result, so
+    duplicate deliveries and out-of-order drains are both safe."""
+
+    __slots__ = ("commit_version", "n", "drained", "_result",
+                 "_materialize")
+
+    def __init__(self, commit_version: int, n: int, materialize=None,
+                 result=None):
+        self.commit_version = commit_version
+        self.n = n
+        self.drained = False
+        self._result = result
+        self._materialize = materialize
+
+    @property
+    def done(self) -> bool:
+        """True once the result is host-resident (no blocking left)."""
+        return self._materialize is None
+
+    def _force(self):
+        if self._materialize is not None:
+            m, self._materialize = self._materialize, None
+            self._result = m()
+        return self._result
+
+
+class ResolvePipeline:
+    """Ticket queue + accounting for the split submit/drain resolve
+    path: up to `depth` batches stay in flight between submit and
+    drain (ref: the commit-pipeline overlap the proxy's
+    latestLocalCommitBatch* interlocks buy for logging, applied to the
+    resolver boundary; batch-level pipelining of conflict checks per
+    the batched-conflict-resolution literature, arXiv:1804.00947).
+
+    Submitting past `depth` force-drains the OLDEST ticket — the front
+    of the device queue, so the stall is one batch's readback, not the
+    whole backlog. Latencies are wall-clock (`time.perf_counter`):
+    they measure the host/device boundary, not simulated time."""
+
+    __slots__ = ("_depth", "in_flight", "peak_in_flight", "submits",
+                 "drains", "forced_drains", "_occ_sum",
+                 "submit_latency", "drain_latency")
+
+    def __init__(self, depth: "int | None" = None):
+        self._depth = depth          # None: read the knob per submit
+        self.in_flight: list = []    # submitted, not yet materialized
+        self.peak_in_flight = 0
+        self.submits = 0
+        self.drains = 0
+        self.forced_drains = 0
+        self._occ_sum = 0            # sum of in-flight depth at submit
+        from ..flow.latency import RequestLatency
+        self.submit_latency = RequestLatency("pipeline_submit")
+        self.drain_latency = RequestLatency("pipeline_drain")
+
+    @property
+    def depth(self) -> int:
+        if self._depth is not None:
+            return max(1, int(self._depth))
+        from ..flow.knobs import SERVER_KNOBS
+        return max(1, int(SERVER_KNOBS.resolve_pipeline_depth))
+
+    def note_submit(self, ticket: ResolveTicket, t0: float) -> None:
+        self.submits += 1
+        self.submit_latency.record(time.perf_counter() - t0)
+        if not ticket.done:
+            # backpressure BEFORE admitting the new ticket: the window
+            # never exceeds depth, and depth 1 degenerates to the
+            # serial submit-block-read path
+            while len(self.in_flight) >= self.depth:
+                self.forced_drains += 1
+                self.drain(self.in_flight[0])
+            self.in_flight.append(ticket)
+        self._occ_sum += len(self.in_flight)
+        if len(self.in_flight) > self.peak_in_flight:
+            self.peak_in_flight = len(self.in_flight)
+
+    def drain(self, ticket: ResolveTicket):
+        try:
+            self.in_flight.remove(ticket)     # list is <= depth+1 long
+        except ValueError:
+            pass                              # already materialized
+        if not ticket.drained:
+            ticket.drained = True
+            self.drains += 1
+            if not ticket.done:
+                t0 = time.perf_counter()
+                ticket._force()
+                self.drain_latency.record(time.perf_counter() - t0)
+        return ticket._result
+
+    def stats(self) -> dict:
+        """Status-ready snapshot: depth/occupancy gauges, submit/drain
+        counters, and the submit-vs-drain wall-latency bands."""
+        return {"depth": self.depth,
+                "in_flight": len(self.in_flight),
+                "peak_in_flight": self.peak_in_flight,
+                "submits": self.submits,
+                "drains": self.drains,
+                "forced_drains": self.forced_drains,
+                # mean in-flight window over configured depth: ~1 means
+                # the pipeline actually runs full, ~0 means serial use
+                "occupancy": round(
+                    self._occ_sum / (self.submits * self.depth), 4)
+                if self.submits else None,
+                "latency": {
+                    "submit": self.submit_latency.snapshot(),
+                    "drain": self.drain_latency.snapshot()}}
 
 
 class ConflictSetBase:
@@ -86,6 +204,51 @@ class ConflictSetBase:
     @property
     def oldest_version(self) -> int:
         raise NotImplementedError
+
+    # -- split submit/drain pipeline ------------------------------------
+    @property
+    def pipeline(self) -> ResolvePipeline:
+        p = getattr(self, "_pipeline", None)
+        if p is None:
+            p = self._pipeline = ResolvePipeline()
+        return p
+
+    def submit(self, txns: Sequence[ResolverTransaction],
+               commit_version: int, new_oldest_version: int,
+               attribute: bool = False) -> ResolveTicket:
+        """Enqueue one batch without waiting for its verdicts; `drain`
+        the returned ticket for the result. Submissions must follow
+        commit-version order (the same contract as `resolve`); drains
+        may happen in any order. The base implementation resolves
+        eagerly — host backends have no device work to overlap — so the
+        ticket is born materialized; the device backends override this
+        with a genuinely asynchronous dispatch and the pipeline keeps
+        up to RESOLVE_PIPELINE_DEPTH batches in flight."""
+        t0 = time.perf_counter()
+        if attribute:
+            result = self.resolve_with_attribution(
+                txns, commit_version, new_oldest_version)
+        else:
+            result = (self.resolve(txns, commit_version,
+                                   new_oldest_version), None)
+        ticket = ResolveTicket(commit_version, len(txns), result=result)
+        self.pipeline.note_submit(ticket, t0)
+        return ticket
+
+    def drain(self, ticket: ResolveTicket) -> list:
+        """Block until THIS ticket's verdicts are host-resident and
+        return them (idempotent)."""
+        return self.pipeline.drain(ticket)[0]
+
+    def drain_with_attribution(self, ticket: ResolveTicket):
+        """(verdicts, attributions) for a ticket submitted with
+        `attribute=True`; attributions is None otherwise."""
+        return self.pipeline.drain(ticket)
+
+    def pipeline_stats(self) -> dict:
+        """Status-ready pipeline counters (every backend has them; the
+        device backends are where the in-flight window matters)."""
+        return self.pipeline.stats()
 
     def kernel_stats(self) -> dict:
         """Device-kernel profile for status; non-device backends have

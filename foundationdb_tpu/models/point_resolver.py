@@ -137,9 +137,12 @@ class PointConflictSet(TpuConflictSet):
 
         from ..ops.point_kernel import (make_point_resolve_packed_fn,
                                         pack_point_batch)
+        # donate=True: chained-state entry (one state allocation across
+        # the whole in-flight pipeline window, like the interval backend)
         fn = make_point_resolve_packed_fn(self._cap, npad, nrp, nwp,
                                           self._n_words,
-                                          attribute=attribute)
+                                          attribute=attribute,
+                                          donate=True)
         # ONE host->device transfer per batch: the per-transfer latency
         # (not bandwidth) dominates the streamed path on a
         # remote-attached chip, so the eight logical inputs ride one

@@ -23,13 +23,14 @@ shapes):
 
 from __future__ import annotations
 
+import time
 from typing import Sequence
 
 import numpy as np
 
 from ..flow.stats import CounterCollection
 from .conflict_set import (COMMITTED, CONFLICT, TOO_OLD, ConflictSetBase,
-                           ResolverTransaction)
+                           ResolveTicket, ResolverTransaction)
 
 # Minimum shape buckets: small batches all land in one compiled kernel
 # instead of one per size (first compile is the expensive part on TPU).
@@ -92,14 +93,42 @@ class TpuConflictSet(ConflictSetBase):
 
     @property
     def interval_count(self) -> int:
-        self._sync_count()
+        """Upper bound on live state rows, refreshed from async count
+        copies that HAVE ARRIVED — it never drains the in-flight
+        pipeline (this audit was the dominant streamed stall: reading
+        the NEWEST count blocks behind every queued batch). Exact
+        counts are available via `_sync_count` for tests/debug."""
+        while self._count_async and self._is_ready(self._count_async[0][0]):
+            self._consume_oldest_count()
         return self._count_hint
 
+    @staticmethod
+    def _is_ready(arr) -> bool:
+        try:
+            return bool(arr.is_ready())
+        except AttributeError:
+            return True   # numpy-backed (CPU tests): always concrete
+
+    @staticmethod
+    def _start_host_copy(arr) -> None:
+        """Begin an async D2H copy so a later np.asarray is a wait, not
+        a round-trip; no-op for host-resident arrays."""
+        if arr is None:
+            return
+        try:
+            arr.copy_to_host_async()
+        except AttributeError:
+            pass
+
     def _sync_count(self) -> None:
+        """EXACT current row count: blocks until the newest submitted
+        batch lands (a full pipeline drain — last resort only)."""
         if self._count_dev is not None:
             # scalar for the single-shard backend, [n_shards] when sharded
             self._count_hint = int(np.max(np.asarray(self._count_dev)))
             self._count_dev = None
+        self._count_async.clear()
+        self._rows_since_async = 0
 
     def _grow(self, needed: int) -> None:
         from ..ops.keys import next_pow2
@@ -180,11 +209,8 @@ class TpuConflictSet(ConflictSetBase):
     # -- resolve --------------------------------------------------------
     def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
                 new_oldest_version: int) -> list[int]:
-        conflict, too_old, n, _hit, _rmap = self._resolve_flags(
-            txns, commit_version, new_oldest_version, attribute=False)
-        if n == 0:
-            return []
-        return self.finalize_verdicts(conflict, too_old)
+        return self.drain(self.submit(txns, commit_version,
+                                      new_oldest_version))
 
     def resolve_with_attribution(self, txns: Sequence[ResolverTransaction],
                                  commit_version: int,
@@ -194,18 +220,73 @@ class TpuConflictSet(ConflictSetBase):
         per-read-slot cause flags in the same dispatch as the verdicts;
         the host routes flagged slots back through the marshalling map
         (slot -> (txn, original range index))."""
+        return self.drain_with_attribution(
+            self.submit(txns, commit_version, new_oldest_version,
+                        attribute=True))
+
+    def submit(self, txns: Sequence[ResolverTransaction],
+               commit_version: int, new_oldest_version: int,
+               attribute: bool = False) -> ResolveTicket:
+        """Asynchronous half of the split resolve: marshal + H2D +
+        kernel dispatch without blocking on any result (JAX async
+        dispatch queues the work; the history carry chains on device,
+        with input-buffer donation, so batch N+1's kernel consumes
+        batch N's output arrays directly). Up to RESOLVE_PIPELINE_DEPTH
+        tickets stay in flight; `drain` awaits only one batch's verdict
+        D2H. Verdict order is the submission (= version) order by
+        construction — the device serializes the chained state — so
+        pipelined verdicts are bit-identical to the serial path."""
+        t0 = time.perf_counter()
         conflict, too_old, n, read_hit, read_map = self._resolve_flags(
-            txns, commit_version, new_oldest_version, attribute=True)
+            txns, commit_version, new_oldest_version, attribute=attribute)
         if n == 0:
-            return [], []
-        verdicts = self.finalize_verdicts(conflict, too_old)
-        attr: list[list[int]] = [[] for _ in range(n)]
-        if read_map:
-            hits = np.asarray(read_hit)[:len(read_map)]
-            for slot in np.nonzero(hits)[0]:
-                t, ri = read_map[slot]
-                attr[t].append(ri)
-        return verdicts, [tuple(a) for a in attr]
+            ticket = ResolveTicket(commit_version, 0,
+                                   result=([], [] if attribute else None))
+        else:
+            self._start_host_copy(conflict)
+            self._start_host_copy(read_hit)
+
+            def materialize():
+                verdicts = self.finalize_verdicts(conflict, too_old)
+                if not attribute:
+                    return verdicts, None
+                attr: list[list[int]] = [[] for _ in range(n)]
+                if read_map:
+                    hits = np.asarray(read_hit)[:len(read_map)]
+                    for slot in np.nonzero(hits)[0]:
+                        t, ri = read_map[slot]
+                        attr[t].append(ri)
+                return verdicts, [tuple(a) for a in attr]
+
+            ticket = ResolveTicket(commit_version, n,
+                                   materialize=materialize)
+        self.pipeline.note_submit(ticket, t0)
+        return ticket
+
+    def submit_arrays(self, snapshots, has_reads, rb, re, rt, wb, we, wt,
+                      commit_version: int,
+                      new_oldest_version: int) -> ResolveTicket:
+        """Pipelined pre-encoded fast path: `resolve_arrays` wrapped in
+        a ticket whose `drain_arrays` yields (conflict[:n] ndarray,
+        too_old ndarray) — the bench/pipeline callers' contract."""
+        t0 = time.perf_counter()
+        conflict, too_old = self.resolve_arrays(
+            snapshots, has_reads, rb, re, rt, wb, we, wt,
+            commit_version, new_oldest_version)
+        self._start_host_copy(conflict)
+        n = snapshots.shape[0]
+
+        def materialize():
+            return np.asarray(conflict)[:n], too_old
+
+        ticket = ResolveTicket(commit_version, n, materialize=materialize)
+        self.pipeline.note_submit(ticket, t0)
+        return ticket
+
+    def drain_arrays(self, ticket: ResolveTicket):
+        """(conflict flags ndarray, too_old ndarray) for a ticket from
+        `submit_arrays` (idempotent, any order)."""
+        return self.pipeline.drain(ticket)
 
     def _resolve_flags(self, txns, commit_version, new_oldest_version,
                        attribute: bool = False):
@@ -334,32 +415,54 @@ class TpuConflictSet(ConflictSetBase):
 
     def _note_count(self, count, new_rows: int) -> None:
         """Record a batch's device-resident row count and start its
-        host copy without blocking; refresh the hint from the oldest
-        pending copy (usually already arrived) plus the rows added
-        since it was taken."""
+        host copy without blocking; keep roughly one pending copy per
+        in-flight pipeline slot so the front of the list is the OLDEST
+        submitted batch — the one whose readback rarely stalls, because
+        every newer batch is queued behind it."""
         self._count_dev = count
         self._rows_since_async += new_rows
-        try:
-            count.copy_to_host_async()
-        except AttributeError:
-            pass   # numpy-backed (CPU tests)
+        self._start_host_copy(count)
         self._count_async.append((count, self._rows_since_async))
-        if len(self._count_async) > 2:
-            old, rows_after = self._count_async.pop(0)
-            stale = int(np.max(np.asarray(old)))
-            bound = stale + (self._rows_since_async - rows_after)
-            if bound < self._count_hint:
-                self._count_hint = bound
+        limit = max(2, self.pipeline.depth + 1)
+        while len(self._count_async) > limit:
+            self._consume_oldest_count()
+
+    def _consume_oldest_count(self) -> bool:
+        """Fold the OLDEST pending async count into the hint: its value
+        plus every row added since it was taken bounds the current
+        count from above (rows only leave via GC), so the hint can only
+        tighten. Blocks at most until the front of the device queue
+        lands — never behind the in-flight window."""
+        if not self._count_async:
+            return False
+        old, rows_after = self._count_async.pop(0)
+        stale = int(np.max(np.asarray(old)))
+        bound = stale + (self._rows_since_async - rows_after)
+        if bound < self._count_hint:
+            self._count_hint = bound
+        if not self._count_async:
+            # the consumed entry WAS the newest count: the hint is now
+            # exact, nothing left for a full sync to add
+            self._count_dev = None
+            self._rows_since_async = 0
+        return True
 
     def _audit_capacity(self, new_rows: int) -> None:
         """Grow the device state if this batch could overflow it.
 
         `new_rows` = state rows this batch can add (2 boundaries per
-        write for the interval backend, 1 per write for points)."""
+        write for the interval backend, 1 per write for points).
+
+        The grow-check consumes pending async counts OLDEST-first:
+        each consume stalls one batch at the front of the device queue
+        at most, so the in-flight window keeps pipelining. A full
+        `_sync_count` drain (previously the dominant streamed stall)
+        only remains as the no-pending-copies fallback."""
+        while (self._count_hint + new_rows + 2 > self._cap
+               and self._consume_oldest_count()):
+            pass
         if self._count_hint + new_rows + 2 > self._cap:
             self._sync_count()
-            self._count_async.clear()
-            self._rows_since_async = 0
         if self._count_hint + new_rows + 2 > self._cap:
             self._grow(self._count_hint + new_rows)
         self._count_hint = min(self._cap - 1, self._count_hint + new_rows)
@@ -400,7 +503,10 @@ class TpuConflictSet(ConflictSetBase):
                 "occupancy": occ,
                 # raw real-row and padded-slot totals per dimension
                 "counts": {k: v for k, v in snap.items()
-                           if k != "batches"}}
+                           if k != "batches"},
+                # split submit/drain window accounting (in-flight
+                # depth, forced drains, submit-vs-drain wall latency)
+                "pipeline": self.pipeline.stats()}
 
     def _call_kernel(self, npad, nrp, nwp, args, attribute: bool):
         """Run one padded batch through the single-shard jitted kernel.
@@ -408,8 +514,11 @@ class TpuConflictSet(ConflictSetBase):
         Subclasses (the sharded resolver) override this to dispatch the
         same padded batch across a device mesh."""
         from ..ops.conflict_kernel import make_resolve_fn
+        # donate=True: the chained-state entry — the history carry is
+        # donated so K in-flight pipeline batches share ONE state
+        # allocation instead of holding K copies alive
         fn = make_resolve_fn(self._cap, npad, nrp, nwp, self._n_words,
-                             attribute=attribute)
+                             attribute=attribute, donate=True)
         read_hit = None
         if attribute:
             self._hk, self._hv, count, conflict, read_hit = fn(

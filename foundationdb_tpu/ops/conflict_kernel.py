@@ -427,13 +427,24 @@ def make_resolve_core(cap: int, n_txns: int, n_reads: int, n_writes: int,
 
 @functools.lru_cache(maxsize=None)
 def make_resolve_fn(cap: int, n_txns: int, n_reads: int, n_writes: int,
-                    n_words: int, attribute: bool = True):
+                    n_words: int, attribute: bool = True,
+                    donate: bool = False):
     """Jitted single-shard resolve step (see make_resolve_core).
     `attribute` is part of the compile cache key: the attributing and
-    verdict-only variants are distinct programs."""
-    fn = jax.jit(make_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
-                                   attribute=attribute))
-    tag = "" if attribute else "/noattr"
+    verdict-only variants are distinct programs.
+
+    `donate` is the chained-state entry point: the history carry
+    (HK, HV) is donated back to the kernel, so batch N+1 reuses batch
+    N's output buffers in place and capacity doubling — not steady
+    state — is the only realloc. The resolve pipeline depends on it
+    (K in-flight batches would otherwise hold K history copies alive).
+    Callers that reuse the input arrays after the call (direct kernel
+    tests) must leave it False."""
+    core = make_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
+                             attribute=attribute)
+    fn = (jax.jit(core, donate_argnums=(0, 1)) if donate
+          else jax.jit(core))
+    tag = ("" if attribute else "/noattr") + ("/don" if donate else "")
     return profile_kernel(
         fn, f"resolve[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]")
 

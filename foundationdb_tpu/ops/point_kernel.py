@@ -234,12 +234,16 @@ def make_point_resolve_core(cap: int, n_txns: int, n_reads: int,
 @functools.lru_cache(maxsize=None)
 def make_point_resolve_fn(cap: int, n_txns: int, n_reads: int,
                           n_writes: int, n_words: int,
-                          attribute: bool = True):
-    """Jitted point-mode resolve step (see make_point_resolve_core)."""
-    fn = jax.jit(
-        make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
-                                attribute=attribute))
-    tag = "" if attribute else "/noattr"
+                          attribute: bool = True, donate: bool = False):
+    """Jitted point-mode resolve step (see make_point_resolve_core).
+    `donate` donates the (sk, sv) state carry — the chained-state entry
+    the resolve pipeline uses so in-flight batches share one state
+    allocation; leave False when reusing inputs after the call."""
+    core = make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
+                                   attribute=attribute)
+    fn = (jax.jit(core, donate_argnums=(0, 1)) if donate
+          else jax.jit(core))
+    tag = ("" if attribute else "/noattr") + ("/don" if donate else "")
     return profile_kernel(
         fn, f"point[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]",
         g_kernel_counters)
@@ -275,10 +279,12 @@ def pack_point_batch(snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid):
 @functools.lru_cache(maxsize=None)
 def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
                                  n_writes: int, n_words: int,
-                                 attribute: bool = True):
+                                 attribute: bool = True,
+                                 donate: bool = False):
     """Jitted point resolve taking the pack_point_batch buffer; the
     unpack happens inside the jit so the eight logical arrays never
-    exist as separate device buffers."""
+    exist as separate device buffers. `donate` donates the (sk, sv)
+    state carry (see make_point_resolve_fn)."""
     core = make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
                                    attribute=attribute)
     width = n_words + 1
@@ -303,8 +309,10 @@ def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
         return core(sk, sv, snap, too_old, rk, rtxn, rvalid,
                     wk, wtxn, wvalid, commit, oldest, init_off)
 
-    tag = "" if attribute else "/noattr"
+    fn = (jax.jit(packed, donate_argnums=(0, 1)) if donate
+          else jax.jit(packed))
+    tag = ("" if attribute else "/noattr") + ("/don" if donate else "")
     return profile_kernel(
-        jax.jit(packed),
+        fn,
         f"point_packed[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]",
         g_kernel_counters)

@@ -185,11 +185,15 @@ class ShardedTpuConflictSet(TpuConflictSet):
         # the replication-check kwarg was renamed check_rep -> check_vma
         # across jax releases; disable it under whichever name this
         # jax accepts (the psum'd fixpoint is deliberately mixed
-        # replicated/sharded)
+        # replicated/sharded). The history carry (args 2,3 — after the
+        # shard bounds, which ARE reused every call) is donated so the
+        # in-flight pipeline window shares one sharded state allocation.
         try:
-            fn = jax.jit(shard_map(wrapped, check_vma=False, **specs))
+            fn = jax.jit(shard_map(wrapped, check_vma=False, **specs),
+                         donate_argnums=(2, 3))
         except TypeError:
-            fn = jax.jit(shard_map(wrapped, check_rep=False, **specs))
+            fn = jax.jit(shard_map(wrapped, check_rep=False, **specs),
+                         donate_argnums=(2, 3))
         # same compile/execute accounting as the single-shard families:
         # the sharded kernels have the most expensive compiles, so
         # bucket churn must be visible in the process-wide profile too

@@ -1137,6 +1137,7 @@ class ClusterController:
                             "commit": role.commit_bands.snapshot()}})
                 elif isinstance(role, Resolver) and \
                         f"-e{info.epoch}-" in rn:
+                    kern = role.kernel_stats()
                     resolvers.append({
                         "name": rn,
                         "version": role.version.get(),
@@ -1148,7 +1149,14 @@ class ClusterController:
                         "hot_spots": role.hot_spots.top(),
                         # device-kernel profile: pad occupancy +
                         # compile/execute accounting ({} off-device)
-                        "kernel": role.kernel_stats()})
+                        "kernel": kern,
+                        # split submit/drain resolve-pipeline window:
+                        # in-flight depth, forced drains, submit-vs-
+                        # drain latency bands (every backend has it;
+                        # reuse the snapshot the device kernel stats
+                        # already embed rather than recomputing)
+                        "pipeline": (kern.get("pipeline")
+                                     or role.pipeline_stats())})
                 elif isinstance(role, Ratekeeper) and \
                         rn.endswith(f"-e{info.epoch}"):
                     rate = role.rate

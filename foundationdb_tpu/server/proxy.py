@@ -216,6 +216,12 @@ class Proxy:
         self._suspect_peers = {}       # id(ref) -> suspect-until time
         # (ref: ProxyStats — txn admission/commit counters for status)
         self.stats = flow.CounterCollection("proxy")
+        # batches between batch_resolving release and verdict arrival:
+        # >1 means the resolver-side pipeline actually overlaps this
+        # proxy's batches end to end (the whole point of the split
+        # submit/drain resolve path)
+        self._resolving_now = 0
+        self._resolving_peak = 0
         # banded request latencies + recent-latency reservoirs (ref:
         # LatencyBandConfig applied to GRV and commit in status, plus
         # the LatencySample percentile surface)
@@ -609,8 +615,12 @@ class Proxy:
                 vf = flow.spawn(self._resolve_split(ver, reqs),
                                 TaskPriority.PROXY_COMMIT)
             self._advance(self.batch_resolving, local)
-            verdicts, conflict_ranges = self._norm_verdicts(
-                await vf, len(reqs))
+            self._note_resolving(+1)
+            try:
+                verdicts, conflict_ranges = self._norm_verdicts(
+                    await vf, len(reqs))
+            finally:
+                self._note_resolving(-1)
             self._mark(dbg,
                        "MasterProxyServer.commitBatch.AfterResolution")
 
@@ -717,6 +727,15 @@ class Proxy:
     def _advance(nv: NotifiedVersion, to: int) -> None:
         if nv.get() < to:
             nv.set(to)
+
+    def _note_resolving(self, delta: int) -> None:
+        """Concurrently-resolving batch gauge + high-water mark."""
+        self._resolving_now += delta
+        self.stats.counter("resolve_in_flight").set(self._resolving_now)
+        if self._resolving_now > self._resolving_peak:
+            self._resolving_peak = self._resolving_now
+            self.stats.counter("resolve_in_flight_peak").set(
+                self._resolving_peak)
 
     @staticmethod
     def _norm_verdicts(r, n):

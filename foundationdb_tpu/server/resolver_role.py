@@ -109,6 +109,11 @@ class Resolver:
         # (ref: outstandingBatches, Resolver.actor.cpp:159,:241-257)
         self._reply_cache: dict[int, list[int]] = {}
         self._reply_order: deque[int] = deque()
+        # batches submitted to the conflict backend but not yet drained
+        # (the resolve-pipeline window): version -> (ticket, want_report,
+        # txns). A duplicate delivered in this window drains the SAME
+        # ticket (idempotent) instead of falling to conflict-everything.
+        self._inflight: dict[int, tuple] = {}
         # a tiny cache stresses the duplicate-delivery fallback path
         self._cache_cap = 2 if flow.buggify("resolver/small_reply_cache") \
             else int(SERVER_KNOBS.resolver_reply_cache_size)
@@ -149,10 +154,23 @@ class Resolver:
         # order batches by version, whatever the arrival order
         await self.version.when_at_least(req.prev_version)
         if self.version.get() >= req.version:
-            # duplicate delivery (e.g. proxy retry): replay the original
-            # verdicts so a retrying proxy cannot livelock
+            # duplicate delivery (e.g. proxy retry): a batch still in
+            # the resolve-pipeline window (submitted, version advanced,
+            # verdicts not yet read back) drains the same ticket and
+            # replies identically; otherwise replay the cached verdicts
+            # so a retrying proxy cannot livelock
             # (ref: Resolver.actor.cpp:241-257). Conflict-everything only
             # if the entry aged out of the window.
+            pend = self._inflight.get(req.version)
+            if pend is not None:
+                flow.cover("resolver.reply_cache.inflight_dup")
+                ticket, want_report, txns = pend
+                verdicts, attributions = \
+                    self.conflict_set.drain_with_attribution(ticket)
+                reply.send(self._build_payload(
+                    txns, verdicts, attributions, want_report,
+                    record_hot=False))
+                return
             cached = self._reply_cache.get(req.version)
             flow.cover("resolver.reply_cache.hit", cached is not None)
             flow.cover("resolver.reply_cache.aged_out", cached is None)
@@ -186,10 +204,17 @@ class Resolver:
                 for t in req.transactions)
             new_oldest = max(0, req.version - self._mwtlv)
             attributions = None
+            verdicts = None
             try:
-                verdicts, attributions = \
-                    self.conflict_set.resolve_with_attribution(
-                        txns, req.version, new_oldest)
+                # split submit/drain: the dispatch is queued WITHOUT
+                # blocking on any result, the version chain advances at
+                # submit time, and this actor yields once — so successor
+                # batches submit while this one's verdict D2H is still
+                # in flight. Up to RESOLVE_PIPELINE_DEPTH batches
+                # overlap end to end with the proxy's
+                # batch_resolving/batch_logging interlocks.
+                ticket = self.conflict_set.submit(
+                    txns, req.version, new_oldest, attribute=True)
             except (ValueError, OverflowError) as e:
                 # A malformed batch (e.g. a key wider than the backend's key
                 # bucket) must not wedge the pipeline: conflict the whole
@@ -201,30 +226,19 @@ class Resolver:
                     Version=req.version, Error=str(e)).log()
                 verdicts = [0] * len(req.transactions)
                 self.conflict_set.resolve([], req.version, new_oldest)
-            # attribution -> actual key ranges: feed the hot-spot table
-            # every batch, and build the per-txn reply payload when a
-            # txn asked for report_conflicting_keys
-            ranges_per_txn = [()] * len(txns)
-            if attributions is not None:
-                n_attr = 0
-                for t, idxs in enumerate(attributions):
-                    if not idxs:
-                        continue
-                    rs = tuple(txns[t].read_ranges[i] for i in idxs)
-                    ranges_per_txn[t] = rs
-                    n_attr += len(rs)
-                    for b, e in rs:
-                        self.hot_spots.record(b, e)
-                if n_attr:
-                    self.stats.counter("conflict_ranges_attributed") \
-                        .add(n_attr)
-            payload = (ResolveReply(tuple(verdicts), tuple(ranges_per_txn))
-                       if want_report else verdicts)
+                self.version.set(req.version)
+            if verdicts is None:
+                self._inflight[req.version] = (ticket, want_report, txns)
+                self.version.set(req.version)
+                await flow.delay(0, TaskPriority.PROXY_RESOLVER_REPLY)
+                verdicts, attributions = \
+                    self.conflict_set.drain_with_attribution(ticket)
+            payload = self._build_payload(txns, verdicts, attributions,
+                                          want_report, record_hot=True)
             self._reply_cache[req.version] = payload
             self._reply_order.append(req.version)
             while len(self._reply_order) > self._cache_cap:
                 self._reply_cache.pop(self._reply_order.popleft(), None)
-            self.version.set(req.version)
             self._mark(req, "Resolver.resolveBatch.After")
             self.stats.counter("batches_resolved").add(1)
             self.stats.counter("transactions_resolved").add(len(txns))
@@ -232,13 +246,44 @@ class Resolver:
             reply.send(payload)
             self._check_state_pressure(req.version)
         finally:
+            self._inflight.pop(req.version, None)
             flow.g_trace_batch.finish_spans(spans)
+
+    def _build_payload(self, txns, verdicts, attributions, want_report,
+                       record_hot: bool):
+        """Attribution -> actual key ranges: feed the hot-spot table
+        (first delivery only — a duplicate must not double-count) and
+        build the per-txn reply payload when some txn asked for
+        report_conflicting_keys."""
+        ranges_per_txn = [()] * len(txns)
+        if attributions is not None:
+            n_attr = 0
+            for t, idxs in enumerate(attributions):
+                if not idxs:
+                    continue
+                rs = tuple(txns[t].read_ranges[i] for i in idxs)
+                ranges_per_txn[t] = rs
+                if record_hot:
+                    n_attr += len(rs)
+                    for b, e in rs:
+                        self.hot_spots.record(b, e)
+            if record_hot and n_attr:
+                self.stats.counter("conflict_ranges_attributed").add(n_attr)
+        return (ResolveReply(tuple(verdicts), tuple(ranges_per_txn))
+                if want_report else verdicts)
 
     def kernel_stats(self) -> dict:
         """The conflict backend's device-kernel profile (occupancy,
         compile/execute accounting) for the status document; {} for
         host-only backends."""
         return self.conflict_set.kernel_stats()
+
+    def pipeline_stats(self) -> dict:
+        """The resolve pipeline's window accounting (in-flight depth,
+        queue occupancy, submit-vs-drain latency bands) — every backend
+        has it, so a stalled pipeline is visible in status without a
+        bench run."""
+        return self.conflict_set.pipeline_stats()
 
     def state_size(self) -> int:
         """Conflict-history row estimate across backends (boundary rows

@@ -128,6 +128,23 @@ def _render_details(cl: dict) -> str:
                 f"  {name:<26} backend={k['backend']} "
                 f"platform={k['platform']} batches={k['batches']} "
                 f"rows={k['state_rows']}/{k['capacity']} occ[{occ}]")
+    pipes = [(r["name"], r["pipeline"]) for r in cl.get("resolvers", ())
+             if r.get("pipeline")]
+    if pipes:
+        lines.append("Resolve pipeline:")
+        for name, p in pipes:
+            lat = p.get("latency", {})
+            sub = lat.get("submit", {})
+            dr = lat.get("drain", {})
+            occ = p.get("occupancy")
+            lines.append(
+                f"  {name:<26} depth={p['depth']} "
+                f"in_flight={p['in_flight']}/{p['peak_in_flight']}peak "
+                f"submits={p['submits']} drains={p['drains']} "
+                f"forced={p['forced_drains']} "
+                f"occ={occ if occ is not None else '-'} "
+                f"submit_p50={sub.get('p50', 0):g}s "
+                f"drain_p50={dr.get('p50', 0):g}s")
     if cl.get("kernels"):
         lines.append("Kernel compile/execute (process-wide):")
         for kn, v in sorted(cl["kernels"].items()):

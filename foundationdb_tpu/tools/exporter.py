@@ -72,15 +72,18 @@ def _band_seconds(band_key: str) -> str:
 
 
 def _add_latency(f: _Families, kind: str, role: str, request: str,
-                 snap: dict) -> None:
+                 snap: dict, stem: str = None) -> None:
     """One RequestLatency snapshot -> a WELL-FORMED Prometheus
     histogram (cumulative `_bucket` counts ordered by `le`, a final
     `+Inf` bucket, and matching `_count`/`_sum` children) plus max and
     quantile gauges (the reservoir percentiles ride a separate family:
     a summary and a histogram may not share a metric name). The raw
     per-band counters additionally ride a `*_band` series, so a
-    dashboard keyed on the LatencyBands thresholds keeps working."""
-    base = f"{_PREFIX}_request_latency_seconds"
+    dashboard keyed on the LatencyBands thresholds keeps working.
+    `stem` picks the family name prefix (default: the shared
+    request-latency family; the resolve pipeline uses its own)."""
+    stem = stem or f"{_PREFIX}_request_latency"
+    base = f"{stem}_seconds"
     help_text = "Request latency bands per pipeline stage"
     labels = {"kind": kind, "role": role, "request": request}
     # LatencyBands.record increments EVERY band at or above the
@@ -101,15 +104,15 @@ def _add_latency(f: _Families, kind: str, role: str, request: str,
     f.add(base, "histogram", help_text, labels,
           snap.get("sum_seconds", 0.0), suffix="_sum")
     for bk, count in bands:
-        f.add(f"{_PREFIX}_request_latency_band", "gauge",
+        f.add(f"{stem}_band", "gauge",
               "Raw per-band request counts (LatencyBands thresholds)",
               {**labels, "band": _band_seconds(bk)}, count)
-    f.add(f"{_PREFIX}_request_latency_max_seconds", "gauge",
+    f.add(f"{stem}_max_seconds", "gauge",
           "Largest latency ever observed per stage", labels,
           snap.get("max_seconds"))
     for q in ("p50", "p90", "p99"):
         if q in snap:
-            f.add(f"{_PREFIX}_request_latency_quantile_seconds", "gauge",
+            f.add(f"{stem}_quantile_seconds", "gauge",
                   "Recent-reservoir latency percentiles per stage",
                   {**labels, "quantile": "0." + q[1:]}, snap[q])
 
@@ -158,6 +161,29 @@ def render_prometheus(status: dict) -> str:
                     f.add(f"{_PREFIX}_resolver_kernel_occupancy", "gauge",
                           "Real rows / padded slots per batch dimension",
                           {"role": r["name"], "dim": dim}, occ)
+        pipe = r.get("pipeline") or {}
+        if pipe:
+            plabels = {"role": r["name"]}
+            for g, help_text in (
+                    ("depth", "Configured RESOLVE_PIPELINE_DEPTH"),
+                    ("in_flight", "Batches submitted but not drained"),
+                    ("peak_in_flight",
+                     "High-water mark of the in-flight window"),
+                    ("occupancy",
+                     "Mean in-flight depth over configured depth")):
+                f.add(f"{_PREFIX}_resolve_pipeline_{g}", "gauge",
+                      help_text, plabels, pipe.get(g))
+            for c, help_text in (
+                    ("submits", "Batches submitted to the pipeline"),
+                    ("drains", "Batch verdicts read back"),
+                    ("forced_drains",
+                     "Submits that hit the depth backpressure")):
+                f.add(f"{_PREFIX}_resolve_pipeline_{c}", "counter",
+                      help_text, plabels, pipe.get(c))
+            for stage, snap in (pipe.get("latency") or {}).items():
+                if snap.get("total"):
+                    _add_latency(f, "resolver", r["name"], stage, snap,
+                                 stem=f"{_PREFIX}_resolve_pipeline_latency")
     for lg in cl.get("logs", ()):
         _add_counters(f, "tlog", lg.get("store", "?"), lg.get("counters"))
         f.add(f"{_PREFIX}_tlog_queue_length", "gauge",

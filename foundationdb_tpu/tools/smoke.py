@@ -20,6 +20,8 @@ PROFILE_REPORT_PATH = "/tmp/_profile_report.txt"
 
 
 def run_smoke(out=print) -> int:
+    import os
+
     from .. import flow
     from ..client import run_transaction
     from ..server import SimCluster
@@ -27,6 +29,11 @@ def run_smoke(out=print) -> int:
     from .exporter import parse_prometheus, render_prometheus
 
     cluster = SimCluster(seed=4242, durable=True)
+    # resolve-pipeline depth under test (CI runs RESOLVE_PIPELINE_DEPTH=4
+    # on the CPU backend); set AFTER SimCluster re-initializes the knobs
+    flow.SERVER_KNOBS.set(
+        "resolve_pipeline_depth",
+        int(os.environ.get("RESOLVE_PIPELINE_DEPTH", 4)))
     cli = Cli.for_cluster(cluster)
     try:
         db = cluster.client("smoke")
@@ -63,9 +70,20 @@ def run_smoke(out=print) -> int:
         assert cl["latency_probe"].get("rounds", 0) >= 1, \
             "latency probe never ran"
 
+        # the resolve pipeline must be visible without a bench run:
+        # every resolver submitted/drained batches through it
+        res = cl.get("resolvers", ())
+        assert res, "no resolvers in status"
+        for r in res:
+            pipe = r.get("pipeline") or {}
+            assert pipe.get("submits", 0) > 0, f"pipeline idle: {pipe}"
+            assert pipe.get("drains") == pipe.get("submits"), pipe
+            assert pipe.get("depth", 0) >= 1, pipe
+
         details = cli.execute("status details")
         for section in ("Latency (seconds):", "Conflict hot spots",
-                        "Latency probe:", b"hot".hex()):
+                        "Latency probe:", "Resolve pipeline:",
+                        b"hot".hex()):
             assert str(section) in details, f"missing {section!r}"
         top = cli.execute("top")
         assert b"hot".hex() in top
@@ -79,11 +97,15 @@ def run_smoke(out=print) -> int:
         names = {n for n, _, _ in samples}
         for need in ("fdbtpu_conflict_hot_spot_score",
                      "fdbtpu_latency_probe_seconds",
-                     "fdbtpu_request_latency_seconds_bucket"):
+                     "fdbtpu_request_latency_seconds_bucket",
+                     "fdbtpu_resolve_pipeline_submits",
+                     "fdbtpu_resolve_pipeline_depth"):
             assert need in names, f"exporter missing {need}"
         out(f"SMOKE OK: {len(samples)} exporter samples, "
             f"{len(cl['conflict_hot_spots'])} hot spots, "
-            f"{cl['latency_probe']['rounds']} probe rounds")
+            f"{cl['latency_probe']['rounds']} probe rounds, "
+            f"pipeline depth {res[0]['pipeline']['depth']} "
+            f"({res[0]['pipeline']['submits']} submits)")
         return 0
     finally:
         cluster.shutdown()
