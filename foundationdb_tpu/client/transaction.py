@@ -165,10 +165,10 @@ class Database:
         ints = {k: v for k, v in updates.items() if k != "conflict_backend"}
         if any(not isinstance(v, int) or v < 1 for v in ints.values()):
             raise error("invalid_option_value")
-        if updates.get("conflict_backend") is not None and \
-                updates["conflict_backend"] not in (
-                    "python", "native", "tpu", "tpu-point"):
-            raise error("invalid_option_value")
+        if updates.get("conflict_backend") is not None:
+            from ..models.native_backend import CONFLICT_BACKENDS
+            if updates["conflict_backend"] not in CONFLICT_BACKENDS:
+                raise error("invalid_option_value")
         if updates.get("usable_regions") not in (None, 1, 2):
             raise error("invalid_option_value")
         role_counts = {k: v for k, v in ints.items()

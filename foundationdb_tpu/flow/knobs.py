@@ -287,6 +287,42 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
 
     # -- conflict-set backends (ref: resolver window GC cadence) -------
     init("CONFLICT_SET_COMPACT_EVERY", 16, lambda: 1)
+
+    # -- conflict-backend fault tolerance (models/failover.py) ---------
+    # per-seam probability of a simulated device fault at the
+    # submit/materialize/drain boundaries (ops/fault_injection.py).
+    # NEVER buggify-distorted: the seams live inside backend code that
+    # unit tests drive without the failover controller; arming is an
+    # explicit act (sim fault workloads, CI smoke) — a BUGGIFY site
+    # inside the injector amplifies an armed campaign x10 instead
+    init("DEVICE_FAULT_INJECTION", 0.0)
+    # resolver-side failover wrapper for the device backends: 0 runs
+    # them bare (bench-style; a device fault then kills the role)
+    init("CONFLICT_FAILOVER", 1)
+    # checkpoint cadence in VERSIONS (~1s of commit traffic at the
+    # reference VERSIONS_PER_SECOND); buggified tiny so sim runs
+    # checkpoint every few batches and restores replay short logs
+    init("CONFLICT_CHECKPOINT_VERSIONS", 1_000_000, lambda: 20_000)
+    # hard bound on the replay log (batches since the last checkpoint);
+    # reaching it forces a checkpoint whatever the version cadence says
+    init("CONFLICT_REPLAY_LOG_MAX", 512, lambda: 4)
+    # fresh-device rebuild attempts before declaring the device dead
+    # and failing over to the CPU backend
+    init("DEVICE_FAULT_RETRIES", 2, lambda: 0)
+    # reattach-to-device backoff after a failover (doubles per failed
+    # reattach, capped); CONFLICT_DEVICE_REATTACH=0 pins the fallback
+    init("CONFLICT_DEVICE_REATTACH", 1)
+    init("DEVICE_REATTACH_BACKOFF", 1.0, lambda: 0.05)
+    init("DEVICE_REATTACH_BACKOFF_MAX", 30.0)
+    # sampled shadow validation: every Nth batch is re-resolved on a
+    # CPU shadow rebuilt from the last checkpoint and the verdicts
+    # compared (0 disables; buggified high so sim runs cross-check
+    # constantly — the early-detection discipline of arXiv:2301.06181)
+    init("SHADOW_RESOLVE_SAMPLE", 0, lambda: 2)
+    # a shadow mismatch normally traces SevError + surfaces in status;
+    # with fail-stop armed it raises and halts the resolver, the way
+    # check_consistency treats replica corruption
+    init("SHADOW_RESOLVE_FAIL_STOP", 0)
     return k
 
 

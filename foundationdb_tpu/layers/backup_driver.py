@@ -150,9 +150,15 @@ class BackupDriver:
         self._container = open_container(dest)
         self.agent = BackupAgent(self.cluster, self.db)
         base = await self.agent.start()
+        # save_to serializes LIVE agent state (log records the puller
+        # actor keeps appending): it must run on the loop, never on a
+        # pool thread — a concurrent snapshot could certify a version
+        # window while missing a mutation inside it. Its blob retries
+        # skip the backoff sleep on the loop (_retry_backoff). Pure
+        # container IO (describe) is offloaded via arun (ADVICE r5).
         self.agent.save_to(self._container)
         self._last_upload = flow.now()
-        d = self._container.describe()
+        d = await self._container.arun(self._container.describe)
         # start() spans a full epoch recovery — if an abort committed
         # meanwhile, the abort wins: don't stamp `running` over it (the
         # next poll sees `abort` and finishes the agent)
@@ -168,8 +174,8 @@ class BackupDriver:
                 flow.SERVER_KNOBS.backup_driver_upload_interval:
             return
         self._last_upload = flow.now()
-        self.agent.save_to(self._container)
-        d = self._container.describe()
+        self.agent.save_to(self._container)   # live agent state: on-loop
+        d = await self._container.arun(self._container.describe)
         if d["max_restorable_version"] is not None:
             await self._write_rows(
                 expect_state=BACKUP_STATE_RUNNING,
@@ -178,8 +184,8 @@ class BackupDriver:
     async def _finish(self) -> None:
         if self.agent is not None:
             await self.agent.stop()
-            self.agent.save_to(self._container)
-            d = self._container.describe()
+            self.agent.save_to(self._container)   # agent stopped; on-loop
+            d = await self._container.arun(self._container.describe)
             extra = {}
             if d["max_restorable_version"] is not None:
                 extra["restorable_version"] = str(

@@ -6,17 +6,30 @@ from .conflict_set import (
     TOO_OLD,
     BruteForceConflictSet,
     ConflictSetBase,
+    ConflictSetCheckpoint,
     PyConflictSet,
     ResolvePipeline,
     ResolveTicket,
     ResolverTransaction,
 )
-from .native_backend import NativeConflictSet, create_conflict_set, native_available
+from .failover import (
+    FailoverConflictSet,
+    ShadowResolveMismatch,
+    create_resilient_conflict_set,
+)
+from .native_backend import (
+    CONFLICT_BACKENDS,
+    NativeConflictSet,
+    create_conflict_set,
+    native_available,
+)
 
 __all__ = [
-    "COMMITTED", "CONFLICT", "TOO_OLD",
-    "BruteForceConflictSet", "ConflictSetBase", "PyConflictSet",
+    "COMMITTED", "CONFLICT", "CONFLICT_BACKENDS", "TOO_OLD",
+    "BruteForceConflictSet", "ConflictSetBase", "ConflictSetCheckpoint",
+    "FailoverConflictSet", "PyConflictSet",
     "ResolvePipeline", "ResolveTicket",
-    "ResolverTransaction", "NativeConflictSet", "create_conflict_set",
+    "ResolverTransaction", "NativeConflictSet", "ShadowResolveMismatch",
+    "create_conflict_set", "create_resilient_conflict_set",
     "native_available",
 ]

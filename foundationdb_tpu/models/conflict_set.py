@@ -43,6 +43,98 @@ COMMITTED = 2
 VERDICT_NAMES = {CONFLICT: "conflict", TOO_OLD: "too_old", COMMITTED: "committed"}
 
 
+class ConflictSetCheckpoint(NamedTuple):
+    """Backend-agnostic snapshot of a conflict-set's live state.
+
+    The history is a step function over the keyspace (see the module
+    docstring); a checkpoint captures it as a BASELINE version covering
+    every key not named below, plus sorted disjoint interval
+    `assignments` (begin, end, version) overriding the baseline — the
+    exact information content of every backend's state, whatever its
+    data-structure (bisect lists, std::map, device arrays, per-key
+    point map). `oldest_version` and `last_commit` restore the MVCC
+    window and the version-ordering floor.
+
+    Restore parity contract: any backend restored from a checkpoint
+    yields bit-identical verdicts to the backend that produced it, for
+    every subsequent batch — dead intervals (version < oldest) are
+    clamped to a dead-equivalent value at capture, which is
+    verdict-invariant (no non-tooOld read snapshot is below oldest)."""
+
+    oldest_version: int
+    last_commit: int
+    baseline_version: int
+    assignments: tuple  # of (begin: bytes, end: bytes, version: int)
+
+
+def checkpoint_from_step(keys: Sequence[bytes], vals: Sequence[int],
+                         oldest: int, last_commit: int
+                         ) -> ConflictSetCheckpoint:
+    """Build a checkpoint from a full-coverage step function (keys[0]
+    must be b""; vals[i] covers [keys[i], keys[i+1}) with the last
+    interval running to +inf). The tail interval's version becomes the
+    baseline, so every emitted assignment has a finite end; dead
+    intervals are clamped (verdict-invariant, see ConflictSetCheckpoint)."""
+    if not keys or keys[0] != b"":
+        raise ValueError("step function must cover the keyspace from b''")
+    baseline = int(vals[-1])
+    dead_v = min(baseline, int(oldest) - 1)
+    out = []
+    for i in range(len(keys) - 1):
+        v = int(vals[i])
+        if v < oldest:
+            v = dead_v
+        if v != baseline:
+            out.append((keys[i], keys[i + 1], v))
+    return ConflictSetCheckpoint(int(oldest), int(last_commit),
+                                 baseline, tuple(out))
+
+
+def step_from_checkpoint(ckpt: ConflictSetCheckpoint):
+    """Materialize a checkpoint back into a full-coverage step function
+    (keys, vals) — the inverse of checkpoint_from_step, also correct
+    for point-backend checkpoints (baseline between the points)."""
+    keys: list[bytes] = [b""]
+    vals: list[int] = [int(ckpt.baseline_version)]
+    for b, e, v in sorted(ckpt.assignments):
+        if e is None or b >= e:
+            raise ValueError(f"malformed checkpoint range [{b!r}, {e!r})")
+        if b < keys[-1]:
+            raise ValueError("checkpoint assignments overlap")
+        if b == keys[-1]:
+            vals[-1] = int(v)
+        else:
+            keys.append(b)
+            vals.append(int(v))
+        keys.append(e)
+        vals.append(int(ckpt.baseline_version))
+    # coalesce equal neighbors (pure cosmetics: fewer rows on restore)
+    ck: list[bytes] = [keys[0]]
+    cv: list[int] = [vals[0]]
+    for k, v in zip(keys[1:], vals[1:]):
+        if v != cv[-1]:
+            ck.append(k)
+            cv.append(v)
+    return ck, cv
+
+
+def clip_step(keys: Sequence[bytes], vals: Sequence[int], lo: bytes,
+              hi: "bytes | None"):
+    """Restrict a full-coverage step function to [lo, hi): the returned
+    lists start with an explicit boundary AT lo carrying the covering
+    version (the shard-state invariant: slot 0 is the shard's lower
+    bound)."""
+    i = bisect_right(keys, lo) - 1
+    out_k: list[bytes] = [lo]
+    out_v: list[int] = [int(vals[i])]
+    for j in range(i + 1, len(keys)):
+        if hi is not None and keys[j] >= hi:
+            break
+        out_k.append(keys[j])
+        out_v.append(int(vals[j]))
+    return out_k, out_v
+
+
 class ResolverTransaction(NamedTuple):
     """One transaction's conflict information (ref: CommitTransactionRef,
     fdbclient/CommitTransaction.h:136-168 — read/write conflict ranges +
@@ -81,8 +173,13 @@ class ResolveTicket:
 
     def _force(self):
         if self._materialize is not None:
-            m, self._materialize = self._materialize, None
-            self._result = m()
+            # the closure is cleared only AFTER it succeeds: a device
+            # fault raised mid-materialize must leave the ticket
+            # un-materialized (drainable again / replayable), never
+            # "done" with a silent None result
+            result = self._materialize()
+            self._materialize = None
+            self._result = result
         return self._result
 
 
@@ -143,12 +240,16 @@ class ResolvePipeline:
         except ValueError:
             pass                              # already materialized
         if not ticket.drained:
-            ticket.drained = True
-            self.drains += 1
             if not ticket.done:
+                # a materialize failure (device fault) propagates with
+                # the ticket still UNDRAINED — the idempotent-drain
+                # contract holds: a later drain retries or returns the
+                # replayed result, never a silent None
                 t0 = time.perf_counter()
                 ticket._force()
                 self.drain_latency.record(time.perf_counter() - t0)
+            ticket.drained = True
+            self.drains += 1
         return ticket._result
 
     def stats(self) -> dict:
@@ -205,6 +306,26 @@ class ConflictSetBase:
     def oldest_version(self) -> int:
         raise NotImplementedError
 
+    def validate_txns(self, txns: Sequence[ResolverTransaction],
+                      oldest_version: "int | None" = None) -> None:
+        """Host-side mirror of this backend's input contract: raise the
+        same ValueError `submit` would raise for a malformed batch (a
+        key wider than the device key bucket, a non-point range on the
+        point backend), WITHOUT touching device state. The failover
+        wrapper runs the PRIMARY's validator while serving from the
+        permissive CPU fallback, so the resolver role's batch-reject
+        behavior — and with it the verdict stream — stays bit-identical
+        across the failover boundary, and every logged batch stays
+        device-replayable for reattach. Host backends accept anything."""
+
+    def input_contract(self):
+        """`validate_txns` as a STATE-FREE callable, safe to hold long
+        after this backend (and any device buffers) are discarded; call
+        it with an explicit `oldest_version`. The base no-op reads no
+        state, so the bound method is already safe; the device backends
+        hand out a view carrying only their key-bucket config."""
+        return self.validate_txns
+
     # -- split submit/drain pipeline ------------------------------------
     @property
     def pipeline(self) -> ResolvePipeline:
@@ -256,6 +377,55 @@ class ConflictSetBase:
         accounting)."""
         return {}
 
+    # -- checkpoint / restore -------------------------------------------
+    def checkpoint(self) -> ConflictSetCheckpoint:
+        """Serialize the live state (oldest-version watermark + the
+        history step function) into a backend-agnostic snapshot. Drains
+        the resolve pipeline first: a checkpoint must reflect every
+        submitted batch, and the device backends D2H their key/version
+        arrays — which blocks behind queued kernels anyway."""
+        for t in list(self.pipeline.in_flight):
+            self.pipeline.drain(t)
+        return self._checkpoint_state()
+
+    def restore(self, ckpt: ConflictSetCheckpoint) -> None:
+        """Rebuild this backend's state from a checkpoint (taken from
+        ANY backend; cross-backend restores yield bit-identical verdicts
+        for every later batch). Existing state is discarded."""
+        for t in list(self.pipeline.in_flight):
+            self.pipeline.drain(t)
+        self._restore_state(ckpt)
+
+    def _checkpoint_state(self) -> ConflictSetCheckpoint:
+        raise NotImplementedError(
+            f"{self.BACKEND} backend does not support checkpoint()")
+
+    def _restore_state(self, ckpt: ConflictSetCheckpoint) -> None:
+        """Default restore: reset to the checkpoint baseline, then
+        deterministically REPLAY the assignments as write-only batches
+        in version order through the backend's own resolve step — every
+        backend reconstructs the identical step function through its
+        public contract (the merge assigns exactly [b,e) -> commit
+        version; disjoint assignments commute, version order keeps
+        non-decreasing-commit backends happy). Backends with a cheaper
+        direct path (host array rebuilds) override this."""
+        self._reset_state(int(ckpt.baseline_version))
+        by_version: dict[int, list] = {}
+        for b, e, v in ckpt.assignments:
+            by_version.setdefault(int(v), []).append((b, e))
+        for v in sorted(by_version):
+            self.resolve([ResolverTransaction(v, (), tuple(by_version[v]))],
+                         v, 0)
+        # advance the window + ordering floor with a rangeless txn (it
+        # can never conflict or be tooOld, and — unlike an empty batch —
+        # every backend runs it through the full GC step)
+        self.resolve([ResolverTransaction(ckpt.last_commit, (), ())],
+                     ckpt.last_commit, ckpt.oldest_version)
+
+    def _reset_state(self, baseline_version: int) -> None:
+        raise NotImplementedError(
+            f"{self.BACKEND} backend does not support restore()")
+
 
 class PyConflictSet(ConflictSetBase):
     """Pure-Python step-function baseline (sorted boundary list + bisect)."""
@@ -270,11 +440,23 @@ class PyConflictSet(ConflictSetBase):
         self._keys: list[bytes] = [b""]
         self._vals: list[int] = [init_version]
         self._oldest = 0
+        self._last_commit = init_version
         self._resolved_batches = 0
 
     @property
     def oldest_version(self) -> int:
         return self._oldest
+
+    # -- checkpoint / restore ------------------------------------------
+    def _checkpoint_state(self) -> ConflictSetCheckpoint:
+        return checkpoint_from_step(self._keys, self._vals, self._oldest,
+                                    self._last_commit)
+
+    def _restore_state(self, ckpt: ConflictSetCheckpoint) -> None:
+        self._keys, self._vals = step_from_checkpoint(ckpt)
+        self._oldest = int(ckpt.oldest_version)
+        self._last_commit = int(ckpt.last_commit)
+        self._resolved_batches = 0
 
     # -- queries ------------------------------------------------------------
     def _range_max(self, begin: bytes, end: bytes) -> int:
@@ -387,6 +569,8 @@ class PyConflictSet(ConflictSetBase):
         # (4) window GC
         if new_oldest_version > self._oldest:
             self._oldest = new_oldest_version
+        if commit_version > self._last_commit:
+            self._last_commit = commit_version
         self._resolved_batches += 1
         from ..flow import SERVER_KNOBS
         if self._resolved_batches % int(

@@ -16,7 +16,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .conflict_set import ConflictSetBase, ResolverTransaction
+from .conflict_set import (ConflictSetBase, ConflictSetCheckpoint,
+                           ResolverTransaction, checkpoint_from_step)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libfdbtpu_native.so")
@@ -72,6 +73,21 @@ def load_native_library(build_if_missing: bool = True) -> ctypes.CDLL:
                 ctypes.POINTER(ctypes.c_uint8)]   # read_hits_out
     except AttributeError:
         pass
+    try:
+        # state-export entry points (checkpoint/restore); absent only
+        # from a stale .so — checkpoint() raises NotImplementedError then
+        lib.fdbtpu_conflictset_export_rows.restype = ctypes.c_int64
+        lib.fdbtpu_conflictset_export_rows.argtypes = [ctypes.c_void_p]
+        lib.fdbtpu_conflictset_export_key_bytes.restype = ctypes.c_int64
+        lib.fdbtpu_conflictset_export_key_bytes.argtypes = [ctypes.c_void_p]
+        lib.fdbtpu_conflictset_export.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),   # key_blob_out
+            ctypes.POINTER(ctypes.c_int64),   # key_lens_out
+            ctypes.POINTER(ctypes.c_int64),   # versions_out
+        ]
+    except AttributeError:
+        pass
     _lib = lib
     return lib
 
@@ -125,6 +141,7 @@ class NativeConflictSet(ConflictSetBase):
     def __init__(self, init_version: int = 0):
         self._lib = load_native_library()
         self._handle = self._lib.fdbtpu_conflictset_new(init_version)
+        self._last_commit = init_version   # ordering floor for checkpoints
 
     def __del__(self):
         try:
@@ -142,13 +159,50 @@ class NativeConflictSet(ConflictSetBase):
     def interval_count(self) -> int:
         return self._lib.fdbtpu_conflictset_interval_count(self._handle)
 
+    # -- checkpoint / restore ------------------------------------------
+    def _checkpoint_state(self) -> ConflictSetCheckpoint:
+        if not hasattr(self._lib, "fdbtpu_conflictset_export"):
+            raise NotImplementedError(
+                "stale native library lacks the export ABI: rebuild "
+                "native/libfdbtpu_native.so")
+        rows = self._lib.fdbtpu_conflictset_export_rows(self._handle)
+        nbytes = self._lib.fdbtpu_conflictset_export_key_bytes(self._handle)
+        blob = np.empty(max(int(nbytes), 1), np.uint8)
+        lens = np.empty(max(int(rows), 1), np.int64)
+        vers = np.empty(max(int(rows), 1), np.int64)
+        p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))  # noqa: E731
+        self._lib.fdbtpu_conflictset_export(
+            self._handle, p(blob, ctypes.c_uint8), p(lens, ctypes.c_int64),
+            p(vers, ctypes.c_int64))
+        raw = blob.tobytes()
+        keys: list = []
+        off = 0
+        for i in range(int(rows)):
+            kl = int(lens[i])
+            keys.append(raw[off:off + kl])
+            off += kl
+        vals = [int(v) for v in vers[:int(rows)]]
+        return checkpoint_from_step(keys, vals, self.oldest_version,
+                                    self._last_commit)
+
+    def _reset_state(self, baseline_version: int) -> None:
+        # the generic replay-based restore (ConflictSetBase) rebuilds
+        # the step function through resolve(); only the reset is native
+        self._lib.fdbtpu_conflictset_destroy(self._handle)
+        self._handle = self._lib.fdbtpu_conflictset_new(baseline_version)
+        self._last_commit = baseline_version
+
     def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
                 new_oldest_version: int) -> list[int]:
         n = len(txns)
-        if n == 0:
-            return []
+        if commit_version > self._last_commit:
+            self._last_commit = commit_version
+        # empty batches still run: the GC window must advance exactly
+        # like the python/TPU backends' empty-batch paths (the silent
+        # early return here made an empty batch a no-op, so the next
+        # batch's tooOld verdicts could diverge cross-backend)
         snapshots, rc, wc, blob, rr, wr = _marshal(txns)
-        out = np.empty(n, dtype=np.uint8)
+        out = np.empty(max(n, 1), dtype=np.uint8)
         p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))  # noqa: E731
         self._lib.fdbtpu_conflictset_resolve(
             self._handle, commit_version, new_oldest_version, n,
@@ -156,7 +210,7 @@ class NativeConflictSet(ConflictSetBase):
             p(wc, ctypes.c_int32), p(blob, ctypes.c_uint8),
             p(rr, ctypes.c_int64), p(wr, ctypes.c_int64),
             p(out, ctypes.c_uint8))
-        return out.tolist()
+        return out[:n].tolist()
 
     def resolve_with_attribution(self, txns: Sequence[ResolverTransaction],
                                  commit_version: int,
@@ -169,7 +223,12 @@ class NativeConflictSet(ConflictSetBase):
                 self, txns, commit_version, new_oldest_version)
         n = len(txns)
         if n == 0:
-            return [], []
+            # run the empty batch through resolve: the GC window
+            # advances identically to every other backend
+            return self.resolve(txns, commit_version,
+                                new_oldest_version), []
+        if commit_version > self._last_commit:
+            self._last_commit = commit_version
         snapshots, rc, wc, blob, rr, wr = _marshal(txns)
         out = np.empty(n, dtype=np.uint8)
         n_reads = int(rc.sum())
@@ -191,6 +250,16 @@ class NativeConflictSet(ConflictSetBase):
         return out.tolist(), attr
 
 
+# Every recruitable conflict-set backend, next to the factory that is
+# its authority. Config validation EVERYWHERE (client configure,
+# cluster-controller management mutations, the conf-sync repair loop)
+# keys off THIS tuple, so a new backend cannot be half-supported — the
+# conf-sync loop once "repaired" a perfectly valid sharded-tpu row
+# every round forever because a second hand-synced list missed it.
+CONFLICT_BACKENDS = ("python", "native", "tpu", "tpu-point",
+                     "sharded-tpu")
+
+
 def create_conflict_set(backend: str = "python", init_version: int = 0) -> ConflictSetBase:
     """Backend factory — the plugin selection point (ref: LoadPlugin)."""
     if backend == "python":
@@ -210,4 +279,13 @@ def create_conflict_set(backend: str = "python", init_version: int = 0) -> Confl
         except ImportError as e:
             raise ValueError(f"tpu conflict-set backend unavailable: {e}") from e
         return PointConflictSet(init_version)
+    if backend == "sharded-tpu":
+        # key-range sharded over every visible device (the multi-chip
+        # resolver deployment; a 1-device mesh degenerates cleanly)
+        try:
+            from ..parallel import ShardedTpuConflictSet
+        except ImportError as e:
+            raise ValueError(f"sharded conflict-set backend unavailable: "
+                             f"{e}") from e
+        return ShardedTpuConflictSet(init_version)
     raise ValueError(f"unknown conflict-set backend: {backend}")

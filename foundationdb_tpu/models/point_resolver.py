@@ -20,7 +20,7 @@ from typing import Sequence
 
 import numpy as np
 
-from .conflict_set import ResolverTransaction
+from .conflict_set import ConflictSetCheckpoint, ResolverTransaction
 from .tpu_resolver import (_KERNEL_MIN_RANGES, _KERNEL_MIN_TXNS, _MIN_CAP,
                            TpuConflictSet)
 
@@ -45,6 +45,54 @@ class PointConflictSet(TpuConflictSet):
         hk = np.full((self._cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
         hv = np.full((self._cap,), -(1 << 30), np.int32)
         return hk, hv
+
+    # -- checkpoint / restore ------------------------------------------
+    def _checkpoint_state(self) -> ConflictSetCheckpoint:
+        """Point state is a latest-version-per-key map, not a step
+        function: the checkpoint carries one [k, k+'\\x00') assignment
+        per live key over the init-version baseline — a representation
+        the interval backends restore verbatim (cross-backend parity),
+        and exactly what restores back into the point map."""
+        from ..ops.fault_injection import convert_device_errors
+        with convert_device_errors("drain", f"{self.BACKEND}.checkpoint"):
+            hk, hv = np.asarray(self._hk), np.asarray(self._hv)
+        keys, vals = self._decode_step(hk, hv)
+        baseline = int(self._init_version)
+        dead_v = min(baseline, self._oldest - 1)
+        # the device map may hold several rows per key (an update adds a
+        # new row; queries read the highest version in the key run, GC
+        # retires the rest): the checkpoint is the per-key MAX
+        latest: dict = {}
+        for k, v in zip(keys, vals):
+            if v > latest.get(k, v - 1):
+                latest[k] = v
+        assignments = []
+        for k in sorted(latest):
+            v = latest[k]
+            if v < self._oldest:
+                v = dead_v
+            if v != baseline:
+                assignments.append((k, k + b"\x00", v))
+        return ConflictSetCheckpoint(self._oldest, self._last_commit,
+                                     baseline, tuple(assignments))
+
+    def _restore_state(self, ckpt: ConflictSetCheckpoint) -> None:
+        """Direct point-map rebuild; every assignment must be a point
+        within the key bucket (restoring an interval checkpoint into
+        the point backend is an explicit opt-in that only works when
+        the captured history is point-shaped)."""
+        import jax.numpy as jnp
+
+        from ..ops.keys import next_pow2
+        pts = sorted(ckpt.assignments)
+        for b, e, _v in pts:
+            self._check_point(b, e)
+        self._restore_bookkeeping(ckpt)
+        self._cap = max(_MIN_CAP, self._cap, next_pow2(len(pts) + 2))
+        hk, hv = self._encode_step([b for b, _e, _v in pts],
+                                   [v for _b, _e, v in pts], self._cap)
+        self._hk, self._hv = jnp.asarray(hk), jnp.asarray(hv)
+        self._count_hint = len(pts)
 
     def _marshal_ranges(self, txns: Sequence[ResolverTransaction], too_old):
         """Point marshalling: end keys are never encoded (they are
@@ -78,6 +126,9 @@ class PointConflictSet(TpuConflictSet):
         nr = len(read_t)
         return ((keys[:nr], None, np.asarray(read_t, np.int32),
                  keys[nr:], None, np.asarray(write_t, np.int32)), read_map)
+
+    def _validate_range(self, b: bytes, e: bytes) -> None:
+        self._check_point(b, e)
 
     def _check_point(self, b: bytes, e: bytes) -> None:
         if e != b + b"\x00":

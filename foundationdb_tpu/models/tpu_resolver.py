@@ -30,7 +30,9 @@ import numpy as np
 
 from ..flow.stats import CounterCollection
 from .conflict_set import (COMMITTED, CONFLICT, TOO_OLD, ConflictSetBase,
-                           ResolveTicket, ResolverTransaction)
+                           ConflictSetCheckpoint, ResolveTicket,
+                           ResolverTransaction, checkpoint_from_step,
+                           step_from_checkpoint)
 
 # Minimum shape buckets: small batches all land in one compiled kernel
 # instead of one per size (first compile is the expensive part on TPU).
@@ -52,6 +54,7 @@ class TpuConflictSet(ConflictSetBase):
         self._cap = max(_MIN_CAP, int(capacity))
         if init_version >= (1 << 30):
             raise ValueError("init_version too large for the version window")
+        self._init_version = init_version
         self._base = 0
         self._oldest = 0
         self._last_commit = init_version
@@ -206,6 +209,74 @@ class TpuConflictSet(ConflictSetBase):
                 jnp.int32(commit_version - new_base), jnp.int32(delta))
         self._base = new_base
 
+    # -- checkpoint / restore -------------------------------------------
+    def _decode_step(self, hk: np.ndarray, hv: np.ndarray):
+        """One shard's device state back into a (keys, vals) step
+        function with ABSOLUTE versions: D2H'd key rows decode exactly
+        (encode_keys keeps the byte length), offsets re-base, and +inf
+        pad rows (length word 0xFFFFFFFF) drop out."""
+        from ..ops.keys import decode_keys
+        real = np.flatnonzero(hk[:, -1] != 0xFFFFFFFF)
+        keys = decode_keys(hk[real])
+        vals = [int(v) + self._base for v in hv[real]]
+        return keys, vals
+
+    def _checkpoint_state(self) -> ConflictSetCheckpoint:
+        from ..ops.fault_injection import convert_device_errors
+        with convert_device_errors("drain", f"{self.BACKEND}.checkpoint"):
+            hk, hv = np.asarray(self._hk), np.asarray(self._hv)
+        keys, vals = self._decode_step(hk, hv)
+        return checkpoint_from_step(keys, vals, self._oldest,
+                                    self._last_commit)
+
+    def _restore_bookkeeping(self, ckpt: ConflictSetCheckpoint) -> None:
+        """Watermarks + version window + async-count caches after a
+        restore (shared by the interval and point restore paths)."""
+        self._oldest = int(ckpt.oldest_version)
+        self._last_commit = int(ckpt.last_commit)
+        self._init_version = int(ckpt.baseline_version)
+        # re-base so every live offset fits the int32 device window
+        # (same invariant _prepare_versions maintains batch to batch)
+        self._base = max(0, int(ckpt.oldest_version))
+        self._count_dev = None
+        self._count_async.clear()
+        self._rows_since_async = 0
+
+    def _restore_state(self, ckpt: ConflictSetCheckpoint) -> None:
+        keys, vals = step_from_checkpoint(ckpt)
+        self._restore_bookkeeping(ckpt)
+        self._install_step(keys, vals)
+
+    def _encode_step(self, keys, vals, cap: int):
+        """Host (hk, hv) arrays for one shard's step function: encoded
+        keys +inf-padded to cap, versions as clamped offsets from the
+        restored base."""
+        from ..ops.conflict_kernel import REBASE_THRESHOLD
+        from ..ops.keys import encode_keys
+        from ..ops.rmq import VDEAD
+        hk = np.full((cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
+        hv = np.full((cap,), VDEAD, np.int32)
+        if keys:
+            hk[:len(keys)] = encode_keys(list(keys), self._key_bytes)
+        for i, v in enumerate(vals):
+            off = int(v) - self._base
+            if off >= REBASE_THRESHOLD:
+                raise OverflowError(
+                    "checkpoint version window exceeds 2^30 (see "
+                    "MAX_WRITE_TRANSACTION_LIFE_VERSIONS)")
+            hv[i] = max(off, VDEAD)
+        return hk, hv
+
+    def _install_step(self, keys, vals) -> None:
+        """Install a restored global step function as device state
+        (the sharded backend overrides this with a per-shard clip)."""
+        from ..ops.keys import next_pow2
+        import jax.numpy as jnp
+        self._cap = max(_MIN_CAP, self._cap, next_pow2(len(keys) + 2))
+        hk, hv = self._encode_step(keys, vals, self._cap)
+        self._hk, self._hv = jnp.asarray(hk), jnp.asarray(hv)
+        self._count_hint = max(1, len(keys))
+
     # -- resolve --------------------------------------------------------
     def resolve(self, txns: Sequence[ResolverTransaction], commit_version: int,
                 new_oldest_version: int) -> list[int]:
@@ -247,6 +318,13 @@ class TpuConflictSet(ConflictSetBase):
             self._start_host_copy(read_hit)
 
             def materialize():
+                from ..ops.fault_injection import (convert_device_errors,
+                                                   g_device_faults)
+                g_device_faults.check("materialize", self.BACKEND)
+                with convert_device_errors("materialize", self.BACKEND):
+                    return _materialize_inner()
+
+            def _materialize_inner():
                 verdicts = self.finalize_verdicts(conflict, too_old)
                 if not attribute:
                     return verdicts, None
@@ -277,7 +355,11 @@ class TpuConflictSet(ConflictSetBase):
         n = snapshots.shape[0]
 
         def materialize():
-            return np.asarray(conflict)[:n], too_old
+            from ..ops.fault_injection import (convert_device_errors,
+                                               g_device_faults)
+            g_device_faults.check("materialize", self.BACKEND)
+            with convert_device_errors("materialize", self.BACKEND):
+                return np.asarray(conflict)[:n], too_old
 
         ticket = ResolveTicket(commit_version, n, materialize=materialize)
         self.pipeline.note_submit(ticket, t0)
@@ -287,6 +369,19 @@ class TpuConflictSet(ConflictSetBase):
         """(conflict flags ndarray, too_old ndarray) for a ticket from
         `submit_arrays` (idempotent, any order)."""
         return self.pipeline.drain(ticket)
+
+    # -- device-fault seams (ops/fault_injection.py) --------------------
+    def drain(self, ticket: ResolveTicket) -> list:
+        if not ticket.done:
+            from ..ops.fault_injection import g_device_faults
+            g_device_faults.check("drain", self.BACKEND)
+        return super().drain(ticket)
+
+    def drain_with_attribution(self, ticket: ResolveTicket):
+        if not ticket.done:
+            from ..ops.fault_injection import g_device_faults
+            g_device_faults.check("drain", self.BACKEND)
+        return super().drain_with_attribution(ticket)
 
     def _resolve_flags(self, txns, commit_version, new_oldest_version,
                        attribute: bool = False):
@@ -329,6 +424,35 @@ class TpuConflictSet(ConflictSetBase):
         self._last_commit = commit_version  # only after a successful batch
         self._oldest = max(self._oldest, new_oldest_version)
         return conflict, too_old, n, read_hit, read_map
+
+    def validate_txns(self, txns, oldest_version=None):
+        """Raises exactly when `_resolve_flags` would: a tooOld
+        transaction contributes no ranges, empty ranges are skipped,
+        and both ends of every surviving range must fit the key bucket
+        (the exact conditions `_marshal_ranges` feeds `encode_keys`)."""
+        oldest = self._oldest if oldest_version is None else oldest_version
+        for tr in txns:
+            if tr.read_snapshot < oldest and len(tr.read_ranges):
+                continue
+            for b, e in (*tr.read_ranges, *tr.write_ranges):
+                if b < e:
+                    self._validate_range(b, e)
+
+    def _validate_range(self, b: bytes, e: bytes) -> None:
+        for k in (b, e):
+            if len(k) > self._key_bytes:
+                raise ValueError(
+                    f"key length {len(k)} exceeds backend key width "
+                    f"{self._key_bytes}")
+
+    def input_contract(self):
+        # the bound validate_txns would pin this instance's history
+        # arrays for as long as the holder lives (the failover wrapper
+        # outlives every faulted device backend): hand out a view
+        # carrying ONLY the key-bucket width
+        view = object.__new__(type(self))
+        view._key_bytes = self._key_bytes
+        return view.validate_txns
 
     def _marshal_ranges(self, txns, too_old):
         """Flatten and encode the batch's conflict ranges in txn order.
@@ -377,9 +501,27 @@ class TpuConflictSet(ConflictSetBase):
         benchmarks/pipelines measure device throughput, and defers the
         verdict readback (returns the device conflict flags + host too_old).
         Ranges of tooOld txns may be included — their writes are excluded by
-        the kernel and their reads only affect their own (overridden) flag."""
+        the kernel and their reads only affect their own (overridden) flag.
+
+        CONTRACT: `rt` and `wt` must be NON-DECREASING (ranges flattened
+        in transaction order — the layout every marshaller produces).
+        The kernel's per-txn reductions are segment sums over that slot
+        order; out-of-order ids would yield silently wrong verdicts, so
+        the cheap host-side monotonicity check below rejects them
+        (ADVICE r5: the scatter-max formulation tolerated any order,
+        the segment-sum rewrite does not)."""
         if commit_version < self._last_commit:
             raise ValueError("commit versions must be non-decreasing")
+        for name, ids in (("rt", rt), ("wt", wt)):
+            # signed view: np.diff on a uint array wraps modulo, which
+            # would wave decreasing ids straight through this check
+            ids = np.asarray(ids, dtype=np.int64)
+            if ids.size > 1 and not np.all(np.diff(ids) >= 0):
+                raise ValueError(
+                    f"per-range txn ids ({name}) must be non-decreasing: "
+                    "flatten conflict ranges in transaction order (the "
+                    "kernel reduces per-txn flags as segment sums over "
+                    "the slot order)")
         too_old = (snapshots < self._oldest) & has_reads.astype(bool)
         live = has_reads.astype(bool) & ~too_old
         floor = min(int(snapshots[live].min()) if live.any() else commit_version,

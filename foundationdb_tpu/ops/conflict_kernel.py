@@ -445,8 +445,26 @@ def make_resolve_fn(cap: int, n_txns: int, n_reads: int, n_writes: int,
     fn = (jax.jit(core, donate_argnums=(0, 1)) if donate
           else jax.jit(core))
     tag = ("" if attribute else "/noattr") + ("/don" if donate else "")
-    return profile_kernel(
+    fn = profile_kernel(
         fn, f"resolve[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]")
+    return _fault_seamed(fn, f"resolve[{cap}c]")
+
+
+def _fault_seamed(fn, where: str):
+    """Device-fault seam at kernel dispatch (the `submit` point): an
+    injected fault models the device rejecting the dispatch, and a REAL
+    JAX runtime error (device lost, kernel failure) is converted to the
+    same DeviceFaultError — either way the chained history carry is in
+    an unknown state and the failover controller must rebuild
+    (models/failover.py)."""
+    from .fault_injection import convert_device_errors, g_device_faults
+
+    def call(*args):
+        g_device_faults.check("submit", where)
+        with convert_device_errors("submit", where):
+            return fn(*args)
+
+    return call
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,143 @@
+"""Simulated accelerator faults for the conflict-resolution backends.
+
+Reference: the simulator's machine/disk fault machinery (sim2's
+process kills, BUGGIFY'd IO errors) applied to the one component it
+could not previously touch — the device backend behind the resolver.
+A real TPU can fail mid-pipeline (device lost, preempted, kernel
+error) with K batches in flight and unrecoverable on-device state;
+`DeviceFaultInjector` raises the simulated analogue at the three
+host/device boundaries (`submit` = kernel dispatch, `materialize` =
+verdict D2H readback, `drain` = the blocking wait) so the failover
+controller (models/failover.py) is exercised deterministically in sim.
+
+Injection is driven by the `DEVICE_FAULT_INJECTION` knob (a per-seam
+probability drawn from the seeded sim RNG, so a given seed reproduces
+the same fault schedule) amplified by a BUGGIFY site when already
+armed; tests can also `schedule()` one-shot faults at exact points.
+The knob defaults to 0.0 and is deliberately NOT buggify-distorted:
+the seams sit inside backend code that unit tests drive unwrapped,
+and a leaked nonzero probability would fault them with no controller
+to recover.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class DeviceFaultError(RuntimeError):
+    """Simulated OR real device-lost / kernel failure. After one of
+    these the on-device state (donated history buffers, queued batches)
+    must be treated as unrecoverable — exactly how a real
+    XlaRuntimeError on a dead device leaves the host wrapper. Real JAX
+    runtime errors are re-raised as this type at the seams
+    (`convert_device_errors`), so the failover controller handles
+    hardware faults and injected ones through one path."""
+
+
+_runtime_errors: "tuple | None" = None
+
+
+def runtime_error_types() -> tuple:
+    """The JAX/XLA exception types that mean 'the device call failed'
+    (device lost, preempted, kernel error, OOM). Resolved lazily and
+    defensively: the names move across jax releases."""
+    global _runtime_errors
+    if _runtime_errors is None:
+        types = []
+        try:
+            from jax.errors import JaxRuntimeError
+            types.append(JaxRuntimeError)
+        except Exception:  # noqa: BLE001 — older jax
+            pass
+        try:
+            from jaxlib.xla_extension import XlaRuntimeError
+            if XlaRuntimeError not in types:
+                types.append(XlaRuntimeError)
+        except Exception:  # noqa: BLE001
+            pass
+        _runtime_errors = tuple(types)
+    return _runtime_errors
+
+
+def convert_device_errors(point: str, where: str = ""):
+    """Context manager for the device seams: re-raises real JAX runtime
+    errors as DeviceFaultError so the failover controller recovers from
+    hardware faults exactly like injected ones (a deterministic kernel
+    bug then degrades to the CPU fallback after the retry budget — the
+    resolver degrades, never dies)."""
+    return _DeviceErrorSeam(point, where)
+
+
+class _DeviceErrorSeam:
+    __slots__ = ("point", "where")
+
+    def __init__(self, point: str, where: str):
+        self.point = point
+        self.where = where
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and not isinstance(exc, DeviceFaultError) \
+                and isinstance(exc, runtime_error_types()):
+            raise DeviceFaultError(
+                f"device error at {self.point} ({self.where}): "
+                f"{exc!r}") from exc
+        return False
+
+
+POINTS = ("submit", "materialize", "drain")
+
+
+class DeviceFaultInjector:
+    """Knob-, BUGGIFY- and schedule-driven fault seam.
+
+    `check(point, where)` is called by the device backends at every
+    submit/materialize/drain boundary; it raises DeviceFaultError with
+    seeded probability DEVICE_FAULT_INJECTION (x10 when the
+    `conflict/device_fault_storm` BUGGIFY site fires — storms only
+    amplify an injection campaign that is already armed, so the site
+    can never destabilize runs with the knob at 0)."""
+
+    def __init__(self):
+        self._scheduled: deque = deque()   # points to fault, one-shot
+        self.injected: dict = {p: 0 for p in POINTS}
+        self.checks = 0
+
+    def schedule(self, point: str) -> None:
+        """Force the NEXT check at `point` to fault (tests: exact fault
+        placement without probability)."""
+        if point not in POINTS:
+            raise ValueError(f"unknown fault point {point!r}")
+        self._scheduled.append(point)
+
+    def clear(self) -> None:
+        self._scheduled.clear()
+
+    def check(self, point: str, where: str = "") -> None:
+        self.checks += 1
+        if self._scheduled and self._scheduled[0] == point:
+            self._scheduled.popleft()
+            self.injected[point] += 1
+            raise DeviceFaultError(
+                f"scheduled device fault at {point} ({where})")
+        from ..flow.knobs import SERVER_KNOBS
+        p = float(getattr(SERVER_KNOBS, "device_fault_injection", 0.0))
+        if p <= 0.0:
+            return
+        from ..flow.rng import buggify, g_random
+        if buggify("conflict/device_fault_storm"):
+            p = min(1.0, p * 10.0)
+        if g_random.random01() < p:
+            self.injected[point] += 1
+            raise DeviceFaultError(
+                f"injected device fault at {point} ({where}), "
+                f"p={p}")
+
+    def stats(self) -> dict:
+        return {"checks": self.checks, "injected": dict(self.injected)}
+
+
+g_device_faults = DeviceFaultInjector()

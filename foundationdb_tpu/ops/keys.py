@@ -56,6 +56,28 @@ def encode_keys(keys: Sequence[bytes], key_bytes: int) -> np.ndarray:
     return out[:n]
 
 
+def decode_keys(rows: np.ndarray) -> list:
+    """Inverse of encode_keys for real rows: [n, W+1] uint32 -> byte
+    strings (the trailing length word truncates the zero padding, so
+    the round trip is exact for any key within the bucket width). Rows
+    must not be +inf sentinels (length word 0xFFFFFFFF)."""
+    rows = np.asarray(rows, np.uint32)
+    n, width = rows.shape
+    n_words = width - 1
+    buf = np.empty((n, n_words, 4), np.uint8)
+    words = rows[:, :n_words]
+    for i, shift in enumerate((24, 16, 8, 0)):
+        buf[:, :, i] = (words >> np.uint32(shift)).astype(np.uint8)
+    flat = buf.reshape(n, n_words * 4)
+    out = []
+    for i in range(n):
+        kl = int(rows[i, n_words])
+        if kl > n_words * 4:
+            raise ValueError(f"row {i} is not a real key (length {kl})")
+        out.append(flat[i, :kl].tobytes())
+    return out
+
+
 def lt_rows(a: jax.Array, b: jax.Array) -> jax.Array:
     """Lexicographic a < b over the trailing word axis ([..., W+1]).
 

@@ -244,9 +244,11 @@ def make_point_resolve_fn(cap: int, n_txns: int, n_reads: int,
     fn = (jax.jit(core, donate_argnums=(0, 1)) if donate
           else jax.jit(core))
     tag = ("" if attribute else "/noattr") + ("/don" if donate else "")
-    return profile_kernel(
+    fn = profile_kernel(
         fn, f"point[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]",
         g_kernel_counters)
+    from .conflict_kernel import _fault_seamed
+    return _fault_seamed(fn, f"point[{cap}c]")
 
 
 def pack_point_batch(snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid):
@@ -312,7 +314,9 @@ def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
     fn = (jax.jit(packed, donate_argnums=(0, 1)) if donate
           else jax.jit(packed))
     tag = ("" if attribute else "/noattr") + ("/don" if donate else "")
-    return profile_kernel(
+    fn = profile_kernel(
         fn,
         f"point_packed[{cap}c/{n_txns}t/{n_reads}r/{n_writes}w{tag}]",
         g_kernel_counters)
+    from .conflict_kernel import _fault_seamed
+    return _fault_seamed(fn, f"point_packed[{cap}c]")

@@ -154,6 +154,59 @@ class ShardedTpuConflictSet(TpuConflictSet):
             (shk, shv), NamedSharding(self._mesh, P(self.AXIS)))
         self._shard_fns.clear()
 
+    # -- checkpoint / restore -------------------------------------------
+    def _checkpoint_state(self):
+        """Stitch the per-shard states back into ONE global step
+        function: each shard's rows are clipped to its key range (slot 0
+        is the shard's lower bound), so concatenating them in shard
+        order is the global history. A boundary a shard recorded AT its
+        upper bound covers keys it never answers for — the next shard's
+        first row is authoritative there and replaces it."""
+        from ..models.conflict_set import checkpoint_from_step
+        from ..ops.fault_injection import convert_device_errors
+        with convert_device_errors("drain", f"{self.BACKEND}.checkpoint"):
+            shk = np.asarray(self._hk)
+            shv = np.asarray(self._hv)
+        keys: list = []
+        vals: list = []
+        for i in range(self._n_shards):
+            k_i, v_i = self._decode_step(shk[i], shv[i])
+            lo = self._split_keys[i]
+            while keys and keys[-1] >= lo:
+                keys.pop()
+                vals.pop()
+            keys.extend(k_i)
+            vals.extend(v_i)
+        return checkpoint_from_step(keys, vals, self._oldest,
+                                    self._last_commit)
+
+    def _install_step(self, keys, vals) -> None:
+        """Re-shard a restored global step function: each shard gets
+        the clip to its own [lo, hi) with an explicit boundary at lo
+        (the same invariant _to_device establishes at init)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..models.conflict_set import clip_step
+        from ..ops.keys import next_pow2
+        s = self._n_shards
+        clips = []
+        for i in range(s):
+            lo = self._split_keys[i]
+            hi = self._split_keys[i + 1] if i + 1 < s else None
+            clips.append(clip_step(keys, vals, lo, hi))
+        rows = max(len(k) for k, _v in clips)
+        self._cap = max(_MIN_CAP, self._cap, next_pow2(rows + 2))
+        shk = np.empty((s, self._cap, self._n_words + 1), np.uint32)
+        shv = np.empty((s, self._cap), np.int32)
+        for i, (k_i, v_i) in enumerate(clips):
+            shk[i], shv[i] = self._encode_step(k_i, v_i, self._cap)
+        self._hk, self._hv = jax.device_put(
+            (shk, shv), NamedSharding(self._mesh, P(self.AXIS)))
+        # _shard_fns stays: entries are keyed by capacity, so a same-cap
+        # restore reuses the compiled kernels and a grown cap compiles new
+        self._count_hint = rows
+
     # -- sharded kernel dispatch ---------------------------------------
     def _get_shard_fn(self, npad, nrp, nwp, attribute: bool):
         key = (self._cap, npad, nrp, nwp, attribute)
@@ -200,6 +253,8 @@ class ShardedTpuConflictSet(TpuConflictSet):
         tag = "" if attribute else "/noattr"
         fn = profile_kernel(
             fn, f"sharded[{self._cap}c/{npad}t/{nrp}r/{nwp}w{tag}]")
+        from ..ops.conflict_kernel import _fault_seamed
+        fn = _fault_seamed(fn, f"sharded[{self._cap}c]")
         self._shard_fns[key] = fn
         return fn
 

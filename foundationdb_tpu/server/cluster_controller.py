@@ -632,8 +632,8 @@ class ClusterController:
         than bricking recovery in a retry loop — mirroring the
         reference, where an unrecruitable \\xff/conf shape needs
         operator repair."""
-        from .systemkeys import CONF_MUTABLE, CONF_PREFIX, CONF_ROWS, \
-            EXCLUDED_PREFIX
+        from .systemkeys import CONFLICT_BACKENDS, CONF_MUTABLE, \
+            CONF_PREFIX, CONF_ROWS, EXCLUDED_PREFIX
         updates: dict = {}
         # worker -> desired excluded state, LAST mutation wins — a
         # single transaction may set then clear the same row and the
@@ -703,8 +703,7 @@ class ClusterController:
                     or cand.n_logs < 1 or cand.n_logs > live
                     or cand.n_resolvers > live or cand.n_proxies > live
                     or cand.usable_regions not in (1, 2)
-                    or cand.conflict_backend not in (
-                        "python", "native", "tpu", "tpu-point")):
+                    or cand.conflict_backend not in CONFLICT_BACKENDS):
                 flow.cover("cc.metadata.config_unrecruitable")
                 flow.TraceEvent(
                     "MetadataConfigIgnored", self.process.name,
@@ -747,7 +746,8 @@ class ClusterController:
     async def _conf_sync_once(self, db) -> None:
         from ..client import run_transaction
         from .systemkeys import (CONF_END, CONF_MUTABLE, CONF_PREFIX,
-                                 CONF_ROWS, EXCLUDED_END, EXCLUDED_PREFIX)
+                                 CONF_ROWS, CONFLICT_BACKENDS,
+                                 EXCLUDED_END, EXCLUDED_PREFIX)
 
         async def read(tr):
             tr.set_option("read_system_keys")
@@ -785,8 +785,7 @@ class ClusterController:
                 or cand.n_logs < 1 or cand.n_logs > n_live
                 or cand.n_resolvers > n_live or cand.n_proxies > n_live
                 or cand.usable_regions not in (1, 2)
-                or cand.conflict_backend not in (
-                    "python", "native", "tpu", "tpu-point")):
+                or cand.conflict_backend not in CONFLICT_BACKENDS):
             flow.cover("cc.metadata.sync_repair_config")
             flow.TraceEvent("ConfRowsRepaired", self.process.name,
                             severity=flow.trace.SevWarnAlways).detail(
@@ -1014,6 +1013,37 @@ class ClusterController:
                                 f"history rows (limit {limit})",
                             "resolver": rn, "state_rows": size,
                             "limit": limit})
+                    fo = role.failover_stats()
+                    if fo and not fo.get("on_primary", True):
+                        msgs.append({
+                            "name": "conflict_backend_degraded",
+                            "severity": flow.trace.SevWarnAlways,
+                            "description":
+                                f"Resolver {rn} failed over to the "
+                                f"{fo.get('active_backend')} backend "
+                                f"({fo.get('failovers')} failovers, "
+                                f"{fo.get('device_faults')} device "
+                                "faults); reattach pending",
+                            "resolver": rn,
+                            "failovers": fo.get("failovers", 0),
+                            "device_faults": fo.get("device_faults", 0)})
+                    mismatches = (fo.get("shadow", {}) or {}).get(
+                        "mismatches", 0) if fo else 0
+                    if mismatches:
+                        # the corruption-grade message: shadow verdicts
+                        # diverged — serializability is suspect (ref:
+                        # how check_consistency reports replica
+                        # divergence)
+                        msgs.append({
+                            "name": "shadow_resolve_mismatch",
+                            "severity": flow.trace.SevError,
+                            "description":
+                                f"Resolver {rn}: {mismatches} sampled "
+                                "batches re-resolved on the CPU shadow "
+                                "disagreed with the "
+                                f"{fo.get('active_backend')} backend",
+                            "resolver": rn,
+                            "mismatches": mismatches})
         # conflict fraction over the sampled tail (the metric sampler is
         # the event source; status just reads the window)
         conflicted = committed = 0.0
@@ -1156,7 +1186,11 @@ class ClusterController:
                         # reuse the snapshot the device kernel stats
                         # already embed rather than recomputing)
                         "pipeline": (kern.get("pipeline")
-                                     or role.pipeline_stats())})
+                                     or role.pipeline_stats()),
+                        # backend fault tolerance: checkpoint cadence,
+                        # device faults/failovers/replay, shadow
+                        # validation ({} for bare host backends)
+                        "failover": role.failover_stats()})
                 elif isinstance(role, Ratekeeper) and \
                         rn.endswith(f"-e{info.epoch}"):
                     rate = role.rate

@@ -397,11 +397,31 @@ class Proxy:
                             + SERVER_KNOBS.grv_peer_suspect_duration)
                 if degraded:
                     self.stats.counter("grv_degraded").add(1)
-                    frontiers = await flow.all_of([
-                        flow.timeout_error(
-                            ref.get_reply(None, self.process),
-                            SERVER_KNOBS.grv_confirm_timeout)
-                        for ref in self.tlog_refs])
+                    # individual probe failures are tolerated like
+                    # suspect peers (ADVICE r5: one timed-out frontier
+                    # — or an empty tlog_refs mid-recovery — used to
+                    # fail the whole GRV batch the fallback exists to
+                    # save). min() over the ANSWERED frontiers is still
+                    # safe: a commit is acked only once ALL logs hold
+                    # it durably, so every log's frontier bounds every
+                    # acknowledged commit from below. At least one
+                    # answer is required — with none, causality cannot
+                    # be proven and clients must retry.
+                    futs = [flow.timeout_error(
+                        ref.get_reply(None, self.process),
+                        SERVER_KNOBS.grv_confirm_timeout)
+                        for ref in self.tlog_refs]
+                    frontiers = []
+                    for f in futs:
+                        try:
+                            frontiers.append(await f)
+                        except flow.FdbError as fe:
+                            if fe.name == "operation_cancelled":
+                                raise
+                            flow.cover("proxy.grv.frontier_probe_failed")
+                    if not frontiers:
+                        flow.cover("proxy.grv.no_frontier")
+                        raise error("broken_promise")
                     version = max(version, min(frontiers))
             self.stats.counter("transactions_started").add(
                 sum(e[1] for e in batch))

@@ -16,7 +16,7 @@ from collections import deque
 
 from .. import flow
 from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority
-from ..models import ResolverTransaction, create_conflict_set
+from ..models import ResolverTransaction, create_resilient_conflict_set
 from ..rpc import RequestStream, SimProcess
 from .types import ResolutionMetricsReply, ResolveReply, ResolveRequest
 
@@ -81,7 +81,12 @@ class Resolver:
     def __init__(self, process: SimProcess, backend: str = "python",
                  recovery_version: int = 0):
         self.process = process
-        self.conflict_set = create_conflict_set(backend, recovery_version)
+        # device backends arrive wrapped in the failover controller
+        # (models/failover.py): checkpoint cadence, replay-log rebuild
+        # on device faults, CPU failover, sampled shadow validation —
+        # the resolver role itself never sees a DeviceFaultError
+        self.conflict_set = create_resilient_conflict_set(
+            backend, recovery_version)
         # the MVCC window width (ref: Knobs.cpp:35; BUGGIFY shrinks it)
         self._mwtlv = SERVER_KNOBS.max_write_transaction_life_versions
         self.version = NotifiedVersion(recovery_version)
@@ -284,6 +289,14 @@ class Resolver:
         has it, so a stalled pipeline is visible in status without a
         bench run."""
         return self.conflict_set.pipeline_stats()
+
+    def failover_stats(self) -> dict:
+        """Backend fault-tolerance accounting (checkpoints, device
+        faults/recoveries, failovers, replay, shadow validation) —
+        populated only when the backend runs under the failover
+        controller; {} for bare host backends."""
+        fn = getattr(self.conflict_set, "failover_stats", None)
+        return fn() if fn is not None else {}
 
     def state_size(self) -> int:
         """Conflict-history row estimate across backends (boundary rows

@@ -102,6 +102,11 @@ CONF_ROWS = {"proxies": "n_proxies", "resolvers": "n_resolvers",
              "storage_engine": "storage_engine"}
 CONF_MUTABLE = ("proxies", "resolvers", "logs", "conflict_backend",
                 "usable_regions")
+# every recruitable conflict-set backend — defined ONCE next to its
+# authority (models.create_conflict_set) and re-exported here for the
+# server-side config validators; the client's configure validation
+# imports the same tuple, so a new backend cannot be half-supported
+from ..models.native_backend import CONFLICT_BACKENDS  # noqa: F401,E402
 CONF_ROW_BY_FIELD = {f: row for row, f in CONF_ROWS.items()
                      if row in CONF_MUTABLE}
 

@@ -145,6 +145,22 @@ def _render_details(cl: dict) -> str:
                 f"occ={occ if occ is not None else '-'} "
                 f"submit_p50={sub.get('p50', 0):g}s "
                 f"drain_p50={dr.get('p50', 0):g}s")
+    fos = [(r["name"], r["failover"]) for r in cl.get("resolvers", ())
+           if r.get("failover")]
+    if fos:
+        lines.append("Backend failover:")
+        for name, fo in fos:
+            sh = fo.get("shadow", {})
+            lines.append(
+                f"  {name:<26} active={fo['active_backend']} "
+                f"{'primary' if fo.get('on_primary') else 'FALLBACK'} "
+                f"ckpts={fo.get('checkpoints', 0)} "
+                f"log={fo.get('replay_log', 0)} "
+                f"faults={fo.get('device_faults', 0)} "
+                f"failovers={fo.get('failovers', 0)} "
+                f"replayed={fo.get('replayed_batches', 0)} "
+                f"reattach={fo.get('reattaches', 0)} "
+                f"shadow={sh.get('sampled', 0)}/{sh.get('mismatches', 0)}mm")
     if cl.get("kernels"):
         lines.append("Kernel compile/execute (process-wide):")
         for kn, v in sorted(cl["kernels"].items()):

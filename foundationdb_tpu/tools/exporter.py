@@ -184,6 +184,40 @@ def render_prometheus(status: dict) -> str:
                 if snap.get("total"):
                     _add_latency(f, "resolver", r["name"], stage, snap,
                                  stem=f"{_PREFIX}_resolve_pipeline_latency")
+        fo = r.get("failover") or {}
+        if fo:
+            flabels = {"role": r["name"]}
+            f.add(f"{_PREFIX}_conflict_failover_on_primary", "gauge",
+                  "1 while the device backend serves, 0 after failover",
+                  flabels, int(bool(fo.get("on_primary"))))
+            f.add(f"{_PREFIX}_conflict_failover_replay_log", "gauge",
+                  "Batches in the bounded replay log since the last "
+                  "checkpoint", flabels, fo.get("replay_log"))
+            f.add(f"{_PREFIX}_conflict_failover_checkpoint_version",
+                  "gauge", "Version of the last backend checkpoint",
+                  flabels, fo.get("checkpoint_version"))
+            for c, help_text in (
+                    ("checkpoints", "Backend state checkpoints taken"),
+                    ("device_faults", "Simulated/real device faults hit"),
+                    ("device_recoveries",
+                     "Rebuilds that stayed on a fresh device backend"),
+                    ("failovers", "Falls to the CPU fallback backend"),
+                    ("replayed_batches",
+                     "Batches deterministically replayed during rebuilds"),
+                    ("reattaches", "Successful moves back to the device"),
+                    ("reattach_failures", "Reattach attempts that faulted")):
+                f.add(f"{_PREFIX}_conflict_failover_{c}", "counter",
+                      help_text, flabels, fo.get(c))
+            sh = fo.get("shadow") or {}
+            f.add(f"{_PREFIX}_shadow_resolve_sample", "gauge",
+                  "Shadow-validation sampling interval (0 = off)",
+                  flabels, sh.get("sample"))
+            f.add(f"{_PREFIX}_shadow_resolve_sampled", "counter",
+                  "Batches re-resolved on the CPU shadow backend",
+                  flabels, sh.get("sampled"))
+            f.add(f"{_PREFIX}_shadow_resolve_mismatches", "counter",
+                  "Sampled batches whose shadow verdicts DIVERGED "
+                  "(corruption-grade)", flabels, sh.get("mismatches"))
     for lg in cl.get("logs", ()):
         _add_counters(f, "tlog", lg.get("store", "?"), lg.get("counters"))
         f.add(f"{_PREFIX}_tlog_queue_length", "gauge",

@@ -227,6 +227,36 @@ int64_t fdbtpu_conflictset_interval_count(void* cs) {
     return static_cast<int64_t>(static_cast<ConflictSet*>(cs)->history.m.size());
 }
 
+// State export for checkpoint/restore: the step function as sorted
+// boundary keys + versions. Two-phase: size the buffers, then fill.
+//   fdbtpu_conflictset_export_rows:      boundary count
+//   fdbtpu_conflictset_export_key_bytes: sum of boundary-key lengths
+//   fdbtpu_conflictset_export:           fill key_blob_out (concatenated
+//       key bytes), key_lens_out (one int64 per boundary), versions_out
+int64_t fdbtpu_conflictset_export_rows(void* cs) {
+    return static_cast<int64_t>(static_cast<ConflictSet*>(cs)->history.m.size());
+}
+
+int64_t fdbtpu_conflictset_export_key_bytes(void* cs) {
+    int64_t total = 0;
+    for (const auto& [k, v] : static_cast<ConflictSet*>(cs)->history.m)
+        total += static_cast<int64_t>(k.size());
+    return total;
+}
+
+void fdbtpu_conflictset_export(void* cs, uint8_t* key_blob_out,
+                               int64_t* key_lens_out,
+                               int64_t* versions_out) {
+    int64_t i = 0;
+    for (const auto& [k, v] : static_cast<ConflictSet*>(cs)->history.m) {
+        std::memcpy(key_blob_out, k.data(), k.size());
+        key_blob_out += k.size();
+        key_lens_out[i] = static_cast<int64_t>(k.size());
+        versions_out[i] = v;
+        i++;
+    }
+}
+
 // Resolve one batch.
 //   key_blob:      all range-endpoint bytes, concatenated
 //   read_ranges:   per read range, 4 int64s (begin_off, begin_len, end_off, end_len)

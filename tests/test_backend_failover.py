@@ -1,0 +1,491 @@
+"""Conflict-backend fault tolerance: checkpoint/restore parity across
+every backend, mid-window failover with bit-identical verdicts (the
+version chain makes deterministic replay exact by construction),
+retry/reattach, and shadow validation catching a sabotaged backend.
+
+Ref: the determinism/replay discipline of the simulator applied to the
+accelerator backend (ROADMAP north star: the TPU path must be
+replayable against the CPU baseline), and the runtime cross-checking
+argued for by "Early Detection for MVCC Conflicts" (arXiv:2301.06181).
+"""
+
+import random
+
+import pytest
+
+from foundationdb_tpu.flow.knobs import SERVER_KNOBS
+from foundationdb_tpu.flow.rng import set_seed
+from foundationdb_tpu.models import (
+    FailoverConflictSet,
+    PyConflictSet,
+    ShadowResolveMismatch,
+    create_conflict_set,
+    native_available,
+)
+from foundationdb_tpu.models.conflict_set import (
+    COMMITTED,
+    TOO_OLD,
+    ConflictSetCheckpoint,
+    ResolverTransaction,
+)
+from foundationdb_tpu.models.point_resolver import PointConflictSet
+from foundationdb_tpu.models.tpu_resolver import TpuConflictSet
+from foundationdb_tpu.ops.fault_injection import g_device_faults
+from foundationdb_tpu.parallel import ShardedTpuConflictSet
+
+
+def txn(snapshot, reads=(), writes=()):
+    return ResolverTransaction(snapshot, tuple(reads), tuple(writes))
+
+
+def rand_batches(seed, n_batches, point=False, n_keys=40, max_txns=8,
+                 version_stride=2000, window=5000):
+    """Batches with keys across the whole byte range (all shards see
+    traffic), empty batches, and sub-window snapshots (tooOld)."""
+    rng = random.Random(seed)
+    out = []
+    v = 0
+
+    def key():
+        return bytes([rng.randrange(256)]) + b"%02d" % rng.randrange(n_keys)
+
+    def rd():
+        k = key()
+        if point:
+            return (k, k + b"\x00")
+        return (k, k + bytes([rng.randrange(1, 8)]))
+
+    for _ in range(n_batches):
+        v += rng.randrange(1, version_stride)
+        batch = []
+        for _ in range(rng.randrange(0, max_txns)):
+            reads = [rd() for _ in range(rng.randrange(0, 3))]
+            writes = [rd() for _ in range(rng.randrange(0, 3))]
+            snap = max(0, v - rng.randrange(0, 2 * window))
+            batch.append(txn(snap, reads, writes))
+        out.append((batch, v, max(0, v - window)))
+    return out
+
+
+BACKENDS = ("python", "tpu", "sharded")
+
+
+def mk(name, point=False, **kw):
+    if name == "python":
+        return PyConflictSet(**kw)
+    if name == "tpu":
+        return TpuConflictSet(**kw)
+    if name == "point":
+        return PointConflictSet(**kw)
+    if name == "native":
+        return create_conflict_set("native", **kw)
+    return ShardedTpuConflictSet(capacity=kw.pop("capacity", 1024), **kw)
+
+
+@pytest.fixture
+def knobs():
+    """Set failover knobs for a test; restore the defaults after."""
+    names = ("device_fault_injection", "device_fault_retries",
+             "conflict_checkpoint_versions", "conflict_replay_log_max",
+             "conflict_device_reattach", "device_reattach_backoff",
+             "shadow_resolve_sample", "shadow_resolve_fail_stop",
+             "resolve_pipeline_depth")
+    prev = {n: getattr(SERVER_KNOBS, n) for n in names}
+    yield SERVER_KNOBS.set
+    for n, v in prev.items():
+        SERVER_KNOBS.set(n, v)
+    g_device_faults.clear()
+
+
+# -- checkpoint / restore parity ---------------------------------------
+
+@pytest.mark.parametrize("producer", BACKENDS)
+@pytest.mark.parametrize("restorer", BACKENDS)
+def test_checkpoint_restore_cross_backend_parity(producer, restorer):
+    """A checkpoint taken on ANY backend restores into ANY backend and
+    the two resolve the identical verdict stream from then on."""
+    batches = rand_batches(3, 30)
+    a = mk(producer)
+    for b, v, o in batches[:20]:
+        a.resolve(b, v, o)
+    ck = a.checkpoint()
+    r = mk(restorer)
+    r.restore(ck)
+    assert r.oldest_version == a.oldest_version
+    for b, v, o in batches[20:]:
+        assert r.resolve(b, v, o) == a.resolve(b, v, o)
+
+
+def test_checkpoint_restore_native_parity():
+    if not native_available():
+        pytest.skip("native backend unavailable")
+    batches = rand_batches(5, 30)
+    a = mk("native")
+    ref = mk("python")
+    for b, v, o in batches[:20]:
+        assert a.resolve(b, v, o) == ref.resolve(b, v, o)
+    # both directions: native -> python and python -> native
+    r_py = mk("python")
+    r_py.restore(a.checkpoint())
+    r_nat = mk("native")
+    r_nat.restore(ref.checkpoint())
+    for b, v, o in batches[20:]:
+        want = a.resolve(b, v, o)
+        assert r_py.resolve(b, v, o) == want
+        assert r_nat.resolve(b, v, o) == want
+
+
+def test_point_checkpoint_roundtrip_and_cross_restore():
+    """Point-backend checkpoints restore into every interval backend;
+    an interval checkpoint of a point-shaped history restores back into
+    the point backend."""
+    batches = rand_batches(7, 30, point=True)
+    a = mk("point")
+    for b, v, o in batches[:20]:
+        a.resolve(b, v, o)
+    ck = a.checkpoint()
+    restored = {n: mk(n, point=True) for n in
+                ("python", "tpu", "point", "sharded")}
+    for r in restored.values():
+        r.restore(ck)
+    # and interval -> point for the same point-shaped history
+    iv = mk("tpu")
+    for b, v, o in batches[:20]:
+        iv.resolve(b, v, o)
+    back = mk("point")
+    back.restore(iv.checkpoint())
+    for b, v, o in batches[20:]:
+        want = a.resolve(b, v, o)
+        for name, r in restored.items():
+            assert r.resolve(b, v, o) == want, name
+        assert back.resolve(b, v, o) == want
+
+
+def test_checkpoint_drains_inflight_pipeline(knobs):
+    """A checkpoint taken with tickets in flight reflects every
+    submitted batch (it drains the window first)."""
+    knobs("resolve_pipeline_depth", 8)
+    batches = rand_batches(9, 8)
+    a = mk("tpu")
+    tickets = [a.submit(b, v, o) for b, v, o in batches]
+    ck = a.checkpoint()
+    assert ck.last_commit == batches[-1][1]
+    r = mk("python")
+    r.restore(ck)
+    serial = mk("tpu")
+    for b, v, o in batches:
+        serial.resolve(b, v, o)
+    assert r.checkpoint().assignments == serial.checkpoint().assignments
+    # the pre-checkpoint tickets still drain idempotently
+    drained = [a.drain(t) for t in tickets]
+    fresh = mk("tpu")
+    assert drained == [fresh.resolve(b, v, o) for b, v, o in batches]
+
+
+def test_restore_rejects_non_point_checkpoint():
+    iv = mk("tpu")
+    iv.resolve([txn(0, writes=[(b"a", b"q")])], 100, 0)
+    with pytest.raises(ValueError):
+        mk("point").restore(iv.checkpoint())
+
+
+def test_restore_after_rebase_window():
+    """Checkpoints taken after the int32 re-base still restore exactly
+    (absolute versions round-trip through the offset encoding)."""
+    MWTLV = 5_000_000
+    a = mk("tpu")
+    ref = mk("python")
+    rng = random.Random(13)
+    v = 0
+    for _ in range(12):
+        v += 300_000_000
+        batch = [txn(v - rng.randrange(0, MWTLV // 2),
+                     reads=[(b"a", b"c")] if rng.random() < 0.5 else [],
+                     writes=[(b"b", b"b\x00")] if rng.random() < 0.5 else [])
+                 for _ in range(5)]
+        assert a.resolve(batch, v, v - MWTLV) == \
+            ref.resolve(batch, v, v - MWTLV)
+    assert a._base > 0
+    r = mk("tpu")
+    r.restore(a.checkpoint())
+    r2 = mk("python")
+    r2.restore(a.checkpoint())
+    for _ in range(4):
+        v += 300_000_000
+        batch = [txn(v - rng.randrange(0, MWTLV // 2),
+                     reads=[(b"a", b"c")], writes=[(b"d", b"e")])]
+        want = a.resolve(batch, v, v - MWTLV)
+        assert r.resolve(batch, v, v - MWTLV) == want
+        assert r2.resolve(batch, v, v - MWTLV) == want
+
+
+# -- failover determinism ----------------------------------------------
+
+FAULT_BACKENDS = ("tpu", "point", "sharded")
+
+
+def _factory(backend):
+    if backend == "tpu":
+        return lambda: TpuConflictSet()
+    if backend == "point":
+        return lambda: PointConflictSet()
+    return lambda: ShardedTpuConflictSet(capacity=1024)
+
+
+def _run_pipelined(cs, batches, window=4):
+    got, pending = [], []
+    for b, v, o in batches:
+        pending.append(cs.submit(b, v, o))
+        if len(pending) >= window:
+            got.append(cs.drain(pending.pop(0)))
+    got.extend(cs.drain(t) for t in pending)
+    return got
+
+
+@pytest.mark.parametrize("backend", FAULT_BACKENDS)
+@pytest.mark.parametrize("point_of_fault",
+                         ("submit", "materialize", "drain"))
+def test_midwindow_failover_is_bit_identical(backend, point_of_fault,
+                                             knobs):
+    """Scheduled device faults at each seam with 4 batches in flight:
+    the verdict stream equals the fault-free run — the rebuild replays
+    the logged batches over the checkpoint, and the version chain makes
+    replayed verdicts bit-identical by construction."""
+    knobs("resolve_pipeline_depth", 4)
+    knobs("conflict_checkpoint_versions", 6000)
+    knobs("conflict_replay_log_max", 64)
+    set_seed(42)
+    point = backend == "point"
+    batches = rand_batches(11, 40, point=point)
+    plain = _factory(backend)()
+    want = [plain.resolve(b, v, o) for b, v, o in batches]
+
+    fo = FailoverConflictSet(_factory(backend), backend_name=backend)
+    faulted = 0
+    got, pending = [], []
+    for i, (b, v, o) in enumerate(batches):
+        if i in (5, 13, 27):
+            g_device_faults.schedule(point_of_fault)
+            faulted += 1
+        pending.append(fo.submit(b, v, o))
+        if len(pending) >= 4:
+            got.append(fo.drain(pending.pop(0)))
+    got.extend(fo.drain(t) for t in pending)
+    assert got == want
+    st = fo.failover_stats()
+    assert st["device_faults"] >= faulted, st
+    assert st["replayed_batches"] > 0, st
+
+
+def test_seeded_faults_failover_to_cpu_and_reattach(knobs):
+    """Probabilistic seeded faults with zero device retries: the
+    wrapper declares the device dead, serves bit-identical verdicts
+    from the CPU fallback, and reattaches once the device is healthy."""
+    set_seed(7)
+    knobs("device_fault_retries", 0)
+    knobs("conflict_device_reattach", 0)
+    knobs("conflict_checkpoint_versions", 6000)
+    batches = rand_batches(11, 40)
+    plain = TpuConflictSet()
+    want = [plain.resolve(b, v, o) for b, v, o in batches]
+    fo = FailoverConflictSet(lambda: TpuConflictSet(),
+                             backend_name="tpu")
+    # arm faults only for the wrapped run (a bare backend would just
+    # propagate the injected error — that is exactly what the wrapper
+    # exists to absorb)
+    knobs("device_fault_injection", 0.15)
+    assert [fo.resolve(b, v, o) for b, v, o in batches] == want
+    st = fo.failover_stats()
+    assert st["failovers"] >= 1 and not st["on_primary"], st
+    assert st["active_backend"] == "python"
+
+    # device healthy again: the next submits move back to the primary
+    SERVER_KNOBS.set("device_fault_injection", 0.0)
+    SERVER_KNOBS.set("conflict_device_reattach", 1)
+    v0 = batches[-1][1]
+    tail = [(b, v0 + v, max(0, v0 + v - 5000))
+            for b, v, _o in rand_batches(12, 5)]
+    for b, v, o in tail:
+        assert fo.resolve(b, v, o) == plain.resolve(b, v, o)
+    st = fo.failover_stats()
+    assert st["on_primary"] and st["reattaches"] == 1, st
+
+
+@pytest.mark.parametrize("bad_batch", [
+    [(b"x" * 33, b"x" * 33 + b"\x00")],   # key wider than the bucket
+    [(b"a", b"z")],                       # non-point range
+], ids=["wide-key", "interval-range"])
+def test_fallback_enforces_primary_input_contract(bad_batch, knobs):
+    """While failed over, batches the device backend would reject must
+    ALSO be rejected by the permissive CPU fallback — the resolver
+    role's batch-reject path then behaves identically on both sides of
+    the failover boundary, and nothing un-replayable-on-device enters
+    the log (a poisoned log would make every reattach rebuild raise)."""
+    knobs("device_fault_retries", 0)
+    knobs("conflict_device_reattach", 1)
+    knobs("device_reattach_backoff", 0.0)
+    fo = FailoverConflictSet(lambda: PointConflictSet(),
+                             backend_name="tpu-point")
+    fo.resolve([txn(0, writes=[(b"a", b"a\x00")])], 100, 0)
+    g_device_faults.schedule("submit")
+    fo.resolve([txn(50, writes=[(b"b", b"b\x00")])], 200, 0)
+    assert not fo.on_primary
+    with pytest.raises(ValueError):
+        fo.resolve([txn(150, writes=bad_batch)], 300, 0)
+    # the rejected batch was never logged: serving continues and the
+    # reattach rebuild replays cleanly back onto the device backend
+    assert fo.resolve([txn(150, writes=[(b"c", b"c\x00")])], 300, 0) \
+        == [COMMITTED]
+    st = fo.failover_stats()
+    assert st["on_primary"] and st["reattach_failures"] == 0, st
+
+
+def test_fallback_skips_contract_check_for_too_old(knobs):
+    """A malformed range inside a tooOld transaction is never
+    marshalled by the device backend, so the fallback must accept it
+    too (exact batch-reject parity, not a stricter approximation)."""
+    knobs("device_fault_retries", 0)
+    knobs("conflict_device_reattach", 0)
+    wide = (b"x" * 33, b"x" * 33 + b"\x00")
+    want = None
+    for faulted in (False, True):
+        cs = FailoverConflictSet(lambda: PointConflictSet(),
+                                 backend_name="tpu-point")
+        cs.resolve([txn(0, writes=[(b"a", b"a\x00")])], 100, 50)
+        if faulted:
+            g_device_faults.schedule("submit")
+        cs.resolve([txn(60, writes=[(b"b", b"b\x00")])], 150, 50)
+        assert cs.on_primary is (not faulted)
+        got = cs.resolve([txn(10, reads=[wide], writes=[wide])], 200, 50)
+        want = got if want is None else want
+        assert got == want == [TOO_OLD]
+
+
+def test_attributed_batches_survive_failover(knobs):
+    """Attribution (report_conflicting_keys) rides the replay too."""
+    knobs("conflict_checkpoint_versions", 10 ** 9)
+    set_seed(21)
+    batches = rand_batches(5, 20)
+    plain = TpuConflictSet()
+    want = [plain.resolve_with_attribution(b, v, o) for b, v, o in batches]
+    fo = FailoverConflictSet(lambda: TpuConflictSet(), backend_name="tpu")
+    got = []
+    for i, (b, v, o) in enumerate(batches):
+        if i in (4, 11):
+            g_device_faults.schedule("materialize")
+        got.append(fo.resolve_with_attribution(b, v, o))
+    assert got == want
+    assert fo.failover_stats()["device_faults"] >= 2
+
+
+# -- shadow validation --------------------------------------------------
+
+class _SabotagedBackend(PyConflictSet):
+    """A backend whose kernel 'went wrong': state evolves by its own
+    (wrong) beliefs while verdicts claim everything committed."""
+
+    BACKEND = "sabotaged"
+
+    def _resolve(self, txns, commit_version, new_oldest_version, collect):
+        from foundationdb_tpu.models import COMMITTED
+        out = super()._resolve(txns, commit_version, new_oldest_version,
+                               collect)
+        return [COMMITTED for _ in out]
+
+
+def test_shadow_validation_catches_sabotaged_backend(knobs):
+    knobs("shadow_resolve_sample", 1)
+    knobs("conflict_checkpoint_versions", 6000)
+    set_seed(33)
+    batches = rand_batches(3, 30)
+    fo = FailoverConflictSet(lambda: _SabotagedBackend(),
+                             backend_name="sabotaged")
+    for b, v, o in batches:
+        fo.resolve(b, v, o)
+    st = fo.failover_stats()["shadow"]
+    assert st["sampled"] > 0
+    assert st["mismatches"] > 0, st
+    assert fo.last_mismatch is not None
+    assert fo.last_mismatch["got"] != fo.last_mismatch["want"]
+
+
+def test_shadow_validation_passes_honest_backend(knobs):
+    """No false positives: an honest device backend sampled on every
+    batch never mismatches (the shadow rebuild replays the same
+    deterministic chain)."""
+    knobs("shadow_resolve_sample", 1)
+    knobs("conflict_checkpoint_versions", 6000)
+    set_seed(34)
+    for backend in FAULT_BACKENDS:
+        fo = FailoverConflictSet(_factory(backend), backend_name=backend)
+        batches = rand_batches(4, 25, point=(backend == "point"))
+        _run_pipelined(fo, batches, window=4)
+        st = fo.failover_stats()["shadow"]
+        assert st["sampled"] > 0
+        assert st["mismatches"] == 0, (backend, st)
+
+
+def test_shadow_fail_stop_halts(knobs):
+    knobs("shadow_resolve_sample", 1)
+    knobs("shadow_resolve_fail_stop", 1)
+    set_seed(35)
+    fo = FailoverConflictSet(lambda: _SabotagedBackend(),
+                             backend_name="sabotaged")
+    with pytest.raises(ShadowResolveMismatch):
+        for b, v, o in rand_batches(3, 30):
+            fo.resolve(b, v, o)
+
+
+# -- the cluster surface ------------------------------------------------
+
+def test_cluster_failover_counters_in_status_and_exporter(knobs):
+    """A tpu-backed SimCluster with seeded fault injection: commits
+    keep succeeding, and the failover/shadow counters surface in
+    status, `status details`, the health messages, and the exporter."""
+    from foundationdb_tpu import flow
+    from foundationdb_tpu.client import run_transaction
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.tools.cli import Cli
+    from foundationdb_tpu.tools.exporter import (parse_prometheus,
+                                                 render_prometheus)
+
+    cluster = SimCluster(seed=606, durable=True, conflict_backend="tpu")
+    # knobs AFTER SimCluster re-initializes them
+    flow.SERVER_KNOBS.set("device_fault_injection", 0.05)
+    flow.SERVER_KNOBS.set("conflict_checkpoint_versions", 200_000)
+    flow.SERVER_KNOBS.set("shadow_resolve_sample", 2)
+    cli = Cli.for_cluster(cluster)
+    try:
+        db = cluster.client("fo")
+
+        async def main():
+            for i in range(25):
+                async def body(tr, i=i):
+                    await tr.get(b"fo%02d" % (i % 7))
+                    tr.set(b"fo%02d" % (i % 7), b"v%d" % i)
+                await run_transaction(db, body, max_retries=200)
+            return await db.get_status()
+
+        status = cluster.run(main(), timeout_time=600)
+        res = status["cluster"]["resolvers"]
+        assert res
+        fo = res[0]["failover"]
+        assert fo, "no failover section for a device backend"
+        assert fo["shadow"]["sample"] == 2
+        assert fo["shadow"]["sampled"] > 0
+        assert fo["shadow"]["mismatches"] == 0, fo
+        assert fo["checkpoints"] >= 0
+        details = cli.execute("status details")
+        assert "Backend failover:" in details
+        assert "active=" in details
+        names = {n for n, _, _ in
+                 parse_prometheus(render_prometheus(status))}
+        for need in ("fdbtpu_conflict_failover_on_primary",
+                     "fdbtpu_conflict_failover_device_faults",
+                     "fdbtpu_shadow_resolve_sampled",
+                     "fdbtpu_shadow_resolve_mismatches"):
+            assert need in names, need
+    finally:
+        flow.SERVER_KNOBS.set("device_fault_injection", 0.0)
+        flow.SERVER_KNOBS.set("shadow_resolve_sample", 0)
+        cluster.shutdown()
