@@ -359,7 +359,25 @@ def bench_tpu_pipelined(n_txns, n_batches, keyspace, depth):
         conflict, _too_old = cs.drain_arrays(pending.popleft())
         n_conflicts += int(conflict.sum())
     elapsed = time.perf_counter() - t0
-    return n_batches * n_txns / elapsed, n_conflicts
+    return (n_batches * n_txns / elapsed, n_conflicts,
+            _compact_pipeline_stats(cs.pipeline_stats()))
+
+
+def _compact_pipeline_stats(pipe: dict) -> dict:
+    """The resolve-pipeline window accounting for the BENCH json
+    (occupancy/peak/forced drains + submit/drain wall percentiles):
+    the observability the still-owed tunnel-up round ships with, so a
+    depth sweep's numbers come with evidence the window actually ran
+    full instead of degenerating to serial."""
+    lat = pipe.get("latency") or {}
+    out = {k: pipe.get(k) for k in ("depth", "occupancy",
+                                    "peak_in_flight", "submits",
+                                    "drains", "forced_drains")}
+    for stage in ("submit", "drain"):
+        snap = lat.get(stage) or {}
+        out[f"{stage}_p50_s"] = snap.get("p50")
+        out[f"{stage}_p99_s"] = snap.get("p99")
+    return out
 
 
 def bench_cpu(backend, n_txns, n_batches, keyspace):
@@ -411,9 +429,6 @@ def _run_backend(backend, n_txns, n_batches, keyspace):
         return bench_tpu_streamed(n_txns, n_batches, keyspace)
     if backend == "tpu-streamed-interval":
         return bench_tpu_streamed(n_txns, n_batches, keyspace, "interval")
-    if backend == "tpu-pipelined":
-        return bench_tpu_pipelined(n_txns, n_batches, keyspace,
-                                   _pipeline_depth())
     return bench_cpu(backend, n_txns, n_batches, keyspace)
 
 
@@ -608,10 +623,13 @@ def main():
         pdepth = _pipeline_depth()
         by_depth = {}
         conflicts_by_depth = {}
+        pipe_by_depth = {}
         for k in sorted({1, 2, 4, 8} | {pdepth}):
-            tps, nc = bench_tpu_pipelined(n_txns, n_batches, keyspace, k)
+            tps, nc, pstats = bench_tpu_pipelined(n_txns, n_batches,
+                                                  keyspace, k)
             by_depth[str(k)] = round(tps, 1)
             conflicts_by_depth[str(k)] = nc
+            pipe_by_depth[str(k)] = pstats
         if len(set(conflicts_by_depth.values())) != 1:
             raise RuntimeError(
                 f"pipelined conflict counts diverged across depths: "
@@ -623,6 +641,10 @@ def main():
             "depth": pdepth,
             "txn_per_s_by_depth": by_depth,
             "conflicts": conflicts_by_depth[str(pdepth)],
+            # window-occupancy evidence per depth (ROADMAP item 1: the
+            # tunnel-up round lands with pipeline observability)
+            "pipeline_stats": pipe_by_depth[str(pdepth)],
+            "pipeline_stats_by_depth": pipe_by_depth,
             "speedup_vs_serial": round(by_depth[str(pdepth)]
                                        / by_depth["1"], 2)
             if by_depth["1"] else None,
@@ -632,6 +654,15 @@ def main():
         txn_per_s = sub["tpu-streamed"]["txn_per_s"]
         n_conflicts = sub["tpu-streamed"]["conflicts"]
         backend_name = "tpu-streamed"
+    elif backend == "tpu-pipelined":
+        # single-backend pipelined run: the window-occupancy evidence
+        # rides sub_metrics here too, not only in the `all` depth sweep
+        pdepth = _pipeline_depth()
+        txn_per_s, n_conflicts, pstats = bench_tpu_pipelined(
+            n_txns, n_batches, keyspace, pdepth)
+        sub["tpu-pipelined"] = {"depth": pdepth,
+                                "pipeline_stats": pstats}
+        backend_name = backend
     else:
         txn_per_s, n_conflicts = _run_backend(backend, n_txns, n_batches,
                                               keyspace)
@@ -651,6 +682,9 @@ def main():
         },
         "sub_metrics": sub,
     }))
+    # piped stdout is block-buffered and jax's CPU runtime can abort
+    # during interpreter teardown — flush so the record survives it
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

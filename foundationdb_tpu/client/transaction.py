@@ -538,6 +538,24 @@ class Transaction:
             self._grv_priority = PRIORITY_BATCH
         elif option == "priority_system_immediate":
             self._grv_priority = PRIORITY_IMMEDIATE
+        elif option == "transaction_tag":
+            # tag this transaction for the proxy's per-tag traffic
+            # accounting (and the tag throttling that will ride it;
+            # ref: the TAG transaction option / TagSet — bounded count
+            # and length, duplicates collapse)
+            if isinstance(value, str):
+                value = value.encode()
+            if not isinstance(value, bytes) or not value:
+                raise error("invalid_option_value")
+            if len(value) > int(
+                    flow.SERVER_KNOBS.max_transaction_tag_length):
+                raise error("tag_too_long")
+            tags = getattr(self, "_tags", ())
+            if value not in tags:
+                if len(tags) >= int(
+                        flow.SERVER_KNOBS.max_tags_per_transaction):
+                    raise error("too_many_tags")
+                self._tags = tags + (value,)
         else:
             raise error("invalid_option_value")
 
@@ -583,6 +601,7 @@ class Transaction:
         self._debug_id = None
         self._profile = None          # re-armed by __init__/set_option
         self._grv_priority = None     # ...including the priority class
+        self._tags = ()               # ...and the transaction tags
         self._report_conflicting = False
         self._conflicting_ranges = None   # last conflicted commit's causes
         # timeout/retry OPTIONS survive an explicit reset, but their
@@ -1200,11 +1219,16 @@ class Transaction:
             # while this is in flight parents (transitively) onto it
             span = flow.g_trace_batch.begin_span(debug_id,
                                                  "NativeAPI.commit")
+        from ..server.types import PRIORITY_DEFAULT as _PRIO_DEFAULT
+        prio = getattr(self, "_grv_priority", None)
         req = CommitRequest(snapshot, tuple(self._read_conflicts),
                             tuple(self._write_conflicts),
                             tuple(self._mutations), debug_id=debug_id,
                             report_conflicting_keys=getattr(
-                                self, "_report_conflicting", False))
+                                self, "_report_conflicting", False),
+                            priority=(_PRIO_DEFAULT if prio is None
+                                      else prio),
+                            tags=tuple(getattr(self, "_tags", ())))
         try:
             proxy = await self._proxy()
             reply = await self._rpc(
@@ -1313,6 +1337,7 @@ class Transaction:
         # and priority class — only an explicit user reset() re-arms
         retries = getattr(self, "_retries_used", 0)
         prio = getattr(self, "_grv_priority", None)
+        tags = getattr(self, "_tags", ())
         debug_id = getattr(self, "_debug_id", None)
         profile = self._profile
         report = getattr(self, "_report_conflicting", False)
@@ -1320,6 +1345,7 @@ class Transaction:
         self.reset()
         self._retries_used = retries
         self._grv_priority = prio
+        self._tags = tags
         # the RETRY attempt is usually the interesting one (it hit a
         # conflict/failure) — keep it sampled
         self._debug_id = debug_id

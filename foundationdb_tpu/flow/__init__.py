@@ -21,6 +21,7 @@ from .actors import (
 from .rng import DeterministicRandom, buggify, g_random, set_seed
 from .knobs import SERVER_KNOBS, Knobs, make_server_knobs, reset_server_knobs
 from .stats import Counter, CounterCollection, TimeSeries
+from .smoother import Smoother, SmoothedQueue, SmoothedRate
 from .latency import (DEFAULT_BANDS, LatencyBands, LatencySample,
                       RequestLatency)
 from .trace import Span, g_trace_batch
@@ -40,6 +41,7 @@ __all__ = [
     "SERVER_KNOBS", "Knobs", "make_server_knobs", "reset_server_knobs",
     "TraceEvent", "g_trace", "reset_trace",
     "Counter", "CounterCollection", "TimeSeries",
+    "Smoother", "SmoothedQueue", "SmoothedRate",
     "DEFAULT_BANDS", "LatencyBands", "LatencySample", "RequestLatency",
     "Span", "g_trace_batch",
 ]

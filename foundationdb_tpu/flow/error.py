@@ -84,6 +84,8 @@ _define("client_invalid_operation", 2000, "Invalid API call")
 _define("key_outside_legal_range", 2004, "Key outside legal range")
 _define("inverted_range", 2005, "Range begin key larger than end key")
 _define("invalid_option_value", 2006, "Option set with an invalid value")
+_define("too_many_tags", 2114, "Too many tags set on transaction")
+_define("tag_too_long", 2115, "Tag set on transaction is too long")
 _define("used_during_commit", 2017, "Operation issued while a commit was outstanding")
 _define("key_too_large", 2102, "Key length exceeds limit")
 _define("value_too_large", 2103, "Value length exceeds limit")

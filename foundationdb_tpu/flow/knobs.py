@@ -183,6 +183,28 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("GRV_BURST_INTERVALS", 10, lambda: 1)
     init("RATEKEEPER_POLL_TIMEOUT", 1.0, lambda: 0.1)
 
+    # -- QoS telemetry plane (per-role saturation signals) -------------
+    # cluster-controller collection cadence for QosSamples; 0 disables
+    # the plane entirely (roles then pay nothing — signals are computed
+    # pull-style at sample time, never on the hot paths)
+    init("QOS_SAMPLE_INTERVAL", 1.0, lambda: 0.1)
+    # time constant for every smoothed QoS signal (flow/smoother.py);
+    # live-tunable: smoothers read it per sample
+    init("QOS_SMOOTHING_TAU", 1.0)
+    # proxy-side per-priority / per-tag transaction accounting
+    # (started/committed/conflicted per class + a bounded decaying
+    # top-K tag table); 0 compiles it down to one knob read per batch
+    init("QOS_TAG_ACCOUNTING", 1)
+    # tag-table bounds + decay (ConflictHotSpots-style): busyness score
+    # half-life seconds, table capacity, rows surfaced in status
+    init("QOS_TAG_HALF_LIFE", 10.0, lambda: 0.5)
+    init("QOS_TAG_MAX_ENTRIES", 64, lambda: 4)
+    init("QOS_TAG_TOP_K", 10)
+    # tags per transaction + tag length caps (ref: the reference's
+    # MAX_TAGS_PER_TRANSACTION / MAX_TRANSACTION_TAG_LENGTH)
+    init("MAX_TAGS_PER_TRANSACTION", 5)
+    init("MAX_TRANSACTION_TAG_LENGTH", 16)
+
     # -- ratekeeper (ref: Ratekeeper.actor.cpp knobs) ------------------
     init("RK_UPDATE_INTERVAL", 0.1, lambda: 0.02)
     init("RK_MIN_RATE", 10.0)
@@ -196,6 +218,13 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("RK_SPRING_TLOG_QUEUE_BYTES", 16 << 20)
     init("RK_BATCH_TARGET_FRACTION", 0.5)
     init("RK_SMOOTHING_SECONDS", 1.0)
+    # resolve-pipeline saturation input (PR 4's occupancy/forced-drain
+    # counters as a throttle signal): a smoothed forced-drain rate
+    # above the target means batches are hitting the depth backpressure
+    # faster than the device drains them — spring-zone throttle like
+    # the queue-byte inputs (0 disables the input)
+    init("RK_PIPELINE_FORCED_DRAIN_LIMIT", 50.0, lambda: 2.0)
+    init("RK_PIPELINE_FORCED_DRAIN_SPRING", 25.0)
 
     # -- region / log router (ref: LOG_ROUTER_* knobs) -----------------
     init("LOG_ROUTER_PEEK_TIMEOUT", 2.0, lambda: 0.2)

@@ -159,6 +159,10 @@ class ClusterController:
         self._latency_probe: dict = {}
         self._probe_bands = {k: flow.RequestLatency(f"probe_{k}")
                              for k in ("grv", "read", "commit")}
+        # the QoS telemetry plane: role name -> latest QosSample
+        # (collected by _qos_sampler_loop at QOS_SAMPLE_INTERVAL; empty
+        # when the knob is 0 — the plane then costs nothing anywhere)
+        self.qos_samples: dict = {}
         # (instance name, counter) -> TimeSeries (ref: TDMetric levels)
         self.metrics: dict = {}
         self._metric_gauges: set = set()   # (rn, cn) sampled via set()
@@ -183,6 +187,7 @@ class ClusterController:
                            (self._dd_loop(), "dataDistribution"),
                            (self._failure_monitor_loop(), "failureMonitor"),
                            (self._metric_sampler_loop(), "metricSampler"),
+                           (self._qos_sampler_loop(), "qosSampler"),
                            (self._trace_counters_loop(), "traceCounters"),
                            (self._latency_probe_loop(), "latencyProbe"),
                            (self._conf_sync_loop(), "confSync")):
@@ -259,6 +264,39 @@ class ClusterController:
             for key in [k for k in self.metrics if k[0] not in known]:
                 del self.metrics[key]
                 self._metric_gauges.discard(key)
+
+    async def _qos_sampler_loop(self) -> None:
+        """Collect every live role's QosSample (smoothed queue/lag/rate
+        saturation signals) at QOS_SAMPLE_INTERVAL — the measurement
+        half of the Ratekeeper feedback loop (ref: updateRate polling
+        StorageQueuingMetrics/TLogQueuingMetrics; here the roles
+        publish through one QosSample vocabulary and the controller
+        holds the latest snapshot for status/exporter/ratekeeper).
+        Interval 0 disables the plane: the dict empties and no role
+        pays a thing (signals are pull-computed, never hot-path)."""
+        while True:
+            interval = flow.SERVER_KNOBS.qos_sample_interval
+            if interval <= 0:
+                if self.qos_samples:
+                    self.qos_samples.clear()
+                await flow.delay(1.0, TaskPriority.LOW_PRIORITY)
+                continue
+            await flow.delay(interval, TaskPriority.LOW_PRIORITY)
+            now = flow.now()
+            known: set = set()
+            for wi in self.workers.values():
+                if not wi.worker.process.alive:
+                    continue
+                for rn, role in wi.worker.roles.items():
+                    fn = getattr(role, "qos_sample", None)
+                    if fn is None:
+                        continue
+                    known.add(rn)
+                    self.qos_samples[rn] = fn(now)
+            # prune retired roles (old epochs, vacated replicas) so the
+            # status document never reports a dead role's stale signals
+            for rn in [r for r in self.qos_samples if r not in known]:
+                del self.qos_samples[rn]
 
     async def _trace_counters_loop(self) -> None:
         """Roll every live role's CounterCollection into a periodic
@@ -1155,9 +1193,12 @@ class ClusterController:
         proxies = []
         resolvers = []
         rate = None
+        rk_role = None
+        proxy_roles = []
         for wi in self.workers.values():
             for rn, role in wi.worker.roles.items():
                 if isinstance(role, Proxy) and f"-e{info.epoch}-" in rn:
+                    proxy_roles.append(role)
                     proxies.append({
                         "name": rn,
                         "committed_version": role.committed_version.get(),
@@ -1194,6 +1235,7 @@ class ClusterController:
                 elif isinstance(role, Ratekeeper) and \
                         rn.endswith(f"-e{info.epoch}"):
                     rate = role.rate
+                    rk_role = role
         # cluster-level hot-spot view: merge every resolver's table by
         # range (keyspace-sharded resolvers each see disjoint causes)
         merged_hot: dict = {}
@@ -1207,6 +1249,52 @@ class ClusterController:
                      "score": round(v["score"], 4), "total": v["total"]}
                     for (b, e), v in merged_hot.items()]
         hot_rows.sort(key=lambda r: (-r["score"], r["begin"]))
+        # the QoS telemetry plane: ratekeeper decision + per-role
+        # smoothed saturation signals + tag/priority traffic accounting
+        # (ref: the qos section of clusterGetStatus, grown here with
+        # the full measurement plane ROADMAP item 3's throttling needs)
+        qos_roles: dict = {}
+        for s in self.qos_samples.values():
+            qos_roles.setdefault(s.kind, {})[s.name] = dict(
+                s.signals, sampled_at=round(s.sampled_at, 3))
+        merged_tags: dict = {}
+        prio_counts: dict = {}
+        for p_role in proxy_roles:
+            for row in p_role.tag_counter.top():
+                ent = merged_tags.setdefault(row["tag"], {
+                    "busyness": 0.0, "started": 0, "committed": 0,
+                    "conflicted": 0})
+                ent["busyness"] += row["busyness"]
+                for f in ("started", "committed", "conflicted"):
+                    ent[f] += row[f]
+            snap = p_role.stats.snapshot()
+            for prio in ("batch", "default", "immediate"):
+                ent = prio_counts.setdefault(prio, {
+                    "started": 0, "committed": 0, "conflicted": 0})
+                ent["started"] += snap.get(
+                    f"transactions_started_{prio}", 0)
+                ent["committed"] += snap.get(
+                    f"transactions_committed_{prio}", 0)
+                ent["conflicted"] += snap.get(
+                    f"transactions_conflicted_{prio}", 0)
+        tag_rows = [dict(tag=t, busyness=round(v["busyness"], 4),
+                         started=v["started"], committed=v["committed"],
+                         conflicted=v["conflicted"])
+                    for t, v in merged_tags.items()]
+        tag_rows.sort(key=lambda r: (-r["busyness"], r["tag"]))
+        decision = dict(rk_role.last_decision) if rk_role is not None \
+            else {}
+        qos_doc = {
+            "transactions_per_second_limit": rate,
+            "batch_transactions_per_second_limit":
+                rk_role.batch_rate if rk_role is not None else None,
+            "limiting_reason": decision.get("limiting_reason", "none"),
+            "inputs": decision.get("inputs", {}),
+            "roles": qos_roles,
+            "tags": tag_rows[
+                :int(flow.SERVER_KNOBS.qos_tag_top_k)],
+            "priorities": prio_counts,
+        }
         from ..flow import coverage as _coverage
         cov = _coverage.report()
         probe = dict(self._latency_probe)
@@ -1235,7 +1323,7 @@ class ClusterController:
                 # once — the compiled kernels are shared across every
                 # backend instance in this process
                 "kernels": _global_kernel_counters(),
-                "qos": {"transactions_per_second_limit": rate},
+                "qos": qos_doc,
                 "latency_probe": probe,
                 # hottest conflict-causing key ranges, cluster-wide
                 # (per-resolver tables under resolvers[*].hot_spots)

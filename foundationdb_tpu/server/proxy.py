@@ -147,6 +147,70 @@ class KeyResolverMap:
         return out
 
 
+PRIORITY_NAMES = {PRIORITY_BATCH: "batch", PRIORITY_DEFAULT: "default",
+                  PRIORITY_IMMEDIATE: "immediate"}
+
+
+class TransactionTagCounter:
+    """Bounded decaying table of per-tag transaction traffic (ref:
+    fdbserver/TransactionTagCounter — the busiest-tag tracking behind
+    tag throttling; same decay/eviction shape as ConflictHotSpots).
+
+    Each client-supplied tag accumulates a busyness score that halves
+    every QOS_TAG_HALF_LIFE seconds, plus raw started / committed /
+    conflicted totals. Bounded at QOS_TAG_MAX_ENTRIES (lowest decayed
+    score evicted); `top(k)` is the status/CLI/exporter surface, and
+    the throttling PR that follows (ROADMAP item 3) reads the same
+    rows to pick which tags to push back on."""
+
+    __slots__ = ("half_life", "max_entries", "_entries")
+
+    def __init__(self, half_life: float = None, max_entries: int = None):
+        self.half_life = (half_life if half_life is not None
+                          else SERVER_KNOBS.qos_tag_half_life)
+        self.max_entries = (max_entries if max_entries is not None
+                            else int(SERVER_KNOBS.qos_tag_max_entries))
+        # tag -> [decayed score, started, committed, conflicted, last t]
+        self._entries: dict = {}
+
+    def _decayed(self, score: float, since: float, now: float) -> float:
+        if now <= since or self.half_life <= 0:
+            return score
+        return score * 0.5 ** ((now - since) / self.half_life)
+
+    def record(self, tag: bytes, outcome: str, now: float,
+               weight: float = 1.0) -> None:
+        ent = self._entries.get(tag)
+        if ent is None:
+            ent = self._entries[tag] = [0.0, 0, 0, 0, now]
+        ent[0] = self._decayed(ent[0], ent[4], now) + weight
+        ent[4] = now
+        if outcome == "started":
+            ent[1] += 1
+        elif outcome == "committed":
+            ent[2] += 1
+        elif outcome == "conflicted":
+            ent[3] += 1
+        if len(self._entries) > self.max_entries:
+            worst = min(self._entries,
+                        key=lambda k: self._decayed(
+                            self._entries[k][0], self._entries[k][4], now))
+            del self._entries[worst]
+
+    def top(self, k: int = None) -> list:
+        """Status-ready rows, busiest first: decayed rate score plus
+        the raw per-outcome totals per tag."""
+        if k is None:
+            k = int(SERVER_KNOBS.qos_tag_top_k)
+        now = flow.now()
+        rows = [(self._decayed(s, t, now), st, cm, cf, tag)
+                for tag, (s, st, cm, cf, t) in self._entries.items()]
+        rows.sort(key=lambda r: (-r[0], r[4]))
+        return [{"tag": tag.hex(), "busyness": round(score, 4),
+                 "started": st, "committed": cm, "conflicted": cf}
+                for score, st, cm, cf, tag in rows[:k]]
+
+
 class Proxy:
     def __init__(self, process: SimProcess, master_ref: NetworkRef,
                  resolver_refs, tlog_refs,
@@ -227,6 +291,18 @@ class Proxy:
         # the LatencySample percentile surface)
         self.grv_bands = flow.RequestLatency("grv")
         self.commit_bands = flow.RequestLatency("commit")
+        # per-tag / per-priority traffic accounting (ref:
+        # TransactionTagCounter + the per-class started counters in
+        # ProxyStats); gated by QOS_TAG_ACCOUNTING — off, the commit
+        # path pays one knob read per batch and nothing else
+        self.tag_counter = TransactionTagCounter()
+        # QoS saturation signals (ref: GRV queue depth + batch
+        # occupancy feeding the reference's GrvProxyMetrics). Pull
+        # model: qos_sample() reads raw state at the collection cadence
+        self._qos_grv_queue = flow.SmoothedQueue()
+        self._qos_batch_rate = flow.SmoothedRate()
+        self._qos_txn_rate = flow.SmoothedRate()
+        self._qos_started_rate = flow.SmoothedRate()
         self.commits = RequestStream(process)
         self.grvs = RequestStream(process)
         self.raw_committed = RequestStream(process)
@@ -425,6 +501,13 @@ class Proxy:
                     version = max(version, min(frontiers))
             self.stats.counter("transactions_started").add(
                 sum(e[1] for e in batch))
+            if SERVER_KNOBS.qos_tag_accounting:
+                # per-priority admission accounting (ref: the per-class
+                # txn counters in ProxyStats feeding GetRateInfo)
+                for _r, cnt, prio, _t in batch:
+                    self.stats.counter(
+                        "transactions_started_"
+                        + PRIORITY_NAMES.get(prio, "default")).add(cnt)
             now = flow.now()
             for entry in batch:
                 self.grv_bands.record(now - entry[3])
@@ -697,9 +780,15 @@ class Proxy:
             # phase 5: per-transaction replies
             st = self.stats
             st.counter("commit_batches").add(1)
+            st.counter("commit_batch_txns").add(len(reqs))
+            account = bool(SERVER_KNOBS.qos_tag_accounting)
+            now_acct = flow.now() if account else 0.0
             elapsed = flow.now() - t0
             for idx, (verdict, reply) in enumerate(zip(verdicts, replies)):
                 self.commit_bands.record(elapsed)
+                if account:
+                    self._account(reqs[idx], verdict, idx in illegal,
+                                  now_acct)
                 if idx in illegal:
                     reply.send_error(error("client_invalid_operation"))
                 elif verdict == COMMITTED:
@@ -747,6 +836,54 @@ class Proxy:
     def _advance(nv: NotifiedVersion, to: int) -> None:
         if nv.get() < to:
             nv.set(to)
+
+    def _account(self, req, verdict: int, illegal: bool,
+                 now: float) -> None:
+        """Per-priority / per-tag outcome accounting (QOS_TAG_ACCOUNTING
+        gated at the caller): priority classes ride plain counters (the
+        metric sampler and trace-counters rollup pick them up for
+        free); client tags go through the bounded decaying table."""
+        prio = PRIORITY_NAMES.get(
+            getattr(req, "priority", PRIORITY_DEFAULT), "default")
+        if illegal:
+            outcome = "illegal"
+        elif verdict == COMMITTED:
+            outcome = "committed"
+        elif verdict == TOO_OLD:
+            outcome = "too_old"
+        else:
+            outcome = "conflicted"
+        if outcome in ("committed", "conflicted"):
+            self.stats.counter(
+                f"transactions_{outcome}_{prio}").add(1)
+        for tag in getattr(req, "tags", ()) or ():
+            self.tag_counter.record(tag, "started", now)
+            if outcome in ("committed", "conflicted"):
+                self.tag_counter.record(tag, outcome, now, weight=0.0)
+
+    def qos_sample(self, now: float) -> "QosSample":
+        """Saturation-signal snapshot (ref: the GRV queue depth /
+        batch-occupancy surface of GrvProxyMetrics): smoothed GRV queue
+        depth, commit-batch occupancy (mean txns per batch over the
+        window — a full batcher means the proxy, not the clients, sets
+        the pace), resolve in-flight, and admission/commit rates."""
+        from .types import QosSample
+        snap = self.stats.snapshot()
+        batch_rate = self._qos_batch_rate.sample_total(
+            snap.get("commit_batches", 0), now)
+        txn_rate = self._qos_txn_rate.sample_total(
+            snap.get("commit_batch_txns", 0), now)
+        return QosSample("proxy", self.process.name, now, {
+            "grv_queue_depth": round(self._qos_grv_queue.sample(
+                len(self._grv_queue), now), 2),
+            "commit_batch_occupancy": round(
+                txn_rate / batch_rate, 2) if batch_rate > 0 else 0.0,
+            "resolve_in_flight": self._resolving_now,
+            "grv_rate": round(self._qos_started_rate.sample_total(
+                snap.get("transactions_started", 0), now), 2),
+            "commit_rate": round(txn_rate, 2),
+            "tps_budget": self._rate,
+        })
 
     def _note_resolving(self, delta: int) -> None:
         """Concurrently-resolving batch gauge + high-water mark."""

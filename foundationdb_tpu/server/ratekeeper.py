@@ -23,35 +23,28 @@ trickle until it rejoins.
 
 from __future__ import annotations
 
-import math
 from typing import Dict, NamedTuple
 
 from .. import flow
 from ..flow import TaskPriority
+# promoted to flow/smoother.py (with the non-increasing-clock clamp);
+# re-exported here because the Smoother is historically this module's
+# vocabulary and importers reach for it here
+from ..flow.smoother import SmoothedRate, Smoother  # noqa: F401
 from ..rpc import RequestStream, SimProcess
 from .types import mutation_bytes
 
+# limiting_reason vocabulary (ref: limitReason_t in Ratekeeper.actor.cpp
+# — the reason string RkUpdate publishes beside the computed rate).
+# Pinned by tests/test_qos_telemetry.py and the status.cluster.qos schema.
+LIMIT_REASONS = ("none", "storage_queue", "tlog_queue", "durability_lag",
+                 "pipeline_occupancy")
 
-class Smoother:
-    """Exponential smoothing toward the newest sample with time
-    constant `tau` seconds (ref: fdbrpc/Smoother.h)."""
 
-    __slots__ = ("_t", "value")
-
-    def __init__(self):
-        self._t = None
-        self.value = 0.0
-
-    def sample(self, x: float, now: float, tau: float) -> float:
-        # tau comes in per sample so a live knob change applies to
-        # existing smoothers (a frozen tau would make the knob a no-op)
-        if self._t is None or tau <= 0:
-            self.value = x
-        else:
-            a = math.exp(-(now - self._t) / tau)
-            self.value = x + (self.value - x) * a
-        self._t = now
-        return self.value
+def _camel(s: str) -> str:
+    """snake_case signal name -> the CamelCase TraceEvent detail key
+    (RkUpdate fields read like the reference's)."""
+    return "".join(p.capitalize() for p in s.split("_"))
 
 
 class GetRateReply(NamedTuple):
@@ -68,6 +61,12 @@ class Ratekeeper:
         self.get_rate = RequestStream(process)
         self._storage_smooth: Dict[str, Smoother] = {}
         self._tlog_smooth: Dict[str, Smoother] = {}
+        # resolve-pipeline forced-drain rate per resolver (PR 4's
+        # backpressure counters as a throttle input)
+        self._pipeline_smooth: Dict[str, SmoothedRate] = {}
+        # the last decision with its input signals and limiting reason
+        # — what RkUpdate traces and status.cluster.qos publish
+        self.last_decision: dict = {}
         self._actors = flow.ActorCollection()
 
     def start(self) -> None:
@@ -91,6 +90,17 @@ class Ratekeeper:
             await flow.delay(flow.SERVER_KNOBS.rk_update_interval,
                              TaskPriority.RATEKEEPER)
             self.rate, self.batch_rate = self._compute_rates()
+            d = self.last_decision
+            if d:
+                # decision trace every interval (ref: the RkUpdate
+                # TraceEvent updateRate emits: the computed rate, every
+                # input signal, and WHY the limit is what it is)
+                flow.TraceEvent("RkUpdate", self.process.name).detail(
+                    TPSLimit=round(d["tps"], 1),
+                    BatchTPSLimit=round(d["batch_tps"], 1),
+                    LimitingReason=d["limiting_reason"],
+                    **{_camel(kk): vv
+                       for kk, vv in d["inputs"].items()}).log()
 
     @staticmethod
     def _spring_limit(queue: float, target: float, spring: float,
@@ -114,6 +124,26 @@ class Ratekeeper:
         batch_frac = k.rk_batch_target_fraction
         tau = k.rk_smoothing_seconds
         limit, batch_limit = max_rate, max_rate
+        # every input signal the decision saw, for RkUpdate + status
+        inputs = {"worst_storage_queue_bytes": 0.0,
+                  "worst_tlog_queue_bytes": 0.0,
+                  "worst_durability_lag_versions": 0,
+                  "pipeline_occupancy": 0.0,
+                  "pipeline_forced_drain_rate": 0.0,
+                  "dead_replicas": 0}
+        reason = "none"
+        # the batch bucket has its own binding constraint (its spring
+        # zone starts at target*batch_frac, well before the normal
+        # one) — track its reason separately so a batch-only throttle
+        # is never reported as "none"
+        batch_reason = "none"
+
+        def lower(new_limit, new_batch, why):
+            nonlocal limit, batch_limit, reason, batch_reason
+            if new_limit < limit:
+                limit, reason = new_limit, why
+            if new_batch < batch_limit:
+                batch_limit, batch_reason = new_batch, why
 
         worst_excess = 0
         # one pass per REPLICA, not per (shard x replica): a server
@@ -125,7 +155,10 @@ class Ratekeeper:
             obj = self.cc._storage_objs.get(name)
             if obj is None or not obj.process.alive:
                 # a dead replica: lag is unbounded until it rejoins
-                return min_rate, min_rate
+                inputs["dead_replicas"] += 1
+                inputs["worst_durability_lag_versions"] = window
+                return self._decide(min_rate, min_rate,
+                                    "durability_lag", inputs, now)
             if obj.kv is None:
                 continue  # no engine: durability is inert (defensive)
             excess = (obj.version.get() - obj.durable_version.get()
@@ -139,12 +172,14 @@ class Ratekeeper:
             if sm is None:
                 sm = self._storage_smooth[name] = Smoother()
             q = sm.sample(qbytes, now, tau)
+            inputs["worst_storage_queue_bytes"] = max(
+                inputs["worst_storage_queue_bytes"], round(q, 1))
             t = k.rk_target_storage_queue_bytes
             sp = k.rk_spring_storage_queue_bytes
-            limit = min(limit, self._spring_limit(
-                q, t, sp, max_rate, min_rate))
-            batch_limit = min(batch_limit, self._spring_limit(
-                q, t * batch_frac, sp, max_rate, min_rate))
+            lower(self._spring_limit(q, t, sp, max_rate, min_rate),
+                  self._spring_limit(q, t * batch_frac, sp, max_rate,
+                                     min_rate),
+                  "storage_queue")
         for stale in set(self._storage_smooth) - replicas:
             del self._storage_smooth[stale]
 
@@ -155,27 +190,94 @@ class Ratekeeper:
             if sm is None:
                 sm = self._tlog_smooth[t_obj.name] = Smoother()
             q = sm.sample(t_obj.mem_bytes, now, tau)
+            inputs["worst_tlog_queue_bytes"] = max(
+                inputs["worst_tlog_queue_bytes"], round(q, 1))
             tt = k.rk_target_tlog_queue_bytes
             sp = k.rk_spring_tlog_queue_bytes
-            limit = min(limit, self._spring_limit(
-                q, tt, sp, max_rate, min_rate))
-            batch_limit = min(batch_limit, self._spring_limit(
-                q, tt * batch_frac, sp, max_rate, min_rate))
+            lower(self._spring_limit(q, tt, sp, max_rate, min_rate),
+                  self._spring_limit(q, tt * batch_frac, sp, max_rate,
+                                     min_rate),
+                  "tlog_queue")
             if len(t_obj.entries) > k.rk_tlog_backlog_limit:
-                return min_rate, min_rate
+                return self._decide(min_rate, min_rate, "tlog_queue",
+                                    inputs, now)
         for stale in set(self._tlog_smooth) - live_logs:
             del self._tlog_smooth[stale]
 
+        # resolve-pipeline backpressure (PR 4's forced-drain counters):
+        # a sustained forced-drain rate means submits outrun the device
+        # drain — the same spring-zone shape as the queue-byte inputs
+        fd_target = k.rk_pipeline_forced_drain_limit
+        if fd_target > 0:
+            live_res = set()
+            for rn, role in self._resolver_roles(info):
+                live_res.add(rn)
+                pipe = role.pipeline_stats()
+                sm = self._pipeline_smooth.get(rn)
+                if sm is None:
+                    sm = self._pipeline_smooth[rn] = SmoothedRate()
+                # tau per sample, like the storage/tlog smoothers — a
+                # construction-time tau would freeze the knob
+                fd_rate = sm.sample_total(pipe.get("forced_drains", 0),
+                                          now, tau)
+                inputs["pipeline_forced_drain_rate"] = max(
+                    inputs["pipeline_forced_drain_rate"],
+                    round(fd_rate, 2))
+                inputs["pipeline_occupancy"] = max(
+                    inputs["pipeline_occupancy"],
+                    pipe.get("occupancy") or 0.0)
+                sp = k.rk_pipeline_forced_drain_spring
+                lower(self._spring_limit(fd_rate, fd_target, sp,
+                                         max_rate, min_rate),
+                      self._spring_limit(fd_rate, fd_target * batch_frac,
+                                         sp, max_rate, min_rate),
+                      "pipeline_occupancy")
+            for stale in set(self._pipeline_smooth) - live_res:
+                del self._pipeline_smooth[stale]
+
         # durability-lag excess scales everything quadratically toward
         # the trickle as it approaches the MVCC window
+        inputs["worst_durability_lag_versions"] = max(0, worst_excess)
         target = window // 5    # distress threshold for excess lag
         if worst_excess >= window:
-            return min_rate, min_rate
+            return self._decide(min_rate, min_rate, "durability_lag",
+                                inputs, now)
         if worst_excess > target:
             frac = 1.0 - (worst_excess - target) / max(1, window - target)
-            limit = min(limit, max(min_rate, max_rate * frac * frac))
-            batch_limit = min(batch_limit, limit)
-        return limit, min(batch_limit, limit)
+            lower(max(min_rate, max_rate * frac * frac), limit,
+                  "durability_lag")
+            if limit < batch_limit:
+                # batch now binds on whatever binds the normal bucket
+                batch_limit, batch_reason = limit, reason
+        if limit >= max_rate:
+            # normal-priority unthrottled — but the batch bucket may
+            # still be engaged; report ITS reason rather than claiming
+            # the cluster is unlimited while batch traffic is shed
+            reason = batch_reason if batch_limit < max_rate else "none"
+        return self._decide(limit, min(batch_limit, limit), reason,
+                            inputs, now)
+
+    def _resolver_roles(self, info):
+        """Live current-epoch resolver roles from the CC's registry
+        (the same walk _health_messages does)."""
+        from .resolver_role import Resolver
+        ep = info.epoch
+        for wi in self.cc.workers.values():
+            if not wi.worker.process.alive:
+                continue
+            for rn, role in wi.worker.roles.items():
+                if isinstance(role, Resolver) and f"-e{ep}-" in rn:
+                    yield rn, role
+
+    def _decide(self, tps, batch_tps, reason, inputs, now):
+        """Record the decision (rate + batch rate + limiting reason +
+        every input signal) for RkUpdate tracing and status.cluster.qos,
+        then return the (tps, batch_tps) pair the update loop expects."""
+        self.last_decision = {
+            "tps": tps, "batch_tps": batch_tps,
+            "limiting_reason": reason, "inputs": inputs,
+            "computed_at": now}
+        return tps, batch_tps
 
 
 from ..rpc import wire as _wire

@@ -106,6 +106,13 @@ class Resolver:
         # conflict-aware scheduling literature presupposes exactly this
         # per-range signal)
         self.hot_spots = ConflictHotSpots()
+        # QoS saturation signals: the resolve pipeline's occupancy and
+        # forced-drain counters (PR 4) smoothed into the telemetry
+        # plane — the Ratekeeper's pipeline_occupancy throttle input.
+        # Pull model: qos_sample() reads pipeline_stats() on demand
+        self._qos_forced_rate = flow.SmoothedRate()
+        self._qos_batch_rate = flow.SmoothedRate()
+        self._qos_txn_rate = flow.SmoothedRate()
         self._pressure_traced = False
         self._actors = flow.ActorCollection()
         # reply cache for duplicate delivery (proxy retry after a broken
@@ -297,6 +304,28 @@ class Resolver:
         controller; {} for bare host backends."""
         fn = getattr(self.conflict_set, "failover_stats", None)
         return fn() if fn is not None else {}
+
+    def qos_sample(self, now: float) -> "QosSample":
+        """Saturation-signal snapshot: the resolve pipeline's window
+        accounting as smoothed QoS signals — occupancy (mean in-flight
+        over depth), in-flight now, the forced-drain rate (submits that
+        hit the depth backpressure — the 'device is not draining fast
+        enough' signal), batch/txn rates, and the history row count."""
+        from .types import QosSample
+        pipe = self.pipeline_stats()
+        snap = self.stats.snapshot()
+        return QosSample("resolver", self.process.name, now, {
+            "pipeline_occupancy": pipe.get("occupancy") or 0.0,
+            "pipeline_in_flight": pipe.get("in_flight", 0),
+            "pipeline_depth": pipe.get("depth", 1),
+            "forced_drain_rate": round(self._qos_forced_rate.sample_total(
+                pipe.get("forced_drains", 0), now), 2),
+            "batch_rate": round(self._qos_batch_rate.sample_total(
+                snap.get("batches_resolved", 0), now), 2),
+            "txn_rate": round(self._qos_txn_rate.sample_total(
+                snap.get("transactions_resolved", 0), now), 2),
+            "state_rows": self.state_size(),
+        })
 
     def state_size(self) -> int:
         """Conflict-history row estimate across backends (boundary rows

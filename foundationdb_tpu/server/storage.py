@@ -540,6 +540,14 @@ class StorageServer:
         # banded + sampled point-read latency (ref: LatencyBandConfig's
         # read bands in status)
         self.read_bands = flow.RequestLatency("read")
+        # QoS saturation signals (ref: StorageQueuingMetrics — the
+        # smoothed queue/lag/rate surface the Ratekeeper polls). Pull
+        # model: nothing here updates on the hot paths; qos_sample()
+        # reads raw state and smooths it at the collection cadence
+        self._qos_queue = flow.SmoothedQueue()
+        self._qos_lag = flow.SmoothedQueue()
+        self._qos_read_rate = flow.SmoothedRate()
+        self._qos_mutation_rate = flow.SmoothedRate()
         # byte sample + write bandwidth for DD sizing decisions
         self.metrics = StorageMetrics()
         self._actors = flow.ActorCollection()
@@ -1064,6 +1072,28 @@ class StorageServer:
         """Smoothed write bytes/sec into this shard (ref: bytesInput
         rate driving SHARD_MAX_BYTES_PER_KSEC splits)."""
         return self.metrics.write_bytes_per_sec(flow.now())
+
+    def qos_sample(self, now: float) -> "QosSample":
+        """Saturation-signal snapshot (ref: StorageQueuingMetricsReply
+        — the per-storage surface the Ratekeeper's updateRate polls):
+        smoothed MVCC-window queue bytes (pulled but not yet durable),
+        durable-version lag, and read/mutation rates. Computed on
+        demand at the collection cadence — the read/write hot paths
+        never touch any of this."""
+        from .types import QosSample, mutation_bytes as _mb
+        qbytes = sum(_mb(m) for _v, ms in self._pending for m in ms)
+        lag = max(0, self.version.get() - self.durable_version.get())
+        snap = self.stats.snapshot()
+        return QosSample("storage", self.name, now, {
+            "queue_bytes": round(self._qos_queue.sample(qbytes, now), 1),
+            "durability_lag_versions": round(
+                self._qos_lag.sample(lag, now), 1),
+            "read_rate": round(self._qos_read_rate.sample_total(
+                snap.get("get_queries", 0)
+                + snap.get("range_queries", 0), now), 2),
+            "mutation_rate": round(self._qos_mutation_rate.sample_total(
+                snap.get("mutations", 0), now), 2),
+        })
 
     def split_key_estimate(self) -> Optional[bytes]:
         """A byte-balanced interior key from the sample (ref:

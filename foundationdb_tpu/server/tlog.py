@@ -84,6 +84,13 @@ class TLog:
         self.stats = flow.CounterCollection("tlog")
         # banded + sampled commit durability latency (accept -> fsync ack)
         self.commit_bands = flow.RequestLatency("commit")
+        # QoS saturation signals (ref: TLogQueuingMetricsReply — the
+        # smoothed queue surface the Ratekeeper polls). Pull model:
+        # qos_sample() reads raw state at the collection cadence; the
+        # commit/peek hot paths never update these
+        self._qos_queue = flow.SmoothedQueue()
+        self._qos_backlog = flow.SmoothedQueue()
+        self._qos_commit_rate = flow.SmoothedRate()
         self._recovered = flow.Future()
         self._actors = flow.ActorCollection()
 
@@ -289,6 +296,23 @@ class TLog:
     async def _ack_when_durable(self, version, reply):
         await self.version.when_at_least(version)
         reply.send(self.version.get())
+
+    def qos_sample(self, now: float) -> "QosSample":
+        """Saturation-signal snapshot (ref: TLogQueuingMetricsReply):
+        smoothed unpopped queue bytes, the fsync backlog (accepted but
+        not yet durable — versions still inside the durability window),
+        queue length, and the commit rate."""
+        from .types import QosSample
+        backlog = max(0, self.queue_version.get() - self.version.get())
+        return QosSample("tlog", self.name, now, {
+            "queue_bytes": round(
+                self._qos_queue.sample(self.mem_bytes, now), 1),
+            "queue_entries": len(self.entries),
+            "fsync_backlog_versions": round(
+                self._qos_backlog.sample(backlog, now), 1),
+            "commit_rate": round(self._qos_commit_rate.sample_total(
+                self.stats.counter("commits").value, now), 2),
+        })
 
     # -- lock (epoch end) ----------------------------------------------
     async def _lock_loop(self):

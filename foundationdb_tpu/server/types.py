@@ -92,6 +92,12 @@ class CommitRequest(NamedTuple):
     # REPORT_CONFLICTING_KEYS transaction option,
     # fdbclient/CommitTransaction.h report_conflicting_keys flag)
     report_conflicting_keys: bool = False
+    # admission priority class + client-supplied transaction tags (ref:
+    # TransactionPriority and the TagSet riding
+    # CommitTransactionRequest — the proxy's per-tag/priority traffic
+    # accounting, and later tag throttling, keys off these)
+    priority: int = 1          # PRIORITY_DEFAULT
+    tags: Tuple[bytes, ...] = ()
 
 
 class CommitReply(NamedTuple):
@@ -265,6 +271,21 @@ class ResolutionMetricsReply(NamedTuple):
 class TLogLockReply(NamedTuple):
     end_version: int        # highest durable version in this log
     known_committed: int    # highest version known replicated log-set-wide
+
+
+class QosSample(NamedTuple):
+    """One role's saturation-signal snapshot for the QoS telemetry
+    plane (ref: the StorageQueuingMetricsReply / TLogQueuingMetricsReply
+    the reference Ratekeeper polls — smoothed queue bytes, durability
+    lag, input rates). `signals` maps signal name -> smoothed value;
+    the signal inventory per role kind is pinned by
+    tests/test_qos_telemetry.py and documented in README's QoS
+    telemetry section."""
+
+    kind: str          # storage | tlog | proxy | resolver
+    name: str          # role instance name
+    sampled_at: float  # sim time of this sample
+    signals: dict      # signal name -> value (floats/ints)
 
 from ..rpc import wire as _wire
 

@@ -362,6 +362,133 @@ class Serializability:
         return self.stats
 
 
+class OpenLoopStorm:
+    """Open-loop Zipfian burst workload (ref: the reference's stress
+    workloads + ROADMAP item 3's admission-control storm): transaction
+    arrivals follow a SEEDED exponential process whose rate is
+    independent of completions — closed-loop clients self-throttle when
+    the cluster slows, an open-loop storm keeps pushing, which is the
+    load shape that exposes saturation and exercises the Ratekeeper.
+    Keys are Zipfian (hot keys → real conflicts and hot shards); a
+    configurable slice of traffic runs at batch priority and every
+    simulated client carries a transaction tag, so one storm drives
+    the whole QoS accounting plane (per-role signals, tag/priority
+    counts, RkUpdate limiting reasons).
+
+    `dbs` is the pool of client handles standing in for the client
+    population; arrivals round-robin across it. In-flight transactions
+    are capped at `max_inflight` (arrivals past the cap are counted as
+    `shed`, not silently dropped), bounding sim memory while keeping
+    the arrival process open-loop."""
+
+    def __init__(self, dbs, rng, duration: float = 4.0,
+                 rate: float = 150.0, burst_rate: float = 800.0,
+                 burst_start: float = 1.0, burst_len: float = 1.5,
+                 keyspace: int = 64, zipf_s: float = 1.2,
+                 prefix: bytes = b"storm/", batch_fraction: float = 0.2,
+                 tags: tuple = (b"web", b"batchjob", b"mobile"),
+                 max_inflight: int = 512):
+        import math
+        self.dbs = list(dbs)
+        self.rng = rng
+        self.duration = duration
+        self.rate = rate
+        self.burst_rate = burst_rate
+        self.burst_start = burst_start
+        self.burst_len = burst_len
+        self.keyspace = keyspace
+        self.prefix = prefix
+        self.batch_fraction = batch_fraction
+        self.tags = tuple(tags)
+        self.max_inflight = max_inflight
+        # Zipfian CDF over key ranks: weight 1/rank^s (precomputed once;
+        # sampling is one random01 + bisect)
+        weights = [1.0 / (r ** zipf_s) for r in range(1, keyspace + 1)]
+        total = sum(weights)
+        acc, cdf = 0.0, []
+        for w in weights:
+            acc += w / total
+            cdf.append(acc)
+        self._zipf_cdf = cdf
+        self._ln = math.log
+        from ..flow.latency import LatencySample
+        self.grv_latency = LatencySample("storm_grv", size=4096)
+        self.commit_latency = LatencySample("storm_commit", size=4096)
+        self.stats = {"issued": 0, "completed": 0, "conflicted": 0,
+                      "shed": 0, "errors": {}}
+        self._inflight = 0
+
+    def _zipf_key(self) -> bytes:
+        u = self.rng.random01()
+        lo, hi = 0, len(self._zipf_cdf) - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._zipf_cdf[mid] < u:
+                lo = mid + 1
+            else:
+                hi = mid
+        return self.prefix + b"k%04d" % lo
+
+    async def _one_txn(self, i: int) -> None:
+        db = self.dbs[i % len(self.dbs)]
+        tr = db.create_transaction()
+        try:
+            tr.set_option("transaction_tag", self.tags[i % len(self.tags)])
+            if self.rng.random01() < self.batch_fraction:
+                tr.set_option("priority_batch")
+            t0 = flow.now()
+            await tr.get_read_version()
+            self.grv_latency.record(flow.now() - t0)
+            k = self._zipf_key()
+            await tr.get(k)
+            tr.set(k, b"s%06d" % i)
+            t1 = flow.now()
+            await tr.commit()
+            self.commit_latency.record(flow.now() - t1)
+            self.stats["completed"] += 1
+        except flow.FdbError as e:
+            # open-loop: one attempt per arrival, no retry — a conflict
+            # or throttle-timeout is an OUTCOME the storm measures, not
+            # something to hide inside a retry loop
+            if e.name == "not_committed":
+                self.stats["conflicted"] += 1
+            else:
+                errs = self.stats["errors"]
+                errs[e.name] = errs.get(e.name, 0) + 1
+        finally:
+            self._inflight -= 1
+
+    async def run(self) -> dict:
+        start = flow.now()
+        t = start
+        outstanding = []
+        i = 0
+        while True:
+            in_burst = (self.burst_start <= (t - start)
+                        < self.burst_start + self.burst_len)
+            r = self.burst_rate if in_burst else self.rate
+            u = self.rng.random01()
+            t += -self._ln(max(1e-12, 1.0 - u)) / max(r, 1e-9)
+            if t - start >= self.duration:
+                break
+            if t > flow.now():
+                await flow.delay(t - flow.now())
+            self.stats["issued"] += 1
+            if self._inflight >= self.max_inflight:
+                self.stats["shed"] += 1
+                continue
+            self._inflight += 1
+            outstanding.append(flow.spawn(
+                self._one_txn(i), name=f"storm-txn-{i}"))
+            i += 1
+        await flow.wait_for_all(outstanding)
+        out = dict(self.stats)
+        out["grv"] = self.grv_latency.snapshot()
+        out["commit"] = self.commit_latency.snapshot()
+        out["wall_seconds"] = round(flow.now() - start, 3)
+        return out
+
+
 class FuzzApiCorrectness:
     """API-misuse fuzz (ref: FuzzApiCorrectness.actor.cpp): drive the
     client surface with invalid inputs — oversized keys/values,

@@ -35,6 +35,10 @@ Commands (ref: fdbcli):
   metrics                    counter time series (latest + rates)
   top                        hottest conflict ranges + role rates
                              (the conflict-attribution view)
+  qos                        saturation telemetry: ratekeeper budget +
+                             limiting reason, per-role queue/lag/rate
+                             signals, tag & priority traffic
+
   configure <k>=<v> ...      change the cluster shape (proxies,
                              resolvers, logs, conflict_backend)
   exclude <worker>           bar a worker from hosting roles
@@ -165,6 +169,22 @@ def _render_details(cl: dict) -> str:
         lines.append("Kernel compile/execute (process-wide):")
         for kn, v in sorted(cl["kernels"].items()):
             lines.append(f"  {kn} = {v}")
+    qos = cl.get("qos") or {}
+    if qos.get("transactions_per_second_limit") is not None:
+        # throttle posture without reaching for the exporter: the
+        # current budget, WHY it is what it is, and the smoothed
+        # inputs behind the decision (ref: fdbcli `status details`
+        # performance-limited-by section)
+        inputs = qos.get("inputs") or {}
+        lines.append("Ratekeeper:")
+        lines.append(
+            f"  tps_limit={qos['transactions_per_second_limit']:g} "
+            f"batch_tps_limit="
+            f"{(qos.get('batch_transactions_per_second_limit') or 0):g} "
+            f"limited_by={qos.get('limiting_reason', 'none')}")
+        if inputs:
+            lines.append("  inputs: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(inputs.items())))
     rl = cl.get("run_loop", {})
     if rl:
         lines.append(f"Run loop: tasks={rl.get('tasks_run')} "
@@ -234,6 +254,58 @@ def _render_top(cl: dict) -> str:
         lines.append("Busiest counters (rate/s over the sampled tail):")
         for rate, rn, cn in rows[:12]:
             lines.append(f"  {rate:>10.2f}/s  {rn}/{cn}")
+    return "\n".join(lines)
+
+
+def _render_qos(cl: dict) -> str:
+    """`qos`: the saturation-telemetry view — the ratekeeper's budget
+    and limiting reason, every role's smoothed queue/lag/rate signals,
+    and the tag/priority traffic accounting (what an operator reads
+    when the cluster feels slow and they want to know WHICH role is
+    saturated before the throttle even engages)."""
+    qos = cl.get("qos") or {}
+    lines = [
+        f"Ratekeeper: tps_limit="
+        f"{qos.get('transactions_per_second_limit')} "
+        f"batch_tps_limit="
+        f"{qos.get('batch_transactions_per_second_limit')} "
+        f"limited_by={qos.get('limiting_reason', 'none')}"]
+    inputs = qos.get("inputs") or {}
+    if inputs:
+        lines.append("Decision inputs:")
+        for k, v in sorted(inputs.items()):
+            lines.append(f"  {k:<36} {v}")
+    roles = qos.get("roles") or {}
+    for kind in ("storage", "tlog", "proxy", "resolver"):
+        if kind not in roles:
+            continue
+        lines.append(f"{kind.capitalize()} signals:")
+        for rname, signals in sorted(roles[kind].items()):
+            sig = "  ".join(f"{k}={v}" for k, v in sorted(signals.items())
+                            if k != "sampled_at")
+            lines.append(f"  {rname:<26} {sig}")
+    if not roles:
+        lines.append("(no QoS samples yet — is QOS_SAMPLE_INTERVAL 0?)")
+    tags = qos.get("tags") or ()
+    lines.append("Tag traffic (decaying busyness):")
+    for row in tags:
+        lines.append(
+            f"  {row['tag']:<20} busyness={row['busyness']:<10g} "
+            f"started={row['started']} committed={row['committed']} "
+            f"conflicted={row['conflicted']}")
+    if not tags:
+        lines.append("  (no tagged transactions)")
+    prios = qos.get("priorities") or {}
+    if prios:
+        lines.append("Priority classes:")
+        for prio in ("immediate", "default", "batch"):
+            c = prios.get(prio)
+            if c is None:
+                continue
+            lines.append(
+                f"  {prio:<10} started={c['started']} "
+                f"committed={c['committed']} "
+                f"conflicted={c['conflicted']}")
     return "\n".join(lines)
 
 
@@ -359,6 +431,10 @@ class Cli:
             async def tp():
                 return await self.db.get_status()
             return _render_top(self._run(tp())["cluster"])
+        if cmd == "qos":
+            async def qs():
+                return await self.db.get_status()
+            return _render_qos(self._run(qs())["cluster"])
         if cmd == "status":
             async def st():
                 return await self.db.get_status()

@@ -133,9 +133,52 @@ def render_prometheus(status: dict) -> str:
     f.add(f"{_PREFIX}_cluster_recovered", "gauge",
           "1 when recovery_state is fully_recovered", {},
           int(cl.get("recovery_state") == "fully_recovered"))
-    tps = (cl.get("qos") or {}).get("transactions_per_second_limit")
+    qos = cl.get("qos") or {}
     f.add(f"{_PREFIX}_qos_transactions_per_second_limit", "gauge",
-          "Ratekeeper transaction budget", {}, tps)
+          "Ratekeeper transaction budget", {},
+          qos.get("transactions_per_second_limit"))
+    f.add(f"{_PREFIX}_qos_batch_transactions_per_second_limit", "gauge",
+          "Ratekeeper batch-priority transaction budget", {},
+          qos.get("batch_transactions_per_second_limit"))
+    # the limiting reason as a one-hot family: exactly one reason label
+    # carries 1 (an enum gauge dashboards can alert on without string
+    # parsing); the enum matches server/ratekeeper.py LIMIT_REASONS
+    reason = qos.get("limiting_reason")
+    if reason is not None:
+        from ..server.ratekeeper import LIMIT_REASONS
+        for r in LIMIT_REASONS:
+            f.add(f"{_PREFIX}_qos_limiting_reason", "gauge",
+                  "Active Ratekeeper limiting reason (one-hot)",
+                  {"reason": r}, int(r == reason))
+    for iname, val in sorted((qos.get("inputs") or {}).items()):
+        f.add(f"{_PREFIX}_qos_input", "gauge",
+              "Ratekeeper decision input signals (RkUpdate)",
+              {"input": iname}, val)
+    # per-role smoothed saturation signals (the QosSample plane)
+    for kind, roles in sorted((qos.get("roles") or {}).items()):
+        for rname, signals in sorted(roles.items()):
+            for sname, val in sorted(signals.items()):
+                if sname == "sampled_at":
+                    continue
+                f.add(f"{_PREFIX}_qos_signal", "gauge",
+                      "Per-role smoothed saturation signals",
+                      {"kind": kind, "role": rname, "signal": sname},
+                      val)
+    # per-tag traffic accounting (the TransactionTagCounter surface)
+    for row in qos.get("tags", ()):
+        tl = {"tag": row["tag"]}
+        f.add(f"{_PREFIX}_tag_busyness", "gauge",
+              "Decayed per-tag commit-traffic score", tl,
+              row.get("busyness"))
+        for c in ("started", "committed", "conflicted"):
+            f.add(f"{_PREFIX}_tag_transactions", "counter",
+                  "Per-tag transaction outcomes at the proxies",
+                  {**tl, "outcome": c}, row.get(c))
+    for prio, counts in sorted((qos.get("priorities") or {}).items()):
+        for c in ("started", "committed", "conflicted"):
+            f.add(f"{_PREFIX}_qos_priority_transactions", "counter",
+                  "Per-priority-class transaction outcomes",
+                  {"priority": prio, "outcome": c}, counts.get(c))
 
     for p in cl.get("proxies", ()):
         _add_counters(f, "proxy", p["name"], p.get("counters"))

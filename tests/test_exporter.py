@@ -18,7 +18,34 @@ def _canned_status():
     return {"cluster": {
         "epoch": 3,
         "recovery_state": "fully_recovered",
-        "qos": {"transactions_per_second_limit": 1000.0},
+        "qos": {
+            "transactions_per_second_limit": 1000.0,
+            "batch_transactions_per_second_limit": 500.0,
+            "limiting_reason": "storage_queue",
+            "inputs": {"worst_storage_queue_bytes": 2048.5,
+                       "worst_tlog_queue_bytes": 100.0,
+                       "worst_durability_lag_versions": 0,
+                       "pipeline_occupancy": 0.75,
+                       "pipeline_forced_drain_rate": 1.25,
+                       "dead_replicas": 0},
+            "roles": {
+                "storage": {"storage-0-r0": {
+                    "queue_bytes": 2048.5, "durability_lag_versions": 3.0,
+                    "read_rate": 12.5, "mutation_rate": 40.0,
+                    "sampled_at": 9.5}},
+                "proxy": {"proxy-e3-0": {
+                    "grv_queue_depth": 1.5, "commit_batch_occupancy": 4.0,
+                    "resolve_in_flight": 2, "grv_rate": 80.0,
+                    "commit_rate": 75.0, "tps_budget": 1000.0,
+                    "sampled_at": 9.5}}},
+            "tags": [{"tag": "776562", "busyness": 3.5, "started": 10,
+                      "committed": 8, "conflicted": 2}],
+            "priorities": {
+                "batch": {"started": 3, "committed": 2, "conflicted": 0},
+                "default": {"started": 9, "committed": 8,
+                            "conflicted": 1},
+                "immediate": {"started": 0, "committed": 0,
+                              "conflicted": 0}}},
         "proxies": [{
             "name": "proxy-e3-0",
             "counters": {"transactions_committed": 42,
@@ -80,6 +107,54 @@ def test_render_is_parseable_and_covers_roles():
              if n == "fdbtpu_role_counter"}
     assert {"proxy-e3-0", "resolver-e3-0", "tlog-e3-0",
             "storage-0-r0"} <= roles
+
+
+def test_qos_and_tag_families_round_trip():
+    """The PR 6 QoS plane through the parser round trip: budgets, the
+    one-hot limiting-reason enum, RkUpdate input signals, the per-role
+    QosSample surface, and the tag/priority traffic families — every
+    value must survive render -> parse bit-exactly, with no duplicate
+    (name, labelset) pairs (already pinned suite-wide above)."""
+    qos = _canned_status()["cluster"]["qos"]
+    samples = parse_prometheus(render_prometheus(_canned_status()))
+    names = {n for n, _, _ in samples}
+    for need in ("fdbtpu_qos_transactions_per_second_limit",
+                 "fdbtpu_qos_batch_transactions_per_second_limit",
+                 "fdbtpu_qos_limiting_reason", "fdbtpu_qos_input",
+                 "fdbtpu_qos_signal", "fdbtpu_tag_busyness",
+                 "fdbtpu_tag_transactions",
+                 "fdbtpu_qos_priority_transactions"):
+        assert need in names, (need, sorted(names))
+    # limiting reason is a one-hot enum over the full vocabulary
+    from foundationdb_tpu.server.ratekeeper import LIMIT_REASONS
+    hot = {l["reason"]: v for n, l, v in samples
+           if n == "fdbtpu_qos_limiting_reason"}
+    assert set(hot) == set(LIMIT_REASONS)
+    assert hot["storage_queue"] == 1 and sum(hot.values()) == 1
+    # every decision input rides with its value intact
+    inputs = {l["input"]: v for n, l, v in samples
+              if n == "fdbtpu_qos_input"}
+    assert inputs == qos["inputs"]
+    # per-role signals keep (kind, role, signal) labels; sampled_at is
+    # bookkeeping, not a metric
+    sig = {(l["kind"], l["role"], l["signal"]): v
+           for n, l, v in samples if n == "fdbtpu_qos_signal"}
+    assert sig[("storage", "storage-0-r0", "queue_bytes")] == 2048.5
+    assert sig[("proxy", "proxy-e3-0", "commit_batch_occupancy")] == 4.0
+    assert not any(s == "sampled_at" for _k, _r, s in sig)
+    assert len(sig) == 10    # 4 storage + 6 proxy signals
+    # tag family: busyness gauge + one counter per outcome
+    (busy,) = [v for n, l, v in samples
+               if n == "fdbtpu_tag_busyness" and l["tag"] == "776562"]
+    assert busy == 3.5
+    tag_counts = {l["outcome"]: v for n, l, v in samples
+                  if n == "fdbtpu_tag_transactions"
+                  and l["tag"] == "776562"}
+    assert tag_counts == {"started": 10, "committed": 8, "conflicted": 2}
+    prio = {(l["priority"], l["outcome"]): v for n, l, v in samples
+            if n == "fdbtpu_qos_priority_transactions"}
+    assert prio[("default", "committed")] == 8
+    assert prio[("immediate", "started")] == 0   # zeros still emitted
 
 
 def test_histogram_buckets_are_cumulative_with_inf():
