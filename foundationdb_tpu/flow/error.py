@@ -99,10 +99,14 @@ _define("internal_error", 4100, "An internal error occurred")
 _RETRYABLE = frozenset({1007, 1009, 1020, 1021, 1037, 1038})
 
 
-def error(name: str) -> FdbError:
-    """Construct a fresh error instance by name, e.g. ``error("not_committed")``."""
+def error(name: str, message: str = "") -> FdbError:
+    """Construct a fresh error instance by name, e.g. ``error("not_committed")``.
+
+    ``message`` overrides the registry's default text (the code always
+    comes from the registry, so diagnosis-carrying errors stay
+    numerically identical to plain ones)."""
     code, msg = _REGISTRY[name]
-    return FdbError(name, code, msg)
+    return FdbError(name, code, message or msg)
 
 
 class ActorCancelled(FdbError):

@@ -242,6 +242,46 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("BACKUP_DRIVER_POLL_INTERVAL", 0.25, lambda: 0.05)
     init("BACKUP_DRIVER_UPLOAD_INTERVAL", 1.0, lambda: 0.2)
 
+    # -- cluster chaos (ref: sim2.actor.cpp swizzling/clogging/kill
+    # workloads; server/chaos.py scenario storms) ----------------------
+    # how long a partition_minority scenario keeps the machine sets
+    # separated before healing
+    init("CHAOS_PARTITION_SECONDS", 4.0, lambda: 8.0)
+    # per-link swizzle window: while swizzled, messages draw extra
+    # reorder latency and one-way datagrams may duplicate
+    init("CHAOS_SWIZZLE_SECONDS", 1.5, lambda: 4.0)
+    # extra latency spread on a swizzled link (uniform draw added per
+    # message — far wider than SIM_LATENCY_MAX, so delivery order
+    # genuinely scrambles)
+    init("CHAOS_SWIZZLE_LATENCY", 0.25, lambda: 1.0)
+    # probability a one-way datagram on a swizzled link delivers twice
+    # (receivers must be idempotent; request/reply pairs never
+    # duplicate — the transport models a TCP-like connection)
+    init("CHAOS_SWIZZLE_DUP_PROB", 0.25)
+    # bytes flipped by a raw sector-corruption injection
+    init("CHAOS_CORRUPT_BYTES", 4)
+    # kill rounds driven by the kill_mid_commit / recovery-storm
+    # scenarios
+    init("CHAOS_KILL_ROUNDS", 3, lambda: 5)
+    # sim-seconds a storm allows between HEAL and a quiesced,
+    # consistency-clean cluster (the bounded-recovery oracle)
+    init("CHAOS_RECOVERY_BOUND", 120.0)
+    # probability that the LAST surviving unsynced write is torn (a
+    # seeded prefix survives instead of the whole write) at power loss
+    # — the in-flight write at the instant the power fails (ref:
+    # AsyncFileNonDurable's partial-write mode). Recovery must already
+    # tolerate arbitrary tail damage (CRC cut), so this is on by
+    # default and amplified under BUGGIFY
+    init("SIM_TORN_WRITE_PROB", 0.25, lambda: 0.75)
+    # a critical transaction-subsystem process unreachable (ping-failed
+    # but alive — a partitioned or wedged machine) for this long ends
+    # the epoch exactly like a death (ref: waitFailure heartbeats — the
+    # reference's failure detection is network-based, so partitions
+    # trigger real recoveries). Deliberately above every ordinary
+    # BUGGIFY clog window so transient clogging never thrashes epochs;
+    # never buggified for the same reason
+    init("FAILURE_UNREACHABLE_SECONDS", 2.0)
+
     # -- simulation environment (ref: sim2 latency/reboot model) -------
     init("SIM_REBOOT_DELAY", 0.5, lambda: 5.0)
     init("QUIET_DATABASE_POLL", 0.25)

@@ -101,14 +101,56 @@ class SimFile:
     def _power_loss(self, rng) -> None:
         """Each unsynced write independently survives or vanishes — the
         OS may or may not have flushed it (ref: AsyncFileNonDurable
-        KILLED mode). Ordering of survivors is preserved."""
+        KILLED mode). Ordering of survivors is preserved. The LAST
+        surviving write — the one in flight when the power failed — may
+        additionally be TORN: only a seeded prefix of it lands
+        (SIM_TORN_WRITE_PROB; ref: AsyncFileNonDurable's partial-write
+        mode), so recovery code is exercised against genuinely
+        half-written records, not just whole-write drops."""
         from ..flow import SERVER_KNOBS
-        for offset, data in self._pending:
-            # survives with probability (1 - drop_prob)
-            if rng.random01() >= SERVER_KNOBS.sim_power_loss_drop_prob:
-                self._apply(offset, data)
+        survivors = [(offset, data) for offset, data in self._pending
+                     if rng.random01() >= SERVER_KNOBS.sim_power_loss_drop_prob]
+        for i, (offset, data) in enumerate(survivors):
+            if (data is not None and len(data) > 1
+                    and i == len(survivors) - 1
+                    and rng.random01() < SERVER_KNOBS.sim_torn_write_prob):
+                from ..flow import cover
+                cover("disk.torn_write")
+                data = data[:rng.random_int(1, len(data))]
+                if self.disk.net is not None:
+                    self.disk.net.chaos_note("torn_write", file=self.name,
+                                             machine=self.disk.machine)
+            self._apply(offset, data)
         self._pending.clear()
         self._open = False
+
+    def corrupt(self, rng, n_bytes: int = None) -> list:
+        """Seeded sector rot: flip bytes in the DURABLE image (the
+        bytes a recovery will read). Returns [(offset, old, new)].
+        Detection is the reader's job, and depends on where the flip
+        lands: a payload hit in a checksummed format (DiskQueue)
+        surfaces as checksum_failed at recovery, while a header hit is
+        indistinguishable from a torn tail and gets CRC-cut — acked
+        data past it must then be re-healed from replication. Tests
+        that need a GUARANTEED-detectable (or guaranteed-undetectable)
+        flip use the format-aware server/chaos.py helpers instead."""
+        from ..flow import SERVER_KNOBS
+        if n_bytes is None:
+            n_bytes = int(SERVER_KNOBS.chaos_corrupt_bytes)
+        if not self._durable:
+            return []
+        flips = []
+        for _ in range(n_bytes):
+            off = rng.random_int(0, len(self._durable))
+            old = self._durable[off]
+            new = old ^ rng.random_int(1, 256)   # guaranteed to differ
+            self._durable[off] = new
+            flips.append((off, old, new))
+        if self.disk.net is not None:
+            self.disk.net.chaos_note("disk_corruption", file=self.name,
+                                     machine=self.disk.machine,
+                                     bytes=len(flips))
+        return flips
 
     def _close(self) -> None:
         self._open = False
@@ -138,6 +180,13 @@ class SimDisk:
 
     def exists(self, name: str) -> bool:
         return name in self.files
+
+    def corrupt_file(self, name: str, rng, n_bytes: int = None) -> list:
+        """Sector-rot a named file's durable bytes (see SimFile.corrupt)."""
+        f = self.files.get(name)
+        if f is None:
+            return []
+        return f.corrupt(rng, n_bytes)
 
     def remove(self, name: str) -> None:
         """Destroy a file (store retirement)."""

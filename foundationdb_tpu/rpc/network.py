@@ -1,4 +1,5 @@
-"""Deterministic simulated network: processes, endpoints, kills, clogs.
+"""Deterministic simulated network: processes, endpoints, kills, clogs,
+partitions, and swizzled links.
 
 Reference behaviors re-implemented (not ported):
   - token-addressed delivery to typed request streams
@@ -7,7 +8,20 @@ Reference behaviors re-implemented (not ported):
     network with its own latency (fdbrpc/fdbrpc.h ReplyPromise /
     networksender.actor.h)
   - simulated latency per message and clogged links
-    (fdbrpc/sim2.actor.cpp:127-160 SimClogging, :176 Sim2Conn)
+    (fdbrpc/sim2.actor.cpp:127-160 SimClogging, :176 Sim2Conn), plus
+    one-sided send/recv clogs (clogSendFor/clogRecvFor) that apply to
+    in-flight REPLIES too — a reply's latency is drawn at reply time,
+    so clogging after the request went out still delays the answer
+  - bidirectional machine-set partitions with healing: while
+    partitioned, a crossing message never arrives and its reply breaks
+    after the wire latency, exactly like a connection reset — failure
+    detection (which pings over this network) therefore sees a
+    partitioned machine as down (ref: sim2's connection-failure
+    injection + the partition workloads)
+  - per-link "swizzle": a window during which messages on the link draw
+    pathological extra latency (aggressive reordering) and one-way
+    datagrams may be delivered twice (ref: the swizzled-clogging
+    workloads, sim2.actor.cpp)
   - process kill semantics: in-flight requests and replies owned by the
     dead process break; new sends to it hang until failure detection or
     break immediately, per knob (fdbrpc/sim2.actor.cpp:1222
@@ -16,7 +30,10 @@ Reference behaviors re-implemented (not ported):
   - machine model grouping processes (fdbrpc/simulator.h:47-147)
 
 Everything randomized draws from the flow deterministic RNG, so a seed
-replays the identical message schedule.
+replays the identical message schedule. Every injected fault is
+recorded in `chaos_log`/`chaos_counters` (see `chaos_note`): the same
+seed must produce the identical fault schedule, and the chaos tests pin
+that by comparing the logs of two runs.
 """
 
 from __future__ import annotations
@@ -159,9 +176,44 @@ class SimNetwork:
         self.disk_factory = None
         # (src_machine, dst_machine) -> unclog time
         self._clogged: Dict[Tuple[str, str], float] = {}
+        # one-sided clogs: machine -> unclog time (ref: clogSendFor /
+        # clogRecvFor, sim2.actor.cpp)
+        self._clog_send: Dict[str, float] = {}
+        self._clog_recv: Dict[str, float] = {}
+        # (src_machine, dst_machine) -> swizzle-window end time
+        self._swizzled: Dict[Tuple[str, str], float] = {}
+        # partition id -> (machine set A, machine set B); messages
+        # crossing any live partition never arrive
+        self._partitions: Dict[int, Tuple[frozenset, frozenset]] = {}
+        self._next_partition = 0
         self.messages_sent = 0
         self.messages_dropped = 0
+        self.messages_duplicated = 0
+        # the chaos plane's deterministic fault record: every injected
+        # fault appends (sim_time, kind, detail) here and bumps a
+        # counter — the seed-replay tests compare two runs' logs, and
+        # status.cluster.chaos surfaces the counters (bounded so a long
+        # attrition run cannot grow memory without bound)
+        self.chaos_log: list = []
+        self.chaos_counters: Dict[str, int] = {}
+        self.chaos_scenarios: Dict[str, int] = {}
+        self.chaos_log_max = 4096
+        self.chaos_log_dropped = 0
         self.disks: Dict[str, "SimDisk"] = {}
+
+    def chaos_note(self, kind: str, **detail) -> None:
+        """Record one injected fault (the shared chaos accounting every
+        primitive feeds — see server/chaos.py for the merged schema)."""
+        self.chaos_counters[kind] = self.chaos_counters.get(kind, 0) + 1
+        if len(self.chaos_log) < self.chaos_log_max:
+            self.chaos_log.append(
+                (round(self.sched.now(), 6), kind, detail))
+        else:
+            self.chaos_log_dropped += 1
+        from ..flow import trace
+        trace.TraceEvent("ChaosEvent", severity=trace.SevWarnAlways) \
+            .detail(Kind=kind, **{k.capitalize(): v
+                                  for k, v in detail.items()}).log()
 
     # -- topology -------------------------------------------------------
     def new_process(self, name: str, machine: str = "", zone: str = "",
@@ -183,6 +235,9 @@ class SimNetwork:
         kills take out all co-located processes and their unsynced
         writes in one power-loss event). Returns the killed names."""
         victims = self.processes_on(machine)
+        if victims:
+            self.chaos_note("machine_power_loss", machine=machine,
+                            victims=len(victims))
         for p in victims:
             self.kill(p)
         return [p.name for p in victims]
@@ -235,6 +290,8 @@ class SimNetwork:
         AsyncFileNonDurable power-loss semantics)."""
         if not process.alive:
             return
+        self.chaos_note("kill", process=process.name,
+                        machine=process.machine)
         process.alive = False
         for fn in process._on_kill:
             fn()
@@ -253,6 +310,7 @@ class SimNetwork:
         (ref: simulatedFDBDRebooter, SimulatedCluster.actor.cpp:194)."""
         old = self.processes[name]
         self.kill(old)
+        self.chaos_note("reboot", process=name, machine=old.machine)
         return self.new_process(name, old.machine, old.zone, old.dc)
 
     def clog_pair(self, a: str, b: str, seconds: float) -> None:
@@ -261,19 +319,96 @@ class SimNetwork:
         until = self.sched.now() + seconds
         for k in ((a, b), (b, a)):
             self._clogged[k] = max(self._clogged.get(k, 0.0), until)
+        self.chaos_note("clog_pair", a=a, b=b, seconds=round(seconds, 6))
+
+    def clog_send(self, machine: str, seconds: float) -> None:
+        """Delay everything the machine SENDS until now+seconds,
+        replies included — a reply's latency is drawn at reply time, so
+        an in-flight request's answer honors a clog installed after the
+        request went out (ref: clogSendFor, sim2.actor.cpp)."""
+        until = self.sched.now() + seconds
+        self._clog_send[machine] = max(
+            self._clog_send.get(machine, 0.0), until)
+        self.chaos_note("clog_send", machine=machine,
+                        seconds=round(seconds, 6))
+
+    def clog_recv(self, machine: str, seconds: float) -> None:
+        """Delay everything the machine RECEIVES until now+seconds
+        (ref: clogRecvFor, sim2.actor.cpp)."""
+        until = self.sched.now() + seconds
+        self._clog_recv[machine] = max(
+            self._clog_recv.get(machine, 0.0), until)
+        self.chaos_note("clog_recv", machine=machine,
+                        seconds=round(seconds, 6))
+
+    def partition(self, machines, others=None) -> int:
+        """Bidirectional partition: no message crosses between the two
+        machine sets until heal(). `others` defaults to every machine
+        not in `machines` — including coordinators, the CC, and
+        clients, so isolating a minority really isolates it. Crossing
+        requests break (broken_promise) after the wire latency, like a
+        reset connection, which is what failure detection keys on.
+        Returns a partition id for heal()."""
+        a = frozenset(machines)
+        if others is None:
+            others = {p.machine for p in self.processes.values()} - a
+        b = frozenset(others) - a
+        pid = self._next_partition
+        self._next_partition += 1
+        self._partitions[pid] = (a, b)
+        self.chaos_note("partition", id=pid, minority=sorted(a),
+                        majority_size=len(b))
+        return pid
+
+    def heal(self, pid: Optional[int] = None) -> None:
+        """Remove one partition (or all of them)."""
+        if pid is None:
+            healed = sorted(self._partitions)
+            self._partitions.clear()
+        else:
+            healed = [pid] if self._partitions.pop(pid, None) else []
+        for h in healed:
+            self.chaos_note("heal", id=h)
+
+    def partitioned(self, m1: str, m2: str) -> bool:
+        for a, b in self._partitions.values():
+            if (m1 in a and m2 in b) or (m1 in b and m2 in a):
+                return True
+        return False
+
+    def swizzle(self, a: str, b: str, seconds: float = None) -> None:
+        """Open a swizzle window on the link: messages draw extra
+        reorder latency (CHAOS_SWIZZLE_LATENCY spread) and one-way
+        datagrams may deliver twice, until the window expires."""
+        from ..flow import SERVER_KNOBS
+        if seconds is None:
+            seconds = SERVER_KNOBS.chaos_swizzle_seconds
+        until = self.sched.now() + seconds
+        for k in ((a, b), (b, a)):
+            self._swizzled[k] = max(self._swizzled.get(k, 0.0), until)
+        self.chaos_note("swizzle", a=a, b=b, seconds=round(seconds, 6))
+
+    def _swizzled_now(self, src: SimProcess, dst: SimProcess) -> bool:
+        until = self._swizzled.get((src.machine, dst.machine), 0.0)
+        return until > self.sched.now()
 
     def _delivery_delay(self, src: SimProcess, dst: SimProcess) -> float:
+        from ..flow import SERVER_KNOBS
         lat = self.min_latency + self.rng.random01() * (
             self.max_latency - self.min_latency)
         if buggify("net/extra_latency"):
             # occasional pathological latency: reorders far more
             # aggressively than the uniform draw (ref: sim2's BUGGIFY'd
             # connection delays)
-            from ..flow import SERVER_KNOBS
             lat += self.rng.random01() * SERVER_KNOBS.sim_clog_extra_latency
-        key = (src.machine, dst.machine)
-        unclog = self._clogged.get(key, 0.0)
+        if self._swizzled_now(src, dst):
+            # swizzled link: a wide uniform draw scrambles delivery
+            # order far beyond the base latency jitter
+            lat += self.rng.random01() * SERVER_KNOBS.chaos_swizzle_latency
         now = self.sched.now()
+        unclog = max(self._clogged.get((src.machine, dst.machine), 0.0),
+                     self._clog_send.get(src.machine, 0.0),
+                     self._clog_recv.get(dst.machine, 0.0))
         if unclog > now:
             lat += unclog - now
         return lat
@@ -288,11 +423,19 @@ class SimNetwork:
         return reply.future
 
     def send_oneway(self, src: SimProcess, dst: Endpoint, request) -> None:
+        from ..flow import SERVER_KNOBS
         request = self._wire(request)
         self._deliver(src, dst, (request, None), None)
         if buggify("net/duplicate_oneway"):
             # best-effort datagrams may be delivered twice (receivers
             # must be idempotent, e.g. TLog pops)
+            self._deliver(src, dst, (request, None), None)
+        elif self._swizzled_now(src, dst.process) and \
+                self.rng.random01() < SERVER_KNOBS.chaos_swizzle_dup_prob:
+            # a swizzled link duplicates datagrams too — each copy
+            # draws its own (scrambled) latency, so the duplicate may
+            # arrive FIRST
+            self.messages_duplicated += 1
             self._deliver(src, dst, (request, None), None)
 
     def _deliver(self, src: SimProcess, dst: Endpoint, item,
@@ -301,6 +444,21 @@ class SimNetwork:
         if not src.alive:
             return  # a dead process sends nothing
         delay = self._delivery_delay(src, dst.process)
+        if self.partitioned(src.machine, dst.process.machine):
+            # the message never crosses; the requester sees a reset
+            # after the wire latency (ref: sim2 failing the connection —
+            # NOT an instant error, or partitions would be cheaper than
+            # real ones and failure detection would look too good)
+            self.messages_dropped += 1
+            if reply is not None:
+                timer = self.sched.delay(delay, TaskPriority.DEFAULT_ENDPOINT)
+
+                def on_reset(_f, reply=reply):
+                    if not reply.is_set:
+                        reply.send_error(error("broken_promise"))
+
+                timer.on_ready(on_reset)
+            return
         timer = self.sched.delay(delay, TaskPriority.DEFAULT_ENDPOINT)
 
         def on_time(_f):
@@ -336,6 +494,13 @@ class _NetReply:
         self.dst = dst      # the original requester
         self.promise = promise
 
+    def _partitioned(self) -> bool:
+        """A reply crossing a live partition never lands: break the
+        requester's promise after the wire latency instead (the same
+        reset a dropped request sees — in-flight replies honor
+        partitions and clogs installed after the request went out)."""
+        return self.net.partitioned(self.owner.machine, self.dst.machine)
+
     def send(self, value=None) -> None:
         if self.promise.is_set:
             return
@@ -345,9 +510,16 @@ class _NetReply:
         delay = self.net._delivery_delay(self.owner, self.dst)
         timer = self.net.sched.delay(delay, TaskPriority.DEFAULT_PROMISE_ENDPOINT)
         p = self.promise
+        if self._partitioned():
+            self.net.messages_dropped += 1
+            value = _PARTITION_RESET
 
         def on_time(_f, p=p, value=value):
-            if not p.is_set:
+            if p.is_set:
+                return
+            if value is _PARTITION_RESET:
+                p.send_error(error("broken_promise"))
+            else:
                 p.send(value)
 
         timer.on_ready(on_time)
@@ -357,6 +529,9 @@ class _NetReply:
             return
         if not self.owner.alive:
             return
+        if self._partitioned():
+            self.net.messages_dropped += 1
+            err = error("broken_promise")
         delay = self.net._delivery_delay(self.owner, self.dst)
         timer = self.net.sched.delay(delay, TaskPriority.DEFAULT_PROMISE_ENDPOINT)
         p = self.promise
@@ -366,3 +541,6 @@ class _NetReply:
                 p.send_error(err)
 
         timer.on_ready(on_time)
+
+
+_PARTITION_RESET = object()

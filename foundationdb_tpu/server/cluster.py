@@ -24,6 +24,11 @@ from .worker import RegisterWorkerRequest, Worker
 
 # seconds before a killed worker restarts: see SIM_REBOOT_DELAY knob
 
+#: seed of the most recently constructed simulation — the test
+#: harness's failure hook reads it to print a one-line seed-replay
+#: repro for any red sim test (tests/conftest.py)
+last_sim_seed: Optional[int] = None
+
 
 class SimCluster:
     def __init__(self, seed: int = 0, conflict_backend: str = "python",
@@ -68,11 +73,17 @@ class SimCluster:
             self.sched = share_with.sched
             self.net = share_with.net
         else:
+            global last_sim_seed
+            last_sim_seed = seed
             flow.set_seed(seed, buggify_enabled=buggify)
             # knob distortion rides the same switch as BUGGIFY (ref:
             # `if (randomize && BUGGIFY)` in Knobs.cpp); always re-init
             # so a prior run's distorted knobs never leak into this one
             flow.reset_server_knobs(randomize=buggify)
+            # a previous simulation's armed chaos station hooks must
+            # never leak into this one (process-global, like the knobs)
+            from .chaos import clear_stations
+            clear_stations()
             # virtual=False runs the same cluster on the wall clock so
             # real-socket peers (the TCP gateway + C binding) can attach
             self.sched = flow.Scheduler(start_time=start_time,
@@ -319,7 +330,52 @@ class SimCluster:
                         CommitRequest(0, (), (), ()),
                         self.cc.process), 1.0))
             await flow.delay(flow.SERVER_KNOBS.quiet_database_poll)
-        raise flow.error("timed_out")
+        diag = self._quiet_diagnosis()
+        flow.TraceEvent("QuietDatabaseTimeout", self.cc.process.name,
+                        severity=flow.trace.SevWarnAlways).detail(
+            MaxWait=max_wait, Diagnosis=diag).log()
+        raise flow.error("timed_out",
+                         f"quiet_database timed out after {max_wait}s: "
+                         + diag)
+
+    def _quiet_diagnosis(self) -> str:
+        """WHY the cluster never quiesced: which condition failed, and
+        which roles/counters are behind — a hung chaos storm is
+        triagable from the error message alone, not from a debugger."""
+        parts = []
+        info = self.cc.dbinfo.get()
+        if info.recovery_state != "fully_recovered":
+            parts.append(f"recovery_state={info.recovery_state} "
+                         f"(epoch {info.epoch})")
+        logs = self.cc.tlog_objs()
+        if not logs:
+            parts.append("no live current-generation tlogs")
+        frontier = max((t.version.get() for t in logs), default=0)
+        undrained = [(lr.store, len(obj.entries))
+                     for lr in info.logs.logs
+                     for wi in self.cc.workers.values()
+                     for obj in (wi.worker.roles.get(lr.store),)
+                     if obj is not None and obj.process.alive
+                     and len(obj.entries) > 0]
+        for store, n in undrained:
+            parts.append(f"tlog {store} holds {n} unpopped entries")
+        for s in info.storages:
+            for rep in s.replicas:
+                obj = self.cc._storage_objs.get(rep.name)
+                if obj is None:
+                    parts.append(f"storage {rep.name} unregistered")
+                elif not obj.process.alive:
+                    parts.append(f"storage {rep.name} dead "
+                                 "(no reboot/rebuild landed)")
+                elif obj.version.get() < frontier:
+                    parts.append(
+                        f"storage {rep.name} at v{obj.version.get()} "
+                        f"trails the log frontier v{frontier} by "
+                        f"{frontier - obj.version.get()}")
+        if not parts:
+            parts.append("all conditions met on the final poll "
+                         "(quiesced too late)")
+        return "; ".join(parts)
 
     # -- running ---------------------------------------------------------
     def run(self, coro, timeout_time: Optional[float] = None):

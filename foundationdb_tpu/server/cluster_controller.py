@@ -20,6 +20,7 @@ from typing import NamedTuple, Optional, Tuple
 from .. import flow
 from ..flow import AsyncVar, TaskPriority, error
 from ..rpc import RequestStream, SimProcess
+from .chaos import chaos_status as _chaos_status
 from .coordination import CoordinatedState, elect_leader
 from .dbinfo import (EMPTY_DBINFO, FULLY_RECOVERED, ServerDBInfo,
                      StorageRefs, StorageShard)
@@ -391,14 +392,33 @@ class ClusterController:
         # heartbeats; the sim checks liveness directly — a ping RPC to a
         # dead process would report the same thing a beat later) and
         # management-driven config changes (level-triggered so a change
-        # that raced the recovery is still honored)
+        # that raced the recovery is still honored). A critical process
+        # that is ALIVE but ping-unreachable (a partitioned or wedged
+        # machine — the failure monitor's set) for a sustained window
+        # ends the epoch exactly like a death: the reference's failure
+        # detection is network-based, so a partition triggers a real
+        # recovery, not an indefinite stall (ref: waitFailureServer
+        # timeouts). The window sits above every ordinary BUGGIFY clog
+        # so transient clogging never thrashes epochs.
+        unreachable_since: dict = {}
         while True:
             if self._config_dirty:
                 self._config_dirty = False
                 return "configuration_changed"
+            failed = set(self.dbinfo.get().failed)
+            limit = float(flow.SERVER_KNOBS.failure_unreachable_seconds)
+            now = flow.now()
             for proc in self._recovery.critical_procs:
                 if not proc.alive:
                     return f"process_failed:{proc.name}"
+                if limit > 0 and proc is not self.process \
+                        and proc.name in failed:
+                    since = unreachable_since.setdefault(proc.name, now)
+                    if now - since >= limit:
+                        flow.cover("cc.epoch_unreachable")
+                        return f"process_unreachable:{proc.name}"
+                else:
+                    unreachable_since.pop(proc.name, None)
             await flow.delay(flow.SERVER_KNOBS.failure_detection_interval,
                              TaskPriority.FAILURE_MONITOR)
 
@@ -540,6 +560,16 @@ class ClusterController:
         live = [wi for name, wi in self.workers.items()
                 if wi.worker.process.alive and name not in self.excluded
                 and wi.dc == my_dc]
+        # prefer ping-REACHABLE workers: recruiting onto an alive but
+        # partitioned machine hands the new epoch a role nobody can
+        # talk to, and the unreachability watchdog immediately ends it
+        # again — recovery-storms for the whole partition. Fall back to
+        # the full live set when the reachable pool is too small (the
+        # failure monitor may simply be behind)
+        unreachable = set(self.dbinfo.get().failed)
+        reachable = [wi for wi in live if wi.name not in unreachable]
+        if len(reachable) >= n:
+            live = reachable
         if not live:
             raise error("no_more_servers")
         rot = self._rr % len(live)
@@ -1332,6 +1362,12 @@ class ClusterController:
                 # event-driven health rollup (ref: the status document's
                 # messages array operators alert on)
                 "messages": self._health_messages(info),
+                # the chaos plane's shared fault accounting: every
+                # injected fault — network ops, disk corruption, kills,
+                # PLUS the device-fault injector's seam totals — under
+                # one schema, so "did the storm actually fire" is a
+                # status query, not a trace grep (server/chaos.py)
+                "chaos": _chaos_status(self.process.net),
                 # TEST() coverage summary (ref: the coverage tool over
                 # annotated rare paths; full dump rides the CI artifact)
                 "coverage": {"declared": len(cov["declared"]),

@@ -33,6 +33,37 @@ _REC_HDR = struct.Struct("<QII")
 _FILE_HDR = struct.Struct("<Q")
 
 
+def walk_records(raw) -> Tuple[List[Tuple[int, bytes, int, int, int]],
+                               int, int]:
+    """Walk a file image's valid record chain — THE shared format
+    walker: recovery's `_scan` and the chaos corruption helpers
+    (server/chaos.py) must agree byte-for-byte on what a committed
+    record is, so the walk exists exactly once.
+
+    -> ([(seq, payload, payload_off, length, record_off)...], first_seq,
+        stop_off) where stop_off is where the chain ended (EOF, or the
+    first record that failed a header/length/CRC check). The payload is
+    the bytes the CRC check already materialized — recovery keeps it,
+    offset-only callers ignore it."""
+    if len(raw) < _FILE_HDR.size:
+        return [], 1 << 62, 0
+    (first_seq,) = _FILE_HDR.unpack_from(raw, 0)
+    off = _FILE_HDR.size
+    expect = first_seq
+    out: List[Tuple[int, bytes, int, int, int]] = []
+    while off + _REC_HDR.size <= len(raw):
+        seq, length, crc = _REC_HDR.unpack_from(raw, off)
+        payload = bytes(raw[off + _REC_HDR.size:
+                            off + _REC_HDR.size + length])
+        if seq != expect or len(payload) != length \
+                or zlib.crc32(payload) != crc:
+            break
+        out.append((seq, payload, off + _REC_HDR.size, length, off))
+        expect += 1
+        off += _REC_HDR.size + length
+    return out, first_seq, off
+
+
 class DiskQueue:
     """Two-file durable FIFO. Single writer, cooperative scheduling."""
 
@@ -71,12 +102,26 @@ class DiskQueue:
         across both files (older file first). Everything past it —
         torn tails AND whole stale files whose sequences fall outside
         the prefix — is physically truncated, so a regrown sequence can
-        never collide with stale records at a later recovery."""
+        never collide with stale records at a later recovery.
+
+        DETECTED corruption — a record whose header chain is intact but
+        whose payload fails its checksum, with a VALID successor record
+        chained right behind it — raises checksum_failed instead of
+        silently cutting: records are appended in single writes, so
+        power loss can only damage a suffix (drop whole writes / tear
+        the final one); an intact chain continuing past a bad checksum
+        means the bytes rotted AFTER they were written, i.e. media
+        corruption of possibly-acked data. The caller treats that as a
+        recoverable role death (the store is lost, replication heals),
+        never as a quietly shorter log."""
         scans = [await self._scan(f) for f in self._files]
+        if any(corrupt for _recs, _first, corrupt in scans):
+            flow.cover("diskqueue.corruption_detected")
+            raise flow.error("checksum_failed")
         order = sorted(range(2), key=lambda i: scans[i][1])
         all_recs: List[Tuple[int, bytes, int, int]] = []  # seq,payload,file,end
         for i in order:
-            recs, _first = scans[i]
+            recs, _first, _corrupt = scans[i]
             all_recs.extend((seq, payload, i, end) for seq, payload, end in recs)
         valid: List[Tuple[int, bytes, int, int]] = []
         expect = all_recs[0][0] if all_recs else 0
@@ -109,30 +154,45 @@ class DiskQueue:
         return [p for _s, p in self._records]
 
     async def _scan(self, f: SimFile):
-        """-> ([(seq, payload, end_offset)...], first_seq)."""
+        """-> ([(seq, payload, end_offset)...], first_seq, corrupted)."""
         size = await f.size()
         if size < _FILE_HDR.size:
-            return [], 1 << 62
+            return [], 1 << 62, False
         raw = await f.read(0, size)
-        (first_seq,) = _FILE_HDR.unpack_from(raw, 0)
-        off = _FILE_HDR.size
-        recs: List[Tuple[int, bytes, int]] = []
-        expect = first_seq
-        while off + _REC_HDR.size <= size:
-            seq, length, crc = _REC_HDR.unpack_from(raw, off)
-            payload = bytes(raw[off + _REC_HDR.size:
-                                off + _REC_HDR.size + length])
-            if (seq != expect or len(payload) != length
-                    or zlib.crc32(payload) != crc):
+        walked, first_seq, stop = walk_records(raw)
+        corrupted = False
+        if stop + _REC_HDR.size <= size:
+            # the chain broke on a parseable header: classify the hole
+            seq, length, crc = _REC_HDR.unpack_from(raw, stop)
+            payload = bytes(raw[stop + _REC_HDR.size:
+                                stop + _REC_HDR.size + length])
+            expect = walked[-1][0] + 1 if walked else first_seq
+            corrupted = self._is_corruption_hole(
+                raw, size, stop, expect, seq, length, payload, crc)
+            if not corrupted:
                 flow.cover("diskqueue.torn_tail_dropped")
-                break
-            end = off + _REC_HDR.size + length
-            recs.append((seq, payload, end))
-            expect += 1
-            off = end
+        recs = [(seq, payload, poff + length)
+                for seq, payload, poff, length, _off in walked]
         if not recs:
-            return [], 1 << 62
-        return recs, first_seq
+            return [], 1 << 62, corrupted
+        return recs, first_seq, corrupted
+
+    @staticmethod
+    def _is_corruption_hole(raw, size, off, expect, seq, length, payload,
+                            crc) -> bool:
+        """Bad record with an intact header AND a valid successor right
+        behind it ⇒ mid-log corruption, not tail damage (each record is
+        one write, so power loss only damages a suffix of the chain)."""
+        if seq != expect or len(payload) != length \
+                or zlib.crc32(payload) == crc:
+            return False   # header damage or actually fine: tail cases
+        nxt = off + _REC_HDR.size + length
+        if nxt + _REC_HDR.size > size:
+            return False   # nothing behind it: indistinguishable tear
+        nseq, nlen, ncrc = _REC_HDR.unpack_from(raw, nxt)
+        npay = bytes(raw[nxt + _REC_HDR.size:nxt + _REC_HDR.size + nlen])
+        return (nseq == expect + 1 and len(npay) == nlen
+                and zlib.crc32(npay) == ncrc)
 
     # -- writing --------------------------------------------------------
     async def _write_file_header(self, i: int, first_seq: int) -> None:

@@ -26,6 +26,7 @@ from .. import flow
 from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
+from .chaos import fire_station
 from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS, PRIORITY_BATCH,
                     PRIORITY_DEFAULT, PRIORITY_IMMEDIATE, SET_VALUE,
                     SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
@@ -509,6 +510,9 @@ class Proxy:
                         "transactions_started_"
                         + PRIORITY_NAMES.get(prio, "default")).add(cnt)
             now = flow.now()
+            # chaos station: "GRV handed out" — the kill-mid-commit
+            # scenarios arm role deaths here (server/chaos.py)
+            fire_station("MasterProxyServer.GRV.AfterReply")
             for entry in batch:
                 self.grv_bands.record(now - entry[3])
                 entry[0].send(GetReadVersionReply(version))
@@ -655,6 +659,10 @@ class Proxy:
     @staticmethod
     def _mark(ids, location):
         flow.g_trace_batch.add_events(ids, "CommitDebug", location)
+        # the commit-debug stations double as chaos kill points: the
+        # kill-mid-commit scenarios arm one-shot role deaths at exact
+        # pipeline stations (server/chaos.py; no-op while unarmed)
+        fire_station(location)
 
     async def _commit_batch(self, batch, local: int):
         t0 = flow.now()

@@ -24,6 +24,7 @@ from .. import flow
 from ..flow import FlowLock, NotifiedVersion, TaskPriority, error
 from ..rpc import RequestStream, SimProcess
 from ..rpc.disk import SimDisk
+from .chaos import fire_station
 from .diskqueue import DiskQueue
 from .types import (TLogCommitRequest, TLogLockReply, TLogLockRequest,
                     TLogPeekReply, TLogPeekRequest, TLogPopRequest,
@@ -100,7 +101,10 @@ class TLog:
         self.process.on_kill(self._actors.cancel_all)
 
     async def _run(self) -> None:
-        await self._recover()
+        try:
+            await self._recover()
+        except flow.FdbError:
+            return   # corrupt store: recovered() carries the error
         for coro, prio, name in (
                 (self._commit_loop(), TaskPriority.TLOG_COMMIT, "commit"),
                 (self._peek_loop(), TaskPriority.TLOG_PEEK, "peek"),
@@ -114,7 +118,16 @@ class TLog:
         committed prefix preserved; versions resume from the last
         durable entry."""
         if self._dq is not None:
-            payloads = await self._dq.recover()
+            try:
+                payloads = await self._dq.recover()
+            except flow.FdbError as e:
+                # detected on-disk corruption: this store is LOST — the
+                # waiter (worker boot) learns through the recovered()
+                # future and treats it as a dead store; the role's other
+                # actors never start (ref: a tlog failing its recovery)
+                if not self._recovered.is_ready:
+                    self._recovered.send_error(e)
+                raise
             seq0 = self._dq.next_seq - len(payloads)
             for i, payload in enumerate(payloads):
                 version, tagged = decode_log_entry(payload)
@@ -196,6 +209,7 @@ class TLog:
         flow.g_trace_batch.add_events(
             getattr(req, "debug_ids", ()), "CommitDebug",
             "TLog.tLogCommit.AfterWaitForVersion")
+        fire_station("TLog.tLogCommit.AfterWaitForVersion")
         self.queue_version.set(req.version)
         self.stats.counter("commits").add(1)
         self.stats.counter("mutations").add(len(req.mutations))
@@ -224,6 +238,7 @@ class TLog:
             self.version.set(version)
         flow.g_trace_batch.add_events(
             dbg, "CommitDebug", "TLog.tLogCommit.AfterTLogCommit")
+        fire_station("TLog.tLogCommit.AfterTLogCommit")
         self.commit_bands.record(flow.now() - t0)
         reply.send(version)
 

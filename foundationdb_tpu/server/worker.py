@@ -71,29 +71,61 @@ class Worker:
     async def recover_stores(self):
         """Re-create roles from surviving disk stores (ref: worker boot
         store scan). TLogs come back stopped; storage servers rejoin
-        live. Returns (recovered_logs, recovered_storages)."""
+        live. Returns (recovered_logs, recovered_storages).
+
+        A store whose recovery DETECTS corruption (checksum_failed /
+        io_error) is treated as lost, not fatal: the files are removed
+        so the next reboot cannot trip on them again, and the worker
+        registers without it — replication heals the hole (DD rebuilds
+        the replica; a log generation recovers from its surviving
+        peers). Detected corruption is thus a recoverable role death;
+        UNDETECTED corruption is check_consistency's job."""
         recovered_logs = []
         recovered_storages = []
         if self.durable:
             disk = self.net.disk(self.process.machine)
             for store in sorted(disk.files):
-                if store.startswith("tlog-") and store.endswith(".dq0"):
-                    name = store[:-4]
-                    tlog = self._make_tlog(name)
-                    tlog.stopped = True      # old-generation data only
-                    tlog.start()
-                    await tlog.recovered()
-                    recovered_logs.append(self._log_refs(name, tlog))
-                elif store.startswith("storage-") and store.endswith(".dq0"):
-                    refs = await self._recover_storage(store[:-4], "memory")
-                    if refs is not None:
-                        recovered_storages.append(refs)
-                elif store.startswith("storage-") and \
-                        store.endswith(".btree"):
-                    refs = await self._recover_storage(store[:-6], "btree")
-                    if refs is not None:
-                        recovered_storages.append(refs)
+                try:
+                    if store.startswith("tlog-") and store.endswith(".dq0"):
+                        name = store[:-4]
+                        tlog = self._make_tlog(name)
+                        tlog.stopped = True      # old-generation data only
+                        tlog.start()
+                        await tlog.recovered()
+                        recovered_logs.append(self._log_refs(name, tlog))
+                    elif store.startswith("storage-") and \
+                            store.endswith(".dq0"):
+                        refs = await self._recover_storage(store[:-4],
+                                                           "memory")
+                        if refs is not None:
+                            recovered_storages.append(refs)
+                    elif store.startswith("storage-") and \
+                            store.endswith(".btree"):
+                        refs = await self._recover_storage(store[:-6],
+                                                           "btree")
+                        if refs is not None:
+                            recovered_storages.append(refs)
+                except flow.FdbError as e:
+                    if e.name not in ("checksum_failed", "io_error"):
+                        raise
+                    self._drop_corrupt_store(disk, store, e)
         return tuple(recovered_logs), tuple(recovered_storages)
+
+    def _drop_corrupt_store(self, disk, store: str, e) -> None:
+        """Detected on-disk corruption: destroy the store and carry on
+        (the recoverable-role-death contract of the chaos plane)."""
+        base = store.rsplit(".", 1)[0]
+        flow.cover("worker.corrupt_store_dropped")
+        flow.TraceEvent("WorkerCorruptStoreLost", self.process.name,
+                        severity=flow.trace.SevWarnAlways).detail(
+            Store=base, Error=e.name).log()
+        self.net.chaos_note("corrupt_store_lost", store=base,
+                            machine=self.process.machine)
+        role = self.roles.pop(base, None)
+        if role is not None:
+            role._actors.cancel_all()
+        for f in [f for f in disk.files if f.startswith(base + ".")]:
+            disk.remove(f)
 
     async def _recover_storage(self, name: str, engine: str):
         kv = self._make_engine(name, engine)

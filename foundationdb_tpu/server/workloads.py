@@ -489,6 +489,112 @@ class OpenLoopStorm:
         return out
 
 
+class ChaosStorm:
+    """One named chaos scenario applied mid-flight under open-loop
+    traffic, healed, quiesced, and VERIFIED (ref: the reference's
+    stacked simulation tests — workload + attrition + clogging — with
+    ConsistencyCheck as the closing oracle; ROADMAP item 5).
+
+    Shape of a storm: start an OpenLoopStorm (PR 6's seeded Zipfian
+    arrivals) against the cluster, wait `lead_in`, run the scenario
+    (server/chaos.py — it applies its faults and heals before
+    returning), wait out the traffic, then assert the three oracles:
+
+    - `check_consistency` over the surviving database (the primary, or
+      the promoted region when the scenario moved it) is clean;
+    - shadow validation reported ZERO mismatches (when a device
+      backend with the PR 5 shadow is present);
+    - recovery was BOUNDED: scenario-end → quiesced within
+      CHAOS_RECOVERY_BOUND sim-seconds.
+
+    The returned report carries the network's full chaos event log and
+    a SHA-256 digest of the final keyspace: two runs with the same seed
+    must produce identical logs and digests (test-pinned replay)."""
+
+    def __init__(self, cluster, dbs, rng, scenario: str,
+                 duration: float = 5.0, rate: float = 40.0,
+                 lead_in: float = 1.0, recovery_bound: float = None,
+                 keyspace: int = 32):
+        from .chaos import get_scenario
+        self.cluster = cluster
+        self.dbs = list(dbs)
+        self.rng = rng
+        self.scenario = get_scenario(scenario)
+        self.lead_in = lead_in
+        if recovery_bound is None:
+            recovery_bound = float(flow.SERVER_KNOBS.chaos_recovery_bound)
+        self.recovery_bound = recovery_bound
+        # steady open-loop pressure, no burst: the scenario IS the storm
+        self.storm = OpenLoopStorm(
+            self.dbs, rng, duration=duration, rate=rate, burst_rate=rate,
+            burst_start=duration, keyspace=keyspace, prefix=b"chaos/",
+            max_inflight=256)
+
+    async def run(self) -> dict:
+        from .chaos import chaos_status, database_digest, record_scenario
+        from .consistency import check_consistency
+        net = self.cluster.net
+        record_scenario(net, self.scenario.name)
+        traffic = flow.spawn(self.storm.run(),
+                             name=f"chaos-traffic-{self.scenario.name}")
+        await flow.delay(self.lead_in)
+        result = await self.scenario.run(self.cluster, self.rng)
+        healed_at = flow.now()
+        storm_stats = await traffic
+
+        check_db = result.pop("check_db", None)
+        if check_db is None:
+            # heal → quiesce within the bound, then sweep every replica
+            await self.cluster.quiet_database(max_wait=self.recovery_bound)
+            # the bound covers scenario-end → QUIESCED; the consistency
+            # sweep below is verification time, not recovery time
+            recovery_seconds = flow.now() - healed_at
+            consistency = await check_consistency(self.cluster,
+                                                  quiesce=False)
+            digest_db = self.cluster.client("chaos-digest")
+        else:
+            # the scenario moved the database (region failover): the
+            # promoted epoch already accepts commits, so recovery ended
+            # when the scenario returned; verify through the promoted
+            # side's own client surface
+            recovery_seconds = flow.now() - healed_at
+            consistency = await flow.timeout_error(
+                flow.spawn(check_consistency(check_db),
+                           name="chaos-region-consistency"),
+                self.recovery_bound)
+            digest_db = check_db
+        assert recovery_seconds <= self.recovery_bound, (
+            f"{self.scenario.name}: recovery took {recovery_seconds:.1f}s "
+            f"(bound {self.recovery_bound}s)")
+        digest = await database_digest(digest_db)
+
+        # shadow-validation cleanliness (PR 5's oracle, when present)
+        status = await digest_db.get_status()
+        cl = status["cluster"]
+        for r in cl.get("resolvers", ()):
+            sh = (r.get("failover") or {}).get("shadow") or {}
+            assert not sh.get("mismatches"), (self.scenario.name, r)
+        assert not any(m["name"] == "shadow_resolve_mismatch"
+                       for m in cl.get("messages", ())), cl
+        chaos = chaos_status(net)
+        assert chaos["scenarios"].get(self.scenario.name), chaos
+
+        return {
+            "scenario": self.scenario.name,
+            "result": result,
+            "storm": storm_stats,
+            "consistency": consistency,
+            "digest": digest,
+            "recovery_seconds": round(recovery_seconds, 3),
+            "chaos": chaos,
+            "events": list(net.chaos_log),
+            # the post-storm status doc, read through the SURVIVING
+            # database (after region_failover the primary CC is gone —
+            # callers must not have to query it for chaos accounting)
+            "status": status,
+        }
+
+
 class FuzzApiCorrectness:
     """API-misuse fuzz (ref: FuzzApiCorrectness.actor.cpp): drive the
     client surface with invalid inputs — oversized keys/values,

@@ -185,6 +185,19 @@ def _render_details(cl: dict) -> str:
         if inputs:
             lines.append("  inputs: " + "  ".join(
                 f"{k}={v}" for k, v in sorted(inputs.items())))
+    chaos = cl.get("chaos") or {}
+    if chaos.get("injected") or chaos.get("scenarios"):
+        # the chaos plane only earns a section once something fired
+        lines.append("Chaos (injected faults):")
+        inj = "  ".join(f"{k}={v}"
+                        for k, v in sorted(chaos["injected"].items()))
+        lines.append(f"  {inj if inj else '(none)'}")
+        for sc, n in sorted((chaos.get("scenarios") or {}).items()):
+            lines.append(f"  scenario {sc}: {n} run(s)")
+        lines.append(
+            f"  events={chaos.get('events', 0)} "
+            f"dropped_msgs={chaos.get('messages_dropped', 0)} "
+            f"dup_msgs={chaos.get('messages_duplicated', 0)}")
     rl = cl.get("run_loop", {})
     if rl:
         lines.append(f"Run loop: tasks={rl.get('tasks_run')} "

@@ -309,6 +309,28 @@ def render_prometheus(status: dict) -> str:
               "Raw attributed-conflict count per key range", labels,
               row["total"])
 
+    # the chaos plane's shared fault accounting (server/chaos.py):
+    # injected-fault totals per kind + per-scenario run counts, so a
+    # dashboard can confirm a storm actually fired without trace greps
+    chaos = cl.get("chaos") or {}
+    for kind, n in sorted((chaos.get("injected") or {}).items()):
+        f.add(f"{_PREFIX}_chaos_injected", "counter",
+              "Injected chaos faults by kind (network, disk, kills, "
+              "device seams)", {"kind": kind}, n)
+    for sc, n in sorted((chaos.get("scenarios") or {}).items()):
+        f.add(f"{_PREFIX}_chaos_scenario_runs", "counter",
+              "Chaos scenario storms started, by scenario name",
+              {"scenario": sc}, n)
+    if chaos:
+        f.add(f"{_PREFIX}_chaos_events", "counter",
+              "Total recorded chaos events", {}, chaos.get("events"))
+        f.add(f"{_PREFIX}_chaos_messages_dropped", "counter",
+              "Messages dropped by kills/partitions", {},
+              chaos.get("messages_dropped"))
+        f.add(f"{_PREFIX}_chaos_messages_duplicated", "counter",
+              "One-way datagrams duplicated by swizzled links", {},
+              chaos.get("messages_duplicated"))
+
     msgs = cl.get("messages", ())
     f.add(f"{_PREFIX}_health_messages", "gauge",
           "Active health messages in the status rollup", {}, len(msgs))

@@ -18,6 +18,8 @@ from typing import List, Optional
 
 PROFILE_REPORT_PATH = "/tmp/_profile_report.txt"
 STORM_REPORT_PATH = "/tmp/_storm_report.txt"
+CHAOS_REPORT_PATH = "/tmp/_chaos_report.txt"
+CHAOS_TRACE_PATH = "/tmp/_chaos_trace.jsonl"
 
 
 def run_smoke(out=print) -> int:
@@ -432,6 +434,102 @@ def run_smoke_profile(out=print,
         cluster.shutdown()
 
 
+def run_smoke_chaos(out=print,
+                    report_path: str = CHAOS_REPORT_PATH) -> int:
+    """Single-scenario chaos smoke (the nightly chaos-matrix runs this
+    per grid cell; tier-1 runs one fast cell): one named scenario
+    (`CHAOS_SCENARIO`, default partition_minority) applied as a
+    ChaosStorm at a seeded sim (`CHAOS_SEED`) — open-loop traffic,
+    mid-flight faults, heal, quiesce, `check_consistency` + shadow
+    cleanliness + bounded recovery — then the SAME seed replayed,
+    asserting an identical chaos event schedule and keyspace digest.
+    Chaos accounting must surface in status, the exporter, and the cli
+    section; the full report (events + digest + counters) and the
+    trace file land at /tmp/_chaos_{report.txt,trace.jsonl} for the CI
+    artifacts."""
+    import json
+    import os
+
+    from .. import flow
+    from ..server import SimCluster
+    from ..server.chaos import SCENARIOS
+    from ..server.workloads import ChaosStorm
+    from .cli import _render_details
+    from .exporter import parse_prometheus, render_prometheus
+
+    scenario = os.environ.get("CHAOS_SCENARIO", "partition_minority")
+    seed = int(os.environ.get("CHAOS_SEED", 101))
+    if scenario not in SCENARIOS:
+        out(f"unknown scenario {scenario!r}; known: {sorted(SCENARIOS)}")
+        return 2
+    flow.g_trace.reset(os.environ.get("CHAOS_TRACE_FILE",
+                                      CHAOS_TRACE_PATH))
+
+    def run_once() -> dict:
+        cluster = SimCluster(seed=seed,
+                             **dict(SCENARIOS[scenario].cluster_kwargs))
+        try:
+            dbs = [cluster.client(f"chaos{i}") for i in range(3)]
+            storm = ChaosStorm(cluster, dbs, flow.g_random, scenario)
+            return cluster.run(storm.run(), timeout_time=900)
+        finally:
+            cluster.shutdown()
+
+    rep = run_once()
+    chaos = rep["status"]["cluster"]["chaos"]
+    # the report is THE triage artifact the CI matrix uploads on
+    # failure — build it now and write it even when an assert below
+    # fires (a replay divergence must not lose the event logs)
+    report = {"scenario": scenario, "seed": seed,
+              "digest": rep["digest"],
+              "recovery_seconds": rep["recovery_seconds"],
+              "consistency": rep["consistency"],
+              "chaos": chaos, "storm": rep["storm"],
+              "events": rep["events"]}
+    try:
+        assert rep["storm"]["completed"] > 0, rep["storm"]
+        assert rep["consistency"]["rows"] > 0, rep["consistency"]
+
+        # the shared accounting schema: status doc, exporter, cli section
+        status = rep["status"]
+        assert chaos["scenarios"].get(scenario) == 1, chaos
+        assert chaos["injected"].get("scenario") == 1, chaos
+        samples = parse_prometheus(render_prometheus(status))
+        names = {n for n, _l, _v in samples}
+        for need in ("fdbtpu_chaos_injected", "fdbtpu_chaos_scenario_runs",
+                     "fdbtpu_chaos_events"):
+            assert need in names, f"exporter missing {need}"
+        runs = {l["scenario"]: v for n, l, v in samples
+                if n == "fdbtpu_chaos_scenario_runs"}
+        assert runs.get(scenario) == 1, runs
+        details = _render_details(status["cluster"])
+        assert "Chaos (injected faults):" in details, details
+        assert f"scenario {scenario}" in details, details
+
+        # seed replay: the same seed must reproduce the identical fault
+        # schedule and the identical final keyspace (the determinism half
+        # of the acceptance contract, enforced per nightly grid cell)
+        replay = run_once()
+        report["replay"] = {"digest": replay["digest"],
+                            "events": replay["events"]}
+        assert replay["events"] == rep["events"], \
+            "replay diverged: event schedules differ (see report)"
+        assert replay["digest"] == rep["digest"], (
+            rep["digest"], replay["digest"])
+    finally:
+        with open(report_path, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True, default=str)
+            fh.write("\n")
+    out(f"CHAOS SMOKE OK: {scenario} seed={seed} — "
+        f"{len(rep['events'])} chaos events "
+        f"({', '.join(f'{k}={v}' for k, v in sorted(chaos['injected'].items()))}), "
+        f"storm {rep['storm']['completed']}/{rep['storm']['issued']} "
+        f"committed, recovery {rep['recovery_seconds']}s, "
+        f"digest {rep['digest'][:16]} (replay identical); "
+        f"report at {report_path}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--profile" in argv:
@@ -440,6 +538,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_smoke_faults()
     if "--storm" in argv:
         return run_smoke_storm()
+    if "--chaos" in argv:
+        return run_smoke_chaos()
     return run_smoke()
 
 

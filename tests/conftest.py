@@ -26,6 +26,65 @@ except Exception:
     pass
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--seed", type=int, default=None,
+        help="Force every sim_seed-driven test onto this simulation "
+             "seed — the one-line replay knob the failure hook prints "
+             "(same seed => identical event schedule).")
+
+
+import pytest  # noqa: E402  (after the JAX env pinning above)
+
+
+@pytest.fixture
+def sim_seed(request):
+    """Seed chooser for deterministic sim tests: `sim_seed(default)`
+    returns the test's own default seed unless the run forces one with
+    `--seed=N` — which is exactly what the failure hook's printed repro
+    command does."""
+    forced = request.config.getoption("--seed")
+
+    def pick(default: int) -> int:
+        return default if forced is None else forced
+
+    return pick
+
+
+def pytest_runtest_setup(item):
+    # a stale seed from the previous test must never be blamed for
+    # this test's failure
+    try:
+        from foundationdb_tpu.server import cluster as _cluster_mod
+
+        _cluster_mod.last_sim_seed = None
+    except Exception:
+        pass
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Every red sim test is immediately replayable: print the sim seed
+    the test actually ran under and the one-line repro command."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.failed:
+        return
+    try:
+        from foundationdb_tpu.server import cluster as _cluster_mod
+
+        seed = _cluster_mod.last_sim_seed
+    except Exception:
+        seed = None
+    if seed is None:
+        return
+    path, _sep, selector = item.nodeid.partition("::")
+    rep.sections.append((
+        "sim seed replay",
+        f"sim seed: {seed}\n"
+        f"replay:   pytest {path} -k '{selector}' --seed={seed}\n"))
+
+
 def pytest_sessionfinish(session, exitstatus):
     """Dump the TEST() coverage report (flow/coverage.py) so CI can
     archive it alongside /tmp/_t1.log — the suite-level record of which
