@@ -124,6 +124,12 @@ class Database:
         # decision hashes, and its lazily-derived salt
         self._txn_seq = 0
         self._profile_salt: Optional[int] = None
+        # hot-key conflict windows ridden in on GRV replies
+        # (server/scheduler.py ConflictWindowCache): database-scoped so
+        # every transaction — including every RETRY attempt — consults
+        # the same picture; lazily created on the first window-carrying
+        # reply, so the feature-off path allocates nothing
+        self._conflict_cache = None
 
     def note_latency(self, replica: str, seconds: float) -> None:
         prev = self._latency_ema.get(replica)
@@ -395,6 +401,12 @@ class Database:
             reply = await _rpc(proxy.grvs.get_reply(
                 GetReadVersionRequest(len(waiters), priority),
                 self.process))
+            windows = getattr(reply, "conflict_windows", ())
+            if windows:
+                if self._conflict_cache is None:
+                    from ..server.scheduler import ConflictWindowCache
+                    self._conflict_cache = ConflictWindowCache()
+                self._conflict_cache.update(windows, flow.now())
             for f in waiters:
                 if not f.is_ready:
                     f.send((reply.version, info.seq))
@@ -528,6 +540,18 @@ class Transaction:
                 profiling.note_sampled()
                 self._profile = profiling.TransactionProfile(
                     ident, flow.now())
+        elif option == "automatic_repair":
+            # the transaction-repair contract (server/repair.py): the
+            # client declares its read-set is fully recorded as read
+            # conflicts and its writes do not depend on read VALUES
+            # (atomic ops, blind sets/clears), so a conflicted commit
+            # may be repaired server-side — invalidated reads
+            # re-executed at the conflict version and the commit
+            # revalidated — instead of aborting. The server verifies
+            # what it can (mutation types) and falls back to the
+            # ordinary abort otherwise; with TXN_REPAIR off the flag
+            # rides the wire inert.
+            self._repairable = True
         elif option == "report_conflicting_keys":
             # a conflicted commit surfaces WHICH read ranges aborted it
             # (ref: the REPORT_CONFLICTING_KEYS option + the
@@ -603,6 +627,7 @@ class Transaction:
         self._grv_priority = None     # ...including the priority class
         self._tags = ()               # ...and the transaction tags
         self._report_conflicting = False
+        self._repairable = False      # automatic_repair declaration
         self._conflicting_ranges = None   # last conflicted commit's causes
         # timeout/retry OPTIONS survive an explicit reset, but their
         # spent budgets re-arm — a reused object starts a fresh logical
@@ -1228,8 +1253,15 @@ class Transaction:
                                 self, "_report_conflicting", False),
                             priority=(_PRIO_DEFAULT if prio is None
                                       else prio),
-                            tags=tuple(getattr(self, "_tags", ())))
+                            tags=tuple(getattr(self, "_tags", ())),
+                            repairable=getattr(self, "_repairable",
+                                               False))
         try:
+            # client-side early abort (server/scheduler.py conflict
+            # windows): raised INSIDE this try, so watches, trace
+            # stations and profiling see exactly what a resolver abort
+            # produces — indistinguishable to retry loops by design
+            self._check_conflict_windows(snapshot)
             proxy = await self._proxy()
             reply = await self._rpc(
                 proxy.commits.get_reply(req, self.db.process))
@@ -1261,6 +1293,38 @@ class Transaction:
                                          "NativeAPI.commit.After")
         self._arm_watches(reply.version)
         return reply.version
+
+    def _check_conflict_windows(self, snapshot: int) -> None:
+        """Hot-key early abort (ref: *Early Detection for MVCC
+        Conflicts in Hyperledger Fabric*): a commit whose read ranges
+        overlap a cached, still-fresh conflict window NEWER than its
+        snapshot is near-certain to abort at the resolver — fail it
+        locally before it consumes a proxy round trip and a resolver
+        slot. The retry then starts sooner AND with a fresh snapshot.
+        Raises the same not_committed a resolver abort produces."""
+        cache = self.db._conflict_cache
+        if cache is None or \
+                not flow.SERVER_KNOBS.client_conflict_windows:
+            return
+        if getattr(self, "_repairable", False):
+            # a repairable transaction PROFITS from submitting: the
+            # server repairs the predicted conflict into a commit,
+            # which an early abort would forfeit — the two planes
+            # compose instead of fighting
+            return
+        from ..server.types import PRIORITY_IMMEDIATE
+        if getattr(self, "_grv_priority", None) == PRIORITY_IMMEDIATE:
+            return   # immediate traffic bypasses the heuristic gate
+        hit = cache.doomed(self._read_conflicts, snapshot, flow.now())
+        if not hit:
+            return
+        flow.cover("client.window_early_abort")
+        from ..server.scheduler import note_early_abort
+        note_early_abort()
+        if getattr(self, "_report_conflicting", False):
+            # same surface as a reported resolver conflict
+            self._conflicting_ranges = tuple(hit)
+        raise error("not_committed")
 
     def get_conflicting_ranges(self):
         """The key ranges that aborted the last conflicted commit, or
@@ -1338,6 +1402,7 @@ class Transaction:
         retries = getattr(self, "_retries_used", 0)
         prio = getattr(self, "_grv_priority", None)
         tags = getattr(self, "_tags", ())
+        repairable = getattr(self, "_repairable", False)
         debug_id = getattr(self, "_debug_id", None)
         profile = self._profile
         report = getattr(self, "_report_conflicting", False)
@@ -1346,6 +1411,7 @@ class Transaction:
         self._retries_used = retries
         self._grv_priority = prio
         self._tags = tags
+        self._repairable = repairable
         # the RETRY attempt is usually the interesting one (it hit a
         # conflict/failure) — keep it sampled
         self._debug_id = debug_id

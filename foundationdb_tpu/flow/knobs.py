@@ -357,6 +357,52 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # -- conflict-set backends (ref: resolver window GC cadence) -------
     init("CONFLICT_SET_COMPACT_EVERY", 16, lambda: 1)
 
+    # -- conflict prediction & transaction repair (server/scheduler.py,
+    # server/repair.py — ROADMAP item 2; steering per arXiv:2409.01675,
+    # repair per arXiv:1403.5645). All three planes default OFF so the
+    # abort-only pipeline is byte-identical until an operator (or the
+    # --contention smoke) arms them; BUGGIFY arms them randomly so
+    # chaos/sim runs exercise the new decision paths under faults.
+    # proxy admission scheduling: defer commits whose predicted
+    # conflict probability crosses SCHED_CONFLICT_THRESHOLD
+    init("CONFLICT_SCHEDULING", 0, lambda: 1)
+    init("SCHED_CONFLICT_THRESHOLD", 0.5, lambda: 0.05)
+    # hot-score -> probability mapping: p = score / (score + scale)
+    init("SCHED_HOT_SCORE_SCALE", 5.0)
+    # bounded deferral: a deferred commit never waits longer than this
+    init("SCHED_MAX_DELAY", 0.05, lambda: 0.2)
+    # spacing between releases from one hot-range queue (one release
+    # per spacing ≈ one commit batch apart, so queued rivals land at
+    # successive versions instead of racing inside one batch window)
+    init("SCHED_RELEASE_SPACING", 0.005, lambda: 0.02)
+    init("SCHED_QUEUE_MAX", 64, lambda: 2)
+    # CC cadence for pushing the cluster-merged hot-spot rows to the
+    # proxies' predictors (and the GRV conflict-window piggyback)
+    init("SCHED_HOT_PUSH_INTERVAL", 0.5, lambda: 0.05)
+    # server-side repair of conflicted-but-repairable transactions:
+    # re-read the invalidated ranges, revalidate at the conflict
+    # version, commit without a client round trip
+    init("TXN_REPAIR", 0, lambda: 1)
+    init("REPAIR_MAX_ATTEMPTS", 2, lambda: 1)
+    init("REPAIR_MAX_INFLIGHT", 128, lambda: 2)
+    # re-read bounds: rows per invalidated range, and how long the
+    # proxy waits for storage to reach the conflict version before
+    # falling back to the ordinary abort
+    init("REPAIR_REREAD_ROWS", 64, lambda: 2)
+    init("REPAIR_READ_TIMEOUT", 1.0, lambda: 0.05)
+    # client-side early abort: hot-key conflict windows ride GRV
+    # replies into a per-Database cache; a commit whose read ranges
+    # overlap a fresh window newer than its snapshot aborts locally
+    init("CLIENT_CONFLICT_WINDOWS", 0, lambda: 1)
+    init("CONFLICT_WINDOW_TTL", 2.0, lambda: 0.1)
+    init("CONFLICT_WINDOW_SCORE_MIN", 0.5)
+    init("CONFLICT_WINDOW_TOP_K", 8)
+    # ratekeeper deferral-pressure input: smoothed deferred-commit
+    # queue depth per proxy, spring-zone throttled like the queue-byte
+    # inputs (0 disables the input)
+    init("RK_SCHED_DEFER_LIMIT", 48.0, lambda: 2.0)
+    init("RK_SCHED_DEFER_SPRING", 24.0)
+
     # -- conflict-backend fault tolerance (models/failover.py) ---------
     # per-seam probability of a simulated device fault at the
     # submit/materialize/drain boundaries (ops/fault_injection.py).

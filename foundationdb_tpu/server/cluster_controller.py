@@ -43,6 +43,20 @@ def _global_kernel_counters() -> dict:
     return out
 
 
+def epoch_roles(workers, epoch: int, cls):
+    """Live current-epoch roles of `cls` from a CC worker registry:
+    skip dead processes, match role class + the -e<epoch>- name
+    convention. THE implementation of this walk — the CC's hot-spot
+    push/merge and the Ratekeeper's input gathering both delegate
+    here, so a change to role liveness or epoch naming lands once."""
+    for wi in workers.values():
+        if not wi.worker.process.alive:
+            continue
+        for rn, role in wi.worker.roles.items():
+            if isinstance(role, cls) and f"-e{epoch}-" in rn:
+                yield rn, role
+
+
 def _client_profile_counters() -> dict:
     """Process-wide sampled-transaction profiler counters. Same
     sys.modules guard: a cluster that never sampled anything must not
@@ -189,6 +203,7 @@ class ClusterController:
                            (self._failure_monitor_loop(), "failureMonitor"),
                            (self._metric_sampler_loop(), "metricSampler"),
                            (self._qos_sampler_loop(), "qosSampler"),
+                           (self._hot_spot_push_loop(), "hotSpotPush"),
                            (self._trace_counters_loop(), "traceCounters"),
                            (self._latency_probe_loop(), "latencyProbe"),
                            (self._conf_sync_loop(), "confSync")):
@@ -298,6 +313,57 @@ class ClusterController:
             # status document never reports a dead role's stale signals
             for rn in [r for r in self.qos_samples if r not in known]:
                 del self.qos_samples[rn]
+
+    def _epoch_roles(self, info, cls):
+        """Live current-epoch roles of `cls` from the registry — the
+        walk shared by the hot-spot merge/push and the ratekeeper's
+        input gathering (module-level `epoch_roles` is the single
+        implementation)."""
+        return epoch_roles(self.workers, info.epoch, cls)
+
+    def _merged_hot_rows(self, info) -> tuple:
+        """Cluster-merged raw hot-spot rows across the current epoch's
+        resolvers, hottest first: (begin, end, score, total,
+        last_conflict_version). Keyspace-sharded resolvers each see
+        disjoint causes; after a split-resolver move both owners may
+        report the same range — scores sum, versions max."""
+        from .resolver_role import Resolver
+        merged: dict = {}
+        for _rn, role in self._epoch_roles(info, Resolver):
+            for b, e, s, t, v in role.hot_spots.rows():
+                ent = merged.get((b, e))
+                if ent is None:
+                    merged[(b, e)] = [s, t, v]
+                else:
+                    ent[0] += s
+                    ent[1] += t
+                    ent[2] = max(ent[2], v)
+        rows = [(b, e, s, t, v)
+                for (b, e), (s, t, v) in merged.items()]
+        rows.sort(key=lambda r: (-r[2], r[0], r[1]))
+        return tuple(rows[:int(flow.SERVER_KNOBS.hot_spot_max_entries)])
+
+    async def _hot_spot_push_loop(self) -> None:
+        """Feed the conflict-prediction plane (ISSUE 8 / ROADMAP item
+        2): the cluster-merged hot-spot rows — the only place the
+        per-resolver attribution tables meet — are pushed to every
+        current-epoch proxy at SCHED_HOT_PUSH_INTERVAL, where they
+        drive the admission scheduler's ConflictPredictor and the GRV
+        conflict-window piggyback. Idle (one knob read per interval)
+        while both consuming planes are off."""
+        while True:
+            interval = flow.SERVER_KNOBS.sched_hot_push_interval
+            await flow.delay(interval if interval > 0 else 1.0,
+                             TaskPriority.LOW_PRIORITY)
+            k = flow.SERVER_KNOBS
+            if not (k.conflict_scheduling or k.client_conflict_windows
+                    or k.txn_repair):
+                continue
+            from .proxy import Proxy
+            info = self.dbinfo.get()
+            rows = self._merged_hot_rows(info)
+            for _rn, role in self._epoch_roles(info, Proxy):
+                role.update_hot_spots(rows)
 
     async def _trace_counters_loop(self) -> None:
         """Roll every live role's CounterCollection into a periodic
@@ -1235,7 +1301,12 @@ class ClusterController:
                         "counters": role.stats.snapshot(),
                         "latency_bands": {
                             "grv": role.grv_bands.snapshot(),
-                            "commit": role.commit_bands.snapshot()}})
+                            "commit": role.commit_bands.snapshot()},
+                        # conflict prediction & repair decision plane
+                        # (server/scheduler.py + server/repair.py):
+                        # deferral and repair accounting per proxy
+                        "scheduler": role.scheduler_status(),
+                        "repair": role.repair_status()})
                 elif isinstance(role, Resolver) and \
                         f"-e{info.epoch}-" in rn:
                     kern = role.kernel_stats()
@@ -1354,6 +1425,11 @@ class ClusterController:
                 # backend instance in this process
                 "kernels": _global_kernel_counters(),
                 "qos": qos_doc,
+                # conflict prediction & transaction repair rollup:
+                # the armed planes, cluster totals across the proxies,
+                # and the client-side conflict-window cache counters
+                # (process-wide, like client_profile)
+                "conflict_scheduling": self._sched_doc(proxies),
                 "latency_probe": probe,
                 # hottest conflict-causing key ranges, cluster-wide
                 # (per-resolver tables under resolvers[*].hot_spots)
@@ -1412,6 +1488,36 @@ class ClusterController:
                     "excluded": sorted(self.excluded),
                 },
             },
+        }
+
+    @staticmethod
+    def _sched_doc(proxies: list) -> dict:
+        """status.cluster.conflict_scheduling: knob posture + totals
+        over the per-proxy scheduler/repair sections + the client
+        early-abort counters."""
+        from .scheduler import client_window_counters
+        k = flow.SERVER_KNOBS
+        totals = {"deferrals": 0, "released": 0, "overflow": 0,
+                  "deferred_now": 0, "repair_attempts": 0,
+                  "repair_committed": 0, "repair_conflicted": 0,
+                  "repair_fallbacks": 0}
+        for p in proxies:
+            s = p.get("scheduler") or {}
+            r = p.get("repair") or {}
+            totals["deferrals"] += s.get("deferrals", 0)
+            totals["released"] += s.get("released", 0)
+            totals["overflow"] += s.get("overflow", 0)
+            totals["deferred_now"] += s.get("deferred_now", 0)
+            totals["repair_attempts"] += r.get("attempts", 0)
+            totals["repair_committed"] += r.get("committed", 0)
+            totals["repair_conflicted"] += r.get("conflicted", 0)
+            totals["repair_fallbacks"] += r.get("fallbacks", 0)
+        return {
+            "scheduling_enabled": int(bool(k.conflict_scheduling)),
+            "repair_enabled": int(bool(k.txn_repair)),
+            "client_windows_enabled": int(bool(k.client_conflict_windows)),
+            **totals,
+            "client": client_window_counters(),
         }
 
     # -- data distribution (ref: DataDistribution + MoveKeys) ------------

@@ -27,6 +27,8 @@ from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
 from .chaos import fire_station
+from .repair import RepairManager
+from .scheduler import AdmissionScheduler
 from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS, PRIORITY_BATCH,
                     PRIORITY_DEFAULT, PRIORITY_IMMEDIATE, SET_VALUE,
                     SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
@@ -219,7 +221,8 @@ class Proxy:
                  recovery_version: int = 0,
                  batch_window: float = 0.001, max_batch: int = 512,
                  ratekeeper_ref: NetworkRef = None,
-                 management_ref: NetworkRef = None):
+                 management_ref: NetworkRef = None,
+                 dbinfo=None):
         if not isinstance(resolver_refs, (list, tuple)):
             resolver_refs = [resolver_refs]
         if not isinstance(tlog_refs, (list, tuple)):
@@ -311,6 +314,20 @@ class Proxy:
         # version request so the master's reply carries only the tail
         self._moves_seen = 0
         self._actors = flow.ActorCollection()
+        # conflict prediction & transaction repair (server/scheduler.py
+        # + server/repair.py, ROADMAP item 2): the admission scheduler
+        # defers predicted-conflict commits into per-hot-range queues
+        # (released back through the commit stream), the repair manager
+        # re-executes invalidated reads and resubmits instead of
+        # aborting, and the CC-pushed hot rows double as the GRV
+        # conflict-window piggyback. All knob-gated off by default.
+        self.scheduler = AdmissionScheduler(process, self.stats,
+                                            self._sched_release)
+        self.repair = RepairManager(process, dbinfo, self.commits,
+                                    self.stats, self._actors,
+                                    committed_version=self.committed_version,
+                                    account=self._repair_fallback_account)
+        self._conflict_windows: tuple = ()
 
     def set_peers(self, raw_refs) -> None:
         """Raw-committed-version endpoints of the OTHER proxies (ref:
@@ -351,6 +368,10 @@ class Proxy:
             entry[0].send_error(error("broken_promise"))
         self._grv_queue = []
         self._grv_inflight = []
+        # deferred commits held by the admission scheduler fail over
+        # the same way (repair actors ride self._actors and answer
+        # their replies from their cancellation path)
+        self.scheduler.shutdown()
 
     # -- GRV ------------------------------------------------------------
     async def _grv_loop(self):
@@ -513,9 +534,14 @@ class Proxy:
             # chaos station: "GRV handed out" — the kill-mid-commit
             # scenarios arm role deaths here (server/chaos.py)
             fire_station("MasterProxyServer.GRV.AfterReply")
+            # hot-key conflict windows ride the GRV reply into the
+            # client-side early-abort cache (server/scheduler.py);
+            # empty and free while CLIENT_CONFLICT_WINDOWS is off
+            windows = (self._conflict_windows
+                       if SERVER_KNOBS.client_conflict_windows else ())
             for entry in batch:
                 self.grv_bands.record(now - entry[3])
-                entry[0].send(GetReadVersionReply(version))
+                entry[0].send(GetReadVersionReply(version, windows))
         except flow.FdbError as e:
             cancelled = e.name == "operation_cancelled"
             if cancelled:
@@ -627,14 +653,26 @@ class Proxy:
                       for b, e in (tuple(req.read_conflict_ranges)
                                    + tuple(req.write_conflict_ranges))))
 
+    def _sched_release(self, req, reply) -> None:
+        """A deferred commit re-enters the commit stream locally (no
+        wire hop): the batcher picks it up like any fresh arrival, and
+        the scheduler's released-marker keeps it from re-deferring."""
+        self.commits.stream.send((req, reply))
+
     async def _batcher(self):
         """(ref: commitBatcher :344 — batch by window / count / BYTES:
         a batch closes early once its mutation payload reaches
         COMMIT_TRANSACTION_BATCH_BYTES_MAX, bounding resolver/log
-        request sizes)"""
+        request sizes). Arrivals first pass the admission scheduler:
+        a commit whose predicted conflict probability crosses the
+        threshold is captured into a per-hot-range queue instead of
+        racing this batch (server/scheduler.py; no-op while
+        CONFLICT_SCHEDULING is off)."""
         bytes_max = SERVER_KNOBS.commit_transaction_batch_bytes_max
         while True:
             req, reply = await self.commits.pop()
+            if self.scheduler.consider(req, reply):
+                continue
             batch: List = [(req, reply)]
             nbytes = self._req_bytes(req)
             deadline = flow.delay(self.batch_window,
@@ -644,8 +682,11 @@ class Proxy:
                 got = await flow.first_of(nxt, deadline)
                 if got[0] == 1:  # window expired
                     break
-                batch.append(got[1])
-                nbytes += self._req_bytes(got[1][0])
+                r2, p2 = got[1]
+                if self.scheduler.consider(r2, p2):
+                    continue
+                batch.append((r2, p2))
+                nbytes += self._req_bytes(r2)
             deadline.cancel()
             self._local_batch += 1
             flow.spawn(self._commit_batch(batch, self._local_batch),
@@ -794,10 +835,36 @@ class Proxy:
             elapsed = flow.now() - t0
             for idx, (verdict, reply) in enumerate(zip(verdicts, replies)):
                 self.commit_bands.record(elapsed)
-                if account:
+                # server-side repair first (server/repair.py): a
+                # conflicted-but-repairable transaction is re-executed
+                # at THIS batch's version and resubmitted instead of
+                # aborting — its reply (and its tag/priority
+                # accounting) settles with the resubmission's outcome
+                # only FIRST-attempt conflicts are captured: a repair
+                # RESUBMISSION that conflicts again reports back to
+                # the repair actor that owns it (which holds the
+                # range's serialization lock and loops) — capturing it
+                # here would nest a second actor behind that same lock
+                attempt = getattr(reqs[idx], "repair_attempt", 0)
+                repairing = (verdict not in (COMMITTED, TOO_OLD)
+                             and idx not in illegal
+                             and attempt == 0
+                             and self.repair.try_repair(
+                                 reqs[idx], reply, ver.version,
+                                 conflict_ranges[idx]))
+                # a resubmission that conflicts with budget left will
+                # be retried by its repair actor — account only the
+                # TERMINAL outcome, or one client txn counts N times
+                interim = (attempt > 0
+                           and verdict not in (COMMITTED, TOO_OLD)
+                           and attempt < int(
+                               SERVER_KNOBS.repair_max_attempts))
+                if account and not repairing and not interim:
                     self._account(reqs[idx], verdict, idx in illegal,
                                   now_acct)
-                if idx in illegal:
+                if repairing:
+                    flow.cover("proxy.commit.repair_pending")
+                elif idx in illegal:
                     reply.send_error(error("client_invalid_operation"))
                 elif verdict == COMMITTED:
                     st.counter("transactions_committed").add(1)
@@ -808,7 +875,11 @@ class Proxy:
                     reply.send_error(error("transaction_too_old"))
                 else:
                     flow.cover("proxy.commit.conflict")
-                    st.counter("transactions_conflicted").add(1)
+                    if not interim:
+                        # interim repair rounds must not inflate the
+                        # conflict rate: one client txn, one terminal
+                        # outcome (same invariant as _account above)
+                        st.counter("transactions_conflicted").add(1)
                     if getattr(reqs[idx], "report_conflicting_keys",
                                False):
                         # a reporting client gets the attributed key
@@ -892,6 +963,38 @@ class Proxy:
             "commit_rate": round(txn_rate, 2),
             "tps_budget": self._rate,
         })
+
+    def _repair_fallback_account(self, req) -> None:
+        """A terminal abort delivered by the repair engine itself
+        (re-read failure and friends): restore the conflict accounting
+        phase 5 skipped when it captured this transaction — the txn
+        DID conflict, and tag/priority QoS rates must not undercount
+        exactly when the cluster is degraded."""
+        self.stats.counter("transactions_conflicted").add(1)
+        if SERVER_KNOBS.qos_tag_accounting:
+            self._account(req, CONFLICT, False, flow.now())
+
+    def update_hot_spots(self, rows) -> None:
+        """CC-pushed cluster-merged hot-spot rows -> the admission
+        scheduler's predictor AND the GRV conflict-window piggyback
+        (rows arrive hottest-first: (begin, end, score, total,
+        last_conflict_version))."""
+        self.scheduler.update_hot_spots(rows, flow.now())
+        k = SERVER_KNOBS
+        min_score = float(k.conflict_window_score_min)
+        top = int(k.conflict_window_top_k)
+        self._conflict_windows = tuple(
+            (b, e, v) for b, e, s, _t, v in rows[:top] if s >= min_score)
+
+    def scheduler_status(self) -> dict:
+        """Admission-scheduler decision counters for status/cli/
+        exporter."""
+        return self.scheduler.status()
+
+    def repair_status(self) -> dict:
+        """Transaction-repair decision counters for status/cli/
+        exporter."""
+        return self.repair.status()
 
     def _note_resolving(self, delta: int) -> None:
         """Concurrently-resolving batch gauge + high-water mark."""

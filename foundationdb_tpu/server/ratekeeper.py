@@ -38,7 +38,7 @@ from .types import mutation_bytes
 # — the reason string RkUpdate publishes beside the computed rate).
 # Pinned by tests/test_qos_telemetry.py and the status.cluster.qos schema.
 LIMIT_REASONS = ("none", "storage_queue", "tlog_queue", "durability_lag",
-                 "pipeline_occupancy")
+                 "pipeline_occupancy", "conflict_deferrals")
 
 
 def _camel(s: str) -> str:
@@ -64,6 +64,9 @@ class Ratekeeper:
         # resolve-pipeline forced-drain rate per resolver (PR 4's
         # backpressure counters as a throttle input)
         self._pipeline_smooth: Dict[str, SmoothedRate] = {}
+        # admission-scheduler deferred-commit depth per proxy (the
+        # conflict-prediction plane's pressure as a throttle input)
+        self._sched_smooth: Dict[str, Smoother] = {}
         # the last decision with its input signals and limiting reason
         # — what RkUpdate traces and status.cluster.qos publish
         self.last_decision: dict = {}
@@ -130,6 +133,7 @@ class Ratekeeper:
                   "worst_durability_lag_versions": 0,
                   "pipeline_occupancy": 0.0,
                   "pipeline_forced_drain_rate": 0.0,
+                  "sched_deferred_depth": 0.0,
                   "dead_replicas": 0}
         reason = "none"
         # the batch bucket has its own binding constraint (its spring
@@ -235,6 +239,31 @@ class Ratekeeper:
             for stale in set(self._pipeline_smooth) - live_res:
                 del self._pipeline_smooth[stale]
 
+        # admission-scheduler deferral pressure (ISSUE 8): a deep
+        # deferred-commit queue means admission is outrunning what the
+        # hot ranges can serialize — throttle at the GRV gate BEFORE
+        # the per-range queues overflow into racing aborts (same
+        # spring-zone shape as the queue-byte inputs; 0 disables)
+        sd_target = k.rk_sched_defer_limit
+        if sd_target > 0:
+            live_px = set()
+            for pn, role in self._proxy_roles(info):
+                live_px.add(pn)
+                sm = self._sched_smooth.get(pn)
+                if sm is None:
+                    sm = self._sched_smooth[pn] = Smoother()
+                q = sm.sample(role.scheduler.queue_depth(), now, tau)
+                inputs["sched_deferred_depth"] = max(
+                    inputs["sched_deferred_depth"], round(q, 2))
+                sp = k.rk_sched_defer_spring
+                lower(self._spring_limit(q, sd_target, sp,
+                                         max_rate, min_rate),
+                      self._spring_limit(q, sd_target * batch_frac, sp,
+                                         max_rate, min_rate),
+                      "conflict_deferrals")
+            for stale in set(self._sched_smooth) - live_px:
+                del self._sched_smooth[stale]
+
         # durability-lag excess scales everything quadratically toward
         # the trickle as it approaches the MVCC window
         inputs["worst_durability_lag_versions"] = max(0, worst_excess)
@@ -257,17 +286,22 @@ class Ratekeeper:
         return self._decide(limit, min(batch_limit, limit), reason,
                             inputs, now)
 
+    def _epoch_roles(self, info, cls):
+        """Live current-epoch roles of `cls` from the CC's registry —
+        the shared cluster_controller.epoch_roles walk (lazy import:
+        no module cycle, and fake-CC unit tests still only need a
+        `workers` dict)."""
+        from .cluster_controller import epoch_roles
+        return epoch_roles(self.cc.workers, info.epoch, cls)
+
     def _resolver_roles(self, info):
-        """Live current-epoch resolver roles from the CC's registry
-        (the same walk _health_messages does)."""
         from .resolver_role import Resolver
-        ep = info.epoch
-        for wi in self.cc.workers.values():
-            if not wi.worker.process.alive:
-                continue
-            for rn, role in wi.worker.roles.items():
-                if isinstance(role, Resolver) and f"-e{ep}-" in rn:
-                    yield rn, role
+        return self._epoch_roles(info, Resolver)
+
+    def _proxy_roles(self, info):
+        """The deferral-pressure input's source."""
+        from .proxy import Proxy
+        return self._epoch_roles(info, Proxy)
 
     def _decide(self, tps, batch_tps, reason, inputs, now):
         """Record the decision (rate + batch rate + limiting reason +

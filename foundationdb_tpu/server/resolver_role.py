@@ -31,50 +31,76 @@ class ConflictHotSpots:
     `half_life` seconds of simulated time, so a burst of aborts shows
     up immediately and ages out instead of pinning the table forever.
     Bounded at `max_entries` (lowest decayed score evicted); `top(k)`
-    is the status/CLI surface."""
+    is the status/CLI surface and `rows(k)` the raw feed the CC pushes
+    to the proxies' conflict predictors (server/scheduler.py).
 
-    __slots__ = ("half_life", "max_entries", "_entries")
+    Half-life, capacity and top-K are LIVE-READ from the knobs when
+    not pinned at construction — the Smoother discipline PR 6
+    established; a construction-time read would freeze a SimCluster's
+    later knob changes out of the decay math (satellite audit: the
+    bug PR 6 fixed in Smoother was latent here too)."""
+
+    __slots__ = ("_half_life", "_max_entries", "_entries")
 
     def __init__(self, half_life: float = None, max_entries: int = None):
-        self.half_life = (half_life if half_life is not None
-                          else SERVER_KNOBS.hot_spot_half_life)
-        self.max_entries = (max_entries if max_entries is not None
-                            else int(SERVER_KNOBS.hot_spot_max_entries))
-        # (begin, end) -> [decayed score, raw total, last update time]
+        self._half_life = half_life      # None -> live knob read
+        self._max_entries = max_entries  # None -> live knob read
+        # (begin, end) -> [decayed score, raw total, last update time,
+        #                  last attributed conflict version]
         self._entries: dict = {}
+
+    @property
+    def half_life(self) -> float:
+        return (self._half_life if self._half_life is not None
+                else SERVER_KNOBS.hot_spot_half_life)
+
+    @property
+    def max_entries(self) -> int:
+        return int(self._max_entries if self._max_entries is not None
+                   else SERVER_KNOBS.hot_spot_max_entries)
 
     def _decayed(self, score: float, since: float, now: float) -> float:
         if now <= since or self.half_life <= 0:
             return score
         return score * 0.5 ** ((now - since) / self.half_life)
 
-    def record(self, begin: bytes, end: bytes, weight: float = 1.0) -> None:
+    def record(self, begin: bytes, end: bytes, weight: float = 1.0,
+               version: int = 0) -> None:
         now = flow.now()
         ent = self._entries.get((begin, end))
         if ent is None:
-            self._entries[(begin, end)] = [float(weight), 1, now]
+            self._entries[(begin, end)] = [float(weight), 1, now, version]
         else:
             ent[0] = self._decayed(ent[0], ent[2], now) + weight
             ent[1] += 1
             ent[2] = now
-        if len(self._entries) > self.max_entries:
+            ent[3] = max(ent[3], version)
+        # while, not if: a live-shrunk capacity knob drains the excess
+        # instead of hovering one-in-one-out above the new bound
+        while len(self._entries) > self.max_entries:
             worst = min(self._entries,
                         key=lambda k: self._decayed(
                             self._entries[k][0], self._entries[k][2], now))
             del self._entries[worst]
+
+    def rows(self, k: int = None) -> list:
+        """Raw decayed rows, hottest first: (begin, end, score, total,
+        last attributed conflict version) — the conflict predictor /
+        GRV conflict-window feed (bytes, unrounded)."""
+        now = flow.now()
+        out = [(b, e, self._decayed(s, t, now), total, ver)
+               for (b, e), (s, total, t, ver) in self._entries.items()]
+        out.sort(key=lambda r: (-r[2], r[0], r[1]))
+        return out if k is None else out[:k]
 
     def top(self, k: int = None) -> list:
         """Status-ready rows, hottest first: decayed rate score + raw
         total per attributed range."""
         if k is None:
             k = int(SERVER_KNOBS.hot_spot_top_k)
-        now = flow.now()
-        rows = [(self._decayed(s, t, now), total, b, e)
-                for (b, e), (s, total, t) in self._entries.items()]
-        rows.sort(key=lambda r: (-r[0], r[2], r[3]))
         return [{"begin": b.hex(), "end": e.hex(),
                  "score": round(score, 4), "total": total}
-                for score, total, b, e in rows[:k]]
+                for b, e, score, total, _v in self.rows(k)]
 
 
 class Resolver:
@@ -181,7 +207,7 @@ class Resolver:
                     self.conflict_set.drain_with_attribution(ticket)
                 reply.send(self._build_payload(
                     txns, verdicts, attributions, want_report,
-                    record_hot=False))
+                    record_hot=False, version=req.version))
                 return
             cached = self._reply_cache.get(req.version)
             flow.cover("resolver.reply_cache.hit", cached is not None)
@@ -211,8 +237,15 @@ class Resolver:
                 for b, _e in t.write_ranges:
                     self.key_hist[b[0] if b else 0] += 1
                 self.work_units += len(t.read_ranges) + len(t.write_ranges)
+            # repairable transactions need the cause mask at the proxy
+            # even when the client never asked to SEE it — repair
+            # (server/repair.py) keys off exactly the attributed reads.
+            # Gated on the knob: with TXN_REPAIR off the declaration
+            # rides the wire inert, costing no attribution payload
+            repair_on = bool(SERVER_KNOBS.txn_repair)
             want_report = any(
                 getattr(t, "report_conflicting_keys", False)
+                or (repair_on and getattr(t, "repairable", False))
                 for t in req.transactions)
             new_oldest = max(0, req.version - self._mwtlv)
             attributions = None
@@ -246,7 +279,8 @@ class Resolver:
                 verdicts, attributions = \
                     self.conflict_set.drain_with_attribution(ticket)
             payload = self._build_payload(txns, verdicts, attributions,
-                                          want_report, record_hot=True)
+                                          want_report, record_hot=True,
+                                          version=req.version)
             self._reply_cache[req.version] = payload
             self._reply_order.append(req.version)
             while len(self._reply_order) > self._cache_cap:
@@ -262,9 +296,11 @@ class Resolver:
             flow.g_trace_batch.finish_spans(spans)
 
     def _build_payload(self, txns, verdicts, attributions, want_report,
-                       record_hot: bool):
+                       record_hot: bool, version: int = 0):
         """Attribution -> actual key ranges: feed the hot-spot table
-        (first delivery only — a duplicate must not double-count) and
+        (first delivery only — a duplicate must not double-count; the
+        batch version rides along as the range's last-conflict
+        version, the client conflict windows' staleness anchor) and
         build the per-txn reply payload when some txn asked for
         report_conflicting_keys."""
         ranges_per_txn = [()] * len(txns)
@@ -278,7 +314,7 @@ class Resolver:
                 if record_hot:
                     n_attr += len(rs)
                     for b, e in rs:
-                        self.hot_spots.record(b, e)
+                        self.hot_spots.record(b, e, version=version)
             if record_hot and n_attr:
                 self.stats.counter("conflict_ranges_attributed").add(n_attr)
         return (ResolveReply(tuple(verdicts), tuple(ranges_per_txn))

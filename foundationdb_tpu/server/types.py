@@ -98,6 +98,15 @@ class CommitRequest(NamedTuple):
     # accounting, and later tag throttling, keys off these)
     priority: int = 1          # PRIORITY_DEFAULT
     tags: Tuple[bytes, ...] = ()
+    # transaction-repair contract (server/repair.py): the client
+    # declares a covered read-set and value-independent writes, so a
+    # conflicted commit may be repaired server-side — the invalidated
+    # reads re-read at the conflict version and the commit revalidated
+    # — instead of aborting. repair_attempt counts server-side
+    # resubmissions (bounded by REPAIR_MAX_ATTEMPTS; also tells the
+    # admission scheduler a resubmission already waited its turn)
+    repairable: bool = False
+    repair_attempt: int = 0
 
 
 class CommitReply(NamedTuple):
@@ -146,6 +155,12 @@ class GetReadVersionRequest(NamedTuple):
 
 class GetReadVersionReply(NamedTuple):
     version: int
+    # hot-key conflict windows piggybacked for the client-side early
+    # abort (server/scheduler.py ConflictWindowCache): rows of
+    # (begin, end, last_conflict_version), shipped only while
+    # CLIENT_CONFLICT_WINDOWS is armed — the reply is byte-identical
+    # to the pre-subsystem one otherwise
+    conflict_windows: Tuple = ()
 
 
 class ResolveRequest(NamedTuple):

@@ -177,7 +177,10 @@ class Worker:
                   storage_tags=storage_tags,
                   recovery_version=recovery_version,
                   ratekeeper_ref=ratekeeper_ref,
-                  management_ref=management_ref)
+                  management_ref=management_ref,
+                  # transaction repair re-reads invalidated ranges
+                  # straight from storage via the broadcast shard map
+                  dbinfo=self.dbinfo)
         p.start()
         self.roles[name] = p
         return ProxyRefs(name, p.grvs.ref(), p.commits.ref(),

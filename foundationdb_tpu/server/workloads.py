@@ -387,11 +387,19 @@ class OpenLoopStorm:
                  keyspace: int = 64, zipf_s: float = 1.2,
                  prefix: bytes = b"storm/", batch_fraction: float = 0.2,
                  tags: tuple = (b"web", b"batchjob", b"mobile"),
-                 max_inflight: int = 512):
+                 max_inflight: int = 512,
+                 repairable_fraction: float = 0.0):
         import math
         self.dbs = list(dbs)
         self.rng = rng
         self.duration = duration
+        # fraction of transactions declaring the automatic_repair
+        # contract (their get+blind-set shape is value-independent, so
+        # the declaration is honest); inert while TXN_REPAIR is off —
+        # the chaos storms arm it so BUGGIFY-randomized nightlies run
+        # the repair paths under faults. 0 draws no RNG at all, so the
+        # default arrival schedule is bit-identical to pre-subsystem.
+        self.repairable_fraction = repairable_fraction
         self.rate = rate
         self.burst_rate = burst_rate
         self.burst_start = burst_start
@@ -436,6 +444,9 @@ class OpenLoopStorm:
             tr.set_option("transaction_tag", self.tags[i % len(self.tags)])
             if self.rng.random01() < self.batch_fraction:
                 tr.set_option("priority_batch")
+            if self.repairable_fraction > 0 and \
+                    self.rng.random01() < self.repairable_fraction:
+                tr.set_option("automatic_repair")
             t0 = flow.now()
             await tr.get_read_version()
             self.grv_latency.record(flow.now() - t0)
@@ -524,11 +535,15 @@ class ChaosStorm:
         if recovery_bound is None:
             recovery_bound = float(flow.SERVER_KNOBS.chaos_recovery_bound)
         self.recovery_bound = recovery_bound
-        # steady open-loop pressure, no burst: the scenario IS the storm
+        # steady open-loop pressure, no burst: the scenario IS the storm.
+        # A quarter of the traffic declares automatic_repair — inert
+        # unless a BUGGIFY-randomized nightly cell armed TXN_REPAIR, in
+        # which case the repair paths run under the scenario's faults
+        # with the same consistency/shadow/digest oracles watching
         self.storm = OpenLoopStorm(
             self.dbs, rng, duration=duration, rate=rate, burst_rate=rate,
             burst_start=duration, keyspace=keyspace, prefix=b"chaos/",
-            max_inflight=256)
+            max_inflight=256, repairable_fraction=0.25)
 
     async def run(self) -> dict:
         from .chaos import chaos_status, database_digest, record_scenario
@@ -593,6 +608,140 @@ class ChaosStorm:
             # callers must not have to query it for chaos accounting)
             "status": status,
         }
+
+
+class ContentionStorm:
+    """High-contention goodput workload (ISSUE 8's measurement plane):
+    seeded open-loop arrivals at a FIXED offered load, every arrival a
+    read-modify-write on one of a few hot keys driven through a
+    bounded client retry loop. The measure is COMMITTED GOODPUT —
+    transactions that actually committed per second — not verdicts/s:
+    under contention the abort-only baseline burns its capacity on
+    retries and exhausted attempts, which is exactly the tax the
+    scheduler/repair subsystem exists to convert into commits. Two
+    runs with the same seed offer the identical arrival schedule, so
+    `off vs on` is an apples-to-apples goodput comparison.
+
+    Each transaction: read the hot key (records the read conflict),
+    ADD 1 to it atomically, blind-set a unique payload row — a
+    value-independent shape, honestly `automatic_repair`-declarable.
+    The hot counters double as a bit-exactness oracle: their sum must
+    equal the committed count exactly (a repair that double-applied or
+    lost a mutation cannot hide), modulo unknown-outcome attempts
+    which are counted, not retried."""
+
+    def __init__(self, dbs, rng, duration: float = 4.0,
+                 rate: float = 150.0, hot_keys: int = 2,
+                 prefix: bytes = b"cont/", max_retries: int = 4,
+                 repairable: bool = True, max_inflight: int = 512):
+        import math
+        self.dbs = list(dbs)
+        self.rng = rng
+        self.duration = duration
+        self.rate = rate
+        self.hot_keys = hot_keys
+        self.prefix = prefix
+        self.max_retries = max_retries
+        self.repairable = repairable
+        self.max_inflight = max_inflight
+        self._ln = math.log
+        self._inflight = 0
+        from ..flow.latency import LatencySample
+        self.txn_latency = LatencySample("contention_txn", size=4096)
+        self.stats = {"issued": 0, "committed": 0, "conflicts": 0,
+                      "failed": 0, "unknown": 0, "shed": 0,
+                      "attempts": 0}
+
+    def _hot_key(self, i: int) -> bytes:
+        return self.prefix + b"hot%02d" % (i % self.hot_keys)
+
+    async def _one_txn(self, i: int) -> None:
+        import struct
+        db = self.dbs[i % len(self.dbs)]
+        k = self._hot_key(i)
+        t0 = flow.now()
+        tr = db.create_transaction()
+        attempts = 0
+        try:
+            while True:
+                attempts += 1
+                self.stats["attempts"] += 1
+                try:
+                    if self.repairable:
+                        tr.set_option("automatic_repair")
+                    await tr.get(k)
+                    tr.atomic_op(k, struct.pack("<q", 1), ADD_VALUE)
+                    tr.set(self.prefix + b"r%07d" % i, b"x")
+                    await tr.commit()
+                    self.stats["committed"] += 1
+                    self.txn_latency.record(flow.now() - t0)
+                    return
+                except flow.FdbError as e:
+                    if e.name in UNKNOWN_OUTCOME:
+                        # never retried: the goodput oracle (hot-key
+                        # sum == committed) must stay exact, and a
+                        # retried unknown could double-apply the ADD
+                        self.stats["unknown"] += 1
+                        return
+                    if e.name == "not_committed":
+                        self.stats["conflicts"] += 1
+                    if attempts > self.max_retries or \
+                            e.name not in RETRYABLE:
+                        self.stats["failed"] += 1
+                        return
+                    try:
+                        await tr.on_error(e)
+                    except flow.FdbError:
+                        self.stats["failed"] += 1
+                        return
+        finally:
+            self._inflight -= 1
+
+    async def run(self) -> dict:
+        start = flow.now()
+        t = start
+        outstanding = []
+        i = 0
+        while True:
+            u = self.rng.random01()
+            t += -self._ln(max(1e-12, 1.0 - u)) / max(self.rate, 1e-9)
+            if t - start >= self.duration:
+                break
+            if t > flow.now():
+                await flow.delay(t - flow.now())
+            self.stats["issued"] += 1
+            if self._inflight >= self.max_inflight:
+                self.stats["shed"] += 1
+                continue
+            self._inflight += 1
+            outstanding.append(flow.spawn(
+                self._one_txn(i), name=f"cont-txn-{i}"))
+            i += 1
+        await flow.wait_for_all(outstanding)
+        out = dict(self.stats)
+        wall = flow.now() - start
+        out["wall_seconds"] = round(wall, 3)
+        out["goodput_per_sec"] = round(out["committed"] / max(wall, 1e-9),
+                                       2)
+        out["attempts_per_commit"] = round(
+            out["attempts"] / max(out["committed"], 1), 3)
+        out["latency"] = self.txn_latency.snapshot()
+        return out
+
+    async def read_hot_total(self, db) -> int:
+        """Sum of the hot ADD counters — must equal committed (plus at
+        most `unknown`, whose outcomes the storm deliberately did not
+        settle). The bit-exactness oracle for repaired commits."""
+        import struct
+
+        async def body(tr):
+            total = 0
+            for j in range(self.hot_keys):
+                v = await tr.get(self.prefix + b"hot%02d" % j)
+                if v is not None:
+                    total += struct.unpack("<q", v)[0]
+            return total
+        return await run_transaction(db, body, max_retries=200)
 
 
 class FuzzApiCorrectness:

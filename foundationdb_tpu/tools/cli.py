@@ -165,6 +165,43 @@ def _render_details(cl: dict) -> str:
                 f"replayed={fo.get('replayed_batches', 0)} "
                 f"reattach={fo.get('reattaches', 0)} "
                 f"shadow={sh.get('sampled', 0)}/{sh.get('mismatches', 0)}mm")
+    cs = cl.get("conflict_scheduling") or {}
+    scheds = [(p["name"], p.get("scheduler") or {})
+              for p in cl.get("proxies", ())]
+    if cs.get("scheduling_enabled") or any(
+            s.get("deferrals") for _n, s in scheds):
+        # conflict prediction at admission: who deferred how much, and
+        # what the predictors currently know (server/scheduler.py)
+        lines.append("Conflict scheduler:")
+        for name, s in scheds:
+            lines.append(
+                f"  {name:<26} deferrals={s.get('deferrals', 0)} "
+                f"released={s.get('released', 0)} "
+                f"overflow={s.get('overflow', 0)} "
+                f"held={s.get('deferred_now', 0)} "
+                f"queues={s.get('queue_ranges', 0)} "
+                f"hot_rows={s.get('hot_rows', 0)}")
+        client = cs.get("client") or {}
+        lines.append(
+            f"  client windows: early_aborts="
+            f"{client.get('early_aborts', 0)} "
+            f"checks={client.get('checks', 0)} "
+            f"cached={client.get('windows_cached', 0)}")
+    reps = [(p["name"], p.get("repair") or {})
+            for p in cl.get("proxies", ())]
+    if cs.get("repair_enabled") or any(
+            r.get("attempts") for _n, r in reps):
+        # server-side transaction repair: the abort tax converted
+        # (server/repair.py)
+        lines.append("Transaction repair:")
+        for name, r in reps:
+            lines.append(
+                f"  {name:<26} attempts={r.get('attempts', 0)} "
+                f"repaired={r.get('committed', 0)} "
+                f"reconflicted={r.get('conflicted', 0)} "
+                f"fallbacks={r.get('fallbacks', 0)} "
+                f"reread_rows={r.get('reread_rows', 0)} "
+                f"in_flight={r.get('in_flight', 0)}")
     if cl.get("kernels"):
         lines.append("Kernel compile/execute (process-wide):")
         for kn, v in sorted(cl["kernels"].items()):

@@ -180,10 +180,75 @@ def render_prometheus(status: dict) -> str:
                   "Per-priority-class transaction outcomes",
                   {"priority": prio, "outcome": c}, counts.get(c))
 
+    # conflict prediction & transaction repair (server/scheduler.py +
+    # server/repair.py): armed planes, cluster totals, and the
+    # client-side early-abort counters
+    sched = cl.get("conflict_scheduling") or {}
+    if sched:
+        for feat in ("scheduling", "repair", "client_windows"):
+            f.add(f"{_PREFIX}_sched_enabled", "gauge",
+                  "1 while the named conflict-scheduling plane is armed",
+                  {"feature": feat}, sched.get(f"{feat}_enabled"))
+        for cname, value in sorted((sched.get("client") or {}).items()):
+            if cname == "windows_cached":
+                # a level (Counter.set), not a monotone count: typing
+                # it counter would make rate() read every decrease as
+                # a reset
+                f.add(f"{_PREFIX}_sched_client_windows", "gauge",
+                      "Hot-key conflict windows currently cached "
+                      "client-side", {}, value)
+            else:
+                f.add(f"{_PREFIX}_sched_client", "counter",
+                      "Client-side conflict-window cache counters "
+                      "(early aborts, checks, updates)",
+                      {"counter": cname}, value)
+
     for p in cl.get("proxies", ()):
         _add_counters(f, "proxy", p["name"], p.get("counters"))
         for req, snap in (p.get("latency_bands") or {}).items():
             _add_latency(f, "proxy", p["name"], req, snap)
+        ps = p.get("scheduler") or {}
+        if ps:
+            slabels = {"role": p["name"]}
+            for c, help_text in (
+                    ("deferrals", "Commits captured into per-hot-range "
+                                  "deferral queues"),
+                    ("released", "Deferred commits released back into "
+                                 "the batcher"),
+                    ("overflow", "Deferrals refused by the bounded-"
+                                 "delay/queue-cap contract"),
+                    ("pushes", "Hot-spot pushes received from the CC")):
+                f.add(f"{_PREFIX}_sched_{c}", "counter", help_text,
+                      slabels, ps.get(c))
+            for g, help_text in (
+                    ("deferred_now", "Commits currently held deferred"),
+                    ("queue_ranges", "Hot ranges with a live deferral "
+                                     "queue"),
+                    ("hot_rows", "Hot-spot rows in the predictor")):
+                f.add(f"{_PREFIX}_sched_{g}", "gauge", help_text,
+                      slabels, ps.get(g))
+        pr = p.get("repair") or {}
+        if pr:
+            rlabels = {"role": p["name"]}
+            for c, help_text in (
+                    ("attempts", "Conflicted transactions captured for "
+                                 "server-side repair"),
+                    ("committed", "Repairs that committed without a "
+                                  "client round trip"),
+                    ("conflicted", "Repairs that re-conflicted with the "
+                                   "attempt budget exhausted"),
+                    ("failed", "Repairs whose resubmission outcome is "
+                               "unknown"),
+                    ("fallbacks", "Repairs that fell back to the "
+                                  "ordinary abort before resubmitting"),
+                    ("shed", "Repairs refused by the in-flight cap"),
+                    ("reread_rows", "Rows re-read from storage during "
+                                    "partial re-execution")):
+                f.add(f"{_PREFIX}_repair_{c}", "counter", help_text,
+                      rlabels, pr.get(c))
+            f.add(f"{_PREFIX}_repair_in_flight", "gauge",
+                  "Repairs currently in flight", rlabels,
+                  pr.get("in_flight"))
     for r in cl.get("resolvers", ()):
         _add_counters(f, "resolver", r["name"], r.get("counters"))
         for req, snap in (r.get("latency_bands") or {}).items():

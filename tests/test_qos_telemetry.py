@@ -33,7 +33,8 @@ RESOLVER_SIGNALS = {"pipeline_occupancy", "pipeline_in_flight",
                     "txn_rate", "state_rows"}
 RK_INPUTS = {"worst_storage_queue_bytes", "worst_tlog_queue_bytes",
              "worst_durability_lag_versions", "pipeline_occupancy",
-             "pipeline_forced_drain_rate", "dead_replicas"}
+             "pipeline_forced_drain_rate", "sched_deferred_depth",
+             "dead_replicas"}
 
 
 # -- Smoother (satellite: promotion + clamp) ---------------------------
