@@ -39,7 +39,12 @@ _ATOMIC_APPLY = {
 
 RETRYABLE = {"not_committed", "transaction_too_old", "future_version",
              "broken_promise", "commit_unknown_result", "timed_out",
-             "tlog_stopped", "coordinators_changed", "wrong_shard_server"}
+             "tlog_stopped", "coordinators_changed", "wrong_shard_server",
+             # the enforced-admission plane's designed overload
+             # responses: a rejected GRV retries through the ordinary
+             # backoff loop (ref: proxy_memory_limit_exceeded /
+             # tag_throttled both retryable in NativeAPI onError)
+             "proxy_memory_limit_exceeded", "tag_throttled"}
 
 # errors that mean our picture of the cluster may be stale: re-fetch the
 # ServerDBInfo before retrying (ref: the client reconnecting through
@@ -130,6 +135,11 @@ class Database:
         # the same picture; lazily created on the first window-carrying
         # reply, so the feature-off path allocates nothing
         self._conflict_cache = None
+        # server-advertised tag throttles ridden in on GRV replies
+        # (server/tag_throttler.py ClientTagThrottleCache): same
+        # plumbing — database-scoped so retries honor the backoff too,
+        # lazily created on the first throttle-carrying reply
+        self._tag_throttle_cache = None
 
     def note_latency(self, replica: str, seconds: float) -> None:
         prev = self._latency_ema.get(replica)
@@ -360,10 +370,13 @@ class Database:
         info = await self.info()
         return info.storages[_shard_index(info.storages, key)]
 
-    def batched_grv(self, priority: Optional[int] = None) -> Future:
+    def batched_grv(self, priority: Optional[int] = None,
+                    tags: Tuple[bytes, ...] = ()) -> Future:
         """Batch concurrent GRV REQUESTS into one proxy round trip PER
         PRIORITY CLASS (ref: readVersionBatcher,
-        NativeAPI.actor.cpp:2854). Requests are collected for one batch
+        NativeAPI.actor.cpp:2854) — and per tag set, once tag
+        throttling arms, so the proxy's per-tag admission gate sees the
+        tags it must charge. Requests are collected for one batch
         interval and THEN fetched — a request must never join a fetch
         already in flight, or a client could receive a version
         predating its own acknowledged commit."""
@@ -371,7 +384,7 @@ class Database:
         if priority is None:
             priority = PRIORITY_DEFAULT
         f = Future()
-        self._grv_waiters.setdefault(priority, []).append(f)
+        self._grv_waiters.setdefault((priority, tuple(tags)), []).append(f)
         if not self._grv_timer_armed:
             self._grv_timer_armed = True
             flow.spawn(self._grv_batch_fire(),
@@ -387,19 +400,19 @@ class Database:
         # classes fetch CONCURRENTLY: a throttled or dead-proxy fetch in
         # one class must not head-of-line block (or, on cancellation,
         # strand) another class's independent round trip
-        for priority, waiters in by_prio.items():
-            flow.spawn(self._grv_fetch_one(priority, waiters),
+        for (priority, tags), waiters in by_prio.items():
+            flow.spawn(self._grv_fetch_one(priority, tags, waiters),
                        TaskPriority.DEFAULT_ENDPOINT,
                        name=f"client.grvFetch.p{priority}")
 
-    async def _grv_fetch_one(self, priority: int, waiters) -> None:
+    async def _grv_fetch_one(self, priority: int, tags, waiters) -> None:
         from ..server.types import GetReadVersionRequest
         info = None
         try:
             info = await self.info()
             proxy = await self.proxy()
             reply = await _rpc(proxy.grvs.get_reply(
-                GetReadVersionRequest(len(waiters), priority),
+                GetReadVersionRequest(len(waiters), priority, tags),
                 self.process))
             windows = getattr(reply, "conflict_windows", ())
             if windows:
@@ -407,6 +420,13 @@ class Database:
                     from ..server.scheduler import ConflictWindowCache
                     self._conflict_cache = ConflictWindowCache()
                 self._conflict_cache.update(windows, flow.now())
+            throttles = getattr(reply, "tag_throttles", ())
+            if throttles:
+                if self._tag_throttle_cache is None:
+                    from ..server.tag_throttler import \
+                        ClientTagThrottleCache
+                    self._tag_throttle_cache = ClientTagThrottleCache()
+                self._tag_throttle_cache.update(throttles, flow.now())
             for f in waiters:
                 if not f.is_ready:
                     f.send((reply.version, info.seq))
@@ -430,6 +450,27 @@ class Database:
                 if not f.is_ready:
                     f.send_error(error("operation_failed"))
             raise
+
+    async def honor_tag_throttles(self, tags,
+                                  max_delay: Optional[float] = None) -> None:
+        """Client-honored backoff (ref: the client-side tag-throttle
+        delay in NativeAPI's readVersionBatcher): a tag the server
+        advertised as throttled paces itself locally BEFORE the next
+        GRV, so the shed work never reaches the proxy's queue at all.
+        `max_delay` clips one wait (a transaction's TIMEOUT deadline
+        must not be slept through before its own machinery can fire).
+        Zero-cost until a throttle-carrying reply created the cache."""
+        cache = self._tag_throttle_cache
+        if cache is None:
+            return
+        d = cache.delay(tags, flow.now())
+        if max_delay is not None:
+            d = min(d, max(0.0, max_delay))
+        if d > 0:
+            from ..server.tag_throttler import note_backoff
+            flow.cover("client.tag_backoff")
+            note_backoff(d)
+            await flow.delay(d, TaskPriority.DEFAULT_ENDPOINT)
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
@@ -731,7 +772,26 @@ class Transaction:
         if self._read_version is None:
             prof = self._profile
             t0 = flow.now() if prof is not None else 0.0
-            fut = self.db.batched_grv(getattr(self, "_grv_priority", None))
+            # tags ride the GRV request ONLY while tag throttling is
+            # armed (one knob read) — the off-posture wire request is
+            # byte-identical to the pre-subsystem one. A throttled tag
+            # delays locally first; immediate-priority traffic is
+            # never tag-throttled (matching the server's gate).
+            grv_tags: tuple = ()
+            if flow.SERVER_KNOBS.tag_throttling:
+                grv_tags = tuple(getattr(self, "_tags", ()))
+                if grv_tags:
+                    from ..server.types import PRIORITY_IMMEDIATE
+                    if getattr(self, "_grv_priority", None) != \
+                            PRIORITY_IMMEDIATE:
+                        # a TIMEOUT-bounded transaction never sleeps
+                        # past its own deadline honoring a throttle
+                        ddl = getattr(self, "_timeout_deadline", None)
+                        await self.db.honor_tag_throttles(
+                            grv_tags,
+                            None if ddl is None else ddl - flow.now())
+            fut = self.db.batched_grv(getattr(self, "_grv_priority", None),
+                                      grv_tags)
             deadline = getattr(self, "_timeout_deadline", None)
             if deadline is not None:
                 # the shared class fetch continues for other waiters;

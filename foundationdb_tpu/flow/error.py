@@ -54,6 +54,8 @@ _define("transaction_too_old", 1007, "Transaction is too old to perform reads or
 _define("no_more_servers", 1008, "Not enough physical servers available")
 _define("future_version", 1009, "Request for future version")
 _define("tlog_stopped", 1011, "TLog stopped")
+_define("proxy_memory_limit_exceeded", 1042,
+        "Proxy commit memory limit exceeded")
 _define("server_request_queue_full", 1012, "Server request queue is full")
 _define("not_committed", 1020, "Transaction not committed due to conflict with another transaction")
 _define("commit_unknown_result", 1021, "Transaction may or may not have committed")
@@ -74,6 +76,7 @@ _define("please_reboot", 1207, "Reboot of server process requested")
 _define("please_reboot_delete", 1208, "Reboot of server process requested, with deletion of state")
 _define("master_proxy_failed", 1209, "Master terminating because a Proxy failed")
 _define("master_resolver_failed", 1210, "Master terminating because a Resolver failed")
+_define("tag_throttled", 1213, "Transaction tag is being throttled")
 _define("platform_error", 1500, "Platform error")
 _define("io_error", 1510, "Disk i/o operation failed")
 _define("file_not_found", 1511, "File not found")
@@ -95,8 +98,9 @@ _define("internal_error", 4100, "An internal error occurred")
 
 # Errors on which fdb clients retry the transaction (ref: NativeAPI onError
 # retries exactly: transaction_too_old, future_version, not_committed,
-# commit_unknown_result, process_behind, database_locked):
-_RETRYABLE = frozenset({1007, 1009, 1020, 1021, 1037, 1038})
+# commit_unknown_result, process_behind, database_locked,
+# proxy_memory_limit_exceeded, tag_throttled):
+_RETRYABLE = frozenset({1007, 1009, 1020, 1021, 1037, 1038, 1042, 1213})
 
 
 def error(name: str, message: str = "") -> FdbError:

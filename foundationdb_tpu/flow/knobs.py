@@ -183,6 +183,46 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("GRV_BURST_INTERVALS", 10, lambda: 1)
     init("RATEKEEPER_POLL_TIMEOUT", 1.0, lambda: 0.1)
 
+    # -- enforced admission control (server/admission.py +
+    # server/tag_throttler.py — ROADMAP item 3). All planes default
+    # OFF, the PR 8 posture: the GRV path is byte-identical until an
+    # operator (or the --overload smoke) arms them; BUGGIFY arms them
+    # randomly so chaos/sim runs exercise the throttled paths.
+    # per-priority GRV token buckets at every proxy, refilled from the
+    # ratekeeper's budget SPLIT across proxies (ref: transactionRate /
+    # proxy count in GetRateInfoReply), with bounded queues
+    init("GRV_ADMISSION_CONTROL", 0, lambda: 1)
+    # per-priority admission queue depth cap; overflow is rejected with
+    # retryable proxy_memory_limit_exceeded (ref: the GRV proxy's
+    # queue-memory rejection) rather than silently growing
+    init("GRV_QUEUE_MAX", 10_000, lambda: 4)
+    # longest a queued GRV may wait before it is shed with the same
+    # retryable error — the bound that keeps ADMITTED p99 meaningful
+    init("GRV_QUEUE_MAX_WAIT", 2.0, lambda: 0.05)
+    # per-tag throttling: proxies watch \xff\x02/throttledTags/ and
+    # enforce per-tag buckets IN FRONT of the class buckets; clients
+    # honor throttles by delaying locally before their next GRV
+    init("TAG_THROTTLING", 0, lambda: 1)
+    # ratekeeper-side auto-throttler: busy tags get auto rows written
+    # into the same system keyspace manual throttles use
+    init("AUTO_TAG_THROTTLING", 0, lambda: 1)
+    init("TAG_THROTTLE_POLL_INTERVAL", 0.5, lambda: 0.05)
+    init("TAG_THROTTLE_UPDATE_INTERVAL", 0.5, lambda: 0.1)
+    # smoothed per-tag started-transaction rate at which the
+    # auto-throttler reads a tag as abusive
+    init("TAG_THROTTLE_BUSY_RATE", 50.0, lambda: 2.0)
+    # auto-throttle target: the busy tag is cut to this fraction of
+    # its observed rate (floored at TAG_THROTTLE_MIN_TPS)
+    init("TAG_THROTTLE_TARGET_FRACTION", 0.25)
+    init("TAG_THROTTLE_MIN_TPS", 1.0)
+    init("TAG_THROTTLE_DURATION", 5.0, lambda: 0.5)
+    # per-tag parked-request queue bound; overflow rejects with
+    # retryable tag_throttled
+    init("TAG_THROTTLE_QUEUE_MAX", 256, lambda: 2)
+    # cap on the client-side local delay honored per GRV (the server
+    # still enforces; the cap only bounds one wait)
+    init("CLIENT_TAG_BACKOFF_MAX", 2.0, lambda: 0.1)
+
     # -- QoS telemetry plane (per-role saturation signals) -------------
     # cluster-controller collection cadence for QosSamples; 0 disables
     # the plane entirely (roles then pay nothing — signals are computed

@@ -1306,7 +1306,11 @@ class ClusterController:
                         # (server/scheduler.py + server/repair.py):
                         # deferral and repair accounting per proxy
                         "scheduler": role.scheduler_status(),
-                        "repair": role.repair_status()})
+                        "repair": role.repair_status(),
+                        # enforced admission control (server/
+                        # admission.py): per-class admission counters,
+                        # queue bounds, and the live tag-throttle rows
+                        "admission": role.admission_status()})
                 elif isinstance(role, Resolver) and \
                         f"-e{info.epoch}-" in rn:
                     kern = role.kernel_stats()
@@ -1430,6 +1434,13 @@ class ClusterController:
                 # and the client-side conflict-window cache counters
                 # (process-wide, like client_profile)
                 "conflict_scheduling": self._sched_doc(proxies),
+                # enforced admission control & tag throttling rollup:
+                # armed knobs, per-class admission totals across the
+                # proxies, the merged live throttle-row table, the
+                # ratekeeper's auto-throttler counters, and the
+                # client-side backoff counters (process-wide)
+                "admission_control": self._admission_doc(proxies,
+                                                         rk_role),
                 "latency_probe": probe,
                 # hottest conflict-causing key ranges, cluster-wide
                 # (per-resolver tables under resolvers[*].hot_spots)
@@ -1488,6 +1499,47 @@ class ClusterController:
                     "excluded": sorted(self.excluded),
                 },
             },
+        }
+
+    @staticmethod
+    def _admission_doc(proxies: list, rk_role) -> dict:
+        """status.cluster.admission_control: knob posture + totals over
+        the per-proxy admission sections + the merged throttle table +
+        the ratekeeper auto-throttler + client backoff counters."""
+        from .tag_throttler import client_throttle_counters
+        k = flow.SERVER_KNOBS
+        totals = {"admitted": {"immediate": 0, "default": 0, "batch": 0},
+                  "queued_now": 0, "rejected": 0, "timed_out": 0,
+                  "throttle_delayed": 0, "throttle_released": 0,
+                  "throttle_rejected": 0, "confirm_rounds": 0}
+        rows: dict = {}
+        for p in proxies:
+            a = p.get("admission") or {}
+            for cls, n in (a.get("admitted") or {}).items():
+                totals["admitted"][cls] = totals["admitted"].get(cls,
+                                                                 0) + n
+            totals["queued_now"] += sum((a.get("queued") or {}).values())
+            for f in ("rejected", "timed_out", "throttle_delayed",
+                      "throttle_released", "throttle_rejected",
+                      "confirm_rounds"):
+                totals[f] += a.get(f, 0)
+            for r in a.get("tag_rows", ()):
+                # every proxy enforces the same durable rows; keep the
+                # freshest picture per tag
+                if r["tag"] not in rows or \
+                        r["expiry"] > rows[r["tag"]]["expiry"]:
+                    rows[r["tag"]] = dict(r)
+        return {
+            "grv_admission_enabled": int(bool(k.grv_admission_control)),
+            "tag_throttling_enabled": int(bool(k.tag_throttling)),
+            "auto_tag_throttling_enabled": int(
+                bool(k.auto_tag_throttling)),
+            **totals,
+            "throttled_tags": sorted(rows.values(),
+                                     key=lambda r: r["tag"]),
+            "auto_throttler": (rk_role.throttler.status()
+                               if rk_role is not None else {}),
+            "client": client_throttle_counters(),
         }
 
     @staticmethod

@@ -26,6 +26,7 @@ from .. import flow
 from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority, error
 from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
+from .admission import GrvAdmissionQueues
 from .chaos import fire_station
 from .repair import RepairManager
 from .scheduler import AdmissionScheduler
@@ -281,6 +282,7 @@ class Proxy:
         self._batch_rate = 1e9         # batch-priority budget (<= _rate)
         self._grv_queue = []           # waiting GRV replies
         self._grv_inflight = []        # batch being confirmed right now
+        self._admission_inflight = []  # ...and the admission loop's own
         self._suspect_peers = {}       # id(ref) -> suspect-until time
         # (ref: ProxyStats — txn admission/commit counters for status)
         self.stats = flow.CounterCollection("proxy")
@@ -328,6 +330,14 @@ class Proxy:
                                     committed_version=self.committed_version,
                                     account=self._repair_fallback_account)
         self._conflict_windows: tuple = ()
+        # enforced admission control (server/admission.py, ROADMAP item
+        # 3): per-priority GRV token buckets fed by the ratekeeper's
+        # per-proxy budget share, per-tag throttle buckets fed by the
+        # \xff\x02/throttledTags/ poll, bounded queues with retryable
+        # rejection. Knob-gated off: with GRV_ADMISSION_CONTROL and
+        # TAG_THROTTLING both 0 no request ever routes through it.
+        self._dbinfo = dbinfo
+        self.admission = GrvAdmissionQueues(process, self.stats)
 
     def set_peers(self, raw_refs) -> None:
         """Raw-committed-version endpoints of the OTHER proxies (ref:
@@ -345,6 +355,12 @@ class Proxy:
         self._actors.add(flow.spawn(self._grv_batcher(),
                                     TaskPriority.PROXY_GRV_TIMER,
                                     name=f"{self.process.name}.grvBatcher"))
+        self._actors.add(flow.spawn(self._admission_loop(),
+                                    TaskPriority.PROXY_GRV_TIMER,
+                                    name=f"{self.process.name}.admission"))
+        self._actors.add(flow.spawn(self._tag_throttle_loop(),
+                                    TaskPriority.PROXY_GRV_TIMER,
+                                    name=f"{self.process.name}.tagThrottle"))
         self._actors.add(flow.spawn(self._raw_committed_loop(),
                                     TaskPriority.PROXY_GET_RAW_COMMITTED_VERSION,
                                     name=f"{self.process.name}.rawCommitted"))
@@ -364,26 +380,43 @@ class Proxy:
         self.raw_committed.close()
         # a stop mid-confirmation must fail the popped batch too, or
         # those clients wait out the full request timeout (code review)
-        for entry in self._grv_queue + self._grv_inflight:
-            entry[0].send_error(error("broken_promise"))
+        for entry in (self._grv_queue + self._grv_inflight
+                      + self._admission_inflight):
+            try:
+                entry[0].send_error(error("broken_promise"))
+            except Exception:
+                pass  # already answered
         self._grv_queue = []
         self._grv_inflight = []
+        self._admission_inflight = []
         # deferred commits held by the admission scheduler fail over
         # the same way (repair actors ride self._actors and answer
-        # their replies from their cancellation path)
+        # their replies from their cancellation path), and so do GRVs
+        # queued in the enforced-admission plane
         self.scheduler.shutdown()
+        self.admission.shutdown()
 
     # -- GRV ------------------------------------------------------------
     async def _grv_loop(self):
         """Queue GRV requests for the batcher (ref: transactionStarter
         :1102 — requests are batched on a timer and released at the
         ratekeeper's rate). Client-batched requests carry how many
-        transactions they admit."""
+        transactions they admit. With the enforced-admission plane
+        armed (GRV_ADMISSION_CONTROL / TAG_THROTTLING), requests route
+        through server/admission.py's bounded per-priority/per-tag
+        queues instead of the legacy unbounded list."""
         while True:
             req, reply = await self.grvs.pop()
             count = getattr(req, "transaction_count", None) or 1
             prio = getattr(req, "priority", PRIORITY_DEFAULT)
-            self._grv_queue.append((reply, count, prio, flow.now()))
+            tags = tuple(getattr(req, "tags", ()) or ())
+            self.stats.counter("grv_wire_requests").add(1)
+            entry = (reply, count, prio, flow.now(), tags)
+            k = SERVER_KNOBS
+            if k.grv_admission_control or k.tag_throttling:
+                self.admission.submit(entry, flow.now())
+            else:
+                self._grv_queue.append(entry)
 
     async def _grv_batcher(self):
         """Release queued GRVs in rate-gated batches; one causal
@@ -425,7 +458,7 @@ class Proxy:
             charged = 0
             bcharged = 0
             while take < len(self._grv_queue):
-                _r, cnt, prio, _t = self._grv_queue[take]
+                _r, cnt, prio, _t, _tags = self._grv_queue[take]
                 if prio < PRIORITY_IMMEDIATE:
                     if charged + cnt > tokens:
                         break
@@ -455,6 +488,69 @@ class Proxy:
             finally:
                 self._grv_inflight = []
 
+    async def _admission_loop(self):
+        """The enforced-admission release ticker (ref: the
+        transactionStarter loop of GrvProxyServer): one tick per
+        GRV_BATCH_INTERVAL window refills the class buckets from this
+        proxy's budget SHARE, releases tag-parked requests at their
+        commanded pace, sheds wait-bound violations, and serves the
+        whole admitted batch with ONE causal-confirmation round trip —
+        the GRV batching coalesce (`grv_confirm_rounds` vs
+        `transactions_started` is the measured request-rate drop).
+        Costs one knob read per tick while the plane is off."""
+        interval = SERVER_KNOBS.grv_batch_interval
+        while True:
+            await flow.delay(interval, TaskPriority.PROXY_GRV_TIMER)
+            k = SERVER_KNOBS
+            if not (k.grv_admission_control or k.tag_throttling) and \
+                    not self.admission.depth():
+                continue
+            batch = self.admission.tick(flow.now(), self._rate,
+                                        self._batch_rate, interval)
+            if not batch:
+                continue
+            # a separate in-flight list: during a knob flip both
+            # serving loops can be mid-confirmation at once, and
+            # sharing the legacy list would let one finally clear the
+            # other's entries out of the stop() drain set
+            self._admission_inflight = batch
+            try:
+                await self._serve_grv_batch(batch)
+            finally:
+                self._admission_inflight = []
+
+    async def _tag_throttle_loop(self):
+        """Watch \\xff\\x02/throttledTags/ and install the rows into
+        the admission plane's enforcement table (ref: the GRV proxies
+        monitoring the tag-throttle keyspace). A failed read (storage
+        mid-recovery) keeps the last installed rows and retries next
+        poll; row expiry is enforced by the table itself, so a stale
+        poll can never extend a throttle."""
+        from .tag_throttler import read_throttle_rows
+        while True:
+            interval = float(SERVER_KNOBS.tag_throttle_poll_interval)
+            await flow.delay(interval if interval > 0 else 1.0,
+                             TaskPriority.PROXY_GRV_TIMER)
+            if not SERVER_KNOBS.tag_throttling:
+                continue
+            info = self._dbinfo.get() if self._dbinfo is not None else None
+            try:
+                rows = await flow.timeout_error(
+                    flow.spawn(read_throttle_rows(
+                        info, self.process, self.committed_version.get()),
+                        TaskPriority.PROXY_GRV_TIMER), 1.0)
+            except flow.FdbError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                continue
+            now = flow.now()
+            for entry in self.admission.tags.install(rows, now):
+                # a vanished row (manual `throttle off`) frees its
+                # parked requests into the ordinary class queues
+                self.admission.submit(entry, now)
+            self.stats.counter("throttle_rows").set(
+                len(self.admission.tags.rows))
+
     async def _serve_grv_batch(self, batch):
         """Causally-correct GRV with multiple proxies: the read version
         is the max committed version across ALL of them, so a client
@@ -474,6 +570,9 @@ class Proxy:
         pay one frontier round-trip during the window until recovery
         rotates the peer set, instead of seeing errors."""
         try:
+            # one confirmation round serves the whole batch: the GRV
+            # coalescing factor is transactions_started / these rounds
+            self.stats.counter("grv_confirm_rounds").add(1)
             version = self.committed_version.get()
             if self._peers:
                 now = flow.now()
@@ -526,7 +625,7 @@ class Proxy:
             if SERVER_KNOBS.qos_tag_accounting:
                 # per-priority admission accounting (ref: the per-class
                 # txn counters in ProxyStats feeding GetRateInfo)
-                for _r, cnt, prio, _t in batch:
+                for _r, cnt, prio, _t, _tags in batch:
                     self.stats.counter(
                         "transactions_started_"
                         + PRIORITY_NAMES.get(prio, "default")).add(cnt)
@@ -539,9 +638,16 @@ class Proxy:
             # empty and free while CLIENT_CONFLICT_WINDOWS is off
             windows = (self._conflict_windows
                        if SERVER_KNOBS.client_conflict_windows else ())
+            # tag-throttle info rides the reply per entry so throttled
+            # clients back off locally (server/tag_throttler.py);
+            # empty and free while TAG_THROTTLING is off
+            throttling = bool(SERVER_KNOBS.tag_throttling)
             for entry in batch:
                 self.grv_bands.record(now - entry[3])
-                entry[0].send(GetReadVersionReply(version, windows))
+                throttles = (self.admission.reply_throttles(entry[4], now)
+                             if throttling and entry[4] else ())
+                entry[0].send(GetReadVersionReply(version, windows,
+                                                  throttles))
         except flow.FdbError as e:
             cancelled = e.name == "operation_cancelled"
             if cancelled:
@@ -954,7 +1060,7 @@ class Proxy:
             snap.get("commit_batch_txns", 0), now)
         return QosSample("proxy", self.process.name, now, {
             "grv_queue_depth": round(self._qos_grv_queue.sample(
-                len(self._grv_queue), now), 2),
+                len(self._grv_queue) + self.admission.depth(), now), 2),
             "commit_batch_occupancy": round(
                 txn_rate / batch_rate, 2) if batch_rate > 0 else 0.0,
             "resolve_in_flight": self._resolving_now,
@@ -995,6 +1101,11 @@ class Proxy:
         """Transaction-repair decision counters for status/cli/
         exporter."""
         return self.repair.status()
+
+    def admission_status(self) -> dict:
+        """Enforced-admission decision counters + the live tag-throttle
+        rows this proxy enforces, for status/cli/exporter."""
+        return self.admission.status()
 
     def _note_resolving(self, delta: int) -> None:
         """Concurrently-resolving batch gauge + high-water mark."""

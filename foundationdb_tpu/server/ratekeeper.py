@@ -70,11 +70,18 @@ class Ratekeeper:
         # the last decision with its input signals and limiting reason
         # — what RkUpdate traces and status.cluster.qos publish
         self.last_decision: dict = {}
+        # tag auto-throttler (server/tag_throttler.py, ROADMAP item 3):
+        # busy tags per the proxies' TransactionTagCounter get throttle
+        # rows written into \xff\x02/throttledTags/; idle (one knob
+        # read per interval) while AUTO_TAG_THROTTLING is off
+        from .tag_throttler import TagThrottler
+        self.throttler = TagThrottler(process, cc)
         self._actors = flow.ActorCollection()
 
     def start(self) -> None:
         for coro, name in ((self._update_loop(), "update"),
-                           (self._serve_loop(), "getRate")):
+                           (self._serve_loop(), "getRate"),
+                           (self.throttler.run(), "tagThrottler")):
             self._actors.add(flow.spawn(coro, TaskPriority.RATEKEEPER,
                                         name=f"{self.process.name}.{name}"))
         self.process.on_kill(self._actors.cancel_all)
@@ -83,10 +90,26 @@ class Ratekeeper:
         self._actors.cancel_all()
         self.get_rate.close()
 
+    def _served_rates(self):
+        """What one polling proxy may admit. With enforced admission
+        armed, the cluster budget is SPLIT across the current epoch's
+        proxies (ref: GetRateInfoReply's transactionRate divided by
+        proxy count in Ratekeeper.actor.cpp) — without the split, N
+        proxies would each enforce the full budget and the cluster
+        would admit N× what the controller computed. Off-posture
+        serves the undivided rate, exactly as before."""
+        tps, batch_tps = self.rate, self.batch_rate
+        if flow.SERVER_KNOBS.grv_admission_control:
+            n = max(1, len(self.cc.dbinfo.get().proxies))
+            tps = tps / n
+            if batch_tps >= 0:
+                batch_tps = batch_tps / n
+        return tps, batch_tps
+
     async def _serve_loop(self):
         while True:
             _req, reply = await self.get_rate.pop()
-            reply.send(GetRateReply(self.rate, self.batch_rate))
+            reply.send(GetRateReply(*self._served_rates()))
 
     async def _update_loop(self):
         while True:

@@ -91,6 +91,54 @@ def client_latency_cutoff_key(start_ts_us: int,
     return CLIENT_LATENCY_PREFIX + b"%d/%016x/" % (version, start_ts_us)
 
 
+# \xff\x02/throttledTags/<tag> — the tag-throttle table (ref:
+# tagThrottleKeys / TagThrottleValue in fdbclient/TagThrottle.actor.cpp:
+# the ratekeeper writes AUTO rows for busy tags, operators write MANUAL
+# rows through `fdbcli throttle`, and every GRV proxy watches the range
+# and enforces the rates). Rows are real stored data committed through
+# the ordinary pipeline, so manual and automatic throttles round-trip
+# through the SAME keys. Value fields (ascii, '|'-separated so `cli
+# throttle list` stays greppable): tps rate, expiry (absolute cluster
+# seconds), priority class throttled AT AND BELOW (0=batch, 1=default;
+# immediate traffic is never tag-throttled), auto flag (1 = written by
+# the ratekeeper's TagThrottler, 0 = manual).
+THROTTLED_TAGS_PREFIX = STORED_SYSTEM_PREFIX + b"/throttledTags/"
+THROTTLED_TAGS_END = STORED_SYSTEM_PREFIX + b"/throttledTags0"
+TAG_THROTTLE_VALUE_VERSION = 1
+
+
+def throttled_tag_key(tag: bytes) -> bytes:
+    return THROTTLED_TAGS_PREFIX + tag
+
+
+def parse_throttled_tag_key(key: bytes):
+    """-> the raw tag bytes, or None for a foreign key."""
+    if not (THROTTLED_TAGS_PREFIX <= key < THROTTLED_TAGS_END):
+        return None
+    return key[len(THROTTLED_TAGS_PREFIX):]
+
+
+def encode_tag_throttle_value(tps: float, expiry: float, priority: int,
+                              auto: bool) -> bytes:
+    return b"%d|%.17g|%.17g|%d|%d" % (TAG_THROTTLE_VALUE_VERSION,
+                                      float(tps), float(expiry),
+                                      int(priority), int(bool(auto)))
+
+
+def parse_tag_throttle_value(value: bytes):
+    """-> (tps, expiry, priority, auto) or None for an unparseable or
+    unknown-version row (readers must skip foreign encodings, the same
+    contract as the client_latency records)."""
+    try:
+        parts = value.split(b"|")
+        if len(parts) != 5 or int(parts[0]) != TAG_THROTTLE_VALUE_VERSION:
+            return None
+        return (float(parts[1]), float(parts[2]), int(parts[3]),
+                bool(int(parts[4])))
+    except (ValueError, TypeError):
+        return None
+
+
 # \xff/conf/<row> -> ClusterConfig field. The first four are
 # operator-mutable (what `configure` accepts); the rest are seeded
 # informational rows.

@@ -151,6 +151,11 @@ class GetReadVersionRequest(NamedTuple):
 
     transaction_count: int = 1
     priority: int = PRIORITY_DEFAULT
+    # transaction tags for the proxy's per-tag admission gate (ref: the
+    # TagSet riding GetReadVersionRequest once tag throttling is on);
+    # attached only while TAG_THROTTLING is armed — the request is
+    # byte-identical to the pre-subsystem one otherwise
+    tags: Tuple[bytes, ...] = ()
 
 
 class GetReadVersionReply(NamedTuple):
@@ -161,6 +166,13 @@ class GetReadVersionReply(NamedTuple):
     # CLIENT_CONFLICT_WINDOWS is armed — the reply is byte-identical
     # to the pre-subsystem one otherwise
     conflict_windows: Tuple = ()
+    # tag-throttle info for the requesting transaction's tags (ref:
+    # GetReadVersionReply.tagThrottleInfo): rows of (tag, tps, expiry)
+    # the client honors by delaying locally before its next GRV
+    # (server/tag_throttler.py ClientTagThrottleCache). Shipped only
+    # while TAG_THROTTLING is armed — defaulted empty otherwise, so
+    # the reply stays byte-identical
+    tag_throttles: Tuple = ()
 
 
 class ResolveRequest(NamedTuple):

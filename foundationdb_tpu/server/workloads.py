@@ -362,6 +362,29 @@ class Serializability:
         return self.stats
 
 
+def make_zipf_cdf(keyspace: int, s: float) -> list:
+    """Zipfian CDF over key ranks (weight 1/rank^s), shared by the
+    storm workloads; sampling is one random01 + binary search."""
+    weights = [1.0 / (r ** s) for r in range(1, keyspace + 1)]
+    total = sum(weights)
+    acc, cdf = 0.0, []
+    for w in weights:
+        acc += w / total
+        cdf.append(acc)
+    return cdf
+
+
+def zipf_rank(cdf: list, u: float) -> int:
+    lo, hi = 0, len(cdf) - 1
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if cdf[mid] < u:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
 class OpenLoopStorm:
     """Open-loop Zipfian burst workload (ref: the reference's stress
     workloads + ROADMAP item 3's admission-control storm): transaction
@@ -409,33 +432,25 @@ class OpenLoopStorm:
         self.batch_fraction = batch_fraction
         self.tags = tuple(tags)
         self.max_inflight = max_inflight
-        # Zipfian CDF over key ranks: weight 1/rank^s (precomputed once;
-        # sampling is one random01 + bisect)
-        weights = [1.0 / (r ** zipf_s) for r in range(1, keyspace + 1)]
-        total = sum(weights)
-        acc, cdf = 0.0, []
-        for w in weights:
-            acc += w / total
-            cdf.append(acc)
-        self._zipf_cdf = cdf
+        self._zipf_cdf = make_zipf_cdf(keyspace, zipf_s)
         self._ln = math.log
         from ..flow.latency import LatencySample
         self.grv_latency = LatencySample("storm_grv", size=4096)
         self.commit_latency = LatencySample("storm_commit", size=4096)
-        self.stats = {"issued": 0, "completed": 0, "conflicted": 0,
-                      "shed": 0, "errors": {}}
+        # admitted vs shed vs completed are counted SEPARATELY: the
+        # max_inflight cap exists to bound sim memory, but every
+        # arrival it sheds is an arrival the cluster never saw — at
+        # saturation that silently turns the storm closed-loop, so the
+        # report must say how much of the offered load actually
+        # reached the cluster (the `attainment` fraction) for any
+        # open-loop assert to be honest about what it measured
+        self.stats = {"issued": 0, "admitted": 0, "completed": 0,
+                      "conflicted": 0, "shed": 0, "errors": {}}
         self._inflight = 0
 
     def _zipf_key(self) -> bytes:
-        u = self.rng.random01()
-        lo, hi = 0, len(self._zipf_cdf) - 1
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if self._zipf_cdf[mid] < u:
-                lo = mid + 1
-            else:
-                hi = mid
-        return self.prefix + b"k%04d" % lo
+        return self.prefix + b"k%04d" % zipf_rank(self._zipf_cdf,
+                                                  self.rng.random01())
 
     async def _one_txn(self, i: int) -> None:
         db = self.dbs[i % len(self.dbs)]
@@ -488,6 +503,7 @@ class OpenLoopStorm:
             if self._inflight >= self.max_inflight:
                 self.stats["shed"] += 1
                 continue
+            self.stats["admitted"] += 1
             self._inflight += 1
             outstanding.append(flow.spawn(
                 self._one_txn(i), name=f"storm-txn-{i}"))
@@ -497,6 +513,191 @@ class OpenLoopStorm:
         out["grv"] = self.grv_latency.snapshot()
         out["commit"] = self.commit_latency.snapshot()
         out["wall_seconds"] = round(flow.now() - start, 3)
+        # offered-load attainment: the fraction of the open-loop
+        # arrival process that actually reached the cluster (1.0 =
+        # genuinely open-loop end to end; below that, the inflight cap
+        # was converting offered load into shed load)
+        out["attainment"] = round(
+            out["admitted"] / max(out["issued"], 1), 4)
+        return out
+
+
+class OverloadStorm:
+    """The enforced-admission-control proof storm (ROADMAP item 3 /
+    ISSUE 10): a large simulated open-loop client population —
+    `n_clients` logical tenants multiplexed over the `dbs` handle pool
+    — offering Zipfian-keyed traffic well past the cluster's budget,
+    with ONE abusive tenant tag generating a disproportionate share.
+    Same seed, knobs off vs on, is the collapse-vs-degrade comparison:
+
+    - disarmed, the GRV queue grows without bound, waits walk toward
+      the client timeout, and every tenant's latency collapses
+      together;
+    - armed (GRV_ADMISSION_CONTROL + TAG_THROTTLING +
+      AUTO_TAG_THROTTLING), admission settles at the ratekeeper's
+      budget with BOUNDED admitted-GRV latency, the abusive tag gets
+      an auto row in \\xff\\x02/throttledTags/ (enforced at every
+      proxy, honored by the clients' local backoff), and the other
+      tenants' latency recovers.
+
+    Each arrival belongs to a LOGICAL CLIENT drawn from the
+    `n_clients` population (the abusive tenant owns the first tenth of
+    the ids): the client id picks the handle the arrival multiplexes
+    over — so GRV batching groups, the client-honored backoff caches,
+    and the tenant tag all follow the population structure rather than
+    the arrival order — and the report counts the distinct clients
+    actually seen.
+
+    Latency is tracked per tenant group (abusive vs others) so the
+    recovery is a measured assert, not a narrative. One attempt per
+    arrival, no retries: a rejection (`proxy_memory_limit_exceeded` /
+    `tag_throttled`) is a designed OUTCOME the storm counts, exactly
+    like the OpenLoopStorm's honesty contract — shed, admitted, and
+    completed are reported separately with offered-load attainment."""
+
+    def __init__(self, dbs, rng, duration: float = 4.0,
+                 fair_rate: float = 60.0, abusive_rate: float = 240.0,
+                 n_clients: int = 100_000, keyspace: int = 64,
+                 zipf_s: float = 1.2, prefix: bytes = b"ovl/",
+                 abusive_tag: bytes = b"tenant-abuse",
+                 tenant_tags: tuple = (b"tenant-web", b"tenant-mobile",
+                                       b"tenant-api"),
+                 batch_fraction: float = 0.2,
+                 max_inflight: int = 4096):
+        import math
+        self.dbs = list(dbs)
+        self.rng = rng
+        self.duration = duration
+        self.fair_rate = fair_rate
+        self.abusive_rate = abusive_rate
+        self.n_clients = n_clients
+        self.prefix = prefix
+        self.abusive_tag = abusive_tag
+        self.tenant_tags = tuple(tenant_tags)
+        self.batch_fraction = batch_fraction
+        self.max_inflight = max_inflight
+        self._zipf_cdf = make_zipf_cdf(keyspace, zipf_s)
+        self._ln = math.log
+        from ..flow.latency import LatencySample
+        #: per tenant group: admitted-GRV latency and whole-txn latency
+        self.grv_latency = {"abusive": LatencySample("ovl_grv_ab", 4096),
+                            "others": LatencySample("ovl_grv_ot", 4096)}
+        self.txn_latency = {"abusive": LatencySample("ovl_txn_ab", 4096),
+                            "others": LatencySample("ovl_txn_ot", 4096)}
+        self.stats = {"issued": 0, "admitted": 0, "shed": 0,
+                      "completed": 0, "conflicted": 0,
+                      "grv_rejected": 0, "tag_rejected": 0,
+                      "abusive_issued": 0, "abusive_completed": 0,
+                      "others_issued": 0, "others_completed": 0,
+                      # the settle window: arrivals from the second
+                      # half of the storm, past the initial
+                      # unthrottled burst — what "the cluster settled
+                      # at the budget" is measured over
+                      "late_issued": 0, "late_completed": 0,
+                      "errors": {}}
+        self._inflight = 0
+
+    def _zipf_key(self) -> bytes:
+        return self.prefix + b"k%04d" % zipf_rank(self._zipf_cdf,
+                                                  self.rng.random01())
+
+    async def _one_txn(self, i: int, cid: int, tag: bytes, group: str,
+                       late: bool) -> None:
+        db = self.dbs[cid % len(self.dbs)]
+        tr = db.create_transaction()
+        t0 = flow.now()
+        try:
+            tr.set_option("transaction_tag", tag)
+            if group == "others" and \
+                    self.rng.random01() < self.batch_fraction:
+                tr.set_option("priority_batch")
+            await tr.get_read_version()
+            self.grv_latency[group].record(flow.now() - t0)
+            k = self._zipf_key()
+            await tr.get(k)
+            tr.set(k, b"o%06d" % i)
+            await tr.commit()
+            self.txn_latency[group].record(flow.now() - t0)
+            self.stats["completed"] += 1
+            self.stats[group + "_completed"] += 1
+            if late:
+                self.stats["late_completed"] += 1
+        except flow.FdbError as e:
+            # one attempt per arrival: throttle rejections and
+            # timeouts are outcomes the storm measures, never hidden
+            # in a retry loop
+            if e.name == "not_committed":
+                self.stats["conflicted"] += 1
+            elif e.name == "proxy_memory_limit_exceeded":
+                self.stats["grv_rejected"] += 1
+            elif e.name == "tag_throttled":
+                self.stats["tag_rejected"] += 1
+            else:
+                errs = self.stats["errors"]
+                errs[e.name] = errs.get(e.name, 0) + 1
+        finally:
+            self._inflight -= 1
+
+    async def run(self) -> dict:
+        start = flow.now()
+        t = start
+        outstanding = []
+        i = 0
+        total_rate = self.fair_rate + self.abusive_rate
+        abusive_frac = self.abusive_rate / max(total_rate, 1e-9)
+        # the abusive tenant owns the first tenth of the client ids;
+        # the fair tenants split the rest
+        n_abusive = max(1, self.n_clients // 10)
+        clients_seen: set = set()
+        while True:
+            u = self.rng.random01()
+            t += -self._ln(max(1e-12, 1.0 - u)) / max(total_rate, 1e-9)
+            if t - start >= self.duration:
+                break
+            if t > flow.now():
+                await flow.delay(t - flow.now())
+            # which logical client arrived: the abusive tenant's pool
+            # generates its rate share outright; the rest of the
+            # population splits the fair share across the tenant tags
+            if self.rng.random01() < abusive_frac:
+                # random_int is half-open [lo, hi)
+                cid = self.rng.random_int(0, n_abusive)
+                tag, group = self.abusive_tag, "abusive"
+            else:
+                cid = self.rng.random_int(
+                    n_abusive, max(n_abusive + 1, self.n_clients))
+                tag = self.tenant_tags[cid % len(self.tenant_tags)]
+                group = "others"
+            clients_seen.add(cid)
+            late = (t - start) >= self.duration / 2
+            self.stats["issued"] += 1
+            self.stats[group + "_issued"] += 1
+            if late:
+                self.stats["late_issued"] += 1
+            if self._inflight >= self.max_inflight:
+                self.stats["shed"] += 1
+                continue
+            self.stats["admitted"] += 1
+            self._inflight += 1
+            outstanding.append(flow.spawn(
+                self._one_txn(i, cid, tag, group, late),
+                name=f"ovl-txn-{i}"))
+            i += 1
+        await flow.wait_for_all(outstanding)
+        out = dict(self.stats)
+        out["distinct_clients"] = len(clients_seen)
+        wall = flow.now() - start
+        out["wall_seconds"] = round(wall, 3)
+        out["attainment"] = round(
+            out["admitted"] / max(out["issued"], 1), 4)
+        out["committed_per_sec"] = round(
+            out["completed"] / max(wall, 1e-9), 2)
+        out["late_window_seconds"] = round(self.duration / 2, 3)
+        out["late_committed_per_sec"] = round(
+            out["late_completed"] / max(self.duration / 2, 1e-9), 2)
+        out["grv"] = {g: s.snapshot() for g, s in self.grv_latency.items()}
+        out["txn"] = {g: s.snapshot() for g, s in self.txn_latency.items()}
+        out["n_clients"] = self.n_clients
         return out
 
 

@@ -39,6 +39,11 @@ Commands (ref: fdbcli):
                              limiting reason, per-role queue/lag/rate
                              signals, tag & priority traffic
 
+  throttle on <tag> <tps> [prio] [secs]   manually throttle a tag
+                             (prio: default | batch; secs: how long
+                             the row lives, default 3600)
+  throttle off <tag>         clear a tag's throttle row
+  throttle list              the live \\xff\\x02/throttledTags/ rows
   configure <k>=<v> ...      change the cluster shape (proxies,
                              resolvers, logs, conflict_backend)
   exclude <worker>           bar a worker from hosting roles
@@ -165,6 +170,40 @@ def _render_details(cl: dict) -> str:
                 f"replayed={fo.get('replayed_batches', 0)} "
                 f"reattach={fo.get('reattaches', 0)} "
                 f"shadow={sh.get('sampled', 0)}/{sh.get('mismatches', 0)}mm")
+    adm = cl.get("admission_control") or {}
+    if adm.get("grv_admission_enabled") or \
+            adm.get("tag_throttling_enabled") or \
+            any((p.get("admission") or {}).get("rejected")
+                for p in cl.get("proxies", ())):
+        # enforced admission posture: who admitted/shed how much per
+        # class, and which tags are throttled (server/admission.py)
+        lines.append("Admission control:")
+        for p in cl.get("proxies", ()):
+            a = p.get("admission") or {}
+            ad = a.get("admitted") or {}
+            q = a.get("queued") or {}
+            lines.append(
+                f"  {p['name']:<26} "
+                f"admitted imm={ad.get('immediate', 0)} "
+                f"def={ad.get('default', 0)} batch={ad.get('batch', 0)} "
+                f"queued={sum(q.values())} "
+                f"rejected={a.get('rejected', 0)} "
+                f"timed_out={a.get('timed_out', 0)} "
+                f"tag_delayed={a.get('throttle_delayed', 0)} "
+                f"rounds={a.get('confirm_rounds', 0)}")
+        for r in adm.get("throttled_tags", ()):
+            lines.append(
+                f"  throttled tag {r['tag']}: tps={r['tps']:g} "
+                f"prio<={r['priority']} "
+                f"{'auto' if r.get('auto') else 'manual'} "
+                f"expires@{r['expiry']:g} queued={r.get('queued', 0)}")
+        auto = adm.get("auto_throttler") or {}
+        client = adm.get("client") or {}
+        lines.append(
+            f"  auto throttler: written={auto.get('auto_throttles', 0)} "
+            f"cleared={auto.get('auto_cleared', 0)}  "
+            f"client backoffs={client.get('backoffs', 0)} "
+            f"({client.get('backoff_ms', 0)}ms)")
     cs = cl.get("conflict_scheduling") or {}
     scheds = [(p["name"], p.get("scheduler") or {})
               for p in cl.get("proxies", ())]
@@ -508,6 +547,74 @@ class Cli:
                 f"{px.get('transactions_committed', 0)}"
                 f"  conflicts: {px.get('transactions_conflicted', 0)}")
             return "\n".join(lines)
+        if cmd == "throttle":
+            # (ref: fdbcli `throttle on tag|off|list` — manual rows
+            # round-trip through the SAME \xff\x02/throttledTags/ keys
+            # the ratekeeper's auto-throttler writes; every proxy
+            # enforces whatever is in the table, however it got there)
+            from ..server import systemkeys as sk
+            from ..server.types import PRIORITY_BATCH, PRIORITY_DEFAULT
+            sub = raw[0] if raw else ""
+            if sub == "list":
+                async def body(tr):
+                    tr.set_option("read_system_keys")
+                    return await tr.get_range(sk.THROTTLED_TAGS_PREFIX,
+                                              sk.THROTTLED_TAGS_END)
+                rows = self._run(run_transaction(self.db, body))
+                lines = []
+                for key, value in rows:
+                    tag = sk.parse_throttled_tag_key(key)
+                    parsed = sk.parse_tag_throttle_value(value)
+                    if tag is None or parsed is None:
+                        continue
+                    tps, expiry, prio, auto = parsed
+                    pname = "batch" if prio == PRIORITY_BATCH else "default"
+                    lines.append(
+                        f"  {_printable(tag):<20} tps={tps:g} "
+                        f"prio<={pname} "
+                        f"{'auto' if auto else 'manual'} "
+                        f"expires@{expiry:g}")
+                return ("Throttled tags:\n" + "\n".join(lines)
+                        if lines else "(no throttled tags)")
+            if not self.writemode:
+                return "ERROR: writemode off"
+            if sub == "on":
+                if len(args) < 3:
+                    return ("usage: throttle on <tag> <tps> "
+                            "[default|batch] [secs]")
+                tag = args[1]
+                try:
+                    tps = float(raw[2])
+                    secs = float(raw[4]) if len(raw) > 4 else 3600.0
+                except ValueError:
+                    return ("usage: throttle on <tag> <tps> "
+                            "[default|batch] [secs]")
+                pname = raw[3] if len(raw) > 3 else "default"
+                if pname not in ("default", "batch"):
+                    return "ERROR: throttle priority is default or batch"
+                prio = (PRIORITY_BATCH if pname == "batch"
+                        else PRIORITY_DEFAULT)
+
+                async def body(tr):
+                    tr.set_option("access_system_keys")
+                    tr.set(sk.throttled_tag_key(tag),
+                           sk.encode_tag_throttle_value(
+                               tps, flow.now() + secs, prio, auto=False))
+                self._run(run_transaction(self.db, body))
+                return (f"Throttle set: {_printable(tag)} at {tps:g} "
+                        f"tps ({pname} and below) for {secs:g}s")
+            if sub == "off":
+                if len(args) < 2:
+                    return "usage: throttle off <tag>"
+                tag = args[1]
+
+                async def body(tr):
+                    tr.set_option("access_system_keys")
+                    tr.clear(sk.throttled_tag_key(tag))
+                self._run(run_transaction(self.db, body))
+                return f"Throttle cleared: {_printable(tag)}"
+            return "usage: throttle on <tag> <tps> [prio] [secs]" \
+                   "|off <tag>|list"
         if cmd == "configure":
             mapping = {"proxies": "n_proxies", "resolvers": "n_resolvers",
                        "logs": "n_logs",

@@ -203,10 +203,77 @@ def render_prometheus(status: dict) -> str:
                       "(early aborts, checks, updates)",
                       {"counter": cname}, value)
 
+    # enforced admission control & tag throttling (server/admission.py
+    # + server/tag_throttler.py): armed planes, the merged throttle
+    # table, the ratekeeper's auto-throttler, and client backoff
+    adm = cl.get("admission_control") or {}
+    if adm:
+        for feat in ("grv_admission", "tag_throttling",
+                     "auto_tag_throttling"):
+            f.add(f"{_PREFIX}_admission_enabled", "gauge",
+                  "1 while the named admission-control plane is armed",
+                  {"feature": feat}, adm.get(f"{feat}_enabled"))
+        for r in adm.get("throttled_tags", ()):
+            tl = {"tag": r["tag"], "priority": r.get("priority", "?"),
+                  "auto": str(r.get("auto", 0))}
+            f.add(f"{_PREFIX}_throttle_tag_tps", "gauge",
+                  "Enforced per-tag transaction rate from the "
+                  "throttledTags system keyspace", tl, r.get("tps"))
+        f.add(f"{_PREFIX}_throttle_tags", "gauge",
+              "Live rows in the tag-throttle table", {},
+              len(adm.get("throttled_tags", ())))
+        auto = adm.get("auto_throttler") or {}
+        f.add(f"{_PREFIX}_throttle_auto_written", "counter",
+              "Auto-throttle rows written by the ratekeeper", {},
+              auto.get("auto_throttles"))
+        f.add(f"{_PREFIX}_throttle_auto_cleared", "counter",
+              "Expired auto-throttle rows cleared by the ratekeeper",
+              {}, auto.get("auto_cleared"))
+        for cname, value in sorted((adm.get("client") or {}).items()):
+            if cname == "tags_cached":
+                f.add(f"{_PREFIX}_throttle_client_tags", "gauge",
+                      "Throttled tags currently cached client-side",
+                      {}, value)
+            else:
+                f.add(f"{_PREFIX}_throttle_client", "counter",
+                      "Client-honored backoff counters (local delays "
+                      "before tagged GRVs)", {"counter": cname}, value)
+
     for p in cl.get("proxies", ()):
         _add_counters(f, "proxy", p["name"], p.get("counters"))
         for req, snap in (p.get("latency_bands") or {}).items():
             _add_latency(f, "proxy", p["name"], req, snap)
+        pa = p.get("admission") or {}
+        if pa:
+            alabels = {"role": p["name"]}
+            for cls, n in sorted((pa.get("admitted") or {}).items()):
+                f.add(f"{_PREFIX}_admission_admitted", "counter",
+                      "Transactions admitted through the GRV token "
+                      "buckets per priority class",
+                      {**alabels, "priority": cls}, n)
+            for cls, n in sorted((pa.get("queued") or {}).items()):
+                f.add(f"{_PREFIX}_admission_queued", "gauge",
+                      "GRV requests currently queued per priority class",
+                      {**alabels, "priority": cls}, n)
+            for c, help_text in (
+                    ("rejected", "GRV requests rejected by the queue "
+                                 "depth bound (retryable)"),
+                    ("timed_out", "Queued GRV requests shed by the "
+                                  "wait bound (retryable)"),
+                    ("confirm_rounds", "Causal-confirmation round "
+                                       "trips (the GRV batching "
+                                       "denominator)")):
+                f.add(f"{_PREFIX}_admission_{c}", "counter", help_text,
+                      alabels, pa.get(c))
+            for c, help_text in (
+                    ("delayed", "Tagged GRVs parked by a per-tag "
+                                "throttle bucket"),
+                    ("released", "Parked GRVs released at the tag's "
+                                 "commanded pace"),
+                    ("rejected", "Tagged GRVs rejected by the per-tag "
+                                 "queue bound (retryable)")):
+                f.add(f"{_PREFIX}_throttle_{c}", "counter", help_text,
+                      alabels, pa.get(f"throttle_{c}"))
         ps = p.get("scheduler") or {}
         if ps:
             slabels = {"role": p["name"]}
