@@ -126,8 +126,27 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("PROFILE_JANITOR_INTERVAL", 10.0, lambda: 0.5)
     # run-loop steps longer than this (wall seconds) emit a SlowTask
     # TraceEvent and enter the slow-task table (ref: Net2's
-    # SLOWTASK_PROFILING_LOG_INTERVAL family)
+    # SLOWTASK_PROFILING_LOG_INTERVAL family). 0 disables slow-task
+    # sampling — and, with SIM_TASK_STATS also off, the run loop skips
+    # the per-step monotonic() pair entirely (busy_seconds then
+    # accrues through windowed coarse accounting)
     init("SLOW_TASK_THRESHOLD", 0.05)
+    # -- sim-perf attribution plane (ROADMAP item 6: profile the run
+    # loop before refactoring it). SIM_TASK_STATS=1 arms per-task-name
+    # wall-µs accounting in the scheduler AND per-message-type
+    # accounting in the sim network at cluster boot. Default off; the
+    # off posture is byte-identical sim behavior (profiling only ever
+    # reads the wall clock, never the sim timeline) — never buggified:
+    # it would add wall overhead to every randomized CI cell for no
+    # coverage (the armed-vs-off equivalence is its own pinned test)
+    init("SIM_TASK_STATS", 0)
+    # bounded-table caps: task names beyond the cap fold into
+    # "(other)"; message types beyond theirs into "(other)"
+    init("SIM_TASK_STATS_MAX_NAMES", 256)
+    init("SIM_MSG_STATS_MAX_TYPES", 128)
+    # rows the status document / exporter / storm reports surface from
+    # the task and message tables (the full tables ride tools/simprof)
+    init("SIM_TASK_STATS_TOP_K", 10)
     # time 1-in-N kernel dispatches with a block_until_ready fence
     # (first call per shape bucket is always timed: that's the compile);
     # 0 disables the periodic fence entirely so the streamed bench can

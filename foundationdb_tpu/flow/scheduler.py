@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import heapq
 import time as _time
+from bisect import bisect_right
 from typing import Any, Coroutine, Optional
 
 from .error import FdbError, error
@@ -62,6 +63,32 @@ class TaskPriority:
     ZERO = 0
 
 
+# Priority bands for the task-stats rollup: every named TaskPriority
+# level, deduplicated (first name wins for aliases like
+# STORAGE/UPDATE_STORAGE) and sorted ascending. A step's band is the
+# highest named level at or below its popped priority, so custom
+# priorities between levels fold into the level they outrank.
+def _build_priority_bands():
+    seen: dict = {}
+    for n, v in vars(TaskPriority).items():
+        if not n.startswith("_") and isinstance(v, int):
+            seen.setdefault(v, n.lower())
+    return sorted(seen.items())
+
+
+_PRIORITY_BANDS = _build_priority_bands()
+_PRIORITY_BAND_KEYS = [v for v, _n in _PRIORITY_BANDS]
+
+
+def priority_band(priority: int) -> str:
+    """The named TaskPriority band a numeric priority rolls up into."""
+    i = bisect_right(_PRIORITY_BAND_KEYS, priority) - 1
+    return _PRIORITY_BANDS[max(i, 0)][1]
+
+
+# steps per coarse busy-accounting window (see Scheduler._flush_coarse)
+_COARSE_WINDOW = 4096
+
 _knobs = None    # cached handle: the slow-task threshold is read per
                  # step and must not pay the import machinery each time
 
@@ -104,21 +131,65 @@ class Scheduler:
         # task sampling): wall seconds spent executing steps, and the
         # worst offenders over the threshold. None follows the
         # SLOW_TASK_THRESHOLD knob live; an explicit value (tests, the
-        # cli) pins it for this scheduler.
-        self.busy_seconds = 0.0
+        # cli) pins it for this scheduler. A threshold of 0 disables
+        # slow-task sampling entirely (it used to flag EVERY step).
+        self._busy_accum = 0.0
         self.slow_task_threshold: Optional[float] = None
         self.slow_task_count = 0       # total steps over the threshold
-        self.slow_tasks: list = []     # (task name, seconds), worst kept
+        self.slow_tasks: list = []     # (name, seconds, suspension
+        #                                stack), worst kept
+        # coarse busy accounting: with every profiling consumer off
+        # (no task stats, threshold 0) the loop skips the per-step
+        # monotonic() pair and instead times windows of up to
+        # _COARSE_WINDOW steps — two clock reads per window instead of
+        # two per step — flushed whenever busy_seconds is read, the
+        # loop idles/sleeps, or run() exits (so wall time spent OUTSIDE
+        # the loop never counts as busy)
+        self._coarse_anchor: Optional[float] = None
+        self._coarse_steps = 0
         # on-demand sampling profiler (ref: flow/Profiler.actor.cpp —
         # the SIGPROF stack sampler, expressed cooperatively: every
         # Nth task step records the task's coroutine suspension stack)
         self._profile_every = 0        # 0 = off
         self._profile_samples: dict = {}
         self._profile_countdown = 0
+        # per-task attribution plane (SIM_TASK_STATS — ROADMAP item 6's
+        # "profile the run loop before refactoring it"): armed via
+        # start_task_stats(), each step folds its wall µs into a
+        # BOUNDED per-task-name table plus a per-TaskPriority-band
+        # rollup. None = off (the default posture pays nothing here).
+        self._task_stats: Optional[dict] = None  # name -> [steps, µs, max µs]
+        self._task_stats_max = 256
+        self._band_stats: dict = {}    # band -> [steps, µs]
+        self._band_cache: dict = {}    # priority int -> band name
+        self.task_stats_dropped = 0    # folds routed to "(other)"
 
     # -- time ---------------------------------------------------------------
     def now(self) -> float:
         return self._now
+
+    # -- busy accounting -----------------------------------------------------
+    @property
+    def busy_seconds(self) -> float:
+        """Wall seconds the loop spent executing steps. Fine-grained
+        (per step) while a profiling consumer is armed; coarse
+        (windowed) otherwise — reading it flushes any open window."""
+        if self._coarse_anchor is not None:
+            self._flush_coarse()
+        return self._busy_accum
+
+    @busy_seconds.setter
+    def busy_seconds(self, value: float) -> None:
+        self._coarse_anchor = None
+        self._coarse_steps = 0
+        self._busy_accum = value
+
+    def _flush_coarse(self) -> None:
+        a = self._coarse_anchor
+        if a is not None:
+            self._busy_accum += _time.monotonic() - a
+            self._coarse_anchor = None
+            self._coarse_steps = 0
 
     # -- spawning -----------------------------------------------------------
     def spawn(self, coro: Coroutine, priority: int = TaskPriority.DEFAULT_ENDPOINT,
@@ -169,38 +240,60 @@ class Scheduler:
                 t = self._timers[0][0]
                 if max_time is not None and t > max_time:
                     if not self.virtual:
+                        self._flush_coarse()
                         _time.sleep(max(
                             0.0, (self._wall_anchor + max_time) - _time.monotonic()))
                     self._now = max_time  # deadline reached before any work
                     return False
                 if not self.virtual:
+                    self._flush_coarse()  # sleeping is not busy time
                     _time.sleep(max(0.0, (self._wall_anchor + t) - _time.monotonic()))
                 self._now = t
             _, _, fut = heapq.heappop(self._timers)
             if not fut.is_ready:
                 fut.send(None)
         if not self._ready:
+            self._flush_coarse()   # the loop is about to go idle
             return False
-        _, _, task, value, exc = heapq.heappop(self._ready)
+        neg_prio, _, task, value, exc = heapq.heappop(self._ready)
         self.tasks_run += 1
         if self._profile_every:
             self._profile_countdown -= 1
             if self._profile_countdown <= 0:
                 self._profile_countdown = self._profile_every
                 self._profile_sample(task)
-        t0 = _time.monotonic()
-        task._step(value, exc)
-        dt = _time.monotonic() - t0
-        self.busy_seconds += dt
+        stats = self._task_stats
         thr = self.slow_task_threshold
         if thr is None:
             thr = _slow_task_threshold_knob()
-        if dt >= thr:
+        if stats is None and thr <= 0.0:
+            # every profiling consumer is off: skip the per-step
+            # monotonic() pair — busy time accrues through the coarse
+            # window (two clock reads per _COARSE_WINDOW steps)
+            if self._coarse_anchor is None:
+                self._coarse_anchor = _time.monotonic()
+            task._step(value, exc)
+            self._coarse_steps += 1
+            if self._coarse_steps >= _COARSE_WINDOW:
+                self._flush_coarse()
+            return True
+        self._flush_coarse()   # a mid-window arm must not double-count
+        t0 = _time.monotonic()
+        task._step(value, exc)
+        dt = _time.monotonic() - t0
+        self._busy_accum += dt
+        if stats is not None:
+            self._fold_task_stat(task, -neg_prio, dt)
+        if thr > 0.0 and dt >= thr:
             # a step that hogs the loop starves every other actor — the
             # reference's slow-task profiler samples exactly this
             name = getattr(task, "name", "") or "?"
+            # the coroutine is suspended at its next await (or done):
+            # the suspension stack names the code location of the hog,
+            # not just the actor label
+            stack = self._suspension_stack(task)
             self.slow_task_count += 1
-            self.slow_tasks.append((name, dt))
+            self.slow_tasks.append((name, dt, stack))
             if len(self.slow_tasks) > 32:
                 self.slow_tasks = sorted(
                     self.slow_tasks, key=lambda s: -s[1])[:16]
@@ -210,7 +303,8 @@ class Scheduler:
                 "Type": "SlowTask", "Severity": SevWarn,
                 "Machine": "runloop", "TaskName": name,
                 "Seconds": round(dt, 4),
-                "ElapsedUs": int(dt * 1e6)})
+                "ElapsedUs": int(dt * 1e6),
+                "Stack": stack})
         return True
 
     def run(self, until: Optional[Future] = None, timeout_time: Optional[float] = None) -> Any:
@@ -219,15 +313,21 @@ class Scheduler:
         Raises ``timed_out`` if virtual time passes `timeout_time` first, and
         ``operation_failed`` on deadlock (until-future pending but no work).
         """
-        while not self._stopped:
-            if until is not None and until.is_ready:
-                return until.get()
-            if timeout_time is not None and self._now >= timeout_time:
-                raise error("timed_out")
-            if not self._run_one(max_time=timeout_time):
+        try:
+            while not self._stopped:
+                if until is not None and until.is_ready:
+                    return until.get()
                 if timeout_time is not None and self._now >= timeout_time:
                     raise error("timed_out")
-                break
+                if not self._run_one(max_time=timeout_time):
+                    if timeout_time is not None and \
+                            self._now >= timeout_time:
+                        raise error("timed_out")
+                    break
+        finally:
+            # close any open coarse window: wall time between run()
+            # calls must never read as loop busy time
+            self._flush_coarse()
         if until is not None:
             if until.is_ready:
                 return until.get()
@@ -238,8 +338,83 @@ class Scheduler:
     def stop(self) -> None:
         self._stopped = True
 
+    # -- per-task attribution (SIM_TASK_STATS) ------------------------------
+    def start_task_stats(self, max_names: Optional[int] = None) -> None:
+        """Arm per-task run-loop accounting: every step folds its wall
+        µs into a bounded per-task-name table (trailing digits collapse
+        — `storm-txn-17` folds into `storm-txn-*`) and a per-
+        TaskPriority-band rollup. Costless until armed."""
+        if max_names is None:
+            try:
+                from .knobs import SERVER_KNOBS
+                max_names = int(SERVER_KNOBS.sim_task_stats_max_names)
+            except Exception:
+                max_names = 256
+        self._task_stats_max = max(1, max_names)
+        self._task_stats = {}
+        self._band_stats = {}
+        self._band_cache = {}
+        self.task_stats_dropped = 0
+
+    @property
+    def task_stats_armed(self) -> bool:
+        return self._task_stats is not None
+
+    def stop_task_stats(self) -> dict:
+        """Disarm and return the final report."""
+        report = self.task_stats_report()
+        self._task_stats = None
+        return report
+
+    def _fold_task_stat(self, task, priority: int, dt: float) -> None:
+        st = self._task_stats
+        name = getattr(task, "name", "") or "?"
+        base = name.rstrip("0123456789")
+        if base != name:       # indexed spawns fold into one family
+            name = base + "*"
+        rec = st.get(name)
+        if rec is None:
+            if len(st) >= self._task_stats_max:
+                # bounded table: late-arriving names share one bucket
+                self.task_stats_dropped += 1
+                name = "(other)"
+                rec = st.get(name)
+            if rec is None:
+                st[name] = rec = [0, 0.0, 0.0]
+        us = dt * 1e6
+        rec[0] += 1
+        rec[1] += us
+        if us > rec[2]:
+            rec[2] = us
+        band = self._band_cache.get(priority)
+        if band is None:
+            band = self._band_cache[priority] = priority_band(priority)
+        brec = self._band_stats.get(band)
+        if brec is None:
+            self._band_stats[band] = brec = [0, 0.0]
+        brec[0] += 1
+        brec[1] += us
+
+    def task_stats_report(self, top_k: Optional[int] = None) -> dict:
+        """-> {armed, tasks: [{task, steps, busy_us, max_us}] (busiest
+        first), bands: [{band, steps, busy_us}], dropped_names}."""
+        tasks = [{"task": n, "steps": r[0], "busy_us": round(r[1], 1),
+                  "max_us": round(r[2], 1)}
+                 for n, r in (self._task_stats or {}).items()]
+        tasks.sort(key=lambda row: (-row["busy_us"], row["task"]))
+        if top_k is not None:
+            tasks = tasks[:top_k]
+        bands = [{"band": b, "steps": r[0], "busy_us": round(r[1], 1)}
+                 for b, r in sorted(self._band_stats.items(),
+                                    key=lambda kv: (-kv[1][1], kv[0]))]
+        return {"armed": int(self._task_stats is not None),
+                "tasks": tasks, "bands": bands,
+                "dropped_names": self.task_stats_dropped}
+
     # -- sampling profiler --------------------------------------------------
-    def _profile_sample(self, task) -> None:
+    def _frame_walk(self, task) -> list:
+        """The coroutine suspension stack, innermost last — shared by
+        the sampling profiler and the SlowTask capture."""
         frames = []
         coro = getattr(task, "_coro", None)
         depth = 0
@@ -253,8 +428,14 @@ class Scheduler:
                           f":{frame.f_lineno})")
             coro = getattr(coro, "cr_await", None)
             depth += 1
+        return frames
+
+    def _suspension_stack(self, task) -> str:
+        return " <- ".join(reversed(self._frame_walk(task))) or "?"
+
+    def _profile_sample(self, task) -> None:
         key = (getattr(task, "name", "") or "?",
-               " <- ".join(reversed(frames)) or "?")
+               self._suspension_stack(task))
         self._profile_samples[key] = self._profile_samples.get(key, 0) + 1
 
     def start_profiler(self, sample_every: int = 16) -> None:
@@ -271,6 +452,23 @@ class Scheduler:
                for (t, st), n in self._profile_samples.items()]
         out.sort(key=lambda e: -e["samples"])
         return out
+
+    def profile_folded(self) -> str:
+        """The sampling profiler's stacks in collapsed/folded format
+        (`frame;frame;frame count`, root first — flamegraph.pl /
+        speedscope ready). The display stacks read leaf-first
+        ("inner <- outer"), so they re-reverse here. Frames are
+        space-stripped: the folded format splits the trailing count
+        on whitespace."""
+        lines = []
+        for (t, st), n in sorted(self._profile_samples.items()):
+            frames = [t.replace(" ", "").replace(";", ":") or "?"]
+            if st != "?":
+                frames.extend(f.strip().replace(" ", "")
+                              .replace(";", ":")
+                              for f in reversed(st.split(" <- ")))
+            lines.append(";".join(frames) + f" {n}")
+        return "\n".join(lines)
 
 
 class _TimerFuture(Future):

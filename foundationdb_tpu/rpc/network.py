@@ -200,6 +200,60 @@ class SimNetwork:
         self.chaos_log_max = 4096
         self.chaos_log_dropped = 0
         self.disks: Dict[str, "SimDisk"] = {}
+        # sim-perf message accounting (the SIM_TASK_STATS plane's
+        # network half — ROADMAP item 6 names per-message allocation
+        # as a run-loop hot path): armed via arm_message_stats(), each
+        # delivery bumps a bounded per-request-type counter. None =
+        # off, zero hot-path cost; the delivery-timer / ready-backlog
+        # population gauges are pull-computed from the scheduler's
+        # heaps at report time, never maintained per message.
+        self.msg_stats: Optional[Dict[str, int]] = None
+        self._msg_stats_max = 128
+        self.msg_stats_dropped = 0
+
+    # -- sim-perf message accounting ------------------------------------
+    def arm_message_stats(self, max_types: Optional[int] = None) -> None:
+        """Arm per-request-type delivery counting (bounded table)."""
+        if max_types is None:
+            try:
+                from ..flow import SERVER_KNOBS
+                max_types = int(SERVER_KNOBS.sim_msg_stats_max_types)
+            except Exception:
+                max_types = 128
+        self._msg_stats_max = max(1, max_types)
+        self.msg_stats = {}
+        self.msg_stats_dropped = 0
+
+    def _count_msg(self, type_name: str) -> None:
+        ms = self.msg_stats
+        if type_name in ms:
+            ms[type_name] += 1
+        elif len(ms) < self._msg_stats_max:
+            ms[type_name] = 1
+        else:
+            self.msg_stats_dropped += 1
+            ms["(other)"] = ms.get("(other)", 0) + 1
+
+    def message_stats_report(self, top_k: Optional[int] = None) -> dict:
+        """-> {armed, types: [{type, count}] (busiest first),
+        dropped_types, messages_*, timers_now, ready_now}. The gauges
+        are read live from the scheduler heaps (every in-flight
+        delivery rides a timer, so the timer heap IS the delivery
+        queue plus role timers)."""
+        types = sorted(((t, n) for t, n in (self.msg_stats or {}).items()),
+                       key=lambda kv: (-kv[1], kv[0]))
+        if top_k is not None:
+            types = types[:top_k]
+        return {
+            "armed": int(self.msg_stats is not None),
+            "types": [{"type": t, "count": n} for t, n in types],
+            "dropped_types": self.msg_stats_dropped,
+            "messages_sent": self.messages_sent,
+            "messages_dropped": self.messages_dropped,
+            "messages_duplicated": self.messages_duplicated,
+            "timers_now": len(self.sched._timers),
+            "ready_now": len(self.sched._ready),
+        }
 
     def chaos_note(self, kind: str, **detail) -> None:
         """Record one injected fault (the shared chaos accounting every
@@ -418,7 +472,8 @@ class SimNetwork:
         reply = Promise()
         dst.process._track_reply(reply)
         self._deliver(src, dst, (self._wire(request),
-                                 _NetReply(self, dst.process, src, reply)),
+                                 _NetReply(self, dst.process, src, reply,
+                                           type(request).__name__)),
                       reply)
         return reply.future
 
@@ -441,6 +496,8 @@ class SimNetwork:
     def _deliver(self, src: SimProcess, dst: Endpoint, item,
                  reply: Optional[Promise]) -> None:
         self.messages_sent += 1
+        if self.msg_stats is not None:
+            self._count_msg(type(item[0]).__name__)
         if not src.alive:
             return  # a dead process sends nothing
         delay = self._delivery_delay(src, dst.process)
@@ -485,14 +542,15 @@ class _NetReply:
     Breaks (broken_promise) if the replying process dies first — tracked
     via SimProcess._pending_replies."""
 
-    __slots__ = ("net", "owner", "dst", "promise")
+    __slots__ = ("net", "owner", "dst", "promise", "rtype")
 
     def __init__(self, net: SimNetwork, owner: SimProcess, dst: SimProcess,
-                 promise: Promise):
+                 promise: Promise, rtype: str = "?"):
         self.net = net
         self.owner = owner  # the serving process
         self.dst = dst      # the original requester
         self.promise = promise
+        self.rtype = rtype  # request type name (message accounting)
 
     def _partitioned(self) -> bool:
         """A reply crossing a live partition never lands: break the
@@ -506,6 +564,8 @@ class _NetReply:
             return
         if not self.owner.alive:
             return  # the kill path already broke the promise
+        if self.net.msg_stats is not None:
+            self.net._count_msg(self.rtype + ".reply")
         value = self.net._wire(value)
         delay = self.net._delivery_delay(self.owner, self.dst)
         timer = self.net.sched.delay(delay, TaskPriority.DEFAULT_PROMISE_ENDPOINT)
@@ -529,6 +589,8 @@ class _NetReply:
             return
         if not self.owner.alive:
             return
+        if self.net.msg_stats is not None:
+            self.net._count_msg(self.rtype + ".reply")
         if self._partitioned():
             self.net.messages_dropped += 1
             err = error("broken_promise")

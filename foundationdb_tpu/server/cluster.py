@@ -90,6 +90,15 @@ class SimCluster:
                                         virtual=virtual)
             flow.set_scheduler(self.sched)
             self.net = SimNetwork(self.sched, flow.g_random)
+            # sim-perf attribution plane (SIM_TASK_STATS): armed at
+            # boot so recovery, workload and quiesce windows are all
+            # attributed. Profiling reads only the wall clock — the
+            # sim timeline and every seeded draw are untouched, so the
+            # armed run's event schedule is identical to the off run's
+            # (test-pinned)
+            if int(getattr(flow.SERVER_KNOBS, "sim_task_stats", 0)):
+                self.sched.start_task_stats()
+                self.net.arm_message_stats()
             if data_dir is not None:
                 # REAL on-disk stores: durable state survives an actual
                 # process restart (tools/server --data-dir)

@@ -66,6 +66,38 @@ def _client_profile_counters() -> dict:
     return m.profiler_counters() if m is not None else {}
 
 
+def _run_loop_status() -> dict:
+    """status.cluster.run_loop: the run-loop profiler rollup — step and
+    busy-time totals, the wall-vs-sim ratio, the slow-task table (each
+    entry carrying the coroutine suspension stack captured at the slow
+    step), and the SIM_TASK_STATS attribution table when armed."""
+    sched = flow.g()
+    busy = sched.busy_seconds     # one read: the property may flush
+    doc = {
+        "tasks_run": sched.tasks_run,
+        "busy_seconds": round(busy, 3),
+        # how many sim-seconds each busy wall-second buys — the
+        # sim-scale headline ROADMAP item 6 optimizes (None until the
+        # loop has done any measurable work)
+        "sim_seconds": round(sched.now(), 3),
+        "sim_per_busy": (round(sched.now() / busy, 2) if busy > 0
+                         else None),
+        "slow_task_count": sched.slow_task_count,
+        "slow_task_threshold": (
+            sched.slow_task_threshold
+            if sched.slow_task_threshold is not None
+            else float(flow.SERVER_KNOBS.slow_task_threshold)),
+        "slow_tasks": [
+            {"task": n, "seconds": round(s, 4), "stack": stack}
+            for n, s, stack in sorted(sched.slow_tasks,
+                                      key=lambda t: -t[1])[:5]],
+    }
+    if sched.task_stats_armed:
+        doc["task_stats"] = sched.task_stats_report(
+            top_k=int(flow.SERVER_KNOBS.sim_task_stats_top_k))
+    return doc
+
+
 class ClusterConfig(NamedTuple):
     """(ref: DatabaseConfiguration — the subset this slice understands)"""
 
@@ -1471,20 +1503,14 @@ class ClusterController:
                     }
                     for (rn, cn), ts in sorted(self.metrics.items())},
                 # run-loop profiler (ref: Net2 slow-task sampling /
-                # SystemMonitor machine metrics in status)
-                "run_loop": {
-                    "tasks_run": flow.g().tasks_run,
-                    "busy_seconds": round(flow.g().busy_seconds, 3),
-                    "slow_task_count": flow.g().slow_task_count,
-                    "slow_task_threshold": (
-                        flow.g().slow_task_threshold
-                        if flow.g().slow_task_threshold is not None
-                        else float(flow.SERVER_KNOBS.slow_task_threshold)),
-                    "slow_tasks": [
-                        {"task": n, "seconds": round(s, 4)}
-                        for n, s in sorted(flow.g().slow_tasks,
-                                           key=lambda t: -t[1])[:5]],
-                },
+                # SystemMonitor machine metrics in status) + the
+                # SIM_TASK_STATS attribution table when armed
+                "run_loop": _run_loop_status(),
+                # sim-network message accounting (the plane's network
+                # half): per-request-type counts when armed, plus the
+                # always-available population gauges and totals
+                "network": self.process.net.message_stats_report(
+                    top_k=int(flow.SERVER_KNOBS.sim_task_stats_top_k)),
                 # sampled-transaction profiler counters (process-wide,
                 # like the kernel profile: every client in this sim
                 # shares the sampler's CounterCollection)

@@ -20,6 +20,7 @@ commit_unknown_result).
 
 from __future__ import annotations
 
+import time as _time
 from bisect import bisect_left
 from typing import Dict, List, Optional, Tuple
 
@@ -362,6 +363,55 @@ class Serializability:
         return self.stats
 
 
+def _find_net(dbs):
+    """The SimNetwork behind a pool of client handles (for message
+    accounting in storm reports); None when unreachable."""
+    for db in dbs:
+        ref = getattr(db, "cluster_ref", None)
+        if ref is not None:
+            try:
+                return ref.endpoint.process.net
+            except AttributeError:
+                pass
+    return None
+
+
+def sim_perf_report(wall_t0: float, sim_t0: float, tasks0: int,
+                    net=None, top_k: Optional[int] = None) -> dict:
+    """The wall-vs-sim budget every storm report carries (ROADMAP item
+    6: the binding constraint on 10^6-client storms is simulator
+    wall-clock, so every storm measures what its sim-seconds COST):
+    sim seconds, real wall seconds, their ratio, run-loop steps and
+    step rate — plus, when the SIM_TASK_STATS plane is armed, the
+    top-K task types and top-K message types burning that wall time.
+
+    Wall readings feed reports only, never sim decisions, so seeded
+    replay determinism is untouched."""
+    sched = flow.g()
+    if top_k is None:
+        top_k = int(flow.SERVER_KNOBS.sim_task_stats_top_k)
+    wall = max(_time.monotonic() - wall_t0, 1e-9)
+    sim = flow.now() - sim_t0
+    tasks = sched.tasks_run - tasks0
+    out = {
+        "sim_seconds": round(sim, 3),
+        "wall_seconds": round(wall, 4),
+        "sim_per_wall": round(sim / wall, 3),
+        "tasks_run": tasks,
+        "tasks_per_wall_sec": round(tasks / wall, 1),
+    }
+    if sched.task_stats_armed:
+        rep = sched.task_stats_report(top_k=top_k)
+        out["top_tasks"] = rep["tasks"]
+        out["priority_bands"] = rep["bands"]
+    if net is not None and net.msg_stats is not None:
+        mrep = net.message_stats_report(top_k=top_k)
+        out["top_messages"] = mrep["types"]
+        out["timers_now"] = mrep["timers_now"]
+        out["messages_sent"] = mrep["messages_sent"]
+    return out
+
+
 def make_zipf_cdf(keyspace: int, s: float) -> list:
     """Zipfian CDF over key ranks (weight 1/rank^s), shared by the
     storm workloads; sampling is one random01 + binary search."""
@@ -486,6 +536,7 @@ class OpenLoopStorm:
 
     async def run(self) -> dict:
         start = flow.now()
+        wall0, tasks0 = _time.monotonic(), flow.g().tasks_run
         t = start
         outstanding = []
         i = 0
@@ -519,6 +570,8 @@ class OpenLoopStorm:
         # was converting offered load into shed load)
         out["attainment"] = round(
             out["admitted"] / max(out["issued"], 1), 4)
+        out["sim_perf"] = sim_perf_report(wall0, start, tasks0,
+                                          net=_find_net(self.dbs))
         return out
 
 
@@ -640,6 +693,7 @@ class OverloadStorm:
 
     async def run(self) -> dict:
         start = flow.now()
+        wall0, tasks0 = _time.monotonic(), flow.g().tasks_run
         t = start
         outstanding = []
         i = 0
@@ -698,6 +752,8 @@ class OverloadStorm:
         out["grv"] = {g: s.snapshot() for g, s in self.grv_latency.items()}
         out["txn"] = {g: s.snapshot() for g, s in self.txn_latency.items()}
         out["n_clients"] = self.n_clients
+        out["sim_perf"] = sim_perf_report(wall0, start, tasks0,
+                                          net=_find_net(self.dbs))
         return out
 
 
@@ -750,6 +806,8 @@ class ChaosStorm:
         from .chaos import chaos_status, database_digest, record_scenario
         from .consistency import check_consistency
         net = self.cluster.net
+        sim0 = flow.now()
+        wall0, tasks0 = _time.monotonic(), flow.g().tasks_run
         record_scenario(net, self.scenario.name)
         traffic = flow.spawn(self.storm.run(),
                              name=f"chaos-traffic-{self.scenario.name}")
@@ -804,6 +862,10 @@ class ChaosStorm:
             "recovery_seconds": round(recovery_seconds, 3),
             "chaos": chaos,
             "events": list(net.chaos_log),
+            # wall-vs-sim budget over the WHOLE storm (traffic +
+            # scenario + quiesce + verification), message accounting
+            # included when the plane is armed
+            "sim_perf": sim_perf_report(wall0, sim0, tasks0, net=net),
             # the post-storm status doc, read through the SURVIVING
             # database (after region_failover the primary CC is gone —
             # callers must not have to query it for chaos accounting)
@@ -900,6 +962,7 @@ class ContentionStorm:
 
     async def run(self) -> dict:
         start = flow.now()
+        wall0, tasks0 = _time.monotonic(), flow.g().tasks_run
         t = start
         outstanding = []
         i = 0
@@ -927,6 +990,8 @@ class ContentionStorm:
         out["attempts_per_commit"] = round(
             out["attempts"] / max(out["committed"], 1), 3)
         out["latency"] = self.txn_latency.snapshot()
+        out["sim_perf"] = sim_perf_report(wall0, start, tasks0,
+                                          net=_find_net(self.dbs))
         return out
 
     async def read_hot_total(self, db) -> int:

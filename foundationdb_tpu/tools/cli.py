@@ -34,7 +34,9 @@ Commands (ref: fdbcli):
                              probe, health messages)
   metrics                    counter time series (latest + rates)
   top                        hottest conflict ranges + role rates
-                             (the conflict-attribution view)
+                             (the conflict-attribution view; with
+                             SIM_TASK_STATS armed, also the run-loop
+                             task table and network message types)
   qos                        saturation telemetry: ratekeeper budget +
                              limiting reason, per-role queue/lag/rate
                              signals, tag & priority traffic
@@ -276,10 +278,16 @@ def _render_details(cl: dict) -> str:
             f"dup_msgs={chaos.get('messages_duplicated', 0)}")
     rl = cl.get("run_loop", {})
     if rl:
+        ratio = rl.get("sim_per_busy")
         lines.append(f"Run loop: tasks={rl.get('tasks_run')} "
-                     f"busy={rl.get('busy_seconds')}s")
+                     f"busy={rl.get('busy_seconds')}s "
+                     f"sim={rl.get('sim_seconds')}s"
+                     + (f" sim/busy={ratio}x" if ratio else ""))
         for t in rl.get("slow_tasks", ()):
-            lines.append(f"  slow: {t['seconds']:<8} {t['task']}")
+            lines.append(f"  slow: {t['seconds']:<8} {t['task']}"
+                         + (f"  @ {t['stack']}"
+                            if t.get("stack") else ""))
+    lines.extend(_sim_perf_lines(cl))
     lines.append("Latency probe:")
     probe = cl.get("latency_probe") or {}
     scalars = {k: v for k, v in probe.items() if k != "bands"}
@@ -292,6 +300,39 @@ def _render_details(cl: dict) -> str:
         lines.append(_band_line("cluster-probe", stage, snap))
     lines.extend(_hot_spot_and_message_lines(cl))
     return "\n".join(lines)
+
+
+def _sim_perf_lines(cl: dict) -> List[str]:
+    """The SIM_TASK_STATS attribution view (run-loop task table +
+    priority bands + network message types) — shared by `status
+    details` and `top`; empty while the plane is off."""
+    lines: List[str] = []
+    ts = (cl.get("run_loop") or {}).get("task_stats") or {}
+    if ts.get("tasks"):
+        lines.append("Run-loop attribution (SIM_TASK_STATS):")
+        for r in ts["tasks"]:
+            lines.append(
+                f"  {r['task']:<30} steps={r['steps']:<9}"
+                f" busy={r['busy_us'] / 1e6:<9.3f}s"
+                f" max={r['max_us']:.0f}us")
+        bands = "  ".join(f"{b['band']}={b['busy_us'] / 1e6:.3f}s"
+                          for b in ts.get("bands", ()))
+        if bands:
+            lines.append(f"  priority bands: {bands}")
+        if ts.get("dropped_names"):
+            lines.append(f"  (table bound hit: {ts['dropped_names']} "
+                         f"folds in '(other)')")
+    net = cl.get("network") or {}
+    if net.get("types"):
+        lines.append("Network messages (by request type):")
+        for r in net["types"]:
+            lines.append(f"  {r['type']:<30} {r['count']}")
+        lines.append(
+            f"  sent={net.get('messages_sent')} "
+            f"dropped={net.get('messages_dropped')} "
+            f"timers_now={net.get('timers_now')} "
+            f"ready_now={net.get('ready_now')}")
+    return lines
 
 
 def _hot_spot_and_message_lines(cl: dict) -> List[str]:
@@ -343,6 +384,9 @@ def _render_top(cl: dict) -> str:
         lines.append("Busiest counters (rate/s over the sampled tail):")
         for rate, rn, cn in rows[:12]:
             lines.append(f"  {rate:>10.2f}/s  {rn}/{cn}")
+    # the run-loop/network attribution tables (when SIM_TASK_STATS is
+    # armed) — `top` is exactly where "what burns the wall clock" goes
+    lines.extend(_sim_perf_lines(cl))
     return "\n".join(lines)
 
 

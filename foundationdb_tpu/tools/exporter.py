@@ -493,11 +493,74 @@ def render_prometheus(status: dict) -> str:
           rl.get("slow_task_threshold"))
     worst: dict = {}   # the same task label may recur: keep its worst
     for t in rl.get("slow_tasks", ()):
-        worst[t["task"]] = max(worst.get(t["task"], 0.0), t["seconds"])
-    for task, seconds in sorted(worst.items()):
+        prev = worst.get(t["task"])
+        if prev is None or t["seconds"] > prev[0]:
+            worst[t["task"]] = (t["seconds"], t.get("stack", "?"))
+    for task, (seconds, stack) in sorted(worst.items()):
         f.add(f"{_PREFIX}_run_loop_slow_task_seconds", "gauge",
-              "Worst run-loop steps by task label", {"task": task},
-              seconds)
+              "Worst run-loop steps by task label (stack = coroutine "
+              "suspension stack at the slow step)",
+              {"task": task, "stack": stack}, seconds)
+
+    # sim-perf attribution plane (SIM_TASK_STATS — flow/scheduler.py
+    # task table + rpc/network.py message accounting): the fdbtpu_sim_*
+    # wall-vs-sim headline, the fdbtpu_task_* attribution families, and
+    # the fdbtpu_net_* message families
+    f.add(f"{_PREFIX}_sim_seconds", "counter",
+          "Simulated seconds elapsed on the run loop's timeline", {},
+          rl.get("sim_seconds"))
+    f.add(f"{_PREFIX}_sim_per_busy_second", "gauge",
+          "Sim seconds advanced per busy wall second (the sim-scale "
+          "headline)", {}, rl.get("sim_per_busy"))
+    ts = rl.get("task_stats") or {}
+    if ts:
+        f.add(f"{_PREFIX}_sim_task_stats_armed", "gauge",
+              "1 while per-task run-loop attribution is armed", {},
+              ts.get("armed"))
+        f.add(f"{_PREFIX}_task_names_dropped", "counter",
+              "Task-stat folds routed to the (other) bucket by the "
+              "table bound", {}, ts.get("dropped_names"))
+    for row in ts.get("tasks", ()):
+        tl = {"task": row["task"]}
+        f.add(f"{_PREFIX}_task_steps", "counter",
+              "Run-loop steps per task family (SIM_TASK_STATS)", tl,
+              row.get("steps"))
+        f.add(f"{_PREFIX}_task_busy_us", "counter",
+              "Cumulative step wall-microseconds per task family", tl,
+              row.get("busy_us"))
+        f.add(f"{_PREFIX}_task_max_step_us", "gauge",
+              "Worst single step per task family (µs)", tl,
+              row.get("max_us"))
+    for row in ts.get("bands", ()):
+        bl = {"band": row["band"]}
+        f.add(f"{_PREFIX}_task_band_steps", "counter",
+              "Run-loop steps per TaskPriority band", bl,
+              row.get("steps"))
+        f.add(f"{_PREFIX}_task_band_busy_us", "counter",
+              "Cumulative step wall-microseconds per TaskPriority band",
+              bl, row.get("busy_us"))
+    netdoc = cl.get("network") or {}
+    if netdoc:
+        for row in netdoc.get("types", ()):
+            f.add(f"{_PREFIX}_net_messages", "counter",
+                  "Sim-network messages delivered, by request type "
+                  "(armed with SIM_TASK_STATS)", {"type": row["type"]},
+                  row.get("count"))
+        f.add(f"{_PREFIX}_net_messages_sent", "counter",
+              "Total sim-network messages sent", {},
+              netdoc.get("messages_sent"))
+        f.add(f"{_PREFIX}_net_messages_dropped", "counter",
+              "Messages dropped by kills/partitions", {},
+              netdoc.get("messages_dropped"))
+        f.add(f"{_PREFIX}_net_messages_duplicated", "counter",
+              "Datagrams duplicated by swizzled links", {},
+              netdoc.get("messages_duplicated"))
+        f.add(f"{_PREFIX}_net_delivery_timers", "gauge",
+              "Scheduler timer-heap population (in-flight deliveries "
+              "+ role timers)", {}, netdoc.get("timers_now"))
+        f.add(f"{_PREFIX}_net_ready_tasks", "gauge",
+              "Runnable task backlog on the scheduler ready heap", {},
+              netdoc.get("ready_now"))
 
     # client transaction-profiling sampler (client/profiling.py,
     # process-wide like the kernel profile)

@@ -22,6 +22,8 @@ CHAOS_REPORT_PATH = "/tmp/_chaos_report.txt"
 CHAOS_TRACE_PATH = "/tmp/_chaos_trace.jsonl"
 CONTENTION_REPORT_PATH = "/tmp/_contention_report.txt"
 OVERLOAD_REPORT_PATH = "/tmp/_overload_report.txt"
+SIMPROF_REPORT_PATH = "/tmp/_simprof_smoke.txt"
+SIMPROF_CHAOS_PATH = "/tmp/_simprof_chaos.json"
 
 
 def run_smoke(out=print) -> int:
@@ -485,6 +487,12 @@ def run_smoke_chaos(out=print,
         if buggify:
             kwargs["buggify"] = True
         cluster = SimCluster(seed=seed, **kwargs)
+        # the sim-perf plane rides every chaos cell: profiling reads
+        # only the wall clock (armed-vs-off same-seed equivalence is
+        # test-pinned), and a red cell's post-mortem then carries the
+        # wall-time attribution picture (/tmp/_simprof_chaos.json)
+        cluster.sched.start_task_stats()
+        cluster.net.arm_message_stats()
         if admission:
             flow.SERVER_KNOBS.set("grv_admission_control", 1)
             flow.SERVER_KNOBS.set("tag_throttling", 1)
@@ -494,6 +502,21 @@ def run_smoke_chaos(out=print,
             storm = ChaosStorm(cluster, dbs, flow.g_random, scenario)
             return cluster.run(storm.run(), timeout_time=900)
         finally:
+            # the wall-time picture must survive a RED cell (a storm
+            # that fails its oracle raises before any report exists):
+            # snapshot the attribution tables straight off the
+            # scheduler/network, whatever happened
+            with open(SIMPROF_CHAOS_PATH, "w") as fh:
+                json.dump(
+                    {"scenario": scenario, "seed": seed,
+                     "tasks_run": cluster.sched.tasks_run,
+                     "busy_seconds": round(cluster.sched.busy_seconds,
+                                           3),
+                     "task_stats": cluster.sched.task_stats_report(),
+                     "message_stats":
+                         cluster.net.message_stats_report()},
+                    fh, indent=2, sort_keys=True, default=str)
+                fh.write("\n")
             cluster.shutdown()
 
     rep = run_once()
@@ -506,6 +529,7 @@ def run_smoke_chaos(out=print,
               "recovery_seconds": rep["recovery_seconds"],
               "consistency": rep["consistency"],
               "chaos": chaos, "storm": rep["storm"],
+              "sim_perf": rep["sim_perf"],
               "events": rep["events"]}
     try:
         assert rep["storm"]["completed"] > 0, rep["storm"]
@@ -885,6 +909,125 @@ def run_smoke_overload(out=print,
     return 0
 
 
+def run_smoke_simprof(out=print,
+                      report_path: str = SIMPROF_REPORT_PATH) -> int:
+    """Sim-perf attribution smoke (ISSUE 11's acceptance cell): the
+    SAME seeded open-loop storm run twice — SIM_TASK_STATS off, then
+    armed. The off-posture pin: identical keyspace digest, identical
+    network message count, identical scheduler step count and storm
+    outcome (profiling reads only the wall clock, never the sim
+    timeline). The armed run must POPULATE the plane: a per-task table
+    naming the storm's actors, a priority-band rollup, per-message-type
+    counts, the wall-vs-sim budget in the storm report, the
+    fdbtpu_task_* / fdbtpu_net_* / fdbtpu_sim_* exporter families
+    parsing, and the `cli top` attribution section rendering. The
+    report lands at /tmp/_simprof_smoke.txt for the CI artifact."""
+    import json
+    import os
+
+    from .. import flow
+    from ..server import SimCluster
+    from ..server.chaos import database_digest
+    from ..server.workloads import OpenLoopStorm
+    from .cli import _render_top
+    from .exporter import parse_prometheus, render_prometheus
+
+    seed = int(os.environ.get("SIMPROF_SEED", 7272))
+    duration = float(os.environ.get("SIMPROF_DURATION", 2.0))
+
+    def run_once(armed: bool) -> tuple:
+        cluster = SimCluster(seed=seed, durable=True)
+        if armed:
+            # knob AFTER SimCluster re-initializes them; arm directly
+            # (the knob path arms at boot for operator-configured runs)
+            flow.SERVER_KNOBS.set("sim_task_stats", 1)
+            cluster.sched.start_task_stats()
+            cluster.net.arm_message_stats()
+        try:
+            dbs = [cluster.client(f"sp{i}") for i in range(4)]
+
+            async def main():
+                storm = OpenLoopStorm(
+                    dbs, flow.g_random, duration=duration, rate=80.0,
+                    burst_rate=300.0, burst_start=0.5, burst_len=0.5,
+                    max_inflight=256)
+                stats = await storm.run()
+                digest = await database_digest(dbs[0])
+                status = await dbs[0].get_status()
+                return stats, digest, status
+
+            stats, digest, status = cluster.run(main(), timeout_time=600)
+            return (stats, digest, status, cluster.sched.tasks_run,
+                    cluster.net.messages_sent)
+        finally:
+            flow.reset_server_knobs(randomize=False)
+            cluster.shutdown()
+
+    off_stats, off_digest, _off_status, off_tasks, off_msgs = \
+        run_once(armed=False)
+    on_stats, on_digest, on_status, on_tasks, on_msgs = \
+        run_once(armed=True)
+
+    sp = on_stats.get("sim_perf") or {}
+    report = {"seed": seed, "duration": duration,
+              "off": {"digest": off_digest, "tasks_run": off_tasks,
+                      "messages_sent": off_msgs,
+                      "issued": off_stats["issued"],
+                      "completed": off_stats["completed"]},
+              "armed": {"digest": on_digest, "tasks_run": on_tasks,
+                        "messages_sent": on_msgs,
+                        "issued": on_stats["issued"],
+                        "completed": on_stats["completed"]},
+              "sim_perf": sp}
+    try:
+        # (1) off-posture pin: the armed plane must not perturb the sim
+        assert on_digest == off_digest, (off_digest, on_digest)
+        assert on_msgs == off_msgs, (off_msgs, on_msgs)
+        assert on_tasks == off_tasks, (off_tasks, on_tasks)
+        assert on_stats["issued"] == off_stats["issued"], report
+        assert on_stats["completed"] == off_stats["completed"], report
+
+        # (2) the plane populates under the storm
+        assert sp.get("top_tasks"), sp
+        top_names = [r["task"] for r in sp["top_tasks"]]
+        assert "storm-txn-*" in top_names, top_names
+        assert sp.get("top_messages"), sp
+        msg_types = {r["type"] for r in sp["top_messages"]}
+        assert "GetReadVersionRequest" in msg_types, msg_types
+        rl = on_status["cluster"]["run_loop"]
+        ts = rl.get("task_stats") or {}
+        assert ts.get("tasks") and ts.get("bands"), rl
+        assert rl.get("sim_per_busy"), rl
+        netdoc = on_status["cluster"]["network"]
+        assert netdoc["armed"] and netdoc["types"], netdoc
+
+        # (3) exporter families parse and cover the plane
+        samples = parse_prometheus(render_prometheus(on_status))
+        names = {n for n, _l, _v in samples}
+        for need in ("fdbtpu_task_steps", "fdbtpu_task_busy_us",
+                     "fdbtpu_task_band_steps", "fdbtpu_net_messages",
+                     "fdbtpu_net_delivery_timers", "fdbtpu_sim_seconds",
+                     "fdbtpu_sim_per_busy_second"):
+            assert need in names, f"exporter missing {need}"
+
+        # (4) the operator view renders the attribution tables
+        top = _render_top(on_status["cluster"])
+        assert "Run-loop attribution" in top, top
+        assert "Network messages" in top, top
+        report["asserts"] = "all passed"
+    finally:
+        with open(report_path, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True, default=str)
+            fh.write("\n")
+    out(f"SIMPROF SMOKE OK: seed={seed} off-posture pin held "
+        f"(digest {on_digest[:16]}, {on_tasks} steps, {on_msgs} msgs "
+        f"both postures); sim {sp['sim_seconds']}s in wall "
+        f"{sp['wall_seconds']}s ({sp['sim_per_wall']}x), top task "
+        f"{top_names[0]}, {len(msg_types)} message types; "
+        f"report at {report_path}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--profile" in argv:
@@ -899,6 +1042,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_smoke_contention()
     if "--overload" in argv:
         return run_smoke_overload()
+    if "--simprof" in argv:
+        return run_smoke_simprof()
     return run_smoke()
 
 

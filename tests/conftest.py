@@ -34,6 +34,13 @@ def pytest_addoption(parser):
              "(same seed => identical event schedule).")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight end-to-end cells tier-1 skips "
+        "(-m 'not slow'); nightly/full runs include them")
+
+
 import pytest  # noqa: E402  (after the JAX env pinning above)
 
 
