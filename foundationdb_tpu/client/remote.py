@@ -96,8 +96,9 @@ class RemoteDatabase(Database):
             raise flow.error("client_invalid_operation")
         ref = self._transport.ref(self._host, self._port,
                                   self._status_token)
+        from ..server.types import STATUS_REQUEST
         return await flow.timeout_error(
-            ref.get_reply(None),
+            ref.get_reply(STATUS_REQUEST),
             flow.SERVER_KNOBS.remote_client_request_timeout)
 
     # configure/exclude ride the inherited Database implementations —

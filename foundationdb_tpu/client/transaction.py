@@ -116,6 +116,9 @@ class Database:
         self.coordinators = coordinators
         self._leader_gen = 0       # bumped on every rediscovered leader
         self._info = None
+        #: (priority, tags) -> extra logical-transaction weight beyond
+        #: the waiter count (client multiplexing; see batched_grv)
+        self._grv_extra: Dict = {}
         #: priority class -> waiting futures (batched per class so a
         #: BATCH rider can never borrow DEFAULT's admission)
         self._grv_waiters: Dict[int, List[Future]] = {}
@@ -151,7 +154,9 @@ class Database:
         CC-assembled JSON, fdbclient/StatusClient.actor.cpp)."""
         if self.status_ref is None:
             raise error("client_invalid_operation")
-        return await _rpc(self.status_ref.get_reply(None, self.process))
+        from ..server.types import STATUS_REQUEST
+        return await _rpc(
+            self.status_ref.get_reply(STATUS_REQUEST, self.process))
 
     async def _live_workers(self, without: str = "") -> int:
         """Alive, non-excluded workers per status — the client-side
@@ -371,7 +376,8 @@ class Database:
         return info.storages[_shard_index(info.storages, key)]
 
     def batched_grv(self, priority: Optional[int] = None,
-                    tags: Tuple[bytes, ...] = ()) -> Future:
+                    tags: Tuple[bytes, ...] = (),
+                    weight: int = 1) -> Future:
         """Batch concurrent GRV REQUESTS into one proxy round trip PER
         PRIORITY CLASS (ref: readVersionBatcher,
         NativeAPI.actor.cpp:2854) — and per tag set, once tag
@@ -384,7 +390,18 @@ class Database:
         if priority is None:
             priority = PRIORITY_DEFAULT
         f = Future()
-        self._grv_waiters.setdefault((priority, tuple(tags)), []).append(f)
+        key = (priority, tuple(tags))
+        self._grv_waiters.setdefault(key, []).append(f)
+        if weight > 1:
+            # client-multiplexing (ISSUE 12): one wire GRV may stand in
+            # for `weight` logical client transactions — the request's
+            # transaction_count carries the full weight, so the proxy's
+            # token buckets and the ratekeeper see the true offered
+            # load even when a storm drives 10^6 simulated clients
+            # through a handful of handles (ref: the batched
+            # transaction_count in GetReadVersionRequest)
+            self._grv_extra[key] = self._grv_extra.get(key, 0) \
+                + (weight - 1)
         if not self._grv_timer_armed:
             self._grv_timer_armed = True
             flow.spawn(self._grv_batch_fire(),
@@ -396,23 +413,26 @@ class Database:
         await flow.delay(SERVER_KNOBS.grv_batch_interval,
                          TaskPriority.DEFAULT_ENDPOINT)
         by_prio, self._grv_waiters = self._grv_waiters, {}
+        extra, self._grv_extra = self._grv_extra, {}
         self._grv_timer_armed = False
         # classes fetch CONCURRENTLY: a throttled or dead-proxy fetch in
         # one class must not head-of-line block (or, on cancellation,
         # strand) another class's independent round trip
         for (priority, tags), waiters in by_prio.items():
-            flow.spawn(self._grv_fetch_one(priority, tags, waiters),
+            flow.spawn(self._grv_fetch_one(priority, tags, waiters,
+                                           extra.get((priority, tags), 0)),
                        TaskPriority.DEFAULT_ENDPOINT,
                        name=f"client.grvFetch.p{priority}")
 
-    async def _grv_fetch_one(self, priority: int, tags, waiters) -> None:
+    async def _grv_fetch_one(self, priority: int, tags, waiters,
+                             extra: int = 0) -> None:
         from ..server.types import GetReadVersionRequest
         info = None
         try:
             info = await self.info()
             proxy = await self.proxy()
             reply = await _rpc(proxy.grvs.get_reply(
-                GetReadVersionRequest(len(waiters), priority, tags),
+                GetReadVersionRequest(len(waiters) + extra, priority, tags),
                 self.process))
             windows = getattr(reply, "conflict_windows", ())
             if windows:
@@ -603,6 +623,18 @@ class Transaction:
             self._grv_priority = PRIORITY_BATCH
         elif option == "priority_system_immediate":
             self._grv_priority = PRIORITY_IMMEDIATE
+        elif option == "grv_batch_weight":
+            # this transaction's GRV stands in for `value` logical
+            # client transactions (storm client-multiplexing — the wire
+            # request's transaction_count carries the full weight so
+            # admission control charges the true offered load)
+            try:
+                weight = int(value)
+            except (TypeError, ValueError):
+                raise error("invalid_option_value")
+            if weight < 1:
+                raise error("invalid_option_value")
+            self._grv_weight = weight
         elif option == "transaction_tag":
             # tag this transaction for the proxy's per-tag traffic
             # accounting (and the tag throttling that will ride it;
@@ -666,6 +698,7 @@ class Transaction:
         self._debug_id = None
         self._profile = None          # re-armed by __init__/set_option
         self._grv_priority = None     # ...including the priority class
+        self._grv_weight = 1          # ...and the multiplexing weight
         self._tags = ()               # ...and the transaction tags
         self._report_conflicting = False
         self._repairable = False      # automatic_repair declaration
@@ -791,7 +824,8 @@ class Transaction:
                             grv_tags,
                             None if ddl is None else ddl - flow.now())
             fut = self.db.batched_grv(getattr(self, "_grv_priority", None),
-                                      grv_tags)
+                                      grv_tags,
+                                      getattr(self, "_grv_weight", 1))
             deadline = getattr(self, "_timeout_deadline", None)
             if deadline is not None:
                 # the shared class fetch continues for other waiters;

@@ -89,6 +89,78 @@ def priority_band(priority: int) -> str:
 # steps per coarse busy-accounting window (see Scheduler._flush_coarse)
 _COARSE_WINDOW = 4096
 
+
+class WakeSignal:
+    """Coalesced-timer helper for periodic run loops (the sim-perf
+    plane's top band was fixed-interval polling loops ticking through
+    empty queues — ROADMAP item 6). A loop that would otherwise poll
+    every interval parks on the signal while its queues are empty and
+    is resumed by the producer's ``touch()``:
+
+        while True:
+            if queue_empty:
+                await signal.wait_beyond(signal.count)
+            await flow.delay(interval, prio)
+            ... drain ...
+
+    ``touch()`` is O(1) and allocation-free when nothing is parked (the
+    hot producer path pays a counter bump and an empty-list check);
+    parking allocates one Future per idle period, not per interval.
+    Waiters resume through the ordinary ready queue at their task
+    priority, so adopting the helper never reorders a loop relative to
+    the priority band it already ran in."""
+
+    __slots__ = ("_count", "_waiters")
+
+    def __init__(self):
+        self._count = 0
+        self._waiters: list = []
+
+    @property
+    def count(self) -> int:
+        """Monotone touch counter — snapshot before parking."""
+        return self._count
+
+    def touch(self) -> None:
+        """Record one producer event and wake every parked waiter."""
+        self._count += 1
+        if self._waiters:
+            waiters, self._waiters = self._waiters, []
+            for f in waiters:
+                if not f.is_ready:
+                    f.send(None)
+
+    def wait_beyond(self, seen: int) -> Future:
+        """Future that is ready once ``count`` exceeds `seen` (already
+        ready if it has). The caller re-checks its own queues after the
+        wait — a wake is a hint, not a handoff."""
+        if self._count > seen:
+            f = Future()
+            f.send(None)
+            return f
+        f = Future()
+        self._waiters.append(f)
+        return f
+
+
+class _TimerCall:
+    """A heap entry that runs a plain callback when its deadline fires
+    — the allocation-lean alternative to a _TimerFuture + on_ready
+    closure for fire-and-forget deadlines (the sim network's delivery
+    timers). Quacks like an unready Future so the timer pump needs no
+    extra branch."""
+
+    __slots__ = ("fn", "args")
+    is_ready = False
+
+    def __init__(self, fn, args):
+        self.fn = fn
+        self.args = args
+
+    def send(self, _value) -> None:
+        self.fn(*self.args)
+
+
 _knobs = None    # cached handle: the slow-task threshold is read per
                  # step and must not pay the import machinery each time
 
@@ -163,6 +235,8 @@ class Scheduler:
         self._band_stats: dict = {}    # band -> [steps, µs]
         self._band_cache: dict = {}    # priority int -> band name
         self.task_stats_dropped = 0    # folds routed to "(other)"
+        self._fold_cache: dict = {}    # raw task name -> folded family
+        self._frame_cache: dict = {}   # code object @ lineno -> frame str
 
     # -- time ---------------------------------------------------------------
     def now(self) -> float:
@@ -226,6 +300,19 @@ class Scheduler:
 
     def yield_now(self, priority: int = TaskPriority.DEFAULT_ENDPOINT) -> Future:
         return self.delay(0.0, priority)
+
+    def call_at(self, seconds: float, fn, *args) -> None:
+        """Run `fn(*args)` when the deadline fires, straight from the
+        timer pump — no Future, no waiter, no closure. The lean path
+        for fire-and-forget deadlines (per-message delivery timers):
+        ordering relative to delay() timers is identical (one shared
+        (time, seq) heap), and the callback runs at the same point the
+        equivalent _TimerFuture's on_ready callbacks would have."""
+        if seconds < 0:
+            seconds = 0.0
+        self._seq += 1
+        heapq.heappush(self._timers,
+                       (self._now + seconds, self._seq, _TimerCall(fn, args)))
 
     # -- run loop -----------------------------------------------------------
     def _run_one(self, max_time: Optional[float] = None) -> bool:
@@ -368,10 +455,19 @@ class Scheduler:
 
     def _fold_task_stat(self, task, priority: int, dt: float) -> None:
         st = self._task_stats
-        name = getattr(task, "name", "") or "?"
-        base = name.rstrip("0123456789")
-        if base != name:       # indexed spawns fold into one family
-            name = base + "*"
+        raw = getattr(task, "name", "") or "?"
+        # the rstrip + compare per step adds up at 10^5 steps/sec; raw
+        # names repeat heavily (pooled actors, role loops), so the
+        # folded family is memoized (bounded: one-shot names fold to a
+        # small family set, but a pathological namer must not grow it)
+        name = self._fold_cache.get(raw)
+        if name is None:
+            base = raw.rstrip("0123456789")
+            # indexed spawns fold into one family
+            name = base + "*" if base != raw else raw
+            if len(self._fold_cache) >= 4096:
+                self._fold_cache.clear()
+            self._fold_cache[raw] = name
         rec = st.get(name)
         if rec is None:
             if len(st) >= self._task_stats_max:
@@ -418,14 +514,25 @@ class Scheduler:
         frames = []
         coro = getattr(task, "_coro", None)
         depth = 0
+        cache = self._frame_cache
         while coro is not None and depth < 32:
             frame = getattr(coro, "cr_frame", None)
             if frame is None:
                 break
             code = frame.f_code
-            frames.append(f"{code.co_name} "
-                          f"({code.co_filename.rsplit('/', 1)[-1]}"
-                          f":{frame.f_lineno})")
+            # suspension points repeat across samples: memoize the
+            # formatted frame per (code, lineno) so the sampling
+            # profiler stops re-rendering the same few hot locations
+            key = (code, frame.f_lineno)
+            s = cache.get(key)
+            if s is None:
+                if len(cache) >= 4096:
+                    cache.clear()
+                s = cache[key] = (
+                    f"{code.co_name} "
+                    f"({code.co_filename.rsplit('/', 1)[-1]}"
+                    f":{frame.f_lineno})")
+            frames.append(s)
             coro = getattr(coro, "cr_await", None)
             depth += 1
         return frames

@@ -25,7 +25,8 @@ from .coordination import CoordinatedState, elect_leader
 from .dbinfo import (EMPTY_DBINFO, FULLY_RECOVERED, ServerDBInfo,
                      StorageRefs, StorageShard)
 from .master import MasterRecovery
-from .types import CLEAR_RANGE, SET_VALUE, MetadataMutations
+from .types import (CLEAR_RANGE, PING_REQUEST, SET_VALUE,
+                    MetadataMutations)
 from .worker import RegisterWorkerRequest
 
 
@@ -450,7 +451,8 @@ class ClusterController:
                 # freshly recovered roles blamed for the old ping
                 pinged.append((name, tuple(wi.worker.roles.keys())))
                 futs.append(flow.catch_errors(flow.timeout_error(
-                    wi.worker.pings.ref().get_reply(None, self.process),
+                    wi.worker.pings.ref().get_reply(PING_REQUEST,
+                                                    self.process),
                     flow.SERVER_KNOBS.failure_monitor_ping_timeout)))
             settled = await flow.all_of(futs)
             failed: set = set()

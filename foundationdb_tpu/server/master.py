@@ -25,7 +25,8 @@ from ..flow import TaskPriority, error
 from ..rpc import RequestStream, SimProcess
 from . import dbinfo as dbi
 from .dbinfo import LogSetInfo, ServerDBInfo
-from .types import CommitRequest, TLogLockRequest
+from .types import (RESOLUTION_METRICS_REQUEST, CommitRequest,
+                    TLogLockRequest)
 
 
 
@@ -467,7 +468,8 @@ class MasterRecovery:
                              TaskPriority.RESOLUTION_METRICS)
             settled = await flow.all_of([flow.catch_errors(
                 flow.timeout_error(
-                    ref.get_reply(None, self.process),
+                    ref.get_reply(RESOLUTION_METRICS_REQUEST,
+                                  self.process),
                     flow.SERVER_KNOBS.resolution_metrics_timeout))
                 for ref in metric_refs])
             if any(f.is_error for f in settled):

@@ -35,8 +35,9 @@ from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS, PRIORITY_BATCH,
                     SET_VERSIONSTAMPED_KEY, SET_VERSIONSTAMPED_VALUE,
                     CommitConflictReply, CommitReply, CommitRequest,
                     GetReadVersionReply, MetadataMutations, MutationRef,
-                    ResolveReply, ResolveRequest, TLogCommitRequest,
-                    TaggedMutation, mutation_bytes)
+                    DURABLE_FRONTIER_REQUEST, GET_RATE_REQUEST,
+                    RAW_COMMITTED_REQUEST, ResolveReply, ResolveRequest,
+                    TLogCommitRequest, TaggedMutation, mutation_bytes)
 
 from .systemkeys import is_management_mutation as _is_management_mutation
 
@@ -281,6 +282,7 @@ class Proxy:
         self._rate = 1e9               # tps budget (ratekeeper-fed)
         self._batch_rate = 1e9         # batch-priority budget (<= _rate)
         self._grv_queue = []           # waiting GRV replies
+        self._grv_queue_dirty = False  # new arrivals since last sort
         self._grv_inflight = []        # batch being confirmed right now
         self._admission_inflight = []  # ...and the admission loop's own
         self._suspect_peers = {}       # id(ref) -> suspect-until time
@@ -338,6 +340,16 @@ class Proxy:
         # TAG_THROTTLING both 0 no request ever routes through it.
         self._dbinfo = dbinfo
         self.admission = GrvAdmissionQueues(process, self.stats)
+        # timer-band diet (ISSUE 12): the GRV-side periodic loops —
+        # batcher, admission ticker, rate poll, tag-throttle poll —
+        # used to poll fixed intervals through empty queues, making
+        # proxy_grv_timer the sim's top run-loop band. They now park on
+        # these signals while idle: `_grv_wake` is touched by every GRV
+        # arrival, `_admission_wake` by every admission submission, so
+        # an idle proxy costs ZERO timer events and the first arrival
+        # restores the exact old cadence.
+        self._grv_wake = flow.WakeSignal()
+        self._admission_wake = flow.WakeSignal()
 
     def set_peers(self, raw_refs) -> None:
         """Raw-committed-version endpoints of the OTHER proxies (ref:
@@ -415,8 +427,11 @@ class Proxy:
             k = SERVER_KNOBS
             if k.grv_admission_control or k.tag_throttling:
                 self.admission.submit(entry, flow.now())
+                self._admission_wake.touch()
             else:
                 self._grv_queue.append(entry)
+                self._grv_queue_dirty = True
+            self._grv_wake.touch()   # unpark the idle GRV-side loops
 
     async def _grv_batcher(self):
         """Release queued GRVs in rate-gated batches; one causal
@@ -426,7 +441,15 @@ class Proxy:
         tokens = 0.0
         btokens = 0.0     # batch-priority bucket (always <= the default)
         last = flow.now()
+        wake = self._grv_wake
         while True:
+            if not self._grv_queue:
+                # timer diet: nothing queued — park until the next GRV
+                # arrival instead of burning a timer event per interval
+                # on an empty queue (token math is unaffected: refill
+                # below is elapsed-time-based and burst-capped, exactly
+                # what idle ticking converged to)
+                await wake.wait_beyond(wake.count)
             await flow.delay(interval, TaskPriority.PROXY_GRV_TIMER)
             now = flow.now()
             # token buckets with a bounded burst allowance; a ZERO
@@ -452,8 +475,14 @@ class Proxy:
             # bypasses the gate and pays no tokens; DEFAULT pays the
             # default bucket; BATCH sorts last and must afford BOTH
             # buckets, so batch traffic throttles first (ref: the
-            # separate batchTransactions limit in GetRateInfoReply)
-            self._grv_queue.sort(key=lambda e: -e[2])
+            # separate batchTransactions limit in GetRateInfoReply).
+            # Sorted ONLY when arrivals were appended since the last
+            # pass: the post-slice tail is already ordered, and under
+            # a throttled backlog the former every-tick sort was
+            # O(n log n) per 0.5ms on a queue that hadn't changed
+            if self._grv_queue_dirty:
+                self._grv_queue.sort(key=lambda e: -e[2])
+                self._grv_queue_dirty = False
             take = 0
             charged = 0
             bcharged = 0
@@ -499,7 +528,16 @@ class Proxy:
         `transactions_started` is the measured request-rate drop).
         Costs one knob read per tick while the plane is off."""
         interval = SERVER_KNOBS.grv_batch_interval
+        wake = self._admission_wake
         while True:
+            if not self.admission.depth():
+                # park until something is submitted: with the plane off
+                # this loop costs nothing at all, and with it armed an
+                # idle window (queues drained, no tag-parked requests)
+                # skips straight to the next submission — bucket refill
+                # is lazy/elapsed-time-based and row expiry is enforced
+                # by the table on read, so skipped ticks change nothing
+                await wake.wait_beyond(wake.count)
             await flow.delay(interval, TaskPriority.PROXY_GRV_TIMER)
             k = SERVER_KNOBS
             if not (k.grv_admission_control or k.tag_throttling) and \
@@ -527,7 +565,17 @@ class Proxy:
         poll; row expiry is enforced by the table itself, so a stale
         poll can never extend a throttle."""
         from .tag_throttler import read_throttle_rows
+        wake = self._grv_wake
+        seen = -1
         while True:
+            if seen == wake.count and not self._grv_queue and \
+                    not self.admission.depth():
+                # no GRV traffic since the last poll: throttle rows
+                # have nobody to apply to — park until a client shows
+                # up (row expiry is enforced by the table on read, so
+                # a stale poll can never extend a throttle)
+                await wake.wait_beyond(wake.count)
+            seen = wake.count
             interval = float(SERVER_KNOBS.tag_throttle_poll_interval)
             await flow.delay(interval if interval > 0 else 1.0,
                              TaskPriority.PROXY_GRV_TIMER)
@@ -548,6 +596,7 @@ class Proxy:
                 # a vanished row (manual `throttle off`) frees its
                 # parked requests into the ordinary class queues
                 self.admission.submit(entry, now)
+                self._admission_wake.touch()
             self.stats.counter("throttle_rows").set(
                 len(self.admission.tags.rows))
 
@@ -579,8 +628,9 @@ class Proxy:
                 live = [p for p in self._peers
                         if self._suspect_peers.get(id(p), 0.0) <= now]
                 degraded = len(live) < len(self._peers)
-                futs = [flow.timeout_error(p.get_reply(None, self.process),
-                                           SERVER_KNOBS.grv_confirm_timeout)
+                futs = [flow.timeout_error(
+                    p.get_reply(RAW_COMMITTED_REQUEST, self.process),
+                    SERVER_KNOBS.grv_confirm_timeout)
                         for p in live]
                 for p, f in zip(live, futs):
                     try:
@@ -605,7 +655,8 @@ class Proxy:
                     # answer is required — with none, causality cannot
                     # be proven and clients must retry.
                     futs = [flow.timeout_error(
-                        ref.get_reply(None, self.process),
+                        ref.get_reply(DURABLE_FRONTIER_REQUEST,
+                                      self.process),
                         SERVER_KNOBS.grv_confirm_timeout)
                         for ref in self.tlog_refs]
                     frontiers = []
@@ -671,11 +722,34 @@ class Proxy:
             raise
 
     async def _rate_loop(self):
-        """(ref: proxies polling GetRateInfo from the ratekeeper)"""
+        """(ref: proxies polling GetRateInfo from the ratekeeper).
+
+        Event-driven (ISSUE 12): the budget only matters while GRV
+        traffic flows, so an idle proxy parks instead of polling the
+        ratekeeper every interval forever — the first arrival after an
+        idle period triggers an immediate poll (fresher than the old
+        fixed grid), and sustained traffic restores the old cadence.
+
+        Known, accepted staleness window: the wake-up poll costs one
+        network round trip while the batcher only waits one
+        GRV_BATCH_INTERVAL, so the FIRST post-idle batch may be
+        admitted against the pre-idle rate (the always-polling loop
+        bounded staleness at one poll interval instead). One
+        burst-capped batch per idle period is the worst case; the
+        ratekeeper's next reply corrects the very next window, and
+        armed-admission storms never park (traffic keeps the loop
+        hot), so the enforcement measurements are unaffected."""
+        wake = self._grv_wake
+        seen = -1
         while True:
+            if seen == wake.count and not self._grv_queue and \
+                    not self.admission.depth():
+                await wake.wait_beyond(wake.count)
+            seen = wake.count
             try:
                 r = await flow.timeout_error(
-                    self._ratekeeper_ref.get_reply(None, self.process),
+                    self._ratekeeper_ref.get_reply(GET_RATE_REQUEST,
+                                                   self.process),
                     SERVER_KNOBS.ratekeeper_poll_timeout)
                 self._rate = r.tps
                 bt = getattr(r, "batch_tps", -1.0)

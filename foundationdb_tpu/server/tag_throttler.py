@@ -155,9 +155,10 @@ class TagThrottler:
         # before writing, not just its own bookkeeping
         manual_live = set()
         if candidates and info.proxies[0].raw_committed is not None:
+            from .types import RAW_COMMITTED_REQUEST
             ver = await flow.timeout_error(
                 info.proxies[0].raw_committed.get_reply(
-                    None, self.process), 2.0)
+                    RAW_COMMITTED_REQUEST, self.process), 2.0)
             for tag, _tps, expiry, _prio, auto in await read_throttle_rows(
                     info, self.process, ver):
                 if not auto and expiry > now:

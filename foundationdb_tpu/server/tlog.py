@@ -26,7 +26,8 @@ from ..rpc import RequestStream, SimProcess
 from ..rpc.disk import SimDisk
 from .chaos import fire_station
 from .diskqueue import DiskQueue
-from .types import (TLogCommitRequest, TLogLockReply, TLogLockRequest,
+from .types import (DurableFrontierRequest,
+                    TLogCommitRequest, TLogLockReply, TLogLockRequest,
                     TLogPeekReply, TLogPeekRequest, TLogPopRequest,
                     mutation_bytes)
 from .wire import decode_log_entry, encode_log_entry
@@ -158,7 +159,7 @@ class TLog:
         # reordered pair (same per-request tolerance as the resolver).
         while True:
             req, reply = await self.commits.pop()
-            if req is None:
+            if type(req) is DurableFrontierRequest:
                 # durable-frontier probe (degraded GRV): every commit a
                 # proxy has EVER acked is durable on all logs, so the
                 # min of these frontiers across logs is a committed,

@@ -314,6 +314,51 @@ class QosSample(NamedTuple):
     sampled_at: float  # sim time of this sample
     signals: dict      # signal name -> value (floats/ints)
 
+# -- typed bare-payload envelopes (ISSUE 12) ----------------------------
+# Every request that used to ship a bare ``None`` payload (ratekeeper
+# rate polls, failure-monitor pings, raw-committed/durable-frontier
+# probes, resolution-metrics polls, status fetches) gets a field-less
+# typed envelope instead: the sim network's per-type message accounting
+# then attributes them (no more anonymous `NoneType` rows — enforced by
+# an armed-mode assert in SimNetwork._count_msg), and the wire layer
+# serves field-less messages from a per-type round-trip cache, so the
+# typed envelope is CHEAPER than the None it replaces. Send the module
+# singletons below; receivers that dispatch match on the type.
+
+
+class GetRateRequest(NamedTuple):
+    """Proxy -> ratekeeper GetRateInfo poll (ref: GetRateInfoRequest)."""
+
+
+class PingRequest(NamedTuple):
+    """CC failure monitor -> worker liveness ping."""
+
+
+class RawCommittedRequest(NamedTuple):
+    """Proxy -> peer proxy raw committed-version probe (GRV causal
+    confirmation, ref: getLiveCommittedVersion)."""
+
+
+class DurableFrontierRequest(NamedTuple):
+    """Proxy -> TLog durable-frontier probe (degraded-GRV fallback)."""
+
+
+class ResolutionMetricsRequest(NamedTuple):
+    """Master -> resolver work/key-histogram poll (ref:
+    ResolutionMetricsRequest)."""
+
+
+class StatusRequest(NamedTuple):
+    """Client -> CC status-document fetch (ref: StatusRequest)."""
+
+
+GET_RATE_REQUEST = GetRateRequest()
+PING_REQUEST = PingRequest()
+RAW_COMMITTED_REQUEST = RawCommittedRequest()
+DURABLE_FRONTIER_REQUEST = DurableFrontierRequest()
+RESOLUTION_METRICS_REQUEST = ResolutionMetricsRequest()
+STATUS_REQUEST = StatusRequest()
+
 from ..rpc import wire as _wire
 
 _wire.register_module(__name__)  # all NamedTuples here are RPC vocabulary

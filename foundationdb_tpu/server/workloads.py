@@ -435,6 +435,165 @@ def zipf_rank(cdf: list, u: float) -> int:
     return lo
 
 
+# -- vectorized storm schedules (ISSUE 12) -------------------------------
+# The storms used to draw every per-arrival decision (inter-arrival
+# gap, Zipf key, priority/tag/repair flags, client id) one random01()
+# at a time from the SHARED flow RNG, interleaved with the simulator's
+# own draws — tens of thousands of python-side bisects and RNG calls on
+# the measured hot path. Each storm's draw_schedule() now draws its
+# ENTIRE randomized schedule up front in bulk numpy passes on an
+# independent stream seeded by ONE draw from the storm's flow RNG:
+#
+#   - replay determinism is preserved (same seed -> one identical fork
+#     draw -> identical schedule arrays -> identical arrival sequence),
+#     pinned by tests/test_storm_vectorized.py;
+#   - the Zipf CDF inverts via vectorized searchsorted (identical rank
+#     for identical u as the per-txn zipf_rank bisect);
+#   - key bytes render once per RANK, not once per transaction.
+#
+# Re-baselining note: the one-time schedule change moves each storm's
+# sim timeline relative to the pre-vectorization code (the old path's
+# draws interleaved with network-latency draws on one shared stream, so
+# matching it arrival-for-arrival is impossible by construction). The
+# same-seed replay oracle — the contract PR 7 enforces and the nightly
+# matrix pins — holds unchanged on the new path.
+
+def _fork_np_rng(rng):
+    """An independent numpy stream seeded by ONE draw from the storm's
+    flow RNG — the schedule's only footprint on the shared stream."""
+    import numpy as np
+    return np.random.Generator(np.random.PCG64(rng.random_int(0, 1 << 63)))
+
+
+def _arrival_offsets(g, duration: float, rate_fn, est_rate: float) -> list:
+    """Open-loop exponential arrival offsets in [0, duration) under a
+    piecewise rate (same inverse-CDF formula the per-arrival loop
+    used), from bulk uniform passes on `g`."""
+    import math
+    times: list = []
+    t = 0.0
+    n = max(64, int(est_rate * duration * 5 // 4) + 16)
+    u = g.random(n).tolist()
+    i = 0
+    ln = math.log
+    while True:
+        if i >= n:
+            u = g.random(n).tolist()   # top-up pass (rarely needed)
+            i = 0
+        r = rate_fn(t)
+        t += -ln(max(1e-12, 1.0 - u[i])) / max(r, 1e-9)
+        i += 1
+        if t >= duration:
+            return times
+        times.append(t)
+
+
+def _zipf_ranks(g, cdf: list, n: int) -> list:
+    """n Zipf ranks via one uniform pass + vectorized searchsorted
+    (identical rank per u as zipf_rank's bisect)."""
+    import numpy as np
+    if n == 0:
+        return []
+    ranks = np.searchsorted(np.asarray(cdf), g.random(n), side="left")
+    # the float-summed CDF tail sits just below 1.0, so a draw beyond
+    # cdf[-1] would index one past the key table — clamp exactly like
+    # zipf_rank's hi bound
+    return np.minimum(ranks, len(cdf) - 1).tolist()
+
+
+def _flag_array(g, n: int, fraction: float) -> list:
+    """n booleans at `fraction` (a zero fraction draws nothing, so
+    arrays drawn before it are unaffected either way)."""
+    if fraction <= 0.0 or n == 0:
+        return [False] * n
+    return (g.random(n) < fraction).tolist()
+
+
+_POOL_DONE = object()
+
+
+class ClientActorPool:
+    """Bounded, reusable client-actor pool (ISSUE 12).
+
+    Storms used to spawn one `storm-txn-<i>` task PER ARRIVAL: a fresh
+    coroutine, Task object and name string each, with every dead
+    one-shot name folding through the sim-task table. The pool spawns
+    at most `limit` long-lived workers — lazily, on first concurrent
+    demand — and reuses them across arrivals, so the task-name set is
+    FIXED and small (`<label>-0..k`, k <= peak concurrency): PR 11's
+    trailing-digit folding still attributes every arrival to the same
+    `<label>-*` family, and SIM_TASK_STATS_MAX_NAMES slots stop
+    leaking to one-shot names.
+
+    `dispatch(job)` hands the job to an idle worker (LIFO — the
+    warmest worker runs next) or returns False when all `limit`
+    workers are busy: the open-loop shed decision stays at arrival
+    time, exactly like the old `_inflight >= max_inflight` cap."""
+
+    def __init__(self, run_job, limit: int, label: str = "storm-txn"):
+        self._run_job = run_job
+        self._limit = max(1, limit)
+        self._label = label
+        self._idle: list = []      # parked workers' next-job futures
+        self._tasks: list = []
+        self._closing = False
+        self._error = None         # first job failure, re-raised by drain
+
+    @property
+    def size(self) -> int:
+        """Workers ever spawned (== peak concurrency)."""
+        return len(self._tasks)
+
+    @property
+    def busy(self) -> int:
+        return len(self._tasks) - len(self._idle)
+
+    def dispatch(self, job: tuple) -> bool:
+        """Run `job` now on a pooled worker; False = saturated (shed)."""
+        if self._idle:
+            self._idle.pop().send(job)
+            return True
+        if len(self._tasks) < self._limit:
+            self._tasks.append(flow.spawn(
+                self._worker(job),
+                name=f"{self._label}-{len(self._tasks)}"))
+            return True
+        return False
+
+    async def _worker(self, job: tuple) -> None:
+        while True:
+            try:
+                await self._run_job(*job)
+            except flow.ActorCancelled:
+                raise
+            except BaseException as e:  # noqa: BLE001
+                # a dying job must not leak this worker's pool slot
+                # (the old per-arrival code's finally-based inflight
+                # decrement had the same guarantee): record the first
+                # failure for drain() and keep serving
+                if self._error is None:
+                    self._error = e
+            if self._closing:
+                return
+            f = flow.Future()
+            self._idle.append(f)
+            job = await f
+            if job is _POOL_DONE:
+                return
+
+    async def drain(self) -> None:
+        """No further dispatches: release idle workers, wait for busy
+        ones. Re-raises the first job failure — the same contract as
+        the old wait_for_all over per-arrival tasks."""
+        self._closing = True
+        idle, self._idle = self._idle, []
+        for f in idle:
+            f.send(_POOL_DONE)
+        await flow.wait_for_all(self._tasks)
+        if self._error is not None:
+            raise self._error
+
+
 class OpenLoopStorm:
     """Open-loop Zipfian burst workload (ref: the reference's stress
     workloads + ROADMAP item 3's admission-control storm): transaction
@@ -462,7 +621,6 @@ class OpenLoopStorm:
                  tags: tuple = (b"web", b"batchjob", b"mobile"),
                  max_inflight: int = 512,
                  repairable_fraction: float = 0.0):
-        import math
         self.dbs = list(dbs)
         self.rng = rng
         self.duration = duration
@@ -483,7 +641,6 @@ class OpenLoopStorm:
         self.tags = tuple(tags)
         self.max_inflight = max_inflight
         self._zipf_cdf = make_zipf_cdf(keyspace, zipf_s)
-        self._ln = math.log
         from ..flow.latency import LatencySample
         self.grv_latency = LatencySample("storm_grv", size=4096)
         self.commit_latency = LatencySample("storm_commit", size=4096)
@@ -496,28 +653,44 @@ class OpenLoopStorm:
         # open-loop assert to be honest about what it measured
         self.stats = {"issued": 0, "admitted": 0, "completed": 0,
                       "conflicted": 0, "shed": 0, "errors": {}}
-        self._inflight = 0
 
-    def _zipf_key(self) -> bytes:
-        return self.prefix + b"k%04d" % zipf_rank(self._zipf_cdf,
-                                                  self.rng.random01())
+    def draw_schedule(self):
+        """The whole storm schedule in one vectorized pass: arrival
+        offsets (burst-windowed piecewise rate), per-arrival key bytes
+        (Zipf rank -> prerendered key table), batch-priority flags and
+        automatic_repair flags. Deterministic per seed; the shared
+        flow RNG pays exactly one fork draw."""
+        g = _fork_np_rng(self.rng)
+        bs, be = self.burst_start, self.burst_start + self.burst_len
+        times = _arrival_offsets(
+            g, self.duration,
+            lambda t: self.burst_rate if bs <= t < be else self.rate,
+            max(self.rate, self.burst_rate))
+        n = len(times)
+        key_table = [self.prefix + b"k%04d" % r
+                     for r in range(self.keyspace)]
+        keys = [key_table[r] for r in _zipf_ranks(g, self._zipf_cdf, n)]
+        batch = _flag_array(g, n, self.batch_fraction)
+        # drawn LAST (and not at all when 0), so arming repair leaves
+        # the arrival/key/priority schedule untouched
+        repair = _flag_array(g, n, self.repairable_fraction)
+        return times, keys, batch, repair
 
-    async def _one_txn(self, i: int) -> None:
+    async def _one_txn(self, i: int, key: bytes, batch: bool,
+                       repairable: bool) -> None:
         db = self.dbs[i % len(self.dbs)]
         tr = db.create_transaction()
         try:
             tr.set_option("transaction_tag", self.tags[i % len(self.tags)])
-            if self.rng.random01() < self.batch_fraction:
+            if batch:
                 tr.set_option("priority_batch")
-            if self.repairable_fraction > 0 and \
-                    self.rng.random01() < self.repairable_fraction:
+            if repairable:
                 tr.set_option("automatic_repair")
             t0 = flow.now()
             await tr.get_read_version()
             self.grv_latency.record(flow.now() - t0)
-            k = self._zipf_key()
-            await tr.get(k)
-            tr.set(k, b"s%06d" % i)
+            await tr.get(key)
+            tr.set(key, b"s%06d" % i)
             t1 = flow.now()
             await tr.commit()
             self.commit_latency.record(flow.now() - t1)
@@ -531,35 +704,23 @@ class OpenLoopStorm:
             else:
                 errs = self.stats["errors"]
                 errs[e.name] = errs.get(e.name, 0) + 1
-        finally:
-            self._inflight -= 1
 
     async def run(self) -> dict:
         start = flow.now()
         wall0, tasks0 = _time.monotonic(), flow.g().tasks_run
-        t = start
-        outstanding = []
-        i = 0
-        while True:
-            in_burst = (self.burst_start <= (t - start)
-                        < self.burst_start + self.burst_len)
-            r = self.burst_rate if in_burst else self.rate
-            u = self.rng.random01()
-            t += -self._ln(max(1e-12, 1.0 - u)) / max(r, 1e-9)
-            if t - start >= self.duration:
-                break
-            if t > flow.now():
-                await flow.delay(t - flow.now())
+        times, keys, batch, repair = self.draw_schedule()
+        pool = ClientActorPool(self._one_txn, self.max_inflight)
+        now = flow.now
+        for i, t in enumerate(times):
+            at = start + t
+            if at > now():
+                await flow.delay(at - now())
             self.stats["issued"] += 1
-            if self._inflight >= self.max_inflight:
+            if pool.dispatch((i, keys[i], batch[i], repair[i])):
+                self.stats["admitted"] += 1
+            else:
                 self.stats["shed"] += 1
-                continue
-            self.stats["admitted"] += 1
-            self._inflight += 1
-            outstanding.append(flow.spawn(
-                self._one_txn(i), name=f"storm-txn-{i}"))
-            i += 1
-        await flow.wait_for_all(outstanding)
+        await pool.drain()
         out = dict(self.stats)
         out["grv"] = self.grv_latency.snapshot()
         out["commit"] = self.commit_latency.snapshot()
@@ -606,7 +767,15 @@ class OverloadStorm:
     arrival, no retries: a rejection (`proxy_memory_limit_exceeded` /
     `tag_throttled`) is a designed OUTCOME the storm counts, exactly
     like the OpenLoopStorm's honesty contract — shed, admitted, and
-    completed are reported separately with offered-load attainment."""
+    completed are reported separately with offered-load attainment.
+
+    `clients_per_arrival > 1` is the 10^6-client scale path (ISSUE
+    12): each arrival represents a BLOCK of that many distinct logical
+    clients walking the tenant pool behind one wire transaction whose
+    GRV carries the whole block's `transaction_count` — admission
+    control and the ratekeeper see the full offered load, the report's
+    `distinct_clients` counts every logical client (cursor coverage,
+    O(1) memory), and the simulator pays one transaction per block."""
 
     def __init__(self, dbs, rng, duration: float = 4.0,
                  fair_rate: float = 60.0, abusive_rate: float = 240.0,
@@ -616,8 +785,8 @@ class OverloadStorm:
                  tenant_tags: tuple = (b"tenant-web", b"tenant-mobile",
                                        b"tenant-api"),
                  batch_fraction: float = 0.2,
-                 max_inflight: int = 4096):
-        import math
+                 max_inflight: int = 4096,
+                 clients_per_arrival: int = 1):
         self.dbs = list(dbs)
         self.rng = rng
         self.duration = duration
@@ -629,8 +798,16 @@ class OverloadStorm:
         self.tenant_tags = tuple(tenant_tags)
         self.batch_fraction = batch_fraction
         self.max_inflight = max_inflight
+        # client multiplexing (ISSUE 12's 10^6-client path): each
+        # arrival stands in for a BLOCK of `clients_per_arrival`
+        # distinct logical clients walking the tenant pool — the block
+        # leader runs the wire transaction with a GRV weight of the
+        # whole block, so admission control and the ratekeeper are
+        # charged for the true offered load while the sim pays one
+        # transaction per block. 1 = the classic one-client-per-arrival
+        # storm (cid drawn randomly from the population).
+        self.clients_per_arrival = max(1, int(clients_per_arrival))
         self._zipf_cdf = make_zipf_cdf(keyspace, zipf_s)
-        self._ln = math.log
         from ..flow.latency import LatencySample
         #: per tenant group: admitted-GRV latency and whole-txn latency
         self.grv_latency = {"abusive": LatencySample("ovl_grv_ab", 4096),
@@ -648,27 +825,58 @@ class OverloadStorm:
                       # at the budget" is measured over
                       "late_issued": 0, "late_completed": 0,
                       "errors": {}}
-        self._inflight = 0
 
-    def _zipf_key(self) -> bytes:
-        return self.prefix + b"k%04d" % zipf_rank(self._zipf_cdf,
-                                                  self.rng.random01())
+    def draw_schedule(self):
+        """Vectorized arrival schedule: offsets at the combined rate,
+        per-arrival abusive/fair group flags at the rate share, Zipf
+        key bytes, batch-priority flags (applied to fair traffic only,
+        as before), and — for the classic 1-client-per-arrival shape —
+        the logical client id draws. One fork draw on the shared RNG."""
+        g = _fork_np_rng(self.rng)
+        total = self.fair_rate + self.abusive_rate
+        times = _arrival_offsets(g, self.duration, lambda t: total, total)
+        n = len(times)
+        abusive_frac = self.abusive_rate / max(total, 1e-9)
+        abusive = _flag_array(g, n, abusive_frac)
+        key_table = [self.prefix + b"k%04d" % r
+                     for r in range(len(self._zipf_cdf))]
+        keys = [key_table[r] for r in _zipf_ranks(g, self._zipf_cdf, n)]
+        batch = _flag_array(g, n, self.batch_fraction)
+        # the abusive tenant owns the first tenth of the client ids;
+        # the fair tenants split the rest
+        n_abusive = max(1, self.n_clients // 10)
+        fair_pool = max(1, self.n_clients - n_abusive)
+        if self.clients_per_arrival <= 1:
+            u = g.random(n) if n else []
+            cids = [(min(int(u[i] * n_abusive), n_abusive - 1)
+                     if abusive[i]
+                     else n_abusive + min(int(u[i] * fair_pool),
+                                          fair_pool - 1))
+                    for i in range(n)]
+        else:
+            # multiplexed blocks walk the pools with cursors instead of
+            # random draws: coverage of the population is exact, and
+            # distinct-client accounting is O(1) instead of a
+            # 10^6-entry set
+            cids = None
+        return times, abusive, keys, batch, cids
 
     async def _one_txn(self, i: int, cid: int, tag: bytes, group: str,
-                       late: bool) -> None:
+                       late: bool, key: bytes, batch: bool) -> None:
         db = self.dbs[cid % len(self.dbs)]
         tr = db.create_transaction()
         t0 = flow.now()
         try:
             tr.set_option("transaction_tag", tag)
-            if group == "others" and \
-                    self.rng.random01() < self.batch_fraction:
+            if batch and group == "others":
                 tr.set_option("priority_batch")
+            if self.clients_per_arrival > 1:
+                # the block leader's GRV is charged for the whole block
+                tr.set_option("grv_batch_weight", self.clients_per_arrival)
             await tr.get_read_version()
             self.grv_latency[group].record(flow.now() - t0)
-            k = self._zipf_key()
-            await tr.get(k)
-            tr.set(k, b"o%06d" % i)
+            await tr.get(key)
+            tr.set(key, b"o%06d" % i)
             await tr.commit()
             self.txn_latency[group].record(flow.now() - t0)
             self.stats["completed"] += 1
@@ -688,58 +896,77 @@ class OverloadStorm:
             else:
                 errs = self.stats["errors"]
                 errs[e.name] = errs.get(e.name, 0) + 1
-        finally:
-            self._inflight -= 1
 
     async def run(self) -> dict:
         start = flow.now()
         wall0, tasks0 = _time.monotonic(), flow.g().tasks_run
-        t = start
-        outstanding = []
-        i = 0
-        total_rate = self.fair_rate + self.abusive_rate
-        abusive_frac = self.abusive_rate / max(total_rate, 1e-9)
-        # the abusive tenant owns the first tenth of the client ids;
-        # the fair tenants split the rest
+        times, abusive, keys, batch, cids = self.draw_schedule()
         n_abusive = max(1, self.n_clients // 10)
+        fair_pool = max(1, self.n_clients - n_abusive)
+        B = self.clients_per_arrival
+        pool = ClientActorPool(self._one_txn, self.max_inflight,
+                               label="ovl-txn")
         clients_seen: set = set()
-        while True:
-            u = self.rng.random01()
-            t += -self._ln(max(1e-12, 1.0 - u)) / max(total_rate, 1e-9)
-            if t - start >= self.duration:
-                break
-            if t > flow.now():
-                await flow.delay(t - flow.now())
-            # which logical client arrived: the abusive tenant's pool
-            # generates its rate share outright; the rest of the
-            # population splits the fair share across the tenant tags
-            if self.rng.random01() < abusive_frac:
-                # random_int is half-open [lo, hi)
-                cid = self.rng.random_int(0, n_abusive)
-                tag, group = self.abusive_tag, "abusive"
+        tags_seen: set = set()   # bounded by the tag vocabulary
+        # multiplexed mode: per-group block cursors + draw totals
+        cursors = {"abusive": 0, "others": 0}
+        draws = {"abusive": 0, "others": 0}
+        half = self.duration / 2
+        now = flow.now
+        for i, t in enumerate(times):
+            at = start + t
+            if at > now():
+                await flow.delay(at - now())
+            if abusive[i]:
+                group = "abusive"
             else:
-                cid = self.rng.random_int(
-                    n_abusive, max(n_abusive + 1, self.n_clients))
-                tag = self.tenant_tags[cid % len(self.tenant_tags)]
                 group = "others"
-            clients_seen.add(cid)
-            late = (t - start) >= self.duration / 2
+            if cids is not None:
+                cid = cids[i]
+                clients_seen.add(cid)
+            else:
+                # next block of B distinct ids from the group's pool.
+                # The leader is a ROTATING member of the block (offset
+                # i % B), not always the first id: a fixed stride of B
+                # would alias with len(tenant_tags)/len(dbs) whenever B
+                # shares a factor with them, pinning every arrival to
+                # one tag and one handle (found in review — B=600 sent
+                # all fair traffic to a single tenant)
+                psize = n_abusive if group == "abusive" else fair_pool
+                base = 0 if group == "abusive" else n_abusive
+                cid = base + ((cursors[group] + (i % B)) % psize)
+                cursors[group] = (cursors[group] + B) % psize
+                draws[group] += B
+            tag = (self.abusive_tag if group == "abusive"
+                   else self.tenant_tags[cid % len(self.tenant_tags)])
+            tags_seen.add(tag)
+            late = t >= half
             self.stats["issued"] += 1
             self.stats[group + "_issued"] += 1
             if late:
                 self.stats["late_issued"] += 1
-            if self._inflight >= self.max_inflight:
+            if pool.dispatch((i, cid, tag, group, late, keys[i],
+                              batch[i])):
+                self.stats["admitted"] += 1
+            else:
                 self.stats["shed"] += 1
-                continue
-            self.stats["admitted"] += 1
-            self._inflight += 1
-            outstanding.append(flow.spawn(
-                self._one_txn(i, cid, tag, group, late),
-                name=f"ovl-txn-{i}"))
-            i += 1
-        await flow.wait_for_all(outstanding)
+        await pool.drain()
         out = dict(self.stats)
-        out["distinct_clients"] = len(clients_seen)
+        if cids is not None:
+            out["distinct_clients"] = len(clients_seen)
+        else:
+            # cursor walks cover the pool exactly: distinct ids per
+            # group = min(ids drawn, pool size) — O(1), no 10^6 set
+            out["distinct_clients"] = (
+                min(draws["abusive"], n_abusive)
+                + min(draws["others"], fair_pool))
+        out["clients_per_arrival"] = B
+        out["logical_clients_offered"] = self.stats["issued"] * B
+        # which tags actually carried traffic — a multiplexing stride
+        # that aliased the tag modulus would show up as a single fair
+        # tag here (test-pinned)
+        out["tags_seen"] = sorted(tag.decode("latin-1")
+                                  for tag in tags_seen)
         wall = flow.now() - start
         out["wall_seconds"] = round(wall, 3)
         out["attainment"] = round(
@@ -897,7 +1124,6 @@ class ContentionStorm:
                  rate: float = 150.0, hot_keys: int = 2,
                  prefix: bytes = b"cont/", max_retries: int = 4,
                  repairable: bool = True, max_inflight: int = 512):
-        import math
         self.dbs = list(dbs)
         self.rng = rng
         self.duration = duration
@@ -907,8 +1133,6 @@ class ContentionStorm:
         self.max_retries = max_retries
         self.repairable = repairable
         self.max_inflight = max_inflight
-        self._ln = math.log
-        self._inflight = 0
         from ..flow.latency import LatencySample
         self.txn_latency = LatencySample("contention_txn", size=4096)
         self.stats = {"issued": 0, "committed": 0, "conflicts": 0,
@@ -925,63 +1149,60 @@ class ContentionStorm:
         t0 = flow.now()
         tr = db.create_transaction()
         attempts = 0
-        try:
-            while True:
-                attempts += 1
-                self.stats["attempts"] += 1
-                try:
-                    if self.repairable:
-                        tr.set_option("automatic_repair")
-                    await tr.get(k)
-                    tr.atomic_op(k, struct.pack("<q", 1), ADD_VALUE)
-                    tr.set(self.prefix + b"r%07d" % i, b"x")
-                    await tr.commit()
-                    self.stats["committed"] += 1
-                    self.txn_latency.record(flow.now() - t0)
+        while True:
+            attempts += 1
+            self.stats["attempts"] += 1
+            try:
+                if self.repairable:
+                    tr.set_option("automatic_repair")
+                await tr.get(k)
+                tr.atomic_op(k, struct.pack("<q", 1), ADD_VALUE)
+                tr.set(self.prefix + b"r%07d" % i, b"x")
+                await tr.commit()
+                self.stats["committed"] += 1
+                self.txn_latency.record(flow.now() - t0)
+                return
+            except flow.FdbError as e:
+                if e.name in UNKNOWN_OUTCOME:
+                    # never retried: the goodput oracle (hot-key
+                    # sum == committed) must stay exact, and a
+                    # retried unknown could double-apply the ADD
+                    self.stats["unknown"] += 1
                     return
-                except flow.FdbError as e:
-                    if e.name in UNKNOWN_OUTCOME:
-                        # never retried: the goodput oracle (hot-key
-                        # sum == committed) must stay exact, and a
-                        # retried unknown could double-apply the ADD
-                        self.stats["unknown"] += 1
-                        return
-                    if e.name == "not_committed":
-                        self.stats["conflicts"] += 1
-                    if attempts > self.max_retries or \
-                            e.name not in RETRYABLE:
-                        self.stats["failed"] += 1
-                        return
-                    try:
-                        await tr.on_error(e)
-                    except flow.FdbError:
-                        self.stats["failed"] += 1
-                        return
-        finally:
-            self._inflight -= 1
+                if e.name == "not_committed":
+                    self.stats["conflicts"] += 1
+                if attempts > self.max_retries or \
+                        e.name not in RETRYABLE:
+                    self.stats["failed"] += 1
+                    return
+                try:
+                    await tr.on_error(e)
+                except flow.FdbError:
+                    self.stats["failed"] += 1
+                    return
+
+    def draw_schedule(self) -> list:
+        """Arrival offsets in one vectorized pass (key and handle per
+        arrival are index-deterministic — no other randomness)."""
+        g = _fork_np_rng(self.rng)
+        return _arrival_offsets(g, self.duration, lambda t: self.rate,
+                                self.rate)
 
     async def run(self) -> dict:
         start = flow.now()
         wall0, tasks0 = _time.monotonic(), flow.g().tasks_run
-        t = start
-        outstanding = []
-        i = 0
-        while True:
-            u = self.rng.random01()
-            t += -self._ln(max(1e-12, 1.0 - u)) / max(self.rate, 1e-9)
-            if t - start >= self.duration:
-                break
-            if t > flow.now():
-                await flow.delay(t - flow.now())
+        times = self.draw_schedule()
+        pool = ClientActorPool(self._one_txn, self.max_inflight,
+                               label="cont-txn")
+        now = flow.now
+        for i, t in enumerate(times):
+            at = start + t
+            if at > now():
+                await flow.delay(at - now())
             self.stats["issued"] += 1
-            if self._inflight >= self.max_inflight:
+            if not pool.dispatch((i,)):
                 self.stats["shed"] += 1
-                continue
-            self._inflight += 1
-            outstanding.append(flow.spawn(
-                self._one_txn(i), name=f"cont-txn-{i}"))
-            i += 1
-        await flow.wait_for_all(outstanding)
+        await pool.drain()
         out = dict(self.stats)
         wall = flow.now() - start
         out["wall_seconds"] = round(wall, 3)

@@ -24,6 +24,7 @@ CONTENTION_REPORT_PATH = "/tmp/_contention_report.txt"
 OVERLOAD_REPORT_PATH = "/tmp/_overload_report.txt"
 SIMPROF_REPORT_PATH = "/tmp/_simprof_smoke.txt"
 SIMPROF_CHAOS_PATH = "/tmp/_simprof_chaos.json"
+SIMPROF_CHAOS_FOLDED_PATH = "/tmp/_simprof_chaos.folded"
 
 
 def run_smoke(out=print) -> int:
@@ -491,8 +492,11 @@ def run_smoke_chaos(out=print,
         # only the wall clock (armed-vs-off same-seed equivalence is
         # test-pinned), and a red cell's post-mortem then carries the
         # wall-time attribution picture (/tmp/_simprof_chaos.json)
+        # plus flamegraph-ready collapsed stacks
+        # (/tmp/_simprof_chaos.folded — flamegraph.pl / speedscope)
         cluster.sched.start_task_stats()
         cluster.net.arm_message_stats()
+        cluster.sched.start_profiler(sample_every=16)
         if admission:
             flow.SERVER_KNOBS.set("grv_admission_control", 1)
             flow.SERVER_KNOBS.set("tag_throttling", 1)
@@ -517,6 +521,8 @@ def run_smoke_chaos(out=print,
                          cluster.net.message_stats_report()},
                     fh, indent=2, sort_keys=True, default=str)
                 fh.write("\n")
+            with open(SIMPROF_CHAOS_FOLDED_PATH, "w") as fh:
+                fh.write(cluster.sched.profile_folded() + "\n")
             cluster.shutdown()
 
     rep = run_once()
