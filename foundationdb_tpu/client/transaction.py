@@ -891,6 +891,15 @@ class Transaction:
         return None
 
     # -- reads ----------------------------------------------------------
+    def _read_tags(self) -> tuple:
+        """Transaction tags for the storage server's read-cost
+        accounting — attached only while the storage heat plane is
+        armed, so the read requests stay byte-identical to the
+        pre-plane ones otherwise (the GRV-tag contract)."""
+        if flow.SERVER_KNOBS.storage_heat_tracking:
+            return tuple(getattr(self, "_tags", ()))
+        return ()
+
     async def _base_get(self, key: bytes) -> Optional[bytes]:
         found, val = self._overlay_get(key)
         if found:
@@ -907,7 +916,8 @@ class Transaction:
         try:
             val = await self._storage_rpc(
                 shard, lambda rep: rep.gets.get_reply(
-                    StorageGetRequest(key, version, debug_id),
+                    StorageGetRequest(key, version, debug_id,
+                                      self._read_tags()),
                     self.db.process))
             ok = True
         finally:
@@ -1116,7 +1126,9 @@ class Transaction:
                     shard = await self._shard(k)
                     val = await self._storage_rpc(
                         shard, lambda rep, k=k: rep.gets.get_reply(
-                            StorageGetRequest(k, version), self.db.process))
+                            StorageGetRequest(k, version,
+                                              tags=self._read_tags()),
+                            self.db.process))
                 for op, param in ops:
                     val = _ATOMIC_APPLY[op](val, param)
                 if val is None:
@@ -1159,9 +1171,11 @@ class Transaction:
             # NativeAPI getRange issuing parallel requests when limits
             # permit). The race settles on the FIRST error (the serial
             # path's prompt-retry behavior) and cancels the rest.
+            rtags = self._read_tags()
             futs = [flow.spawn(self._storage_rpc(
                 s, lambda rep, b=b, e=e: rep.ranges.get_reply(
-                    StorageGetRangeRequest(b, e, version, limit, reverse),
+                    StorageGetRangeRequest(b, e, version, limit, reverse,
+                                           rtags),
                     self.db.process))) for s, b, e in clamped]
             wrappers = [flow.catch_errors(f) for f in futs]
             results: List = [None] * len(futs)
@@ -1185,11 +1199,13 @@ class Transaction:
                 out.extend(part)
             return out
         out = []
+        rtags = self._read_tags()
         for _s, b, e in clamped:
             part = await self._storage_rpc(
                 _s, lambda rep, b=b, e=e: rep.ranges.get_reply(
                     StorageGetRangeRequest(b, e, version, limit - len(out),
-                                           reverse), self.db.process))
+                                           reverse, rtags),
+                    self.db.process))
             out.extend(part)
             if len(out) >= limit:
                 break

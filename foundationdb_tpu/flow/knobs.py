@@ -67,6 +67,38 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
          lambda: 4_000_000)
     init("BYTE_SAMPLE_FACTOR", 100, lambda: 10)
     init("DD_BANDWIDTH_TAU", 5.0, lambda: 1.0)
+    # -- storage heat plane (server/storage.py read-side metrics;
+    # ref: StorageMetrics.actor bytesReadSample + getReadHotRanges +
+    # TransactionTagCounter on the storage server). Default OFF: the
+    # read hot paths pay one knob read per request and nothing else;
+    # BUGGIFY arms it so sim runs exercise the accounting paths (the
+    # plane is observe-only — arming never changes commit outcomes)
+    init("STORAGE_HEAT_TRACKING", 0, lambda: 1)
+    # read-byte sample inclusion factor (mirrors BYTE_SAMPLE_FACTOR on
+    # the read side; ref: BYTE_SAMPLING_FACTOR for bytesReadSample)
+    init("READ_SAMPLE_FACTOR", 100, lambda: 10)
+    # sampled read keys kept per shard (lowest decayed rate evicted)
+    init("READ_SAMPLE_MAX_KEYS", 256, lambda: 16)
+    # a sub-range is read-hot when its read-bandwidth / sampled-byte
+    # density exceeds this multiple of the shard's own density (ref:
+    # SHARD_MAX_READ_DENSITY_RATIO behind ReadHotSubRangeRequest)
+    init("READ_HOT_RANGE_RATIO", 8.0, lambda: 2.0)
+    # byte-balanced buckets the shard's sample is split into for the
+    # density scan (ref: the chunk math in getReadHotRanges). Finer
+    # buckets name narrower hot ranges; a bucket much wider than the
+    # truly-hot keys dilutes their density below the ratio
+    init("READ_HOT_SUB_RANGE_CHUNKS", 16, lambda: 4)
+    # cluster-wide storage_heat rollup at the CC: decaying top-K table
+    # (ConflictHotSpots-style bounds — per-range state stays O(active))
+    init("STORAGE_HEAT_HALF_LIFE", 10.0, lambda: 0.5)
+    init("STORAGE_HEAT_MAX_ENTRIES", 64, lambda: 4)
+    init("STORAGE_HEAT_TOP_K", 10)
+    # auto-throttler input preference: with this armed the ratekeeper's
+    # TagThrottler also reads per-STORAGE-SERVER tag busyness (one
+    # tenant hammering one shard throttles that tenant even when its
+    # cluster-wide rate looks modest — ROADMAP item 3's storage-aware
+    # steering; enforcement semantics are unchanged, only detection)
+    init("TAG_THROTTLE_STORAGE_BUSYNESS", 0, lambda: 1)
     init("DD_MIN_BALANCE_BYTES", 2_000, lambda: 600)
     init("CONF_SYNC_INTERVAL", 2.0, lambda: 0.3)
     init("WATCH_TIMEOUT", 900.0, lambda: 20.0)

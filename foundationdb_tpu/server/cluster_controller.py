@@ -58,6 +58,69 @@ def epoch_roles(workers, epoch: int, cls):
                 yield rn, role
 
 
+class StorageHeatTable:
+    """Decaying cluster-wide top-K of read-hot sub-ranges (ISSUE 13;
+    ref: the DD/ratekeeper view over per-SS ReadHotSubRange replies).
+    Same bounded-decay shape as ConflictHotSpots: each flagged range's
+    read-bandwidth score halves every STORAGE_HEAT_HALF_LIFE seconds,
+    the table caps at STORAGE_HEAT_MAX_ENTRIES (coldest evicted), so
+    per-range state stays O(active hot ranges), never O(keyspace)."""
+
+    __slots__ = ("_entries",)
+
+    def __init__(self):
+        # (server, begin, end) -> [decayed read-bps score, last density
+        #                          ratio, last update time, sightings]
+        self._entries: dict = {}
+
+    @staticmethod
+    def _decayed(score: float, since: float, now: float) -> float:
+        hl = flow.SERVER_KNOBS.storage_heat_half_life
+        if now <= since or hl <= 0:
+            return score
+        return score * 0.5 ** ((now - since) / hl)
+
+    def record(self, server: str, begin: bytes, end: bytes,
+               density: float, read_bps: float) -> None:
+        now = flow.now()
+        key = (server, begin, end)
+        ent = self._entries.get(key)
+        if ent is None:
+            self._entries[key] = [float(read_bps), float(density), now, 1]
+        else:
+            ent[0] = self._decayed(ent[0], ent[2], now) + float(read_bps)
+            ent[1] = float(density)
+            ent[2] = now
+            ent[3] += 1
+        while len(self._entries) > \
+                int(flow.SERVER_KNOBS.storage_heat_max_entries):
+            worst = min(self._entries,
+                        key=lambda k: self._decayed(
+                            self._entries[k][0], self._entries[k][2], now))
+            del self._entries[worst]
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def prune(self, live_servers) -> None:
+        """Drop rows of retired replicas — a dead server's stale heat
+        must not keep naming split candidates."""
+        for key in [k for k in self._entries if k[0] not in live_servers]:
+            del self._entries[key]
+
+    def top(self, k: int = None) -> list:
+        if k is None:
+            k = int(flow.SERVER_KNOBS.storage_heat_top_k)
+        now = flow.now()
+        rows = [(self._decayed(s, t, now), d, srv, b, e, n)
+                for (srv, b, e), (s, d, t, n) in self._entries.items()]
+        rows.sort(key=lambda r: (-r[0], r[2], r[3]))
+        return [{"server": srv, "begin": b.hex(), "end": e.hex(),
+                 "density": round(d, 4), "read_bps": round(score, 2),
+                 "sightings": n}
+                for score, d, srv, b, e, n in rows[:k]]
+
+
 def _client_profile_counters() -> dict:
     """Process-wide sampled-transaction profiler counters. Same
     sys.modules guard: a cluster that never sampled anything must not
@@ -211,6 +274,13 @@ class ClusterController:
         # (collected by _qos_sampler_loop at QOS_SAMPLE_INTERVAL; empty
         # when the knob is 0 — the plane then costs nothing anywhere)
         self.qos_samples: dict = {}
+        # the storage heat plane's cluster rollup (ISSUE 13): decaying
+        # top-K of read-hot sub-ranges across every storage replica +
+        # the latest busiest-read-tag per server, fed by the QoS
+        # sampler while STORAGE_HEAT_TRACKING is armed (empty — and
+        # costless — otherwise)
+        self.storage_heat = StorageHeatTable()
+        self._heat_tags: dict = {}  # server -> (tag hex, busyness)
         # (instance name, counter) -> TimeSeries (ref: TDMetric levels)
         self.metrics: dict = {}
         self._metric_gauges: set = set()   # (rn, cn) sampled via set()
@@ -346,6 +416,34 @@ class ClusterController:
             # status document never reports a dead role's stale signals
             for rn in [r for r in self.qos_samples if r not in known]:
                 del self.qos_samples[rn]
+            self._roll_storage_heat()
+
+    def _roll_storage_heat(self) -> None:
+        """Fold every live replica's read-hot ranges + busiest read tag
+        into the cluster rollup (one pull per QOS_SAMPLE_INTERVAL —
+        the per-range state is the roles' own samples, never a second
+        copy of the keyspace). Disarmed: empty both tables and pay one
+        knob read per tick."""
+        if not flow.SERVER_KNOBS.storage_heat_tracking:
+            if self._heat_tags or self.storage_heat._entries:
+                self.storage_heat.clear()
+                self._heat_tags.clear()
+            return
+        live: set = set()
+        for name, obj in self._storage_objs.items():
+            if not obj.process.alive:
+                continue
+            live.add(name)
+            for b, e, density, read_bps in obj.read_hot_ranges():
+                self.storage_heat.record(name, b, e, density, read_bps)
+            tag, busy = obj.busiest_read_tag()
+            if tag is not None:
+                self._heat_tags[name] = (tag.hex(), round(busy, 4))
+            else:
+                self._heat_tags.pop(name, None)
+        self.storage_heat.prune(live)
+        for name in [n for n in self._heat_tags if n not in live]:
+            del self._heat_tags[name]
 
     def _epoch_roles(self, info, cls):
         """Live current-epoch roles of `cls` from the registry — the
@@ -1312,6 +1410,13 @@ class ClusterController:
                                   sampled_bytes=obj.sampled_bytes(),
                                   write_bytes_per_sec=round(
                                       obj.write_bandwidth(), 1),
+                                  # read-side heat meters (zeros while
+                                  # the plane is disarmed — the fields
+                                  # stay so dashboards are stable)
+                                  read_bytes_per_sec=round(
+                                      obj.read_bandwidth(), 1),
+                                  read_ops_per_sec=round(
+                                      obj.read_ops_rate(), 1),
                                   counters=obj.stats.snapshot(),
                                   latency_bands={
                                       "read": obj.read_bands.snapshot()})
@@ -1429,6 +1534,9 @@ class ClusterController:
                 rk_role.batch_rate if rk_role is not None else None,
             "limiting_reason": decision.get("limiting_reason", "none"),
             "inputs": decision.get("inputs", {}),
+            # the hex tag behind busiest_read_tag_busyness ("" while
+            # the heat plane is off or no tagged reads were seen)
+            "busiest_read_tag": decision.get("busiest_read_tag", ""),
             "roles": qos_roles,
             "tags": tag_rows[
                 :int(flow.SERVER_KNOBS.qos_tag_top_k)],
@@ -1480,6 +1588,19 @@ class ClusterController:
                 # (per-resolver tables under resolvers[*].hot_spots)
                 "conflict_hot_spots": hot_rows[
                     :int(flow.SERVER_KNOBS.hot_spot_top_k)],
+                # the storage heat plane's rollup (ISSUE 13): decaying
+                # top-K read-hot sub-ranges across the storage replicas
+                # + the busiest read tag per server — the feature
+                # stream ROADMAP items 3 and 5 consume (which shard to
+                # split, which tenant to throttle)
+                "storage_heat": {
+                    "tracking_enabled": int(bool(
+                        flow.SERVER_KNOBS.storage_heat_tracking)),
+                    "ranges": self.storage_heat.top(),
+                    "busiest_read_tags": [
+                        {"server": n, "tag": t, "busyness": b}
+                        for n, (t, b) in sorted(self._heat_tags.items())],
+                },
                 # event-driven health rollup (ref: the status document's
                 # messages array operators alert on)
                 "messages": self._health_messages(info),

@@ -70,6 +70,10 @@ class Ratekeeper:
         # the last decision with its input signals and limiting reason
         # — what RkUpdate traces and status.cluster.qos publish
         self.last_decision: dict = {}
+        # the storage heat plane's observe-only inputs (ISSUE 13): the
+        # hex tag behind busiest_read_tag_busyness, traced beside the
+        # numeric inputs (enforcement stays ROADMAP item 3's follow-up)
+        self._busiest_read_tag = ""
         # tag auto-throttler (server/tag_throttler.py, ROADMAP item 3):
         # busy tags per the proxies' TransactionTagCounter get throttle
         # rows written into \xff\x02/throttledTags/; idle (one knob
@@ -125,6 +129,7 @@ class Ratekeeper:
                     TPSLimit=round(d["tps"], 1),
                     BatchTPSLimit=round(d["batch_tps"], 1),
                     LimitingReason=d["limiting_reason"],
+                    BusiestReadTag=d.get("busiest_read_tag", ""),
                     **{_camel(kk): vv
                        for kk, vv in d["inputs"].items()}).log()
 
@@ -157,7 +162,13 @@ class Ratekeeper:
                   "pipeline_occupancy": 0.0,
                   "pipeline_forced_drain_rate": 0.0,
                   "sched_deferred_depth": 0.0,
+                  # storage heat plane (ISSUE 13), observe-only: the
+                  # worst read-hot density ratio and busiest per-SS
+                  # read-tag busyness — zeros while the plane is off
+                  "worst_read_hot": 0.0,
+                  "busiest_read_tag_busyness": 0.0,
                   "dead_replicas": 0}
+        self._busiest_read_tag = ""
         reason = "none"
         # the batch bucket has its own binding constraint (its spring
         # zone starts at target*batch_frac, well before the normal
@@ -209,6 +220,26 @@ class Ratekeeper:
                   "storage_queue")
         for stale in set(self._storage_smooth) - replicas:
             del self._storage_smooth[stale]
+
+        # storage heat inputs (ISSUE 13): observe-only — they ride
+        # RkUpdate and status so an operator (and item 3's follow-up
+        # enforcement) can SEE which sub-range and tag is hot before
+        # any throttle acts on it; never an input to lower(). Read
+        # from the CC's rollup (refreshed each QOS_SAMPLE_INTERVAL by
+        # _roll_storage_heat) rather than rescanning every replica's
+        # sample per ratekeeper tick — the update loop runs ~10x the
+        # sampler cadence and must not multiply the scan cost
+        if k.storage_heat_tracking:
+            heat = getattr(self.cc, "storage_heat", None)
+            if heat is not None:
+                for row in heat.top():
+                    inputs["worst_read_hot"] = max(
+                        inputs["worst_read_hot"], row["density"])
+            for _srv, (tag_hex, busy) in sorted(
+                    getattr(self.cc, "_heat_tags", {}).items()):
+                if busy > inputs["busiest_read_tag_busyness"]:
+                    inputs["busiest_read_tag_busyness"] = round(busy, 2)
+                    self._busiest_read_tag = tag_hex
 
         live_logs = set()
         for t_obj in self.cc.tlog_objs():
@@ -333,6 +364,7 @@ class Ratekeeper:
         self.last_decision = {
             "tps": tps, "batch_tps": batch_tps,
             "limiting_reason": reason, "inputs": inputs,
+            "busiest_read_tag": self._busiest_read_tag,
             "computed_at": now}
         return tps, batch_tps
 

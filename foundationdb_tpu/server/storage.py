@@ -17,7 +17,9 @@ durable version, and re-pulls the rest from the TLog.
 
 from __future__ import annotations
 
+import math
 import struct
+import zlib
 from bisect import bisect_left, bisect_right, insort
 from typing import Dict, List, Optional, Tuple
 
@@ -50,7 +52,9 @@ class StorageMetrics:
     deterministic hash of the key so every replica samples
     identically and sim runs replay exactly."""
 
-    __slots__ = ("_sample", "_keys", "_total", "_rate", "_rate_t")
+    __slots__ = ("_sample", "_keys", "_total", "_rate", "_rate_t",
+                 "_prefix", "_read_sample", "_read_rate", "_read_ops",
+                 "_read_t")
 
     def __init__(self):
         self._sample: Dict[bytes, int] = {}
@@ -58,13 +62,25 @@ class StorageMetrics:
         self._total = 0                # running sum of sampled weights
         self._rate = 0.0               # smoothed write bytes/sec
         self._rate_t: Optional[float] = None
+        # lazily rebuilt prefix sums over _keys' weights: range-bytes
+        # queries and split_key become two bisects + O(log n) instead
+        # of an O(range) sum (the CC split scan calls them per shard
+        # per tick). None = stale; any sample mutation invalidates.
+        self._prefix: Optional[List[int]] = None
+        # -- read side (ISSUE 13): deterministic crc32-sampled read
+        # bandwidth per key + shard-wide leaky read meters. key ->
+        # [decayed bytes/sec, last update]; bounded by
+        # READ_SAMPLE_MAX_KEYS (lowest decayed rate evicted)
+        self._read_sample: Dict[bytes, list] = {}
+        self._read_rate = 0.0          # smoothed read bytes/sec
+        self._read_ops = 0.0           # smoothed read ops/sec
+        self._read_t: Optional[float] = None
 
     @staticmethod
     def _weight(key: bytes, nbytes: int) -> int:
         factor = SERVER_KNOBS.byte_sample_factor
         if nbytes >= factor:
             return nbytes
-        import zlib
         if zlib.crc32(key) / 0xFFFFFFFF < nbytes / factor:
             return factor
         return 0
@@ -77,17 +93,22 @@ class StorageMetrics:
             self._total += w - (old or 0)
             if old is None:
                 insort(self._keys, key)
+            self._prefix = None
         elif old is not None:
             del self._sample[key]
             self._total -= old
             del self._keys[bisect_left(self._keys, key)]
+            self._prefix = None
 
     def note_clear(self, begin: bytes, end: bytes) -> None:
         i = bisect_left(self._keys, begin)
         j = bisect_left(self._keys, end)
+        if i == j:
+            return
         for k in self._keys[i:j]:
             self._total -= self._sample.pop(k)
         del self._keys[i:j]
+        self._prefix = None
 
     def apply(self, m: MutationRef) -> None:
         if m.type == CLEAR_RANGE:
@@ -102,50 +123,80 @@ class StorageMetrics:
         self._sample.clear()
         self._keys.clear()
         self._total = 0
+        self._prefix = None
         for k, v in rows:
             self.note_set(k, len(k) + len(v))
+
+    def _prefix_sums(self) -> List[int]:
+        """prefix[i] = sum of sampled weights of _keys[:i]; rebuilt
+        lazily after a sample mutation, so a tick's worth of
+        sampled_bytes/split_key/read-hot queries share one O(n) pass."""
+        ps = self._prefix
+        if ps is None or len(ps) != len(self._keys) + 1:
+            ps = [0] * (len(self._keys) + 1)
+            acc = 0
+            sample = self._sample
+            for i, k in enumerate(self._keys):
+                acc += sample[k]
+                ps[i + 1] = acc
+            self._prefix = ps
+        return ps
 
     def sampled_bytes(self, begin: bytes = b"",
                       end: Optional[bytes] = None) -> int:
         if begin == b"" and end is None:
             return self._total
+        ps = self._prefix_sums()
         i = bisect_left(self._keys, begin)
         j = (bisect_left(self._keys, end) if end is not None
              else len(self._keys))
-        return sum(self._sample[k] for k in self._keys[i:j])
+        return ps[j] - ps[i] if j > i else 0
 
     def split_key(self, begin: bytes,
                   end: Optional[bytes]) -> Optional[bytes]:
         """First key past half the sampled bytes — the byte-balanced
         split point (ref: splitMetrics). None when the sample is too
-        thin to name an interior key."""
+        thin to name an interior key. O(log n) over the lazy prefix
+        sums instead of the old O(range) accumulation."""
+        ps = self._prefix_sums()
         i = bisect_left(self._keys, begin)
         j = (bisect_left(self._keys, end) if end is not None
              else len(self._keys))
-        keys = self._keys[i:j]
-        if len(keys) < 2:
+        if j - i < 2:
             return None
-        total = sum(self._sample[k] for k in keys)
-        acc = 0
-        for k in keys:
-            acc += self._sample[k]
-            if acc * 2 >= total and k > begin:
-                return k
+        total = ps[j] - ps[i]
+        # first index m in (i, j) with 2*(ps[m+1]-ps[i]) >= total and
+        # _keys[m] > begin — bisect over the monotone prefix, then walk
+        # past any boundary-equal keys (at most the begin key itself)
+        lo, hi = i, j - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if (ps[mid + 1] - ps[i]) * 2 >= total:
+                hi = mid
+            else:
+                lo = mid + 1
+        for m in range(lo, j):
+            if self._keys[m] > begin:
+                return self._keys[m]
         return None
 
     def reset_rate(self) -> None:
-        """Forget the smoothed write rate — the meter is server-scoped,
-        so after bounds shrink (split/shrink_to) the departed range's
-        traffic must not keep counting against this shard."""
+        """Forget the smoothed rates and the read sample — the meters
+        are server-scoped, so after bounds shrink (split/shrink_to) the
+        departed range's traffic must not keep counting against this
+        shard (reads reset exactly like the write meter)."""
         self._rate = 0.0
         self._rate_t = None
+        self._read_rate = 0.0
+        self._read_ops = 0.0
+        self._read_t = None
+        self._read_sample.clear()
 
     def note_write(self, nbytes: int, now: float) -> None:
         """Leaky-integrator bandwidth: rate decays with time constant
         DD_BANDWIDTH_TAU and each write adds nbytes/tau — steady-state
         equals the true bytes/sec (ref: bytesInput rate smoothing
         feeding SHARD_MAX_BYTES_PER_KSEC splits)."""
-        import math
         tau = SERVER_KNOBS.dd_bandwidth_tau
         if self._rate_t is not None and tau > 0:
             self._rate *= math.exp(-(now - self._rate_t) / tau)
@@ -153,11 +204,129 @@ class StorageMetrics:
         self._rate += nbytes / max(tau, 1e-9)
 
     def write_bytes_per_sec(self, now: float) -> float:
-        import math
         tau = SERVER_KNOBS.dd_bandwidth_tau
         if self._rate_t is None or tau <= 0:
             return 0.0
         return self._rate * math.exp(-(now - self._rate_t) / tau)
+
+    # -- read side (ISSUE 13; ref: StorageMetrics bytesReadSample +
+    # getReadHotRanges density math) -----------------------------------
+
+    @staticmethod
+    def _read_weight(key: bytes, nbytes: int) -> int:
+        """Deterministic inclusion, mirroring the write-side estimator
+        with its own READ_SAMPLE_FACTOR: every replica samples the same
+        reads and sim replays sample identically."""
+        factor = SERVER_KNOBS.read_sample_factor
+        if nbytes >= factor:
+            return nbytes
+        if zlib.crc32(key) / 0xFFFFFFFF < nbytes / factor:
+            return factor
+        return 0
+
+    def note_read(self, key: bytes, nbytes: int, now: float) -> None:
+        """Charge one read of `nbytes` at `key`: the shard-wide leaky
+        read meters always, the per-key read-bandwidth sample when the
+        crc32 draw includes it."""
+        tau = max(SERVER_KNOBS.dd_bandwidth_tau, 1e-9)
+        if self._read_t is not None:
+            decay = math.exp(-(now - self._read_t) / tau)
+            self._read_rate *= decay
+            self._read_ops *= decay
+        self._read_t = now
+        self._read_rate += nbytes / tau
+        self._read_ops += 1.0 / tau
+        w = self._read_weight(key, nbytes)
+        if not w:
+            return
+        ent = self._read_sample.get(key)
+        if ent is None:
+            self._read_sample[key] = [w / tau, now]
+            if len(self._read_sample) > \
+                    int(SERVER_KNOBS.read_sample_max_keys):
+                coldest = min(
+                    self._read_sample,
+                    key=lambda k: self._read_sample[k][0]
+                    * math.exp(-(now - self._read_sample[k][1]) / tau))
+                del self._read_sample[coldest]
+        else:
+            ent[0] = ent[0] * math.exp(-(now - ent[1]) / tau) + w / tau
+            ent[1] = now
+
+    def read_bytes_per_sec(self, now: float) -> float:
+        tau = SERVER_KNOBS.dd_bandwidth_tau
+        if self._read_t is None or tau <= 0:
+            return 0.0
+        return self._read_rate * math.exp(-(now - self._read_t) / tau)
+
+    def read_ops_per_sec(self, now: float) -> float:
+        tau = SERVER_KNOBS.dd_bandwidth_tau
+        if self._read_t is None or tau <= 0:
+            return 0.0
+        return self._read_ops * math.exp(-(now - self._read_t) / tau)
+
+    def read_hot_ranges(self, begin: bytes, end: bytes,
+                        now: float) -> List[Tuple[bytes, bytes, float,
+                                                  float]]:
+        """Read-hot sub-ranges of [begin, end) (ref: the
+        ReadHotSubRangeRequest density scan): split the shard's sampled
+        keys into READ_HOT_SUB_RANGE_CHUNKS byte-balanced buckets and
+        flag every bucket whose read-bandwidth ÷ sampled-byte density
+        exceeds READ_HOT_RANGE_RATIO × the shard's own density. Rows
+        are (begin, end, density_ratio, read_bytes_per_sec), hottest
+        first. Pull-computed: nothing here ever runs on the read hot
+        path."""
+        tau = max(SERVER_KNOBS.dd_bandwidth_tau, 1e-9)
+        shard_read = self.read_bytes_per_sec(now)
+        shard_bytes = self.sampled_bytes(begin, end)
+        if shard_read <= 0 or shard_bytes <= 0:
+            return []
+        ps = self._prefix_sums()
+        i = bisect_left(self._keys, begin)
+        j = bisect_left(self._keys, end)
+        if j - i < 2:
+            return []
+        chunks = max(1, int(SERVER_KNOBS.read_hot_sub_range_chunks))
+        total = ps[j] - ps[i]
+        # byte-balanced bucket boundaries: the first key at or past
+        # each total*k/chunks prefix crossing
+        bounds = [begin]
+        for c in range(1, chunks):
+            target = ps[i] + total * c // chunks
+            lo, hi = i, j
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if ps[mid + 1] > target:
+                    hi = mid
+                else:
+                    lo = mid + 1
+            k = self._keys[min(lo, j - 1)]
+            if k > bounds[-1]:
+                bounds.append(k)
+        bounds.append(end)
+        n = len(bounds) - 1
+        read_bps = [0.0] * n
+        for key, (rate, t) in self._read_sample.items():
+            if not (begin <= key < end):
+                continue
+            b = bisect_right(bounds, key) - 1
+            read_bps[min(max(b, 0), n - 1)] += \
+                rate * math.exp(-(now - t) / tau)
+        shard_density = shard_read / shard_bytes
+        ratio = SERVER_KNOBS.read_hot_range_ratio
+        out = []
+        for b in range(n):
+            bi = bisect_left(self._keys, bounds[b])
+            bj = bisect_left(self._keys, bounds[b + 1])
+            bucket_bytes = ps[bj] - ps[bi]
+            if bucket_bytes <= 0 or read_bps[b] <= 0:
+                continue
+            density = (read_bps[b] / bucket_bytes) / shard_density
+            if density >= ratio:
+                out.append((bounds[b], bounds[b + 1], round(density, 4),
+                            round(read_bps[b], 2)))
+        out.sort(key=lambda r: (-r[2], r[0]))
+        return out
 
 
 def encode_shard_meta(tag: int, begin: bytes, end: Optional[bytes],
@@ -550,6 +719,17 @@ class StorageServer:
         self._qos_mutation_rate = flow.SmoothedRate()
         # byte sample + write bandwidth for DD sizing decisions
         self.metrics = StorageMetrics()
+        # per-storage read-cost tag accounting (ref: fdbserver/
+        # TransactionTagCounter ON the storage server — the busiest-tag
+        # signal the ratekeeper's storage-aware throttling reads; PR 6's
+        # proxy-side counter reused, bounded + decaying). Touched only
+        # while STORAGE_HEAT_TRACKING is armed.
+        from .proxy import TransactionTagCounter
+        self.tag_counter = TransactionTagCounter()
+        # typed metrics probes (StorageMetricsRequest /
+        # ReadHotRangesRequest / SplitMetricsRequest)
+        self.metrics_requests = RequestStream(process)
+        self._hot_cache = None   # (sim time, rows) read_hot_ranges memo
         self._actors = flow.ActorCollection()
         self.recovered = Future()   # engine recovery complete (fetchKeys
                                     # sources/destinations wait on this)
@@ -569,7 +749,8 @@ class StorageServer:
         # expiry actor dies with the role — fail them like set_bounds does
         # so their clients refresh the location map
         self._fail_watches(lambda k: True)
-        for stream in (self.gets, self.ranges, self.get_keys, self.watches):
+        for stream in (self.gets, self.ranges, self.get_keys, self.watches,
+                       self.metrics_requests):
             stream.close()
 
     def _fail_watches(self, pred) -> None:
@@ -590,6 +771,8 @@ class StorageServer:
                 (self._get_loop(), TaskPriority.STORAGE, "get"),
                 (self._range_loop(), TaskPriority.STORAGE, "getrange"),
                 (self._get_key_loop(), TaskPriority.STORAGE, "getkey"),
+                (self._metrics_loop(), TaskPriority.LOW_PRIORITY,
+                 "storageMetrics"),
                 (self._watch_loop(), TaskPriority.STORAGE, "watch"),
                 (self._watch_expiry_loop(), TaskPriority.LOW_PRIORITY,
                  "watchExpiry")):
@@ -1073,6 +1256,100 @@ class StorageServer:
         rate driving SHARD_MAX_BYTES_PER_KSEC splits)."""
         return self.metrics.write_bytes_per_sec(flow.now())
 
+    # -- storage heat plane (ISSUE 13) ----------------------------------
+    def _note_read(self, key: bytes, nbytes: int, tags) -> None:
+        """Charge one admitted point read: the read sample + leaky
+        meters, and read cost against the request's transaction tags.
+        Called only behind the STORAGE_HEAT_TRACKING guard — the off
+        posture pays exactly one knob read per request."""
+        now = flow.now()
+        self.metrics.note_read(key, nbytes, now)
+        for tag in tags:
+            self.tag_counter.record(tag, "started", now,
+                                    weight=float(nbytes))
+
+    def _note_range_read(self, rows, tags) -> None:
+        """Charge an admitted range read row by row (each returned key
+        enters the read sample — a hot scan range heats every key it
+        covers, matching the reference's per-key bytesReadSample)."""
+        if not rows:
+            return
+        now = flow.now()
+        m = self.metrics
+        cost = 0
+        for k, v in rows:
+            nb = len(k) + len(v)
+            cost += nb
+            m.note_read(k, nb, now)
+        for tag in tags:
+            self.tag_counter.record(tag, "started", now,
+                                    weight=float(cost))
+
+    def read_bandwidth(self) -> float:
+        """Smoothed read bytes/sec out of this shard (ref: the
+        bytesReadSample-backed read bandwidth in StorageMetrics)."""
+        return self.metrics.read_bytes_per_sec(flow.now())
+
+    def read_ops_rate(self) -> float:
+        """Smoothed key reads/sec (point reads + range rows)."""
+        return self.metrics.read_ops_per_sec(flow.now())
+
+    def read_hot_ranges(self) -> list:
+        """Read-hot sub-ranges of the OWNED range, hottest first:
+        (begin, end, density_ratio, read_bytes_per_sec). Capped at
+        \\xff like the sizing queries — system-space reads must not
+        name user-shard split candidates. Memoized per sim instant:
+        the QoS sample and the CC heat rollup both pull within one
+        sampler tick, and the bucket scan is pure in (state, now) —
+        one scan serves every same-tick consumer."""
+        now = flow.now()
+        cached = self._hot_cache
+        if cached is not None and cached[0] == now:
+            return cached[1]
+        hi = self.shard_end if self.shard_end is not None else b"\xff"
+        rows = self.metrics.read_hot_ranges(self.shard_begin, hi, now)
+        self._hot_cache = (now, rows)
+        return rows
+
+    def busiest_read_tag(self) -> tuple:
+        """(tag bytes | None, decayed read-cost busyness) — the
+        per-storage busiest-tag signal the ratekeeper's storage-aware
+        throttling reads (ref: TransactionTagCounter::getBusiestTag)."""
+        rows = self.tag_counter.top(1)
+        if not rows or rows[0]["busyness"] <= 0:
+            return None, 0.0
+        return bytes.fromhex(rows[0]["tag"]), rows[0]["busyness"]
+
+    async def _metrics_loop(self):
+        """Serve the typed metrics probes (ref: the waitMetrics /
+        ReadHotSubRangeRequest / SplitMetricsRequest endpoints on
+        StorageServerInterface). Pull-computed from the samples — a
+        probe never touches the read/write hot paths."""
+        from .types import (ReadHotRangesReply, ReadHotRangesRequest,
+                            SplitMetricsReply, SplitMetricsRequest,
+                            StorageMetricsReply, StorageMetricsRequest)
+        while True:
+            req, reply = await self.metrics_requests.pop()
+            try:
+                now = flow.now()
+                if isinstance(req, StorageMetricsRequest):
+                    tag, busy = self.busiest_read_tag()
+                    reply.send(StorageMetricsReply(
+                        self.sampled_bytes(),
+                        round(self.metrics.write_bytes_per_sec(now), 2),
+                        round(self.metrics.read_bytes_per_sec(now), 2),
+                        round(self.metrics.read_ops_per_sec(now), 2),
+                        tag, round(busy, 4)))
+                elif isinstance(req, ReadHotRangesRequest):
+                    reply.send(ReadHotRangesReply(
+                        tuple(self.read_hot_ranges())))
+                elif isinstance(req, SplitMetricsRequest):
+                    reply.send(SplitMetricsReply(self.split_key_estimate()))
+                else:
+                    reply.send_error(error("client_invalid_operation"))
+            except flow.FdbError as e:
+                reply.send_error(e)
+
     def qos_sample(self, now: float) -> "QosSample":
         """Saturation-signal snapshot (ref: StorageQueuingMetricsReply
         — the per-storage surface the Ratekeeper's updateRate polls):
@@ -1084,7 +1361,7 @@ class StorageServer:
         qbytes = sum(_mb(m) for _v, ms in self._pending for m in ms)
         lag = max(0, self.version.get() - self.durable_version.get())
         snap = self.stats.snapshot()
-        return QosSample("storage", self.name, now, {
+        signals = {
             "queue_bytes": round(self._qos_queue.sample(qbytes, now), 1),
             "durability_lag_versions": round(
                 self._qos_lag.sample(lag, now), 1),
@@ -1093,7 +1370,24 @@ class StorageServer:
                 + snap.get("range_queries", 0), now), 2),
             "mutation_rate": round(self._qos_mutation_rate.sample_total(
                 snap.get("mutations", 0), now), 2),
-        })
+            # folded in from the DD meter so every storage signal flows
+            # through the one QosSample path (ISSUE 13 satellite: the
+            # CC used to read write_bandwidth out-of-band)
+            "write_bandwidth": round(
+                self.metrics.write_bytes_per_sec(now), 1),
+        }
+        if SERVER_KNOBS.storage_heat_tracking:
+            # the read-side heat signals, armed-only so the pinned
+            # default schema (and the off posture) stay untouched
+            _tag, busy = self.busiest_read_tag()
+            signals.update(
+                read_bytes_per_sec=round(
+                    self.metrics.read_bytes_per_sec(now), 1),
+                read_ops_per_sec=round(
+                    self.metrics.read_ops_per_sec(now), 1),
+                read_hot_ranges=len(self.read_hot_ranges()),
+                busiest_read_tag_busyness=round(busy, 2))
+        return QosSample("storage", self.name, now, signals)
 
     def split_key_estimate(self) -> Optional[bytes]:
         """A byte-balanced interior key from the sample (ref:
@@ -1182,6 +1476,12 @@ class StorageServer:
                     "StorageServer.getValue.DoRead")
                 admitted = True
             value = self.data.get(req.key, req.version)
+            if SERVER_KNOBS.storage_heat_tracking:
+                # armed-only read accounting; off, the whole heat plane
+                # costs this one knob read (PERF.md posture table)
+                self._note_read(req.key,
+                                len(req.key) + len(value or b""),
+                                req.tags)
             self.read_bands.record(flow.now() - t0)
             if dbg is not None:
                 flow.g_trace_batch.add_event(
@@ -1206,8 +1506,11 @@ class StorageServer:
             self.stats.counter("range_queries").add(1)
             self._check_owned(req.begin, req.end)
             await self._wait_version(req.version)
-            reply.send(self.data.get_range(req.begin, req.end, req.version,
-                                           req.limit, req.reverse))
+            rows = self.data.get_range(req.begin, req.end, req.version,
+                                       req.limit, req.reverse)
+            if SERVER_KNOBS.storage_heat_tracking:
+                self._note_range_read(rows, req.tags)
+            reply.send(rows)
         except flow.FdbError as e:
             reply.send_error(e)
 

@@ -97,6 +97,12 @@ class TagThrottler:
         self.cc = cc
         self.stats = flow.CounterCollection("tag_throttler")
         self._rates: Dict[bytes, SmoothedRate] = {}
+        # per-(storage server, tag) read-request rate trackers — the
+        # TAG_THROTTLE_STORAGE_BUSYNESS input (ISSUE 13): the
+        # reference's ratekeeper reads tag busyness FROM the storage
+        # servers, so a tenant hammering one shard is throttled even
+        # when its cluster-wide rate looks modest
+        self._ss_rates: Dict[tuple, SmoothedRate] = {}
         #: tag -> (expiry, exact encoded value) of the auto row WE
         #: wrote — the value is kept so expiry cleanup can use
         #: COMPARE_AND_CLEAR and can never delete a manual row an
@@ -136,18 +142,56 @@ class TagThrottler:
                 tag = bytes.fromhex(row["tag"])
                 totals[tag] = totals.get(tag, 0) + row["started"]
         tau = float(k.qos_smoothing_tau)
-        candidates = []   # busy tags due a (re)written auto row
-        for tag, total in sorted(totals.items()):
-            sm = self._rates.get(tag)
-            if sm is None:
-                sm = self._rates[tag] = SmoothedRate()
-            rate = sm.sample_total(total, now, tau)
-            if rate < float(k.tag_throttle_busy_rate):
+        # per-storage-server tag busyness (ISSUE 13): with the knob
+        # armed, each (server, tag)'s smoothed read-request rate joins
+        # the detection — the per-SS MAX is what a single hot shard
+        # sees, which cluster-wide proxy rates dilute by design
+        ss_busy: Dict[bytes, float] = {}
+        if not (k.tag_throttle_storage_busyness
+                and k.storage_heat_tracking):
+            # disarmed mid-run: drop the accumulated (server, tag)
+            # trackers — stale pairs must not pin memory or keep
+            # reporting through tracked_ss_pairs
+            if self._ss_rates:
+                self._ss_rates.clear()
+        else:
+            live_ss: set = set()
+            for name, obj in sorted(self.cc._storage_objs.items()):
+                if not obj.process.alive:
+                    continue
+                for row in obj.tag_counter.top(1 << 20):
+                    tag = bytes.fromhex(row["tag"])
+                    key = (name, tag)
+                    live_ss.add(key)
+                    sm = self._ss_rates.get(key)
+                    if sm is None:
+                        sm = self._ss_rates[key] = SmoothedRate()
+                    rate = sm.sample_total(row["started"], now, tau)
+                    if rate > ss_busy.get(tag, 0.0):
+                        ss_busy[tag] = rate
+            for key in [kk for kk in self._ss_rates if kk not in live_ss]:
+                del self._ss_rates[key]
+        candidates = []   # busy tags due a (re)written auto row:
+        #                   (tag, txn rate the tps command derives
+        #                   from, the rate that crossed detection)
+        for tag in sorted(set(totals) | set(ss_busy)):
+            rate = 0.0
+            if tag in totals:
+                sm = self._rates.get(tag)
+                if sm is None:
+                    sm = self._rates[tag] = SmoothedRate()
+                rate = sm.sample_total(totals[tag], now, tau)
+            rate_eff = max(rate, ss_busy.get(tag, 0.0))
+            if rate_eff < float(k.tag_throttle_busy_rate):
                 continue
+            if rate < float(k.tag_throttle_busy_rate):
+                # only the per-SS signal crossed the line: the
+                # storage-aware detection ROADMAP item 3 steers by
+                flow.cover("tag_throttler.storage_busyness")
             expiry = self._written.get(tag, (0.0, b""))[0]
             if expiry - now > float(k.tag_throttle_duration) / 2:
                 continue   # the active row still covers the abuse
-            candidates.append((tag, rate))
+            candidates.append((tag, rate, rate_eff))
         # a live MANUAL row takes precedence over auto-throttling: the
         # operator's word stands, however busy the tag reads (ref:
         # manual throttles winning over auto in TagThrottle.actor.cpp)
@@ -165,12 +209,18 @@ class TagThrottler:
                     manual_live.add(tag)
         mutations = []
         throttled = []   # (tag, rate, tps, new_expiry, value) pending
-        for tag, rate in candidates:
+        for tag, txn_rate, rate in candidates:
             if tag in manual_live:
                 flow.cover("tag_throttler.manual_precedence")
                 continue
+            # the commanded tps is in TRANSACTIONS/sec (what the
+            # proxy's per-tag pacing bucket enforces), so it must
+            # derive from the tag's txn rate — a storage-detected
+            # read-heavy tenant (high read-request rate, modest txn
+            # rate) would otherwise get a row far above its own txn
+            # rate that never throttles anything (code review r13)
             tps = max(float(k.tag_throttle_min_tps),
-                      rate * float(k.tag_throttle_target_fraction))
+                      txn_rate * float(k.tag_throttle_target_fraction))
             new_expiry = now + float(k.tag_throttle_duration)
             value = encode_tag_throttle_value(tps, new_expiry,
                                               PRIORITY_DEFAULT, auto=True)
@@ -229,6 +279,10 @@ class TagThrottler:
             "auto_cleared": snap.get("auto_cleared", 0),
             "tracked_tags": len(self._rates),
             "active_auto": sorted(t.hex() for t in self._written),
+            # storage-aware detection posture (ISSUE 13)
+            "storage_busyness_enabled": int(bool(
+                SERVER_KNOBS.tag_throttle_storage_busyness)),
+            "tracked_ss_pairs": len(self._ss_rates),
         }
 
 

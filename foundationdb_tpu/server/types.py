@@ -203,6 +203,12 @@ class StorageGetRequest(NamedTuple):
     # sampled-read stitching token (ref: the debugID on GetValueRequest
     # driving the GetValueDebug trace-batch stations)
     debug_id: Optional[int] = None
+    # transaction tags for the storage server's read-cost accounting
+    # (ref: the TagSet on GetValueRequest feeding the per-SS
+    # TransactionTagCounter); attached only while STORAGE_HEAT_TRACKING
+    # is armed — the request is byte-identical to the pre-plane one
+    # otherwise
+    tags: Tuple[bytes, ...] = ()
 
 
 class StorageGetRangeRequest(NamedTuple):
@@ -211,6 +217,8 @@ class StorageGetRangeRequest(NamedTuple):
     version: int
     limit: int
     reverse: bool = False
+    # read-cost tags, same contract as StorageGetRequest.tags
+    tags: Tuple[bytes, ...] = ()
 
 
 class StorageGetKeyRequest(NamedTuple):
@@ -352,7 +360,54 @@ class StatusRequest(NamedTuple):
     """Client -> CC status-document fetch (ref: StatusRequest)."""
 
 
+# -- storage heat plane (ISSUE 13) --------------------------------------
+# Field-less probes served by the storage role's metrics endpoint —
+# module singletons per the PR 12 envelope convention (typed, so the
+# sim network's message accounting attributes them and the wire layer
+# round-trip cache applies).
+
+
+class StorageMetricsRequest(NamedTuple):
+    """-> StorageMetricsReply: the shard's sampled bytes + smoothed
+    read/write bandwidth + busiest read tag (ref: GetStorageMetrics /
+    StorageQueuingMetrics read-side fields)."""
+
+
+class ReadHotRangesRequest(NamedTuple):
+    """-> ReadHotRangesReply: read-hot sub-ranges of the owned shard
+    (ref: ReadHotSubRangeRequest density math)."""
+
+
+class SplitMetricsRequest(NamedTuple):
+    """-> SplitMetricsReply: the byte-balanced interior split key
+    (ref: SplitMetricsRequest / splitMetrics)."""
+
+
+class StorageMetricsReply(NamedTuple):
+    sampled_bytes: int
+    write_bytes_per_sec: float
+    read_bytes_per_sec: float
+    read_ops_per_sec: float
+    busiest_read_tag: Optional[bytes]
+    busiest_read_tag_rate: float
+
+
+class ReadHotRangesReply(NamedTuple):
+    """Rows of (begin, end, density_ratio, read_bytes_per_sec) — the
+    sub-ranges whose read-bandwidth ÷ sampled-byte density exceeds
+    READ_HOT_RANGE_RATIO × the shard's own density."""
+
+    ranges: Tuple = ()
+
+
+class SplitMetricsReply(NamedTuple):
+    split_key: Optional[bytes]
+
+
 GET_RATE_REQUEST = GetRateRequest()
+STORAGE_METRICS_REQUEST = StorageMetricsRequest()
+READ_HOT_RANGES_REQUEST = ReadHotRangesRequest()
+SPLIT_METRICS_REQUEST = SplitMetricsRequest()
 PING_REQUEST = PingRequest()
 RAW_COMMITTED_REQUEST = RawCommittedRequest()
 DURABLE_FRONTIER_REQUEST = DurableFrontierRequest()

@@ -984,6 +984,135 @@ class OverloadStorm:
         return out
 
 
+class HotShardStorm:
+    """Storage-heat proof storm (ISSUE 13): seeded open-loop READ
+    arrivals where one tenant tag concentrates Zipfian point reads on a
+    narrow key range at the head of the keyspace (the "hot shard")
+    while background tenants read uniformly across all of it. The
+    storage heat plane must NAME the hot sub-range (read-hot density
+    detection) and the hot tenant (per-SS busiest read tag) — and,
+    same seed, must name them bit-identically on replay.
+
+    Every arrival is read-only: the storm heats the read side without
+    perturbing the keyspace, so the armed-vs-off digest comparison is
+    exact. Every 4th arrival is a short range read (index-determined,
+    no extra RNG) so both read paths feed the sample. One attempt per
+    arrival, the OpenLoopStorm honesty contract: shed and errored
+    arrivals are counted, never hidden."""
+
+    def __init__(self, dbs, rng, duration: float = 3.0,
+                 hot_rate: float = 200.0, background_rate: float = 40.0,
+                 keyspace: int = 192, hot_keys: int = 8,
+                 zipf_s: float = 1.2, prefix: bytes = b"heat/",
+                 hot_tag: bytes = b"tenant-hot",
+                 background_tags: tuple = (b"tenant-a", b"tenant-b"),
+                 value_bytes: int = 96, max_inflight: int = 512):
+        self.dbs = list(dbs)
+        self.rng = rng
+        self.duration = duration
+        self.hot_rate = hot_rate
+        self.background_rate = background_rate
+        self.keyspace = keyspace
+        self.hot_keys = max(1, min(hot_keys, keyspace))
+        self.prefix = prefix
+        self.hot_tag = hot_tag
+        self.background_tags = tuple(background_tags)
+        self.value_bytes = value_bytes
+        self.max_inflight = max_inflight
+        self._hot_cdf = make_zipf_cdf(self.hot_keys, zipf_s)
+        self.stats = {"issued": 0, "admitted": 0, "completed": 0,
+                      "shed": 0, "hot_issued": 0, "background_issued": 0,
+                      "rows_read": 0, "errors": {}}
+
+    def key(self, rank: int) -> bytes:
+        return self.prefix + b"k%04d" % rank
+
+    @property
+    def hot_range(self):
+        """The range the hot tag hammers — what the detector must name
+        (begin inclusive, end exclusive)."""
+        return self.key(0), self.key(self.hot_keys - 1) + b"\x00"
+
+    async def seed(self, db) -> None:
+        """Materialize the keyspace (uniform value sizes, so the byte
+        sample is flat and any density skew is genuinely READ skew)."""
+        val = b"V" * self.value_bytes
+        async def body(tr):
+            for r in range(self.keyspace):
+                tr.set(self.key(r), val)
+        await run_transaction(db, body, max_retries=200)
+
+    def draw_schedule(self):
+        """Vectorized arrival schedule: offsets at the combined rate,
+        hot/background group flags at the rate share, Zipf ranks inside
+        the hot range for hot arrivals and uniform ranks for the rest.
+        One fork draw on the shared flow RNG (the PR 12 idiom)."""
+        g = _fork_np_rng(self.rng)
+        total = self.hot_rate + self.background_rate
+        times = _arrival_offsets(g, self.duration, lambda t: total, total)
+        n = len(times)
+        hot = _flag_array(g, n, self.hot_rate / max(total, 1e-9))
+        hot_ranks = _zipf_ranks(g, self._hot_cdf, n)
+        u = g.random(n).tolist() if n else []
+        keys = [self.key(hot_ranks[i] if hot[i]
+                         else min(int(u[i] * self.keyspace),
+                                  self.keyspace - 1))
+                for i in range(n)]
+        return times, hot, keys
+
+    async def _one_txn(self, i: int, key: bytes, hot: bool) -> None:
+        db = self.dbs[i % len(self.dbs)]
+        tr = db.create_transaction()
+        try:
+            tr.set_option(
+                "transaction_tag",
+                self.hot_tag if hot
+                else self.background_tags[i % len(self.background_tags)])
+            if i % 4 == 0:
+                # short scan: the range-read path feeds the sample too
+                rows = await tr.get_range(key, self.prefix + b"\xff",
+                                          limit=4)
+                self.stats["rows_read"] += len(rows)
+            else:
+                v = await tr.get(key)
+                if v is not None:
+                    self.stats["rows_read"] += 1
+            self.stats["completed"] += 1
+        except flow.FdbError as e:
+            errs = self.stats["errors"]
+            errs[e.name] = errs.get(e.name, 0) + 1
+
+    async def run(self) -> dict:
+        start = flow.now()
+        wall0, tasks0 = _time.monotonic(), flow.g().tasks_run
+        times, hot, keys = self.draw_schedule()
+        pool = ClientActorPool(self._one_txn, self.max_inflight,
+                               label="heat-txn")
+        now = flow.now
+        for i, t in enumerate(times):
+            at = start + t
+            if at > now():
+                await flow.delay(at - now())
+            self.stats["issued"] += 1
+            self.stats["hot_issued" if hot[i]
+                       else "background_issued"] += 1
+            if pool.dispatch((i, keys[i], bool(hot[i]))):
+                self.stats["admitted"] += 1
+            else:
+                self.stats["shed"] += 1
+        await pool.drain()
+        out = dict(self.stats)
+        out["wall_seconds"] = round(flow.now() - start, 3)
+        out["attainment"] = round(
+            out["admitted"] / max(out["issued"], 1), 4)
+        hb, he = self.hot_range
+        out["hot_range"] = [hb.hex(), he.hex()]
+        out["hot_tag"] = self.hot_tag.hex()
+        out["sim_perf"] = sim_perf_report(wall0, start, tasks0,
+                                          net=_find_net(self.dbs))
+        return out
+
+
 class ChaosStorm:
     """One named chaos scenario applied mid-flight under open-loop
     traffic, healed, quiesced, and VERIFIED (ref: the reference's

@@ -40,6 +40,9 @@ Commands (ref: fdbcli):
   qos                        saturation telemetry: ratekeeper budget +
                              limiting reason, per-role queue/lag/rate
                              signals, tag & priority traffic
+  heat                       storage heat: per-server read/write
+                             bandwidth + shard bytes, read-hot
+                             sub-ranges, busiest read tag per server
 
   throttle on <tag> <tps> [prio] [secs]   manually throttle a tag
                              (prio: default | batch; secs: how long
@@ -263,6 +266,18 @@ def _render_details(cl: dict) -> str:
         if inputs:
             lines.append("  inputs: " + "  ".join(
                 f"{k}={v}" for k, v in sorted(inputs.items())))
+    heat = cl.get("storage_heat") or {}
+    if heat.get("ranges") or heat.get("busiest_read_tags"):
+        # the heat plane only earns a details section once it flagged
+        # something (the full per-server view lives under `heat`)
+        lines.append("Storage heat (read-hot sub-ranges):")
+        for row in heat.get("ranges", ()):
+            lines.append(
+                f"  [{row['begin']}, {row['end']})  {row['server']:<20} "
+                f"density={row['density']:g} read={row['read_bps']:g}B/s")
+        for row in heat.get("busiest_read_tags", ()):
+            lines.append(f"  busiest tag {row['tag']} @ {row['server']}: "
+                         f"busyness={row['busyness']:g}")
     chaos = cl.get("chaos") or {}
     if chaos.get("injected") or chaos.get("scenarios"):
         # the chaos plane only earns a section once something fired
@@ -442,6 +457,50 @@ def _render_qos(cl: dict) -> str:
     return "\n".join(lines)
 
 
+def _render_heat(cl: dict) -> str:
+    """`heat`: the storage heat view (ISSUE 13) — per-server sampled
+    bytes + read/write bandwidth, the cluster's read-hot sub-ranges
+    (decaying top-K), and the busiest read tag per server (what an
+    operator reads to answer 'which shard would DD split, and which
+    tenant is hammering it')."""
+    heat = cl.get("storage_heat") or {}
+    armed = heat.get("tracking_enabled")
+    lines = [f"Storage heat (STORAGE_HEAT_TRACKING="
+             f"{'on' if armed else 'off'}):"]
+    seen: set = set()
+    lines.append("Per-server meters:")
+    for s in cl.get("storages", ()):
+        for rep in s.get("replicas", ()):
+            if rep["name"] in seen or "sampled_bytes" not in rep:
+                continue
+            seen.add(rep["name"])
+            lines.append(
+                f"  {rep['name']:<26} bytes={rep['sampled_bytes']:<8} "
+                f"write={rep.get('write_bytes_per_sec', 0):<9g}B/s "
+                f"read={rep.get('read_bytes_per_sec', 0):<9g}B/s "
+                f"ops={rep.get('read_ops_per_sec', 0):g}/s")
+    if not seen:
+        lines.append("  (no storage replicas reporting)")
+    ranges = heat.get("ranges") or ()
+    lines.append("Read-hot sub-ranges (decaying):")
+    for row in ranges:
+        lines.append(
+            f"  [{row['begin']}, {row['end']})  {row['server']:<20} "
+            f"density={row['density']:<8g} read={row['read_bps']:g}B/s "
+            f"seen={row.get('sightings', 0)}x")
+    if not ranges:
+        lines.append("  (none flagged)" if armed
+                     else "  (plane off — arm STORAGE_HEAT_TRACKING)")
+    tags = heat.get("busiest_read_tags") or ()
+    lines.append("Busiest read tag per server:")
+    for row in tags:
+        lines.append(f"  {row['server']:<26} tag={row['tag']} "
+                     f"busyness={row['busyness']:g}")
+    if not tags:
+        lines.append("  (no tagged reads)")
+    return "\n".join(lines)
+
+
 def _render_metrics(cl: dict) -> str:
     """`metrics`: the TDMetric-style counter series — latest value plus
     a rate computed over the fine-grained tail."""
@@ -568,6 +627,10 @@ class Cli:
             async def qs():
                 return await self.db.get_status()
             return _render_qos(self._run(qs())["cluster"])
+        if cmd == "heat":
+            async def ht():
+                return await self.db.get_status()
+            return _render_heat(self._run(ht())["cluster"])
         if cmd == "status":
             async def st():
                 return await self.db.get_status()

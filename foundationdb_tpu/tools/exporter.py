@@ -411,6 +411,50 @@ def render_prometheus(status: dict) -> str:
             _add_counters(f, "storage", rep["name"], rep.get("counters"))
             for req, snap in (rep.get("latency_bands") or {}).items():
                 _add_latency(f, "storage", rep["name"], req, snap)
+            # the storage heat plane's per-server meters (ISSUE 13):
+            # sampled shard bytes + smoothed write/read bandwidth and
+            # read ops (read meters sit at zero while the plane is off
+            # — the families stay, so dashboards are stable)
+            slabels = {"role": rep["name"]}
+            f.add(f"{_PREFIX}_storage_shard_bytes", "gauge",
+                  "Sampled logical bytes per storage replica "
+                  "(byteSample estimator)", slabels,
+                  rep.get("sampled_bytes"))
+            f.add(f"{_PREFIX}_storage_write_bandwidth", "gauge",
+                  "Smoothed write bytes/sec into the shard", slabels,
+                  rep.get("write_bytes_per_sec"))
+            f.add(f"{_PREFIX}_storage_read_bytes", "gauge",
+                  "Smoothed read bytes/sec out of the shard "
+                  "(STORAGE_HEAT_TRACKING)", slabels,
+                  rep.get("read_bytes_per_sec"))
+            f.add(f"{_PREFIX}_storage_read_ops", "gauge",
+                  "Smoothed key reads/sec (point reads + range rows)",
+                  slabels, rep.get("read_ops_per_sec"))
+
+    # the storage heat rollup (ISSUE 13): read-hot sub-ranges (decayed
+    # read-bandwidth score per flagged range) + per-server busiest
+    # read tag
+    heat = cl.get("storage_heat") or {}
+    if heat:
+        f.add(f"{_PREFIX}_storage_heat_tracking", "gauge",
+              "1 while STORAGE_HEAT_TRACKING is armed", {},
+              heat.get("tracking_enabled"))
+        for i, row in enumerate(heat.get("ranges", ())):
+            hlabels = {"rank": str(i), "server": row["server"],
+                       "begin": row["begin"], "end": row["end"]}
+            f.add(f"{_PREFIX}_storage_read_hot_ranges", "gauge",
+                  "Read-hot sub-ranges: decayed read bytes/sec per "
+                  "flagged range (density in the density label set)",
+                  hlabels, row.get("read_bps"))
+            f.add(f"{_PREFIX}_storage_read_hot_density", "gauge",
+                  "Read-bandwidth / sampled-byte density ratio vs the "
+                  "shard's own density", hlabels, row.get("density"))
+        for row in heat.get("busiest_read_tags", ()):
+            f.add(f"{_PREFIX}_storage_tag_busyness", "gauge",
+                  "Busiest read tag per storage server (decayed "
+                  "read-cost score)",
+                  {"server": row["server"], "tag": row["tag"]},
+                  row.get("busyness"))
 
     # process-wide jitted-kernel profile: "family[shape].counter" keys
     for key, value in sorted((cl.get("kernels") or {}).items()):

@@ -22,6 +22,7 @@ CHAOS_REPORT_PATH = "/tmp/_chaos_report.txt"
 CHAOS_TRACE_PATH = "/tmp/_chaos_trace.jsonl"
 CONTENTION_REPORT_PATH = "/tmp/_contention_report.txt"
 OVERLOAD_REPORT_PATH = "/tmp/_overload_report.txt"
+HEAT_REPORT_PATH = "/tmp/_heat_report.txt"
 SIMPROF_REPORT_PATH = "/tmp/_simprof_smoke.txt"
 SIMPROF_CHAOS_PATH = "/tmp/_simprof_chaos.json"
 SIMPROF_CHAOS_FOLDED_PATH = "/tmp/_simprof_chaos.folded"
@@ -483,6 +484,13 @@ def run_smoke_chaos(out=print,
     # oracles; the storm's tagged open-loop traffic drives them)
     admission = os.environ.get("CHAOS_ADMISSION", "") not in ("", "0")
 
+    # CHAOS_HEAT=1: arm the storage heat plane under the scenario (the
+    # nightly's heat-armed storm cells — read sampling, read-hot
+    # detection and per-SS tag busyness run under partitions/kills
+    # with the same consistency + same-seed replay oracles; the plane
+    # is observe-only, so the oracles must hold bit-identically)
+    heat = os.environ.get("CHAOS_HEAT", "") not in ("", "0")
+
     def run_once() -> dict:
         kwargs = dict(SCENARIOS[scenario].cluster_kwargs)
         if buggify:
@@ -501,6 +509,9 @@ def run_smoke_chaos(out=print,
             flow.SERVER_KNOBS.set("grv_admission_control", 1)
             flow.SERVER_KNOBS.set("tag_throttling", 1)
             flow.SERVER_KNOBS.set("auto_tag_throttling", 1)
+        if heat:
+            flow.SERVER_KNOBS.set("storage_heat_tracking", 1)
+            flow.SERVER_KNOBS.set("tag_throttle_storage_busyness", 1)
         try:
             dbs = [cluster.client(f"chaos{i}") for i in range(3)]
             storm = ChaosStorm(cluster, dbs, flow.g_random, scenario)
@@ -915,6 +926,178 @@ def run_smoke_overload(out=print,
     return 0
 
 
+def run_smoke_heat(out=print, report_path: str = HEAT_REPORT_PATH) -> int:
+    """Storage-heat smoke (ISSUE 13's acceptance cell): the SAME seeded
+    HotShardStorm (one tenant tag concentrating Zipfian reads on a
+    narrow hot range, background tenants reading uniformly) run three
+    times — plane off, armed, and armed replay.
+
+    Off-posture pin: arming the plane must not perturb the sim at all
+    (identical keyspace digest, scheduler step count and network
+    message count — the storm is read-only, and the heat plane adds no
+    messages or tasks). Armed: `status.cluster.storage_heat` must NAME
+    the injected hot sub-range and the hot tenant tag, the heat
+    signals must ride the storage QosSamples, the fdbtpu_storage_*
+    exporter families must parse, and `cli heat` + the `status
+    details` section must render. Replay: the armed run's heat rows
+    must be bit-identical at the same seed. The report lands at
+    /tmp/_heat_report.txt for the CI artifact."""
+    import json
+    import os
+
+    from .. import flow
+    from ..server import SimCluster
+    from ..server.chaos import database_digest
+    from ..server.workloads import HotShardStorm
+    from .cli import _render_details, _render_heat
+    from .exporter import parse_prometheus, render_prometheus
+
+    seed = int(os.environ.get("HEAT_SEED", 5151))
+    duration = float(os.environ.get("HEAT_DURATION", 3.0))
+
+    def run_once(armed: bool) -> tuple:
+        cluster = SimCluster(seed=seed, durable=True)
+        # knobs AFTER SimCluster re-initializes them; restored by the
+        # next SimCluster (and the finally) so the runs stay independent
+        flow.SERVER_KNOBS.set("qos_sample_interval", 0.25)
+        if armed:
+            flow.SERVER_KNOBS.set("storage_heat_tracking", 1)
+        try:
+            dbs = [cluster.client(f"heat{i}") for i in range(4)]
+
+            async def main():
+                storm = HotShardStorm(dbs, flow.g_random,
+                                      duration=duration)
+                await storm.seed(dbs[0])
+                stats = await storm.run()
+                await flow.delay(1.0)   # QoS sampler + heat rollup ticks
+                status = await dbs[0].get_status()
+                digest = await database_digest(dbs[0])
+                return storm, stats, status, digest
+
+            storm, stats, status, digest = cluster.run(main(),
+                                                       timeout_time=600)
+            return (storm, stats, status, digest,
+                    cluster.sched.tasks_run, cluster.net.messages_sent)
+        finally:
+            flow.reset_server_knobs(randomize=False)
+            cluster.shutdown()
+
+    _sto, off_stats, off_status, off_digest, off_tasks, off_msgs = \
+        run_once(armed=False)
+    storm, on_stats, on_status, on_digest, on_tasks, on_msgs = \
+        run_once(armed=True)
+    _sto2, re_stats, re_status, re_digest, _re_tasks, re_msgs = \
+        run_once(armed=True)
+
+    cl = on_status["cluster"]
+    heat = cl.get("storage_heat") or {}
+    report = {"seed": seed, "duration": duration,
+              "storm": on_stats, "heat": heat,
+              "off": {"digest": off_digest, "tasks_run": off_tasks,
+                      "messages_sent": off_msgs,
+                      "heat": off_status["cluster"].get("storage_heat")},
+              "armed": {"digest": on_digest, "tasks_run": on_tasks,
+                        "messages_sent": on_msgs},
+              "replay": {"digest": re_digest, "messages_sent": re_msgs,
+                         "heat": re_status["cluster"].get("storage_heat")}}
+    try:
+        # (1) off-posture pin: arming the observe-only plane must not
+        # perturb the sim — same digest, same step count, same message
+        # count, same storm outcome
+        assert on_digest == off_digest, (off_digest, on_digest)
+        assert on_tasks == off_tasks, (off_tasks, on_tasks)
+        assert on_msgs == off_msgs, (off_msgs, on_msgs)
+        assert on_stats["issued"] == off_stats["issued"], report
+        assert on_stats["completed"] == off_stats["completed"], report
+        # ...and the disarmed plane is genuinely empty
+        off_heat = off_status["cluster"]["storage_heat"]
+        assert off_heat["tracking_enabled"] == 0, off_heat
+        assert not off_heat["ranges"], off_heat
+        assert not off_heat["busiest_read_tags"], off_heat
+
+        # (2) the armed plane NAMES the injected hot sub-range: the
+        # top-ranked flagged range overlaps the storm's hot range
+        assert heat["tracking_enabled"] == 1, heat
+        assert heat["ranges"], "no read-hot ranges flagged"
+        hb, he = storm.hot_range
+        top = heat["ranges"][0]
+        tb, te = bytes.fromhex(top["begin"]), bytes.fromhex(top["end"])
+        assert tb < he and te > hb, (
+            "top hot range misses the injected one", top,
+            hb.hex(), he.hex())
+        assert top["density"] >= float(
+            flow.SERVER_KNOBS.read_hot_range_ratio), top
+
+        # (3) ...and the hot tenant: every reporting server's busiest
+        # read tag is the storm's hot tag
+        btags = heat["busiest_read_tags"]
+        assert btags, "no busiest-read-tag rows"
+        assert all(r["tag"] == storm.hot_tag.hex() for r in btags), btags
+
+        # (4) the heat signals ride the storage QosSamples and the
+        # ratekeeper saw the observe-only inputs
+        roles = (cl.get("qos") or {}).get("roles") or {}
+        sto = next(iter(roles.get("storage", {}).values()))
+        for sig in ("read_bytes_per_sec", "read_ops_per_sec",
+                    "read_hot_ranges", "busiest_read_tag_busyness",
+                    "write_bandwidth"):
+            assert sig in sto, (sig, sto)
+        assert sto["read_bytes_per_sec"] > 0, sto
+        inputs = (cl.get("qos") or {}).get("inputs") or {}
+        assert inputs.get("worst_read_hot", 0) > 0, inputs
+        assert inputs.get("busiest_read_tag_busyness", 0) > 0, inputs
+        assert (cl.get("qos") or {}).get("busiest_read_tag") == \
+            storm.hot_tag.hex(), cl.get("qos")
+
+        # (5) exporter families parse and cover the plane
+        samples = parse_prometheus(render_prometheus(on_status))
+        names = {n for n, _l, _v in samples}
+        for need in ("fdbtpu_storage_read_bytes",
+                     "fdbtpu_storage_read_ops",
+                     "fdbtpu_storage_read_hot_ranges",
+                     "fdbtpu_storage_tag_busyness",
+                     "fdbtpu_storage_shard_bytes",
+                     "fdbtpu_storage_write_bandwidth",
+                     "fdbtpu_storage_heat_tracking"):
+            assert need in names, f"exporter missing {need}"
+        busy = [(l, v) for n, l, v in samples
+                if n == "fdbtpu_storage_tag_busyness"]
+        assert busy and all(l["tag"] == storm.hot_tag.hex()
+                            for l, _v in busy), busy
+
+        # (6) operator surfaces render
+        heat_view = _render_heat(cl)
+        for section in ("Storage heat", "Read-hot sub-ranges",
+                        "Busiest read tag", storm.hot_tag.hex()):
+            assert section in heat_view, (section, heat_view)
+        details = _render_details(cl)
+        assert "Storage heat (read-hot sub-ranges):" in details, details
+
+        # (7) same-seed replay: the armed plane names the same range
+        # and tag BIT-IDENTICALLY (digest + message count too)
+        re_heat = re_status["cluster"]["storage_heat"]
+        assert re_heat == heat, (heat, re_heat)
+        assert re_digest == on_digest, (on_digest, re_digest)
+        assert re_msgs == on_msgs, (on_msgs, re_msgs)
+        assert re_stats == on_stats or re_stats["issued"] == \
+            on_stats["issued"], (on_stats, re_stats)
+        report["asserts"] = "all passed"
+    finally:
+        with open(report_path, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True, default=str)
+            fh.write("\n")
+    out(f"HEAT SMOKE OK: {on_stats['issued']} read arrivals "
+        f"({on_stats['hot_issued']} hot / "
+        f"{on_stats['background_issued']} background), hot range "
+        f"[{top['begin']}, {top['end']}) density {top['density']}x "
+        f"named on server {top['server']}, busiest tag "
+        f"{btags[0]['tag']} everywhere, off-posture pin held "
+        f"(digest {on_digest[:16]}, {on_tasks} steps, {on_msgs} msgs), "
+        f"replay identical; report at {report_path}")
+    return 0
+
+
 def run_smoke_simprof(out=print,
                       report_path: str = SIMPROF_REPORT_PATH) -> int:
     """Sim-perf attribution smoke (ISSUE 11's acceptance cell): the
@@ -1050,6 +1233,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_smoke_overload()
     if "--simprof" in argv:
         return run_smoke_simprof()
+    if "--heat" in argv:
+        return run_smoke_heat()
     return run_smoke()
 
 

@@ -22,7 +22,13 @@ from foundationdb_tpu.server.ratekeeper import LIMIT_REASONS
 # the signal inventory each role kind publishes (README QoS telemetry
 # section documents the same table — this test is the schema pin)
 STORAGE_SIGNALS = {"queue_bytes", "durability_lag_versions",
-                   "read_rate", "mutation_rate"}
+                   "read_rate", "mutation_rate", "write_bandwidth"}
+# armed-only storage heat additions (ISSUE 13): present exactly while
+# STORAGE_HEAT_TRACKING is on — the armed-schema pin lives in
+# tests/test_storage_heat.py (test_armed_plane_end_to_end_status_qos_cli
+# asserts the armed set equals STORAGE_SIGNALS | STORAGE_HEAT_SIGNALS)
+STORAGE_HEAT_SIGNALS = {"read_bytes_per_sec", "read_ops_per_sec",
+                        "read_hot_ranges", "busiest_read_tag_busyness"}
 TLOG_SIGNALS = {"queue_bytes", "queue_entries",
                 "fsync_backlog_versions", "commit_rate"}
 PROXY_SIGNALS = {"grv_queue_depth", "commit_batch_occupancy",
@@ -34,6 +40,7 @@ RESOLVER_SIGNALS = {"pipeline_occupancy", "pipeline_in_flight",
 RK_INPUTS = {"worst_storage_queue_bytes", "worst_tlog_queue_bytes",
              "worst_durability_lag_versions", "pipeline_occupancy",
              "pipeline_forced_drain_rate", "sched_deferred_depth",
+             "worst_read_hot", "busiest_read_tag_busyness",
              "dead_replicas"}
 
 

@@ -44,6 +44,94 @@ def test_sample_is_unbiased_estimator(knobs):
     assert m.sampled_bytes() == 0
 
 
+def test_sample_unbiased_across_factor_regimes(knobs):
+    """Directed unbiasedness (ISSUE 13 satellite): the estimator
+    tracks true bytes at every factor regime — all-big values (every
+    row recorded exactly), all-tiny (probabilistic inclusion), and a
+    mix — including after a live factor change."""
+    for factor, sizes in ((10, (4, 7, 9)),        # all below factor
+                          (100, (150, 400, 999)),  # all at/above
+                          (100, (20, 80, 150, 600))):  # mixed
+        flow.SERVER_KNOBS.set("byte_sample_factor", factor)
+        m = StorageMetrics()
+        true = 0
+        for i in range(3000):
+            k = b"u%05d" % i
+            n = sizes[i % len(sizes)]
+            m.note_set(k, n)
+            true += n
+        est = m.sampled_bytes()
+        assert abs(est - true) / true < 0.25, (factor, est, true)
+        # range queries agree with the total (prefix-sum consistency)
+        mid = b"u01500"
+        assert m.sampled_bytes(b"", mid) + m.sampled_bytes(mid) == est
+
+
+def test_split_key_deterministic_across_replicas(knobs):
+    """Two replicas applying the same rows (in different orders) hold
+    identical samples and name the IDENTICAL split key — the
+    deterministic-inclusion contract DD and sim replay rely on."""
+    rows = [(b"d%04d" % i, 11 + (i * 13) % 70) for i in range(500)]
+    a, b = StorageMetrics(), StorageMetrics()
+    for k, n in rows:
+        a.note_set(k, n)
+    for k, n in reversed(rows):
+        b.note_set(k, n)
+    assert a.sampled_bytes() == b.sampled_bytes()
+    assert a.split_key(b"", None) == b.split_key(b"", None)
+    assert a.split_key(b"d0100", b"d0400") == \
+        b.split_key(b"d0100", b"d0400")
+    # and the split point genuinely byte-balances the sample
+    s = a.split_key(b"", None)
+    left = a.sampled_bytes(b"", s)
+    assert abs(2 * left - a.sampled_bytes()) <= \
+        a.sampled_bytes() * 0.2 + 2 * flow.SERVER_KNOBS.byte_sample_factor
+
+
+def test_note_clear_and_rebuild_total_consistency(knobs):
+    """note_clear drops exactly the range's sampled weight (the total
+    equals a fresh rebuild of the surviving rows), and rebuild()
+    resets rather than accumulates."""
+    rows = [(b"c%04d" % i, 9 + (i * 29) % 120) for i in range(800)]
+    m = StorageMetrics()
+    for k, n in rows:
+        m.note_set(k, n)
+    m.note_clear(b"c0200", b"c0600")
+    survivors = [(k, b"x" * (n - len(k))) for k, n in rows
+                 if not b"c0200" <= k < b"c0600"]
+    fresh = StorageMetrics()
+    fresh.rebuild(survivors)
+    assert m.sampled_bytes() == fresh.sampled_bytes()
+    assert m._keys == fresh._keys
+    # rebuild over the same rows twice: identical, not doubled
+    fresh.rebuild(survivors)
+    assert m.sampled_bytes() == fresh.sampled_bytes()
+    # empty-range clear is a no-op
+    before = m.sampled_bytes()
+    m.note_clear(b"c0600", b"c0600")
+    assert m.sampled_bytes() == before
+
+
+def test_prefix_sums_match_naive_after_mutation_mix(knobs):
+    """The lazily-rebuilt prefix sums (ISSUE 13 satellite: sub-linear
+    sampled_bytes/split_key) stay exact through interleaved queries,
+    overwrites, deletions and clears."""
+    m = StorageMetrics()
+    for i in range(300):
+        m.note_set(b"p%04d" % i, 30 + (i * 7) % 90)
+    def naive(b, e):
+        i = 0
+        return sum(w for k, w in m._sample.items()
+                   if b <= k and (e is None or k < e))
+    assert m.sampled_bytes(b"p0050", b"p0250") == naive(b"p0050",
+                                                        b"p0250")
+    m.note_set(b"p0100", 500)          # overwrite between queries
+    m.note_clear(b"p0200", b"p0220")
+    assert m.sampled_bytes(b"p0050", b"p0250") == naive(b"p0050",
+                                                        b"p0250")
+    assert m.sampled_bytes(b"", None) == naive(b"", None)
+
+
 def test_split_key_is_byte_balanced(knobs):
     """With 100 tiny rows and 5 huge rows at the end, the byte-
     balanced split point lands inside the huge tail — a row-median
