@@ -23,9 +23,14 @@ Prints exactly one JSON line:
 Env overrides: FDBTPU_BENCH_TXNS (batch size), FDBTPU_BENCH_BATCHES
 (timed batches), FDBTPU_BENCH_KEYS (keyspace), FDBTPU_BENCH_READS
 (reads per txn), FDBTPU_BENCH_BACKEND (tpu-point|tpu|tpu-streamed|
-tpu-pipelined|python|native — CPU baselines for comparison runs),
-FDBTPU_BENCH_PIPELINE_DEPTH (headline K for the tpu-pipelined
-submit/drain window; `all` mode sweeps K in {1,2,4,8}).
+tpu-pipelined|tpu-packed|python|native — CPU baselines for comparison
+runs; tpu-packed is the packed single-buffer interval feed vs its
+unpacked baseline), FDBTPU_BENCH_PIPELINE_DEPTH (headline K for the
+tpu-pipelined submit/drain window; `all` mode sweeps K in {1,2,4,8}).
+
+`bench.py --dry` runs the packed/unpacked interval parity gate instead
+of a bench round (CI: a feed-path divergence fails the gate, not a
+hardware round) — see run_dry.
 """
 
 import json
@@ -308,7 +313,39 @@ def bench_tpu_streamed(n_txns, n_batches, keyspace, backend="point"):
     n_conflicts = int(sum(np.asarray(c)[:n_txns].sum()
                           for c in results[warmup:]))
     elapsed = time.perf_counter() - t0
-    return n_batches * n_txns / elapsed, n_conflicts
+    # the h2d transfer/bytes counters are the bench record's evidence
+    # that the packed single-buffer feed actually ran (ISSUE 14: the
+    # gain is COUNTED, not inferred)
+    return (n_batches * n_txns / elapsed, n_conflicts,
+            cs.kernel_stats()["h2d"])
+
+
+def bench_tpu_packed(n_txns, n_batches, keyspace):
+    """The packed interval feed path vs its unpacked baseline: the SAME
+    seeded streamed interval batches through resolve_arrays with
+    INTERVAL_PACKED_FEED=1 (one H2D transfer per batch) and =0 (the
+    legacy ~12-transfer feed). Divergent conflict counts REFUSE to
+    publish — the two paths are bit-identical by construction, so a
+    divergence is a bug, not a data point."""
+    from foundationdb_tpu.flow.knobs import SERVER_KNOBS
+    saved = int(SERVER_KNOBS.interval_packed_feed)
+    try:
+        SERVER_KNOBS.set("INTERVAL_PACKED_FEED", 1)
+        tps_p, nc_p, h2d_p = bench_tpu_streamed(n_txns, n_batches,
+                                                keyspace, "interval")
+        SERVER_KNOBS.set("INTERVAL_PACKED_FEED", 0)
+        tps_u, nc_u, h2d_u = bench_tpu_streamed(n_txns, n_batches,
+                                                keyspace, "interval")
+    finally:
+        SERVER_KNOBS.set("INTERVAL_PACKED_FEED", saved)
+    if nc_p != nc_u:
+        raise RuntimeError(
+            f"packed/unpacked interval conflict counts diverged: "
+            f"{nc_p} vs {nc_u} — refusing to publish")
+    return tps_p, nc_p, {
+        "unpacked_txn_per_s": round(tps_u, 1),
+        "speedup_vs_unpacked": round(tps_p / tps_u, 2) if tps_u else None,
+        "h2d_packed": h2d_p, "h2d_unpacked": h2d_u}
 
 
 def bench_tpu_pipelined(n_txns, n_batches, keyspace, depth):
@@ -416,6 +453,14 @@ def bench_cpu(backend, n_txns, n_batches, keyspace):
     return n_batches * n_txns / (time.perf_counter() - t0), n_conflicts
 
 
+def _jax_platform() -> str:
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:
+        return "none"
+
+
 def _pipeline_depth() -> int:
     return max(1, int(os.environ.get("FDBTPU_BENCH_PIPELINE_DEPTH", 4)))
 
@@ -426,9 +471,12 @@ def _run_backend(backend, n_txns, n_batches, keyspace):
     if backend == "tpu":
         return bench_tpu(n_txns, n_batches, keyspace)
     if backend == "tpu-streamed":
-        return bench_tpu_streamed(n_txns, n_batches, keyspace)
+        return bench_tpu_streamed(n_txns, n_batches, keyspace)[:2]
     if backend == "tpu-streamed-interval":
-        return bench_tpu_streamed(n_txns, n_batches, keyspace, "interval")
+        return bench_tpu_streamed(n_txns, n_batches, keyspace,
+                                  "interval")[:2]
+    if backend == "tpu-packed":
+        return bench_tpu_packed(n_txns, n_batches, keyspace)[:2]
     return bench_cpu(backend, n_txns, n_batches, keyspace)
 
 
@@ -541,11 +589,101 @@ def _measure_transport() -> dict:
             "h2d_mb_s": round(8.0 / h2d, 1)}
 
 
+def run_dry() -> int:
+    """Packed/unpacked parity gate (`bench.py --dry`, CI): seeded
+    random INTERVAL batches — mixed widths, empty ranges, tooOld
+    snapshots, growth — resolved with attribution through the same
+    TpuConflictSet feed path under INTERVAL_PACKED_FEED=1 and =0, plus
+    PyConflictSet and BruteForce cross-checks. Verdicts AND attribution
+    must match bit-exactly; a divergence fails THIS gate instead of
+    poisoning a hardware bench round. No timing is published."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import random
+
+    from foundationdb_tpu.flow.knobs import SERVER_KNOBS
+    from foundationdb_tpu.models import (BruteForceConflictSet,
+                                         PyConflictSet,
+                                         ResolverTransaction)
+    from foundationdb_tpu.models.tpu_resolver import TpuConflictSet
+
+    rng = random.Random(20260804)
+
+    def rrange():
+        a = bytes([rng.randrange(256), rng.randrange(8)])
+        b = bytes([rng.randrange(256), rng.randrange(8)])
+        if a > b:
+            a, b = b, a
+        if a == b:
+            b = a + (b"\x00" if rng.random() < 0.9 else b"")  # some empty
+        return a, b
+
+    n_batches = int(os.environ.get("FDBTPU_BENCH_DRY_BATCHES", 40))
+    version, batches = 0, []
+    for _ in range(n_batches):
+        version += rng.randrange(1, 400_000)
+        batch = [ResolverTransaction(
+            max(0, version - rng.randrange(0, int(1.4 * MWTLV))),
+            tuple(rrange() for _ in range(rng.randrange(0, 5))),
+            tuple(rrange() for _ in range(rng.randrange(0, 5))))
+            for _ in range(rng.randrange(1, 24))]
+        batches.append((version, max(0, version - MWTLV), batch))
+
+    runs = {}
+    saved = int(SERVER_KNOBS.interval_packed_feed)
+    try:
+        for label, knob in (("packed", 1), ("unpacked", 0)):
+            SERVER_KNOBS.set("INTERVAL_PACKED_FEED", knob)
+            cs = TpuConflictSet(capacity=1 << 10)  # small: forces growth
+            out = [cs.resolve_with_attribution(b, v, o)
+                   for v, o, b in batches]
+            runs[label] = out
+    finally:
+        SERVER_KNOBS.set("INTERVAL_PACKED_FEED", saved)
+    py = PyConflictSet()
+    runs["python"] = [py.resolve_with_attribution(b, v, o)
+                      for v, o, b in batches]
+    bf = BruteForceConflictSet()
+    bf_verdicts = [bf.resolve(b, v, o) for v, o, b in batches]
+
+    ok = True
+    detail = ""
+    for label in ("unpacked", "python"):
+        for i, (a, b) in enumerate(zip(runs["packed"], runs[label])):
+            if a != b:
+                ok = False
+                detail = (f"packed vs {label} diverged at batch {i}: "
+                          f"{a} != {b}")
+                break
+        if not ok:
+            break
+    if ok:
+        for i, (a, v) in enumerate(zip(runs["packed"], bf_verdicts)):
+            if a[0] != v:
+                ok = False
+                detail = (f"packed vs brute-force verdicts diverged at "
+                          f"batch {i}: {a[0]} != {v}")
+                break
+    n_conf = sum(sum(1 for x in v if x == 0)
+                 for v, _a in runs["packed"])
+    print(json.dumps({
+        "metric": "packed_interval_parity", "dry": True, "ok": ok,
+        "batches": n_batches,
+        "txns": sum(len(b) for _v, _o, b in batches),
+        "conflicts": n_conf,
+        **({"error": detail} if detail else {})}))
+    sys.stdout.flush()
+    return 0 if ok else 1
+
+
 def main():
+    if "--dry" in sys.argv[1:]:
+        return run_dry()
     backend_env = os.environ.get("FDBTPU_BENCH_BACKEND", "all")
     needs_device = backend_env in ("all", "tpu", "tpu-point",
                                    "tpu-streamed", "tpu-streamed-interval",
-                                   "tpu-pipelined")
+                                   "tpu-pipelined", "tpu-packed")
     _enable_compile_cache()
     # the periodic kernel-profiling fence (KERNEL_PROFILE_EVERY) drains
     # the async dispatch pipeline the streamed path depends on — the
@@ -610,11 +748,33 @@ def main():
         # all with 16-byte keys — plus the CPU baselines on the same
         # host. The STREAMED number is the headline: it is what a
         # resolver role actually pays per batch.
-        for name in ("tpu-point", "tpu", "tpu-streamed"):
+        for name in ("tpu-point", "tpu"):
             tps, nc = _run_backend(name, n_txns, n_batches, keyspace)
             sub[name] = {"txn_per_s": round(tps, 1),
                          "vs_baseline": round(tps / TARGET_TXN_PER_S, 4),
                          "conflicts": nc}
+        tps, nc, h2d = bench_tpu_streamed(n_txns, n_batches, keyspace)
+        sub["tpu-streamed"] = {"txn_per_s": round(tps, 1),
+                               "vs_baseline": round(tps / TARGET_TXN_PER_S,
+                                                    4),
+                               "conflicts": nc, "h2d": h2d}
+        # the packed interval feed joins the matrix (ISSUE 14): packed
+        # vs unpacked on the SAME batches, plus a cross-mode refusal —
+        # the streamed point batches are identical (same rng seed), so
+        # the interval backend must see the same conflicts the point
+        # backend did, at every feed discipline
+        tps_pk, nc_pk, packed_detail = bench_tpu_packed(
+            n_txns, n_batches, keyspace)
+        if nc_pk != sub["tpu-streamed"]["conflicts"]:
+            raise RuntimeError(
+                f"per-mode conflict counts diverged: tpu-packed "
+                f"{nc_pk} vs tpu-streamed "
+                f"{sub['tpu-streamed']['conflicts']} — refusing to "
+                f"publish")
+        sub["tpu-packed"] = {
+            "txn_per_s": round(tps_pk, 1),
+            "vs_baseline": round(tps_pk / TARGET_TXN_PER_S, 4),
+            "conflicts": nc_pk, **packed_detail}
         # pipelined submit/drain depth sweep: K=1 is the serial
         # role path (one dispatch round-trip per batch); the ratio
         # K=headline / K=1 is the pipelining win the PR claims, and
@@ -663,6 +823,14 @@ def main():
         sub["tpu-pipelined"] = {"depth": pdepth,
                                 "pipeline_stats": pstats}
         backend_name = backend
+    elif backend == "tpu-packed":
+        # single-backend packed run: the unpacked baseline and the h2d
+        # transfer evidence ride sub_metrics here too, not only in the
+        # `all` matrix — the comparison IS the mode
+        txn_per_s, n_conflicts, packed_detail = bench_tpu_packed(
+            n_txns, n_batches, keyspace)
+        sub["tpu-packed"] = packed_detail
+        backend_name = backend
     else:
         txn_per_s, n_conflicts = _run_backend(backend, n_txns, n_batches,
                                               keyspace)
@@ -679,6 +847,9 @@ def main():
             "writes_per_txn": 1, "keyspace": keyspace,
             "window_batches": WINDOW_BATCHES, "key_bytes": KEY_BYTES,
             "conflicts": n_conflicts,
+            # which jax platform the device modes actually ran on —
+            # "cpu" marks a tunnel-down round honestly in the artifact
+            "platform": _jax_platform(),
         },
         "sub_metrics": sub,
     }))

@@ -189,6 +189,16 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # to the fully synchronous submit-block-read path; buggified tiny
     # so sim runs stress the backpressure/forced-drain machinery
     init("RESOLVE_PIPELINE_DEPTH", 4, lambda: 1)
+    # packed single-buffer interval feed (models/tpu_resolver.py
+    # _dispatch): 1 = every interval batch rides ONE H2D transfer;
+    # 0 = the legacy ~12-transfer feed (bit-exact parity baseline and
+    # operational rollback). Deliberately NOT buggified: a new knob
+    # buggify site consumes a draw from the shared buggify stream and
+    # would shift every later knob's randomization on existing seeds
+    # (invalidating the pinned chaos-storm baselines); the fallback
+    # path is exercised by bench.py --dry and the directed parity
+    # tests instead, and verdicts are identical by construction
+    init("INTERVAL_PACKED_FEED", 1)
     init("DD_POLL_INTERVAL", 2.0, lambda: 0.3)
     init("DD_MOVE_NUDGE_INTERVAL", 0.1, lambda: 0.5)
     # how long a team may stay degraded before DD rebuilds the missing

@@ -20,6 +20,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..ops.keys import next_pow2
 from .conflict_set import ConflictSetCheckpoint, ResolverTransaction
 from .tpu_resolver import (_KERNEL_MIN_RANGES, _KERNEL_MIN_TXNS, _MIN_CAP,
                            TpuConflictSet)
@@ -83,7 +84,6 @@ class PointConflictSet(TpuConflictSet):
         the captured history is point-shaped)."""
         import jax.numpy as jnp
 
-        from ..ops.keys import next_pow2
         pts = sorted(ckpt.assignments)
         for b, e, _v in pts:
             self._check_point(b, e)
@@ -94,38 +94,44 @@ class PointConflictSet(TpuConflictSet):
         self._hk, self._hv = jnp.asarray(hk), jnp.asarray(hv)
         self._count_hint = len(pts)
 
-    def _marshal_ranges(self, txns: Sequence[ResolverTransaction], too_old):
+    def _marshal_ranges(self, txns: Sequence[ResolverTransaction], too_old,
+                        attribute: bool = False):
         """Point marshalling: end keys are never encoded (they are
         begin+'\\x00', one byte past the bucket width); each range is
-        validated to be a point instead. Same ((arrays), read_map)
-        contract as the interval backend."""
-        read_k: list[bytes] = []
-        read_t: list[int] = []
-        read_map: list[tuple] = []
-        write_k: list[bytes] = []
-        write_t: list[int] = []
+        validated to be a point instead. Same ((lists), read_map)
+        contract as the interval backend — keys stay raw bytes here and
+        are encoded once, straight into the packed staging buffer, by
+        `_dispatch`; txn ids ride one np.repeat per side."""
+        n = len(txns)
+        r_counts = np.zeros(n, np.int32)
+        w_counts = np.zeros(n, np.int32)
+        read_k: list = []
+        write_k: list = []
+        r_src: list = []
         for t, tr in enumerate(txns):
             if too_old[t]:
                 continue
+            c0 = len(read_k)
             for ri, (b, e) in enumerate(tr.read_ranges):
                 if b >= e:
                     continue
                 self._check_point(b, e)
                 read_k.append(b)
-                read_t.append(t)
-                read_map.append((t, ri))
+                if attribute:
+                    r_src.append(ri)
+            r_counts[t] = len(read_k) - c0
+            c0 = len(write_k)
             for b, e in tr.write_ranges:
                 if b >= e:
                     continue
                 self._check_point(b, e)
                 write_k.append(b)
-                write_t.append(t)
-
-        from ..ops.keys import encode_keys
-        keys = encode_keys(read_k + write_k, self._key_bytes)
-        nr = len(read_t)
-        return ((keys[:nr], None, np.asarray(read_t, np.int32),
-                 keys[nr:], None, np.asarray(write_t, np.int32)), read_map)
+            w_counts[t] = len(write_k) - c0
+        ids = np.arange(n, dtype=np.int32)
+        rt = np.repeat(ids, r_counts)
+        wt = np.repeat(ids, w_counts)
+        read_map = ((rt, np.asarray(r_src, np.int32)) if attribute else ())
+        return (read_k, None, rt, write_k, None, wt), read_map
 
     def _validate_range(self, b: bytes, e: bytes) -> None:
         self._check_point(b, e)
@@ -158,15 +164,22 @@ class PointConflictSet(TpuConflictSet):
                                       wb, we, wt, commit_version,
                                       new_oldest_version)
 
+    # -- packed single-buffer feed path --------------------------------
+    def _feed_len(self, npad: int, nrp: int, nwp: int) -> int:
+        from ..ops.point_kernel import point_feed_len
+        return point_feed_len(npad, nrp, nwp, self._n_words)
+
+    def _feed_views(self, buf, npad: int, nrp: int, nwp: int):
+        from ..ops.point_kernel import point_batch_views
+        return point_batch_views(buf, npad, nrp, nwp, self._n_words)
+
     def _dispatch(self, n, snapshots, too_old, rb, re, rt, wb, we, wt,
                   offsets, attribute: bool = False):
         commit_off, oldest_off, fixup = offsets
-        import jax.numpy as jnp
-
         from ..ops.conflict_kernel import SNAP_CLAMP
-        from ..ops.keys import next_pow2
+        from ..ops.point_kernel import make_point_resolve_packed_fn
 
-        nr, nw = rb.shape[0], wb.shape[0]
+        nr, nw = len(rt), len(wt)
         npad = next_pow2(max(n, _KERNEL_MIN_TXNS))
         # exact bucket: one extra slot would double both dimensions
         nrp = next_pow2(max(nr, _KERNEL_MIN_RANGES))
@@ -174,20 +187,10 @@ class PointConflictSet(TpuConflictSet):
         self._audit_capacity(nw)  # one state row per point write
         self._note_occupancy(n, npad, nr, nrp, nw, nwp)
 
-        snap_off = np.clip(snapshots - self._base, 0, SNAP_CLAMP).astype(np.int32)
-        snap_p = np.zeros(npad, np.int32)
-        snap_p[:n] = snap_off
-        tooold_p = np.zeros(npad, bool)
-        tooold_p[:n] = too_old
-        rvalid = np.zeros(nrp, bool)
-        rvalid[:nr] = True
-        wvalid = np.zeros(nwp, bool)
-        wvalid[:nw] = True
+        snap_off = np.clip(snapshots - self._base, 0,
+                           SNAP_CLAMP).astype(np.int32)
         init_off = int(np.clip(self._init_version - self._base, 0,
                                SNAP_CLAMP + 1))
-
-        from ..ops.point_kernel import (make_point_resolve_packed_fn,
-                                        pack_point_batch)
         # donate=True: chained-state entry (one state allocation across
         # the whole in-flight pipeline window, like the interval backend)
         fn = make_point_resolve_packed_fn(self._cap, npad, nrp, nwp,
@@ -196,23 +199,35 @@ class PointConflictSet(TpuConflictSet):
                                           donate=True)
         # ONE host->device transfer per batch: the per-transfer latency
         # (not bandwidth) dominates the streamed path on a
-        # remote-attached chip, so the eight logical inputs ride one
-        # contiguous buffer and unpack inside the jit
-        buf = pack_point_batch(
-            snap_p, tooold_p, self._pad_keys(rb, nrp),
-            self._pad_idx(rt, nrp, npad), rvalid,
-            self._pad_keys(wb, nwp), self._pad_idx(wt, nwp, npad), wvalid)
+        # remote-attached chip, so the eleven logical inputs — version
+        # scalars included — ride one contiguous buffer built IN PLACE
+        # over reused staging and unpack inside the jit
+        buf, v = self._staging_views(npad, nrp, nwp)
+        v.hdr[0] = commit_off
+        v.hdr[1] = oldest_off
+        v.hdr[2] = init_off
+        v.snap[:n] = snap_off
+        v.snap[n:] = 0
+        v.too_old[:n] = too_old
+        v.too_old[n:] = 0
+        self._fill_keys(v.rk, rb, nr)
+        v.rtxn[:nr] = rt
+        v.rtxn[nr:] = npad
+        v.rvalid[:nr] = 1
+        v.rvalid[nr:] = 0
+        self._fill_keys(v.wk, wb, nw)
+        v.wtxn[:nw] = wt
+        v.wtxn[nw:] = npad
+        v.wvalid[:nw] = 1
+        v.wvalid[nw:] = 0
+        dev_buf = self._feed(buf)
         read_hit = None
         if attribute:
             self._hk, self._hv, count, conflict, read_hit = fn(
-                self._hk, self._hv, jnp.asarray(buf),
-                jnp.int32(commit_off), jnp.int32(oldest_off),
-                jnp.int32(init_off))
+                self._hk, self._hv, dev_buf)
         else:
             self._hk, self._hv, count, conflict = fn(
-                self._hk, self._hv, jnp.asarray(buf),
-                jnp.int32(commit_off), jnp.int32(oldest_off),
-                jnp.int32(init_off))
+                self._hk, self._hv, dev_buf)
         self._apply_fixup(fixup)
         self._note_count(count, nw)
         return conflict, read_hit

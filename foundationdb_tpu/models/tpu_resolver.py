@@ -29,6 +29,12 @@ from typing import Sequence
 import numpy as np
 
 from ..flow.stats import CounterCollection
+# hoisted out of the resolver hot path (the per-call form re-ran the
+# import machinery on every marshalled batch; same fix PR 13 applied to
+# the storage metrics path) — this module is only ever imported through
+# the lazy backend factory, so the transitive jax import stays off the
+# CPU-only paths
+from ..ops.keys import decode_keys, encode_keys, encode_keys_into, next_pow2
 from .conflict_set import (COMMITTED, CONFLICT, TOO_OLD, ConflictSetBase,
                            ConflictSetCheckpoint, ResolveTicket,
                            ResolverTransaction, checkpoint_from_step,
@@ -39,6 +45,22 @@ from .conflict_set import (COMMITTED, CONFLICT, TOO_OLD, ConflictSetBase,
 _KERNEL_MIN_TXNS = 16
 _KERNEL_MIN_RANGES = 32
 _MIN_CAP = 1 << 10
+
+
+def _unaliasable_u32(n: int) -> np.ndarray:
+    """A uint32 host staging buffer deliberately NOT 64-byte aligned.
+
+    XLA's CPU client zero-copies ("aliases") sufficiently aligned numpy
+    buffers into device arrays (HostBufferSemantics IMMUTABLE_ZERO_COPY)
+    instead of copying — mutating a reused staging buffer would then
+    corrupt an in-flight batch. Any zero-copy path fundamentally
+    requires alignment, so an off-alignment start (4 mod 64) forces a
+    real copy on every backend — which is exactly what an H2D transfer
+    is on a real accelerator. tests/test_packed_interval.py pins the
+    no-alias invariant with a mutate-after-transfer canary."""
+    raw = np.empty(n + 16, np.uint32)
+    off = ((4 - raw.ctypes.data) % 64) // 4
+    return raw[off:off + n]
 
 
 class TpuConflictSet(ConflictSetBase):
@@ -73,6 +95,14 @@ class TpuConflictSet(ConflictSetBase):
         # real rows vs padded slots is THE quantity the shape-bucketing
         # trades against recompiles)
         self.profile = CounterCollection(f"{self.BACKEND}_kernel")
+        # packed-feed staging: per (txn, read, write) shape bucket, a
+        # small ROTATING pool of reusable single-transfer host buffers
+        # (see _staging_views) + a monotonically grown key-encode
+        # scratch matrix — a steady-state batch stream is
+        # allocation-flat (counted by the staging_allocs counter)
+        self._staging: dict = {}
+        self._staging_idx: dict = {}
+        self._enc_scratch = np.empty((0, 0), np.uint8)
         self._hk, self._hv = self._to_device(*self._initial_state(init_version))
 
     def _initial_state(self, init_version: int):
@@ -134,7 +164,6 @@ class TpuConflictSet(ConflictSetBase):
         self._rows_since_async = 0
 
     def _grow(self, needed: int) -> None:
-        from ..ops.keys import next_pow2
         new_cap = max(self._cap * 2, next_pow2(needed + 2))
         hk = np.full((new_cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
         hv = np.full((new_cap,), -(1 << 30), np.int32)
@@ -215,7 +244,6 @@ class TpuConflictSet(ConflictSetBase):
         function with ABSOLUTE versions: D2H'd key rows decode exactly
         (encode_keys keeps the byte length), offsets re-base, and +inf
         pad rows (length word 0xFFFFFFFF) drop out."""
-        from ..ops.keys import decode_keys
         real = np.flatnonzero(hk[:, -1] != 0xFFFFFFFF)
         keys = decode_keys(hk[real])
         vals = [int(v) + self._base for v in hv[real]]
@@ -252,7 +280,6 @@ class TpuConflictSet(ConflictSetBase):
         keys +inf-padded to cap, versions as clamped offsets from the
         restored base."""
         from ..ops.conflict_kernel import REBASE_THRESHOLD
-        from ..ops.keys import encode_keys
         from ..ops.rmq import VDEAD
         hk = np.full((cap, self._n_words + 1), 0xFFFFFFFF, np.uint32)
         hv = np.full((cap,), VDEAD, np.int32)
@@ -270,7 +297,6 @@ class TpuConflictSet(ConflictSetBase):
     def _install_step(self, keys, vals) -> None:
         """Install a restored global step function as device state
         (the sharded backend overrides this with a per-shard clip)."""
-        from ..ops.keys import next_pow2
         import jax.numpy as jnp
         self._cap = max(_MIN_CAP, self._cap, next_pow2(len(keys) + 2))
         hk, hv = self._encode_step(keys, vals, self._cap)
@@ -330,10 +356,10 @@ class TpuConflictSet(ConflictSetBase):
                     return verdicts, None
                 attr: list[list[int]] = [[] for _ in range(n)]
                 if read_map:
-                    hits = np.asarray(read_hit)[:len(read_map)]
+                    slot_txn, slot_src = read_map
+                    hits = np.asarray(read_hit)[:slot_txn.shape[0]]
                     for slot in np.nonzero(hits)[0]:
-                        t, ri = read_map[slot]
-                        attr[t].append(ri)
+                        attr[int(slot_txn[slot])].append(int(slot_src[slot]))
                 return verdicts, [tuple(a) for a in attr]
 
             ticket = ResolveTicket(commit_version, n,
@@ -418,7 +444,8 @@ class TpuConflictSet(ConflictSetBase):
             if tr.read_snapshot < self._oldest and len(tr.read_ranges):
                 too_old[t] = True
 
-        arrays, read_map = self._marshal_ranges(txns, too_old)
+        arrays, read_map = self._marshal_ranges(txns, too_old,
+                                                attribute=attribute)
         conflict, read_hit = self._dispatch(
             n, snapshots, too_old, *arrays, offsets, attribute=attribute)
         self._last_commit = commit_version  # only after a successful batch
@@ -454,43 +481,54 @@ class TpuConflictSet(ConflictSetBase):
         view._key_bytes = self._key_bytes
         return view.validate_txns
 
-    def _marshal_ranges(self, txns, too_old):
-        """Flatten and encode the batch's conflict ranges in txn order.
+    def _marshal_ranges(self, txns, too_old, attribute: bool = False):
+        """Flatten the batch's conflict ranges in txn order — bulk host
+        marshalling, not per-range bookkeeping.
 
-        Returns ((rb, re, rt, wb, we, wt), read_map) — the arrays handed
-        to `_dispatch` plus, per read slot, the (txn index, ORIGINAL
-        read_ranges index) pair attribution routes hits back through.
-        tooOld txns contribute no ranges at all (ref: SkipList.cpp:979
-        addTransaction)."""
-        read_b: list[bytes] = []
-        read_e: list[bytes] = []
-        read_t: list[int] = []
-        read_map: list[tuple] = []
-        write_b: list[bytes] = []
-        write_e: list[bytes] = []
-        write_t: list[int] = []
+        Returns ((rb, re, rt, wb, we, wt), read_map): rb/re/wb/we are
+        flat LISTS of raw key bytes (encoded exactly once, straight
+        into the packed staging buffer, by `_dispatch`), rt/wt are
+        int32 txn-id arrays built by one np.repeat over per-txn counts
+        (the non-decreasing layout the kernel's segment sums require).
+        `read_map` — built only when `attribute` asks for it, the
+        verdict-only hot path skips the bookkeeping entirely — is a
+        (txn-ids, ORIGINAL read_ranges indices) array pair attribution
+        routes per-slot hits back through. tooOld txns contribute no
+        ranges at all (ref: SkipList.cpp:979 addTransaction)."""
+        n = len(txns)
+        r_counts = np.zeros(n, np.int32)
+        w_counts = np.zeros(n, np.int32)
+        rb: list = []
+        re_: list = []
+        wb: list = []
+        we: list = []
+        r_src: list = []
         for t, tr in enumerate(txns):
             if too_old[t]:
                 continue
-            for ri, (b, e) in enumerate(tr.read_ranges):
-                if b < e:
-                    read_b.append(b)
-                    read_e.append(e)
-                    read_t.append(t)
-                    read_map.append((t, ri))
-            for b, e in tr.write_ranges:
-                if b < e:
-                    write_b.append(b)
-                    write_e.append(e)
-                    write_t.append(t)
-
-        from ..ops.keys import encode_keys
-        nr, nw = len(read_t), len(write_t)
-        keys = encode_keys(read_b + read_e + write_b + write_e,
-                           self._key_bytes)
-        return ((keys[:nr], keys[nr:2 * nr], np.asarray(read_t, np.int32),
-                 keys[2 * nr:2 * nr + nw], keys[2 * nr + nw:],
-                 np.asarray(write_t, np.int32)), read_map)
+            rr = tr.read_ranges
+            if rr:
+                kept = [p for p in rr if p[0] < p[1]]
+                r_counts[t] = len(kept)
+                rb += [p[0] for p in kept]
+                re_ += [p[1] for p in kept]
+                if attribute:
+                    if len(kept) == len(rr):
+                        r_src += range(len(rr))
+                    else:
+                        r_src += [i for i, p in enumerate(rr)
+                                  if p[0] < p[1]]
+            ww = tr.write_ranges
+            if ww:
+                kept = [p for p in ww if p[0] < p[1]]
+                w_counts[t] = len(kept)
+                wb += [p[0] for p in kept]
+                we += [p[1] for p in kept]
+        ids = np.arange(n, dtype=np.int32)
+        rt = np.repeat(ids, r_counts)
+        wt = np.repeat(ids, w_counts)
+        read_map = ((rt, np.asarray(r_src, np.int32)) if attribute else ())
+        return (rb, re_, rt, wb, we, wt), read_map
 
     def resolve_arrays(self, snapshots: np.ndarray, has_reads: np.ndarray,
                        rb: np.ndarray, re: np.ndarray, rt: np.ndarray,
@@ -637,12 +675,23 @@ class TpuConflictSet(ConflictSetBase):
             rows = snap.get(f"{dim}s", 0)
             slots = snap.get(f"{dim}_slots", 0)
             occ[dim] = round(rows / slots, 4) if slots else None
+        batches = snap.get("batches", 0)
+        h2d_t = snap.get("h2d_transfers", 0)
         return {"backend": self.BACKEND,
                 "platform": jax.default_backend(),
                 "capacity": self._cap,
                 "state_rows": self._count_hint,
-                "batches": snap.get("batches", 0),
+                "batches": batches,
                 "occupancy": occ,
+                # feed-path transfer accounting: the packed
+                # single-buffer discipline shows as per_batch == 1.0
+                # (n_shards for the sharded backend); the unpacked
+                # fallback as ~12 — counted, not inferred
+                "h2d": {"transfers": h2d_t,
+                        "bytes": snap.get("h2d_bytes", 0),
+                        "per_batch": (round(h2d_t / batches, 2)
+                                      if batches else None),
+                        "staging_allocs": snap.get("staging_allocs", 0)},
                 # raw real-row and padded-slot totals per dimension
                 "counts": {k: v for k, v in snap.items()
                            if k != "batches"},
@@ -670,15 +719,116 @@ class TpuConflictSet(ConflictSetBase):
                 self._hk, self._hv, *args)
         return count, conflict, read_hit
 
+    # -- packed single-buffer feed path ---------------------------------
+    def _feed_len(self, npad: int, nrp: int, nwp: int) -> int:
+        from ..ops.conflict_kernel import interval_feed_len
+        return interval_feed_len(npad, nrp, nwp, self._n_words)
+
+    def _feed_views(self, buf, npad: int, nrp: int, nwp: int):
+        from ..ops.conflict_kernel import interval_batch_views
+        return interval_batch_views(buf, npad, nrp, nwp, self._n_words)
+
+    def _staging_views(self, npad: int, nrp: int, nwp: int):
+        """Reusable packed-feed staging for one shape bucket.
+
+        Buffers ROTATE through a small per-bucket pool (pipeline depth
+        + 2 entries): reuse only comes back around after the pipeline
+        has force-drained past the batch that last rode the buffer, so
+        an in-flight async H2D can never observe the next batch's
+        writes. Buffers are deliberately unaligned (_unaliasable_u32)
+        so XLA's zero-copy path cannot alias them either. Steady state
+        is allocation-flat — `staging_allocs` counts pool entries, not
+        batches."""
+        key = (npad, nrp, nwp)
+        pool = self._staging.get(key)
+        if pool is None:
+            pool = self._staging[key] = []
+        want = max(2, int(self.pipeline.depth) + 2)
+        if len(pool) < want:
+            buf = _unaliasable_u32(self._feed_len(npad, nrp, nwp))
+            ent = (buf, self._feed_views(buf, npad, nrp, nwp))
+            pool.append(ent)
+            self.profile.counter("staging_allocs").add(1)
+            return ent
+        i = self._staging_idx.get(key, 0)
+        self._staging_idx[key] = (i + 1) % len(pool)
+        return pool[i % len(pool)]
+
+    def _fill_keys(self, dst: np.ndarray, src, nsrc: int) -> None:
+        """Fill one padded key sub-matrix of the staging buffer: raw
+        byte keys encode STRAIGHT into the buffer (one vectorized pass
+        over a reused scratch matrix — the encoded keys never exist as
+        a separate array); pre-encoded arrays memcpy. Pad rows are
+        zeroed for deterministic buffer content (the kernel masks them,
+        verdicts never depend on pad rows)."""
+        if isinstance(src, np.ndarray):
+            dst[:nsrc] = src[:nsrc]
+        else:
+            sc = self._enc_scratch
+            if sc.shape[0] < nsrc or sc.shape[1] != self._key_bytes:
+                sc = np.empty((next_pow2(max(nsrc, _KERNEL_MIN_RANGES)),
+                               self._key_bytes), np.uint8)
+                self._enc_scratch = sc
+                self.profile.counter("staging_allocs").add(1)
+            encode_keys_into(src, self._key_bytes, dst, sc)
+        dst[nsrc:] = 0
+
+    def _feed(self, buf: np.ndarray):
+        """ONE host->device transfer carrying the whole packed batch
+        (the sharded backend overrides this with per-device async
+        puts). The transfer/bytes counters are the measured evidence
+        the packed discipline is live — `kernel_stats()["h2d"]`."""
+        import jax.numpy as jnp
+        p = self.profile
+        p.counter("h2d_transfers").add(1)
+        p.counter("h2d_bytes").add(int(buf.nbytes))
+        return jnp.asarray(buf)
+
+    def _h2d(self, a):
+        """Unpacked-fallback transfer accounting: one device array per
+        logical input — the multi-transfer feed the packed path
+        replaces, kept behind INTERVAL_PACKED_FEED=0 as the bit-exact
+        parity baseline and operational rollback."""
+        import jax.numpy as jnp
+        arr = jnp.asarray(a)
+        p = self.profile
+        p.counter("h2d_transfers").add(1)
+        p.counter("h2d_bytes").add(int(arr.nbytes))
+        return arr
+
+    def _call_kernel_packed(self, npad, nrp, nwp, dev_buf, attribute: bool):
+        """Run one packed batch through the single-shard jitted kernel
+        (the sharded resolver overrides this to dispatch across the
+        device mesh)."""
+        from ..ops.conflict_kernel import make_resolve_packed_fn
+        # donate=True: the chained-state entry — one history allocation
+        # across the whole in-flight pipeline window (see _call_kernel)
+        fn = make_resolve_packed_fn(self._cap, npad, nrp, nwp,
+                                    self._n_words, attribute=attribute,
+                                    donate=True)
+        read_hit = None
+        if attribute:
+            self._hk, self._hv, count, conflict, read_hit = fn(
+                self._hk, self._hv, dev_buf)
+        else:
+            self._hk, self._hv, count, conflict = fn(
+                self._hk, self._hv, dev_buf)
+        return count, conflict, read_hit
+
     def _dispatch(self, n, snapshots, too_old, rb, re, rt, wb, we, wt,
                   offsets, attribute: bool = False):
+        """Pad one batch to its shape bucket, build the packed feed
+        buffer IN PLACE over reused staging, and dispatch: every
+        marshalled (`resolve`/`submit`) and pre-encoded
+        (`resolve_arrays`/`submit_arrays`) batch rides the same single
+        host->device transfer. rb/re/wb/we are either flat lists of raw
+        key bytes (from `_marshal_ranges` — encoded straight into the
+        buffer) or pre-encoded [n, W+1] arrays (memcpy'd)."""
         commit_off, oldest_off, fixup = offsets
-        import jax.numpy as jnp
-
+        from ..flow.knobs import SERVER_KNOBS
         from ..ops.conflict_kernel import SNAP_CLAMP
-        from ..ops.keys import next_pow2
 
-        nr, nw = rb.shape[0], wb.shape[0]
+        nr, nw = len(rt), len(wt)
         npad = next_pow2(max(n, _KERNEL_MIN_TXNS))
         # exact bucket: one extra slot would double both dimensions
         nrp = next_pow2(max(nr, _KERNEL_MIN_RANGES))
@@ -686,7 +836,50 @@ class TpuConflictSet(ConflictSetBase):
         self._audit_capacity(2 * nw)
         self._note_occupancy(n, npad, nr, nrp, nw, nwp)
 
-        snap_off = np.clip(snapshots - self._base, 0, SNAP_CLAMP).astype(np.int32)
+        snap_off = np.clip(snapshots - self._base, 0,
+                           SNAP_CLAMP).astype(np.int32)
+        if int(SERVER_KNOBS.interval_packed_feed):
+            buf, v = self._staging_views(npad, nrp, nwp)
+            v.hdr[0] = commit_off
+            v.hdr[1] = oldest_off
+            v.snap[:n] = snap_off
+            v.snap[n:] = 0
+            v.too_old[:n] = too_old
+            v.too_old[n:] = 0
+            self._fill_keys(v.rb, rb, nr)
+            self._fill_keys(v.re, re, nr)
+            v.rtxn[:nr] = rt
+            v.rtxn[nr:] = npad
+            v.rvalid[:nr] = 1
+            v.rvalid[nr:] = 0
+            self._fill_keys(v.wb, wb, nw)
+            self._fill_keys(v.we, we, nw)
+            v.wtxn[:nw] = wt
+            v.wtxn[nw:] = npad
+            v.wvalid[:nw] = 1
+            v.wvalid[nw:] = 0
+            count, conflict, read_hit = self._call_kernel_packed(
+                npad, nrp, nwp, self._feed(buf), attribute)
+        else:
+            count, conflict, read_hit = self._dispatch_unpacked(
+                n, npad, nrp, nwp, snap_off, too_old, rb, re, rt,
+                wb, we, wt, commit_off, oldest_off, attribute)
+        self._apply_fixup(fixup)
+        self._note_count(count, 2 * nw)
+        return conflict, read_hit
+
+    def _dispatch_unpacked(self, n, npad, nrp, nwp, snap_off, too_old,
+                           rb, re, rt, wb, we, wt, commit_off, oldest_off,
+                           attribute: bool):
+        """Legacy multi-transfer feed (INTERVAL_PACKED_FEED=0): ~12
+        separate H2D transfers per batch, all counted — the packed
+        path's parity baseline (bench.py --dry, tests) and rollback."""
+        nr, nw = len(rt), len(wt)
+        if not isinstance(rb, np.ndarray):
+            keys = encode_keys(list(rb) + list(re) + list(wb) + list(we),
+                               self._key_bytes)
+            rb, re = keys[:nr], keys[nr:2 * nr]
+            wb, we = keys[2 * nr:2 * nr + nw], keys[2 * nr + nw:]
         snap_p = np.zeros(npad, np.int32)
         snap_p[:n] = snap_off
         tooold_p = np.zeros(npad, bool)
@@ -695,16 +888,14 @@ class TpuConflictSet(ConflictSetBase):
         rvalid[:nr] = True
         wvalid = np.zeros(nwp, bool)
         wvalid[:nw] = True
-
-        count, conflict, read_hit = self._call_kernel(npad, nrp, nwp, (
-            jnp.asarray(snap_p), jnp.asarray(tooold_p),
-            jnp.asarray(self._pad_keys(rb, nrp)),
-            jnp.asarray(self._pad_keys(re, nrp)),
-            jnp.asarray(self._pad_idx(rt, nrp, npad)), jnp.asarray(rvalid),
-            jnp.asarray(self._pad_keys(wb, nwp)),
-            jnp.asarray(self._pad_keys(we, nwp)),
-            jnp.asarray(self._pad_idx(wt, nwp, npad)), jnp.asarray(wvalid),
-            jnp.int32(commit_off), jnp.int32(oldest_off)), attribute)
-        self._apply_fixup(fixup)
-        self._note_count(count, 2 * nw)
-        return conflict, read_hit
+        h2d = self._h2d
+        return self._call_kernel(npad, nrp, nwp, (
+            h2d(snap_p), h2d(tooold_p),
+            h2d(self._pad_keys(rb, nrp)),
+            h2d(self._pad_keys(re, nrp)),
+            h2d(self._pad_idx(rt, nrp, npad)), h2d(rvalid),
+            h2d(self._pad_keys(wb, nwp)),
+            h2d(self._pad_keys(we, nwp)),
+            h2d(self._pad_idx(wt, nwp, npad)), h2d(wvalid),
+            h2d(np.int32(commit_off)), h2d(np.int32(oldest_off))),
+            attribute)

@@ -35,24 +35,44 @@ def next_pow2(n: int) -> int:
     return 1 << max(0, (int(n) - 1).bit_length())
 
 
-def encode_keys(keys: Sequence[bytes], key_bytes: int) -> np.ndarray:
-    """Encode byte-string keys into [n, W+1] uint32 rows (host side)."""
+_ENC_SHIFTS = np.array([1 << 24, 1 << 16, 1 << 8, 1], np.uint32)
+
+
+def encode_keys_into(keys: Sequence[bytes], key_bytes: int,
+                     out: np.ndarray, scratch: np.ndarray = None) -> None:
+    """encode_keys writing STRAIGHT into a preallocated uint32 view.
+
+    `out` is a [>=n, W+1] uint32 array (typically a reshaped slice of a
+    packed feed staging buffer — the marshalled keys then never exist
+    as a separate intermediate array); `scratch` is an optional
+    reusable [>=n, key_bytes] uint8 byte-staging matrix so a bucketed
+    caller pays zero per-batch allocations for the encode itself."""
     n = len(keys)
     n_words = key_bytes // 4
-    buf = np.zeros((max(n, 1), key_bytes), dtype=np.uint8)
-    out = np.zeros((max(n, 1), n_words + 1), dtype=np.uint32)
+    if scratch is None:
+        scratch = np.zeros((max(n, 1), key_bytes), dtype=np.uint8)
+    else:
+        scratch = scratch[:n]
+        scratch[:] = 0
     for i, k in enumerate(keys):
         kl = len(k)
         if kl > key_bytes:
             raise ValueError(
                 f"key length {kl} exceeds backend key width {key_bytes}")
         if kl:
-            buf[i, :kl] = np.frombuffer(k, np.uint8)
+            scratch[i, :kl] = np.frombuffer(k, np.uint8)
         out[i, n_words] = kl
-    shifts = np.array([1 << 24, 1 << 16, 1 << 8, 1], np.uint32)
-    out[:, :n_words] = (
-        buf.reshape(max(n, 1), n_words, 4).astype(np.uint32) * shifts
+    out[:n, :n_words] = (
+        scratch[:n].reshape(n, n_words, 4).astype(np.uint32) * _ENC_SHIFTS
     ).sum(axis=2, dtype=np.uint32)
+
+
+def encode_keys(keys: Sequence[bytes], key_bytes: int) -> np.ndarray:
+    """Encode byte-string keys into [n, W+1] uint32 rows (host side)."""
+    n = len(keys)
+    n_words = key_bytes // 4
+    out = np.zeros((max(n, 1), n_words + 1), dtype=np.uint32)
+    encode_keys_into(keys, key_bytes, out)
     return out[:n]
 
 

@@ -53,9 +53,11 @@ to the interval kernel's contract.
 from __future__ import annotations
 
 import functools
+from collections import namedtuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..flow.stats import CounterCollection
@@ -251,30 +253,72 @@ def make_point_resolve_fn(cap: int, n_txns: int, n_reads: int,
     return _fault_seamed(fn, f"point[{cap}c]")
 
 
-def pack_point_batch(snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid):
+# Packed single-buffer feed layout (the point sibling of
+# conflict_kernel.pack_interval_batch): the three version scalars ride
+# the buffer head, so one batch is exactly ONE host->device transfer.
+PointBatchViews = namedtuple(
+    "PointBatchViews", "hdr snap too_old rk rtxn rvalid wk wtxn wvalid")
+
+
+def point_feed_len(n_txns: int, n_reads: int, n_writes: int,
+                   n_words: int) -> int:
+    """Total uint32 words of one packed point feed buffer."""
+    width = n_words + 1
+    return 3 + 2 * n_txns + (n_reads + n_writes) * (width + 2)
+
+
+def point_batch_views(buf: np.ndarray, n_txns: int, n_reads: int,
+                      n_writes: int, n_words: int) -> PointBatchViews:
+    """Named numpy views over one packed point feed buffer; `hdr` is
+    [commit_off, oldest_off, init_off] as int32. The views alias `buf`
+    so marshallers build the batch in place (see
+    conflict_kernel.interval_batch_views)."""
+    width = n_words + 1
+    o = [3]
+
+    def take(n):
+        part = buf[o[0]:o[0] + n]
+        o[0] += n
+        return part
+
+    v = PointBatchViews(
+        hdr=buf[0:3].view(np.int32),
+        snap=take(n_txns).view(np.int32),
+        too_old=take(n_txns),
+        rk=take(n_reads * width).reshape(n_reads, width),
+        rtxn=take(n_reads).view(np.int32),
+        rvalid=take(n_reads),
+        wk=take(n_writes * width).reshape(n_writes, width),
+        wtxn=take(n_writes).view(np.int32),
+        wvalid=take(n_writes))
+    assert o[0] == buf.shape[0], (o[0], buf.shape)
+    return v
+
+
+def pack_point_batch(snap, too_old, rk, rtxn, rvalid, wk, wtxn, wvalid,
+                     commit_off: int = 0, oldest_off: int = 0,
+                     init_off: int = 0):
     """Pack one batch's host arrays into a single contiguous uint32
     buffer for make_point_resolve_packed_fn. One host->device transfer
-    per batch instead of eight: on a remote-attached accelerator the
+    per batch instead of eleven: on a remote-attached accelerator the
     per-transfer latency dominates the streamed resolve path, and the
     unpack on device is free (fused slices/bitcasts)."""
-    import numpy as np
     npad = snap.shape[0]
     nrp, width = rk.shape
     nwp = wk.shape[0]
-    buf = np.empty(2 * npad + (nrp + nwp) * (width + 2), np.uint32)
-    o = 0
-    for a, n in ((snap.astype(np.int32).view(np.uint32), npad),
-                 (too_old.astype(np.uint32), npad),
-                 (np.ascontiguousarray(rk, np.uint32).reshape(-1),
-                  nrp * width),
-                 (np.asarray(rtxn, np.int32).view(np.uint32), nrp),
-                 (rvalid.astype(np.uint32), nrp),
-                 (np.ascontiguousarray(wk, np.uint32).reshape(-1),
-                  nwp * width),
-                 (np.asarray(wtxn, np.int32).view(np.uint32), nwp),
-                 (wvalid.astype(np.uint32), nwp)):
-        buf[o:o + n] = a
-        o += n
+    buf = np.empty(point_feed_len(npad, nrp, nwp, width - 1), np.uint32)
+    v = point_batch_views(buf, npad, nrp, nwp, width - 1)
+    v.hdr[0] = commit_off
+    v.hdr[1] = oldest_off
+    v.hdr[2] = init_off
+    v.snap[:] = np.asarray(snap, np.int32)
+    v.too_old[:] = np.asarray(too_old, np.uint32)
+    v.rk[:] = rk
+    v.rtxn[:] = np.asarray(rtxn, np.int32)
+    v.rvalid[:] = np.asarray(rvalid, np.uint32)
+    v.wk[:] = wk
+    v.wtxn[:] = np.asarray(wtxn, np.int32)
+    v.wvalid[:] = np.asarray(wvalid, np.uint32)
     return buf
 
 
@@ -284,15 +328,15 @@ def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
                                  attribute: bool = True,
                                  donate: bool = False):
     """Jitted point resolve taking the pack_point_batch buffer; the
-    unpack happens inside the jit so the eight logical arrays never
+    unpack happens inside the jit so the eleven logical inputs never
     exist as separate device buffers. `donate` donates the (sk, sv)
     state carry (see make_point_resolve_fn)."""
     core = make_point_resolve_core(cap, n_txns, n_reads, n_writes, n_words,
                                    attribute=attribute)
     width = n_words + 1
 
-    def packed(sk, sv, buf, commit, oldest, init_off):
-        o = 0
+    def packed(sk, sv, buf):
+        o = 3
 
         def take(n):
             nonlocal o
@@ -300,6 +344,9 @@ def make_point_resolve_packed_fn(cap: int, n_txns: int, n_reads: int,
             o += n
             return part
 
+        commit = lax.bitcast_convert_type(buf[0], jnp.int32)
+        oldest = lax.bitcast_convert_type(buf[1], jnp.int32)
+        init_off = lax.bitcast_convert_type(buf[2], jnp.int32)
         snap = lax.bitcast_convert_type(take(n_txns), jnp.int32)
         too_old = take(n_txns) != 0
         rk = take(n_reads * width).reshape(n_reads, width)

@@ -138,10 +138,18 @@ def _render_details(cl: dict) -> str:
         for name, k in kern:
             occ = ", ".join(f"{d}={v if v is not None else '-'}"
                             for d, v in k.get("occupancy", {}).items())
+            h2d = k.get("h2d") or {}
+            pb = h2d.get("per_batch")
+            h2d_s = (f" h2d={pb:g}/batch"
+                     f" ({h2d.get('transfers', 0)}x,"
+                     f" {h2d.get('bytes', 0)}B,"
+                     f" staging={h2d.get('staging_allocs', 0)})"
+                     if pb is not None else "")
             lines.append(
                 f"  {name:<26} backend={k['backend']} "
                 f"platform={k['platform']} batches={k['batches']} "
-                f"rows={k['state_rows']}/{k['capacity']} occ[{occ}]")
+                f"rows={k['state_rows']}/{k['capacity']} occ[{occ}]"
+                f"{h2d_s}")
     pipes = [(r["name"], r["pipeline"]) for r in cl.get("resolvers", ())
              if r.get("pipeline")]
     if pipes:

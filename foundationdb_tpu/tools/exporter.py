@@ -336,6 +336,28 @@ def render_prometheus(status: dict) -> str:
                     f.add(f"{_PREFIX}_resolver_kernel_occupancy", "gauge",
                           "Real rows / padded slots per batch dimension",
                           {"role": r["name"], "dim": dim}, occ)
+            # feed-path transfer accounting (the packed single-buffer
+            # discipline: per_batch == 1 when live, ~12 on the
+            # unpacked fallback — counted at _dispatch, not inferred)
+            h2d = kern.get("h2d") or {}
+            if h2d:
+                f.add(f"{_PREFIX}_kernel_h2d_transfers", "counter",
+                      "Host->device transfers issued by the resolver "
+                      "feed path",
+                      {"role": r["name"]}, h2d.get("transfers"))
+                f.add(f"{_PREFIX}_kernel_h2d_bytes", "counter",
+                      "Bytes moved host->device by the resolver feed "
+                      "path",
+                      {"role": r["name"]}, h2d.get("bytes"))
+                if h2d.get("per_batch") is not None:
+                    f.add(f"{_PREFIX}_kernel_h2d_per_batch", "gauge",
+                          "H2D transfers per dispatched batch (1 = "
+                          "packed single-buffer feed live)",
+                          {"role": r["name"]}, h2d.get("per_batch"))
+                f.add(f"{_PREFIX}_kernel_h2d_staging_allocs", "counter",
+                      "Packed-feed staging buffers allocated (flat in "
+                      "steady state: buffers are bucket-reused)",
+                      {"role": r["name"]}, h2d.get("staging_allocs"))
         pipe = r.get("pipeline") or {}
         if pipe:
             plabels = {"role": r["name"]}

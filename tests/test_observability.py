@@ -40,8 +40,10 @@ def test_kernel_profile_records_occupancy_and_compiles():
         # the minimum-size bucket all three batches land in (other
         # tests in this process may have populated other buckets):
         # verdict-only (resolve goes through the noattr variant) with
-        # the donated history carry the model wrappers request
-        bucket = "resolve[1024c/16t/32r/32w/noattr/don]"
+        # the donated history carry the model wrappers request — via
+        # the packed single-buffer feed entry point (ISSUE 14), the
+        # default interval dispatch family
+        bucket = "resolve_packed[1024c/16t/32r/32w/noattr/don]"
         assert kernels[f"{bucket}.compiles"] >= 1
         assert kernels[f"{bucket}.calls"] >= 3
         # the compile was timed via the block_until_ready fence
