@@ -2,8 +2,8 @@
 
 from .error import ActorCancelled, FdbError, error, internal_error
 from .future import Future, Promise, Task, error_future, ready_future
-from .scheduler import (Scheduler, TaskPriority, WakeSignal, delay, g, now,
-                        set_scheduler, spawn)
+from .scheduler import (Scheduler, TaskPriority, WakeSignal, delay, g,
+                        get_scheduler, now, set_scheduler, spawn)
 from .actors import (
     ActorCollection,
     AsyncTrigger,
@@ -33,8 +33,8 @@ from . import coverage, trace
 __all__ = [
     "ActorCancelled", "FdbError", "error", "internal_error",
     "Future", "Promise", "Task", "error_future", "ready_future",
-    "Scheduler", "TaskPriority", "WakeSignal", "delay", "g", "now",
-    "set_scheduler", "spawn",
+    "Scheduler", "TaskPriority", "WakeSignal", "delay", "g",
+    "get_scheduler", "now", "set_scheduler", "spawn",
     "ActorCollection", "AsyncTrigger", "AsyncVar", "FlowLock", "FutureStream",
     "NotifiedVersion", "PromiseStream", "all_of", "catch_errors",
     "first_of", "timeout",

@@ -504,6 +504,42 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("RK_SCHED_DEFER_LIMIT", 48.0, lambda: 2.0)
     init("RK_SCHED_DEFER_SPRING", 24.0)
 
+    # -- dynamic resolver split/merge (ISSUE 15; ref: resolutionBalancing
+    # + the keyResolvers history map, masterserver.actor.cpp:1008 /
+    # MasterProxyServer.actor.cpp:204). The cluster-controller balance
+    # loop watches per-resolver load and, on skew, moves a key range
+    # with LIVE state handoff: donor checkpoint -> clip -> install on
+    # the recipient -> early release of the former owner. Default OFF
+    # (the commit path and the sim event schedule are byte-identical
+    # until armed); deliberately NOT buggified — a new buggify site
+    # would shift the shared randomization stream and invalidate every
+    # seeded chaos baseline (the PR 14 discipline). Chaos cells arm it
+    # explicitly via CHAOS_SPLITS=1.
+    init("RESOLVER_BALANCE", 0)
+    init("RESOLVER_BALANCE_INTERVAL", 0.5)
+    # minimum per-round work delta on the loaded resolver before a
+    # split is considered, and the max/min skew factor that triggers it
+    init("RESOLVER_BALANCE_MIN_WORK", 100)
+    init("RESOLVER_BALANCE_SKEW", 2.0)
+    # a moved range whose traffic fell below this share of MIN_WORK is
+    # merged back to its former owner (the symmetric stitch)
+    init("RESOLVER_BALANCE_MERGE_WORK", 10)
+    # test-only trigger: treat the thresholds as met on the first round
+    # with ANY donor work, so smoke/CI can force one split under a
+    # small seeded workload
+    init("RESOLVER_BALANCE_FORCE", 0)
+    # bound on each handoff RPC (checkpoint / install); a timed-out
+    # handoff falls back to the reference's window-only semantics (the
+    # former owner keeps voting for a full MVCC window) — correct,
+    # just slower to retire the donor
+    init("RESOLVER_HANDOFF_TIMEOUT", 5.0)
+    # modeled resolver service time per transaction (seconds), the
+    # system-bench saturation model (tools/clusterbench.py): resolution
+    # cost is what the source paper scales against (arXiv:1804.00947),
+    # and the sim otherwise resolves in zero sim time, hiding the
+    # resolver axis entirely. Default 0.0 = off = byte-identical.
+    init("SIM_RESOLVE_COST_PER_TXN", 0.0)
+
     # -- conflict-backend fault tolerance (models/failover.py) ---------
     # per-seam probability of a simulated device fault at the
     # submit/materialize/drain boundaries (ops/fault_injection.py).

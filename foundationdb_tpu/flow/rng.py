@@ -98,3 +98,26 @@ def set_seed(seed: int, buggify_enabled: bool = False) -> None:
 
 def buggify(site: str) -> bool:
     return g_buggify(site)
+
+
+def rng_state() -> tuple:
+    """Opaque snapshot of the ambient RNG + BUGGIFY state, for tools
+    that call set_seed() inside a process that may already be running
+    a seeded simulation (networktest, clusterbench): capture before,
+    restore_rng_state() in a finally — or the tool silently desyncs
+    the caller's deterministic stream."""
+    return (g_random.seed, g_random._r.getstate(), g_buggify.rng,
+            g_buggify.enabled, dict(g_buggify._sites))
+
+
+def restore_rng_state(state: tuple) -> None:
+    seed, rstate, brng, benabled, sites = state
+    g_random.seed = seed
+    g_random._r.setstate(rstate)
+    # the displaced fork object was untouched while we ran (set_seed
+    # replaced it wholesale), so restoring the reference restores its
+    # exact stream position
+    g_buggify.rng = brng
+    g_buggify.enabled = benabled
+    g_buggify._sites.clear()
+    g_buggify._sites.update(sites)

@@ -611,6 +611,15 @@ def set_scheduler(s: Optional[Scheduler]) -> None:
     _tls.current = s
 
 
+def get_scheduler() -> Optional[Scheduler]:
+    """The thread's ambient scheduler, or None — the save half of the
+    save/restore discipline tools hosting their OWN loop must follow
+    (tools/networktest.py, tools/clusterbench.py): a tool that leaves
+    its private scheduler installed corrupts whatever flow-driven
+    caller invoked it."""
+    return _tls.current
+
+
 def g() -> Scheduler:
     if _tls.current is None:
         raise error("internal_error")

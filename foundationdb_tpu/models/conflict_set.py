@@ -135,6 +135,107 @@ def clip_step(keys: Sequence[bytes], vals: Sequence[int], lo: bytes,
     return out_k, out_v
 
 
+class ConflictRangePiece(NamedTuple):
+    """One key range's slice of a conflict-set checkpoint — the unit of
+    resolver state handoff (ISSUE 15: a balance-driven split moves
+    [begin, end) from donor to recipient; the donor's clipped step
+    function rides the wire inside this piece and is grafted into the
+    recipient with `graft_checkpoint`).
+
+    `keys`/`vals` are a clip_step-shaped step function over [begin,
+    end): keys[0] == begin, vals[i] covers [keys[i], keys[i+1}) with
+    the last interval running to `end` (None = keyspace tail).
+    `oldest_version`/`last_commit` carry the donor's MVCC window so the
+    graft can only ever ADVANCE the recipient's floor."""
+
+    begin: bytes
+    end: "bytes | None"
+    keys: tuple
+    vals: tuple
+    oldest_version: int
+    last_commit: int
+
+
+def clip_checkpoint(ckpt: ConflictSetCheckpoint, lo: bytes,
+                    hi: "bytes | None") -> ConflictRangePiece:
+    """The [lo, hi) slice of a checkpoint as a handoff piece."""
+    keys, vals = step_from_checkpoint(ckpt)
+    ck, cv = clip_step(keys, vals, lo, hi)
+    return ConflictRangePiece(lo, hi, tuple(ck), tuple(cv),
+                              int(ckpt.oldest_version),
+                              int(ckpt.last_commit))
+
+
+def _step_at(keys: Sequence[bytes], vals: Sequence[int],
+             key: bytes) -> int:
+    """Value of the covering interval at `key` (keys[0] <= key)."""
+    return int(vals[bisect_right(keys, key) - 1])
+
+
+def graft_checkpoint(base: ConflictSetCheckpoint,
+                     piece: ConflictRangePiece) -> ConflictSetCheckpoint:
+    """Merge a handoff piece into a full checkpoint: outside the
+    piece's span the base is untouched; inside, each interval takes the
+    POINTWISE MAX of base and piece. Max — not replace — because step
+    values are monotone (assignments only ever raise a key's version),
+    so whichever side saw a write later holds the higher version: the
+    recipient may already have recorded post-move writes the donor's
+    checkpoint predates, and the piece holds pre-move history the
+    recipient never saw. The union is exactly the unsplit oracle's
+    step function over the span — the bit-exactness the handoff tests
+    pin.
+
+    Watermark discipline under in-flight skew (the donor checkpoints
+    at/after the move's effective version; the recipient's install may
+    land while it is still resolving earlier batches): the recipient's
+    GLOBAL `oldest_version` is KEPT — adopting the donor's (possibly
+    further-advanced) watermark would flip near-window-boundary reads
+    in the recipient's in-flight batches to tooOld verdicts the
+    unsplit oracle never issues. Piece values that were DEAD at the
+    donor (below the donor's watermark — including the donor's own
+    dead-clamp rows) are re-clamped below the RECIPIENT's watermark:
+    a donor clamp value can exceed an in-flight batch's legal read
+    snapshot, which would manufacture conflicts; dropping such a value
+    loses nothing, because during the double-delivery window the donor
+    still votes with full history, and after the early release every
+    legal snapshot is above the donor's watermark (the release rides
+    the version chain behind the checkpoint). `last_commit` takes the
+    max — it is restore-replay metadata, and the span carries writes
+    up to the donor's chain position."""
+    bk, bv = step_from_checkpoint(base)
+    lo, hi = piece.begin, piece.end
+    pk, pv = list(piece.keys), list(piece.vals)
+    if not pk or pk[0] != lo:
+        raise ValueError("piece step must start at its own begin key")
+    oldest = int(base.oldest_version)
+    # dead-equivalent value, floored at 0: no read snapshot is ever
+    # negative, so 0 can never out-version a legal read, and device
+    # backends need non-negative versions
+    dead_v = max(0, oldest - 1)
+    piece_oldest = int(piece.oldest_version)
+    # candidate boundaries: the base's, the piece's, plus the span
+    # edges; value at each = base outside the span, max(base, piece)
+    # inside; equal neighbors coalesce
+    bounds = set(bk) | set(pk) | {lo}
+    if hi is not None:
+        bounds.add(hi)
+    out_k: list[bytes] = []
+    out_v: list[int] = []
+    for k in sorted(bounds):
+        v = _step_at(bk, bv, k)
+        if k >= lo and (hi is None or k < hi):
+            p = _step_at(pk, pv, k)
+            if p < piece_oldest:
+                p = min(p, dead_v)
+            v = max(v, p)
+        if out_k and out_v[-1] == v:
+            continue
+        out_k.append(k)
+        out_v.append(v)
+    last_commit = max(int(base.last_commit), int(piece.last_commit))
+    return checkpoint_from_step(out_k, out_v, oldest, last_commit)
+
+
 class ResolverTransaction(NamedTuple):
     """One transaction's conflict information (ref: CommitTransactionRef,
     fdbclient/CommitTransaction.h:136-168 — read/write conflict ranges +
@@ -663,3 +764,13 @@ class BruteForceConflictSet(ConflictSetBase):
         if new_oldest_version > self._oldest:
             self._oldest = new_oldest_version
         return verdicts
+
+
+# ConflictRangePiece (and the checkpoint it slices) cross the wire in
+# the resolver split/merge handoff RPCs (server/resolver_role.py), so
+# both are RPC vocabulary; rpc.wire imports nothing from models, so
+# the targeted registration is cycle-free.
+from ..rpc import wire as _wire
+
+_wire.register_message(ConflictSetCheckpoint)
+_wire.register_message(ConflictRangePiece)

@@ -30,13 +30,46 @@ from .tcp import TcpRequestStream, TcpTransport
 
 DESCRIBE_TOKEN = 1
 
+# request payload selecting the PEER describe (see _translate_peers):
+# role endpoints — master version authority, resolver resolve/handoff,
+# tlog commit, proxy raw-committed — for an out-of-process PEER
+# (a proxy worker in tools/clusterbench.py), not a client
+PEER_DESCRIBE = "peers"
+
+
+async def forward_stream(stream: TcpRequestStream, ref, src) -> None:
+    """Forward every frame arriving on a TCP endpoint into a sim
+    NetworkRef and relay the reply — the role-endpoint serving seam
+    shared by the gateway and clusterbench's worker processes."""
+
+    async def one(req, reply):
+        try:
+            reply.send(await ref.get_reply(req, src))
+        except flow.FdbError as e:
+            reply.send_error(e)
+        except Exception:  # noqa: BLE001 — a bad frame fails only itself
+            reply.send_error(error("internal_error"))
+
+    while True:
+        req, reply = await stream.pop()
+        flow.spawn(one(req, reply))
+
 
 class TcpGateway:
-    """Serve a cluster (via its client `Database` handle) over TCP."""
+    """Serve a cluster (via its client `Database` handle) over TCP.
+
+    Two endpoint classes share the transport: CLIENT endpoints (proxy
+    GRV/commit, storage reads — the original describe document) and,
+    when a cluster object is attached, PEER endpoints (ISSUE 15):
+    master version authority, per-resolver resolve + handoff streams,
+    per-tlog commit streams and per-proxy raw-committed probes, so
+    out-of-process PEER ROLES — clusterbench's proxy workers — can join
+    the commit pipeline over the real wire, not just clients."""
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
-                 tls=None, protocol: bytes = None):
+                 tls=None, protocol: bytes = None, cluster=None):
         self.db = db
+        self.cluster = cluster
         self.transport = TcpTransport(host, port, tls=tls,
                                       protocol=protocol)
         self._describe = TcpRequestStream(self.transport)
@@ -84,17 +117,7 @@ class TcpGateway:
         return token
 
     async def _forward_loop(self, stream: TcpRequestStream, ref) -> None:
-        while True:
-            req, reply = await stream.pop()
-            flow.spawn(self._forward_one(ref, req, reply))
-
-    async def _forward_one(self, ref, req, reply) -> None:
-        try:
-            reply.send(await ref.get_reply(req, self.db.process))
-        except flow.FdbError as e:
-            reply.send_error(e)
-        except Exception:  # noqa: BLE001 — a bad frame fails only itself
-            reply.send_error(error("internal_error"))
+        await forward_stream(stream, ref, self.db.process)
 
     # -- describe --------------------------------------------------------
     async def _describe_loop(self) -> None:
@@ -106,8 +129,13 @@ class TcpGateway:
         """Request payload: the newest dbinfo seq the client has seen
         (-1 for "whatever is current"). A non-negative seq long-polls
         the CC until the broadcast picture moves past it (the client's
-        post-failure refresh), mirroring Database.refresh_past."""
+        post-failure refresh), mirroring Database.refresh_past. The
+        string payload "peers" selects the peer-role document instead
+        (requires the gateway to be attached to its cluster)."""
         try:
+            if min_seq == PEER_DESCRIBE:
+                reply.send(self._translate_peers())
+                return
             if isinstance(min_seq, int) and min_seq >= 0:
                 await self.db.refresh_past(min_seq)
             info = await self.db.info()
@@ -116,6 +144,61 @@ class TcpGateway:
             reply.send_error(e)
         except Exception:  # noqa: BLE001
             reply.send_error(error("internal_error"))
+
+    def _translate_peers(self) -> dict:
+        """The transaction subsystem's ROLE endpoints as TCP tokens
+        (ISSUE 15): everything an out-of-process proxy needs to join
+        the commit pipeline — the master's version authority, every
+        current-epoch resolver's resolve + handoff streams, every
+        tlog's commit stream, every in-cluster proxy's raw-committed
+        probe (GRV causal confirmation), and the routing config
+        (initial resolver splits — the master's version replies replay
+        the whole move log onto them, so a late joiner reconstructs
+        the exact current keyResolvers map — plus storage splits/tags
+        and the recovery version)."""
+        if self.cluster is None:
+            raise error("client_invalid_operation")
+        from ..server.cluster_controller import epoch_roles
+        from ..server.master import initial_resolver_splits
+        from ..server.proxy import Proxy
+        from ..server.resolver_role import Resolver
+        cc = self.cluster.cc
+        info = cc.dbinfo.get()
+        rec = cc._recovery
+        if rec is None or rec.master is None or not info.proxies:
+            # mid-recovery: peers retry exactly like stale clients
+            raise error("broken_promise")
+
+        def by_index(pairs):
+            return sorted(pairs, key=lambda p: int(p[0].rsplit("-", 1)[1]))
+
+        resolvers = by_index(list(
+            epoch_roles(cc.workers, info.epoch, Resolver)))
+        proxies = by_index(list(
+            epoch_roles(cc.workers, info.epoch, Proxy)))
+        n_res = len(resolvers)
+        first_proxy = proxies[0][1]
+        return {
+            "epoch": info.epoch,
+            "recovery_version": info.recovery_version,
+            "master": self._expose(rec.master.version_requests.ref()),
+            "resolvers": [
+                {"name": rn,
+                 "resolves": self._expose(r.resolves.ref()),
+                 "handoffs": self._expose(r.handoffs.ref())}
+                for rn, r in resolvers],
+            "tlogs": [self._expose(lr.commits)
+                      for lr in info.logs.logs],
+            "proxy_raw_committed": [
+                self._expose(p.raw_committed.ref())
+                for _rn, p in proxies],
+            # recruitment-time resolver splits (THE shared formula —
+            # server/master.py); the move-log replay reconstructs the
+            # live map from them
+            "resolver_splits": list(initial_resolver_splits(n_res)),
+            "storage_splits": list(first_proxy._sbounds[1:-1]),
+            "storage_tags": list(first_proxy._stags),
+        }
 
     def _translate(self, info) -> dict:
         """ServerDBInfo with every NetworkRef replaced by a TCP token

@@ -281,6 +281,12 @@ class ClusterController:
         # costless — otherwise)
         self.storage_heat = StorageHeatTable()
         self._heat_tags: dict = {}  # server -> (tag hex, busyness)
+        # resolver split/merge accounting (ISSUE 15): the master's
+        # balance loop records every split/merge/release/handoff
+        # outcome here, so skew response is a status query
+        # (`status.cluster.resolver_balance`), not a trace grep
+        self.balance_stats = flow.CounterCollection("resolver_balance")
+        self.balance_last: "dict | None" = None
         # (instance name, counter) -> TimeSeries (ref: TDMetric levels)
         self.metrics: dict = {}
         self._metric_gauges: set = set()   # (rn, cn) sampled via set()
@@ -1453,10 +1459,21 @@ class ClusterController:
                 elif isinstance(role, Resolver) and \
                         f"-e{info.epoch}-" in rn:
                     kern = role.kernel_stats()
+                    rsnap = role.stats.snapshot()
                     resolvers.append({
                         "name": rn,
                         "version": role.version.get(),
-                        "counters": role.stats.snapshot(),
+                        "counters": rsnap,
+                        # split/merge visibility (ISSUE 15 satellite):
+                        # state rows + handoff counters per resolver;
+                        # owned_ranges is patched in below from a live
+                        # proxy's keyResolvers map
+                        "splits": {
+                            "state_rows": role.state_size(),
+                            "checkpoints_served":
+                                rsnap.get("split_checkpoints", 0),
+                            "installs": rsnap.get("range_installs", 0),
+                            "last_handoff": role.last_handoff},
                         "latency_bands": {
                             "resolve": role.resolve_bands.snapshot()},
                         # decaying conflict-attribution table: which
@@ -1480,6 +1497,19 @@ class ClusterController:
                         rn.endswith(f"-e{info.epoch}"):
                     rate = role.rate
                     rk_role = role
+        # per-resolver owned-range counts off a live proxy's
+        # keyResolvers map (every proxy applies moves at the same
+        # version, so any one is representative)
+        if proxy_roles and resolvers:
+            owned = proxy_roles[0].key_resolvers.owned_ranges(
+                len(resolvers))
+            for r in resolvers:
+                try:
+                    ridx = int(r["name"].rsplit("-", 1)[1])
+                except (ValueError, IndexError):
+                    continue
+                if 0 <= ridx < len(owned):
+                    r["splits"]["owned_ranges"] = owned[ridx]
         # cluster-level hot-spot view: merge every resolver's table by
         # range (keyspace-sharded resolvers each see disjoint causes)
         merged_hot: dict = {}
@@ -1571,6 +1601,11 @@ class ClusterController:
                 # backend instance in this process
                 "kernels": _global_kernel_counters(),
                 "qos": qos_doc,
+                # dynamic resolver split/merge rollup (ISSUE 15): the
+                # balance loop's split/merge/release/handoff counters
+                # and the last split it made — skew response as a
+                # status query
+                "resolver_balance": self._balance_doc(),
                 # conflict prediction & transaction repair rollup:
                 # the armed planes, cluster totals across the proxies,
                 # and the client-side conflict-window cache counters
@@ -1648,6 +1683,19 @@ class ClusterController:
                     "excluded": sorted(self.excluded),
                 },
             },
+        }
+
+    def _balance_doc(self) -> dict:
+        """status.cluster.resolver_balance: knob posture + the balance
+        loop's event counters + the last split made."""
+        snap = self.balance_stats.snapshot()
+        return {
+            "enabled": int(bool(flow.SERVER_KNOBS.resolver_balance)),
+            "splits": snap.get("splits", 0),
+            "merges": snap.get("merges", 0),
+            "releases": snap.get("releases", 0),
+            "handoff_timeouts": snap.get("handoff_timeouts", 0),
+            "last_split": self.balance_last,
         }
 
     @staticmethod

@@ -26,6 +26,7 @@ from ..rpc import RequestStream, SimProcess
 from . import dbinfo as dbi
 from .dbinfo import LogSetInfo, ServerDBInfo
 from .types import (RESOLUTION_METRICS_REQUEST, CommitRequest,
+                    ResolverCheckpointRequest, ResolverInstallRequest,
                     TLogLockRequest)
 
 
@@ -57,6 +58,17 @@ class CoreState(NamedTuple):
     # enabling epochEnd with remote logs,
     # TagPartitionedLogSystem.actor.cpp:1265)
     region_logs: Tuple[Tuple[str, str], ...] = ()
+
+
+def initial_resolver_splits(n_resolvers: int) -> Tuple[bytes, ...]:
+    """The recruitment-time keyspace partition across resolvers: even
+    first-byte buckets. THE formula — recruitment and the gateway's
+    peer-describe document (whose out-of-process proxies rebuild the
+    live keyResolvers map by replaying the move log onto these splits)
+    must agree, or remote proxies would clip conflict ranges against a
+    different base map than in-cluster ones."""
+    return tuple(bytes([(i * 256) // n_resolvers])
+                 for i in range(1, n_resolvers))
 
 
 class Master:
@@ -102,6 +114,21 @@ class Master:
         proxy — no cross-proxy apply skew by construction."""
         effective = self.version + 1
         self.resolver_moves.append((effective, begin, end, to_idx))
+        return effective
+
+    def register_release(self, begin: bytes, end, from_idx: int) -> int:
+        """Stamp an early FORMER-OWNER release (ISSUE 15's live
+        handoff): once the donor's clipped state is installed on the
+        new owner, the window's double delivery is redundant — every
+        proxy drops `from_idx` from [begin, end)'s owner history for
+        batches at/above the effective version. Safe because the
+        effective version is > the donor version the piece was cut at
+        (versions originate here, so the donor can never be ahead of
+        this authority), and the recipient's grafted state is exact
+        for every batch above that."""
+        effective = self.version + 1
+        self.resolver_moves.append((effective, begin, end, from_idx,
+                                    "release"))
         return effective
 
     async def _version_loop(self):
@@ -261,15 +288,16 @@ class MasterRecovery:
         res_workers = self.cc.pick_workers(cfg.n_resolvers, role="resolver")
         resolver_refs = []
         resolver_metrics = []
+        resolver_handoffs = []
         for i, w in enumerate(res_workers):
-            rref, mref = w.recruit_resolver(
+            rref, mref, href = w.recruit_resolver(
                 f"resolver-e{self.epoch}-{i}", recovery_version,
                 backend=cfg.conflict_backend)
             resolver_refs.append(rref)
             resolver_metrics.append(mref)
+            resolver_handoffs.append(href)
             self.critical_procs.add(w.process)
-        resolver_splits = tuple(bytes([(i * 256) // cfg.n_resolvers])
-                                for i in range(1, cfg.n_resolvers))
+        resolver_splits = initial_resolver_splits(cfg.n_resolvers)
         self.cc.recruit_initial_storages()
         # every tag's records are held until ALL of its replicas pop
         expected = {}
@@ -362,6 +390,17 @@ class MasterRecovery:
                 self._resolution_balancing(resolver_metrics),
                 TaskPriority.RESOLUTION_METRICS,
                 name=f"master-e{self.epoch}.resolutionBalancing"))
+            # load-driven split/merge with live state handoff (ISSUE
+            # 15) — spawned only when armed at recovery time, so the
+            # RESOLVER_BALANCE=0 posture adds not a single timer event
+            # to the sim schedule (byte-identical off, test-pinned)
+            if flow.SERVER_KNOBS.resolver_balance:
+                self.aux.add(flow.spawn(
+                    self._resolver_balance_loop(
+                        resolver_metrics, resolver_handoffs,
+                        resolver_splits, cfg.n_resolvers),
+                    TaskPriority.RESOLUTION_METRICS,
+                    name=f"master-e{self.epoch}.resolverBalance"))
         await self.aux.get_result()
 
     def _set_state(self, state: str) -> None:
@@ -466,6 +505,11 @@ class MasterRecovery:
         while True:
             await flow.delay(flow.SERVER_KNOBS.resolution_balancing_interval,
                              TaskPriority.RESOLUTION_METRICS)
+            if flow.SERVER_KNOBS.resolver_balance:
+                # the split/merge balance loop (ISSUE 15) is
+                # authoritative while armed: two movers would bounce
+                # ranges against each other
+                continue
             settled = await flow.all_of([flow.catch_errors(
                 flow.timeout_error(
                     ref.get_reply(RESOLUTION_METRICS_REQUEST,
@@ -499,6 +543,169 @@ class MasterRecovery:
             effective = self.master.register_move(begin, end, lo)
             self._trace("ResolutionBalancingMove", Bucket=bucket,
                         From=hi, To=lo, EffectiveVersion=effective)
+
+    async def _resolver_balance_loop(self, metric_refs, handoff_refs,
+                                     init_splits, n_resolvers) -> None:
+        """Load-driven resolver split/merge with LIVE state handoff
+        (ISSUE 15; ref: resolutionBalancing + the keyResolvers history,
+        masterserver.actor.cpp:1008 / MasterProxyServer.actor.cpp:204 —
+        grown with the checkpoint/clip/install machinery PR 5 built).
+
+        Per round: poll every resolver's cumulative work + first-byte
+        key histogram, diff against the last round, and when the skew
+        crosses the knob thresholds move the loaded resolver's hottest
+        OWNED byte bucket to the least-loaded one — but through the
+        full handoff protocol (`_handoff`), so the recipient votes
+        bit-exactly from its first post-move batch and the donor
+        retires early instead of double-delivering for a whole MVCC
+        window. A previously-split bucket whose traffic has died is
+        merged back to its original owner (the symmetric stitch).
+        Counters land on the CC (`resolver_balance` in status)."""
+        n = len(metric_refs)
+        last_work = [0] * n
+        last_hist = [[0] * 256 for _ in range(n)]
+        # shadow of the proxies' keyResolvers CURRENT ownership: every
+        # move goes through this loop, so applying our own moves keeps
+        # it exact (releases don't change current ownership)
+        from .proxy import KeyResolverMap
+        owners = KeyResolverMap(init_splits, n_resolvers)
+        splits_made: list = []   # (begin, end, from_idx, to_idx)
+        force_spent = False      # one-shot FORCE consumed for good
+        bal = self.cc.balance_stats
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.resolver_balance_interval,
+                             TaskPriority.RESOLUTION_METRICS)
+            k = flow.SERVER_KNOBS
+            if not k.resolver_balance:
+                continue
+            settled = await flow.all_of([flow.catch_errors(
+                flow.timeout_error(
+                    ref.get_reply(RESOLUTION_METRICS_REQUEST,
+                                  self.process),
+                    flow.SERVER_KNOBS.resolution_metrics_timeout))
+                for ref in metric_refs])
+            if any(f.is_error for f in settled):
+                continue
+            replies = [f.get() for f in settled]
+            dwork = [r.work_units - last_work[i]
+                     for i, r in enumerate(replies)]
+            dhist = [[r.key_hist[b] - last_hist[i][b] for b in range(256)]
+                     for i, r in enumerate(replies)]
+            last_work = [r.work_units for r in replies]
+            last_hist = [list(r.key_hist) for r in replies]
+
+            # merge pass first: stitch back any split whose traffic
+            # died, so a transient hot spot does not fragment the map
+            # forever (the sharded backend's upper-bound-row dedup
+            # makes the re-graft exact)
+            merged = None
+            for mv in splits_made:
+                begin, end, src, dst = mv
+                bucket = begin[0] if begin else 0
+                if dhist[dst][bucket] <= int(k.resolver_balance_merge_work):
+                    if await self._handoff(begin, end, dst, src,
+                                           handoff_refs, owners):
+                        bal.counter("merges").add(1)
+                        self._trace("ResolverBalanceMerge",
+                                    Bucket=bucket, From=dst, To=src)
+                        merged = mv
+                    break
+            if merged is not None:
+                splits_made.remove(merged)
+                continue
+
+            # FORCE is one-shot and STICKY: it exists so smoke/CI can
+            # make the FIRST split deterministic under a small
+            # workload; once consumed the real thresholds govern even
+            # if that split later merges away (deriving spent-ness
+            # from splits_made would re-arm after every merge and
+            # churn split/merge forever — review finding)
+            force = bool(k.resolver_balance_force) and not force_spent
+            hi = max(range(n), key=lambda i: dwork[i])
+            lo = min(range(n), key=lambda i: dwork[i])
+            if hi == lo or dwork[hi] <= 0:
+                continue
+            if not force:
+                if dwork[hi] < k.resolver_balance_min_work:
+                    continue
+                if dwork[hi] <= k.resolver_balance_skew * (dwork[lo] + 1):
+                    continue
+            # hottest byte bucket the donor CURRENTLY owns (the shadow
+            # map keeps picks honest after earlier rounds moved ranges)
+            owned = owners.owned_buckets(hi)
+            if not owned:
+                continue
+            bucket = max(owned, key=lambda b: dhist[hi][b])
+            moved = dhist[hi][bucket]
+            if moved <= 0:
+                continue
+            if not force and dwork[lo] + moved >= dwork[hi]:
+                continue   # a single-bucket hotspot never bounces
+            begin = bytes([bucket])
+            end = bytes([bucket + 1]) if bucket < 255 else None
+            if await self._handoff(begin, end, hi, lo, handoff_refs,
+                                   owners):
+                if force:
+                    force_spent = True
+                bal.counter("splits").add(1)
+                self.cc.balance_last = {
+                    "begin": begin.hex(),
+                    "end": end.hex() if end is not None else "",
+                    "from": hi, "to": lo,
+                    "work_moved": moved}
+                self._trace("ResolverBalanceSplit", Bucket=bucket,
+                            From=hi, To=lo, WorkMoved=moved)
+                splits_made.append((begin, end, hi, lo))
+
+    async def _handoff(self, begin, end, src: int, dst: int,
+                       handoff_refs, owners) -> bool:
+        """The live-handoff protocol for one range move:
+
+          1. register the move (rides the version chain; proxies start
+             double-delivering [begin, end) to src AND dst at E),
+          2. checkpoint the donor AT/ABOVE E (the request's
+             min_version parks on the donor's version chain, so the
+             clipped piece provably holds every pre-move write),
+          3. graft the piece into the recipient (pointwise max — exact
+             whatever post-E writes it already recorded), and
+          4. register the early release: proxies drop the donor from
+             the range's owner history at the next version, ending
+             double delivery a full MVCC window early.
+
+        A checkpoint/install failure (partitioned resolver, timeout)
+        leaves the move in the reference's window-only mode — the donor
+        keeps voting with complete history until the window passes, so
+        verdicts stay exact; only the early retirement is lost."""
+        timeout_s = float(flow.SERVER_KNOBS.resolver_handoff_timeout)
+        eff = self.master.register_move(begin, end, dst)
+        owners.move(begin, end, dst, eff)
+        bal = self.cc.balance_stats
+        try:
+            rep = await flow.timeout_error(
+                handoff_refs[src].get_reply(
+                    ResolverCheckpointRequest(begin, end, eff),
+                    self.process), timeout_s)
+            await flow.timeout_error(
+                handoff_refs[dst].get_reply(
+                    ResolverInstallRequest(begin, end, rep.piece),
+                    self.process), timeout_s)
+        except flow.FdbError as e:
+            if e.name == "operation_cancelled":
+                raise
+            flow.cover("master.resolver_balance.handoff_failed")
+            bal.counter("handoff_timeouts").add(1)
+            flow.TraceEvent("ResolverHandoffTimeout", self.process.name,
+                            severity=flow.trace.SevWarnAlways).detail(
+                Begin=begin.hex(), From=src, To=dst,
+                Error=e.name).log()
+            return True   # the move stands; window semantics cover it
+        rel = self.master.register_release(begin, end, src)
+        bal.counter("releases").add(1)
+        flow.cover("master.resolver_balance.handoff")
+        self._trace("ResolverHandoffComplete", Begin=begin.hex(),
+                    From=src, To=dst, CheckpointVersion=rep.version,
+                    ReleaseVersion=rel)
+        return True
 
     async def _cleanup_old_logs(self) -> None:
         """Drop a drained old generation from the broadcast picture once

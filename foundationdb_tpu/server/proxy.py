@@ -119,17 +119,78 @@ class KeyResolverMap:
             if self.owners[k][0][1] != to_idx:
                 self.owners[k] = [(at_version, to_idx)] + self.owners[k]
 
+    def expire(self, oldest_version: int) -> None:
+        """Drop former owners whose move predates the MVCC window floor
+        (the resolver GC watermark: any still-resolvable snapshot is
+        >= oldest, so a range whose move landed before it has complete
+        write history at the NEW owner). The canonical trim — `prune`
+        derives its commit-version form from this — and explicitly
+        invokable outside the commit path, so a long-idle map does not
+        retain owner history forever (ISSUE 15 satellite: the GRV serve
+        path calls this with the confirmed committed version's
+        watermark)."""
+        for ow in self.owners:
+            while len(ow) > 1 and ow[-2][0] < oldest_version:
+                ow.pop()
+
     def prune(self, commit_version: int) -> None:
         """Drop former owners once one full MVCC window has passed the
         move. No skew slack is needed: moves are versioned through the
         commit stream (Master.register_move), so every proxy applies a
         move at the same effective version."""
-        for ow in self.owners:
-            while len(ow) > 1 and ow[-2][0] + self.window < commit_version:
-                ow.pop()
+        self.expire(commit_version - self.window)
+
+    def release(self, begin: bytes, end, idx: int) -> None:
+        """Retire `idx` as a FORMER owner of [begin, end) ahead of the
+        window — the live-handoff fast path (ISSUE 15): once the
+        donor's clipped state is installed on the new owner, the master
+        registers a release through the version chain and double
+        delivery stops immediately instead of after a full MVCC window.
+        The CURRENT owner is never dropped (a release racing a newer
+        move must not orphan the range)."""
+        i = self._split_at(begin)
+        j = self._split_at(end) if end is not None else len(self.bounds)
+        for k in range(i, j):
+            ow = self.owners[k]
+            if len(ow) > 1:
+                kept = [ow[0]] + [t for t in ow[1:] if t[1] != idx]
+                if len(kept) != len(ow):
+                    self.owners[k] = kept
+
+    def apply(self, entry) -> None:
+        """Apply one version-stamped balance entry off the master's
+        move log: 4-tuples are moves (the original vocabulary),
+        5-tuples carry an op — "move" or "release"."""
+        eff, mb, me, idx = entry[:4]
+        if len(entry) > 4 and entry[4] == "release":
+            self.release(mb, me, idx)
+        else:
+            self.move(mb, me, idx, eff)
 
     def live_owners(self, k: int):
         return [idx for _v, idx in self.owners[k]]
+
+    def owner_of(self, key: bytes) -> int:
+        """CURRENT owner of `key` (newest history entry)."""
+        k = max(0, bisect_right(self.bounds, key) - 1)
+        return self.owners[k][0][1]
+
+    def owned_buckets(self, idx: int) -> list:
+        """First-byte buckets whose bucket-start key `idx` currently
+        owns — the balance loop's pick set (its moves are whole
+        buckets, so bucket starts are ownership-representative)."""
+        return [b for b in range(256)
+                if self.owner_of(bytes([b])) == idx]
+
+    def owned_ranges(self, n_resolvers: int) -> list:
+        """Per-resolver count of ranges currently OWNED (newest entry)
+        — the skew surface status/exporter/cli show before and after
+        the balancer acts."""
+        out = [0] * n_resolvers
+        for ow in self.owners:
+            if 0 <= ow[0][1] < n_resolvers:
+                out[ow[0][1]] += 1
+        return out
 
     def clip_per_resolver(self, txn_ranges, n_resolvers: int):
         """For each resolver, the pieces of `txn_ranges` it must see
@@ -265,6 +326,10 @@ class Proxy:
         self.batch_window = batch_window
         self.max_batch = max_batch
         self.committed_version = NotifiedVersion(recovery_version)
+        # the epoch's version floor: batches chaining from it are the
+        # resolvers' first, when their GC watermark is still 0 (the
+        # split path's proxy-side tooOld decision needs this)
+        self._recovery_version = recovery_version
         # pipeline interlocks sequence THIS proxy's batches by local
         # batch number (ref: localBatchNumber + latestLocalCommitBatch*
         # NotifiedVersions, MasterProxyServer.actor.cpp:453,:517); the
@@ -681,6 +746,12 @@ class Proxy:
                         "transactions_started_"
                         + PRIORITY_NAMES.get(prio, "default")).add(cnt)
             now = flow.now()
+            # keyResolvers retention (ISSUE 15 satellite): trim former
+            # owners from the GC watermark here too, so a long-idle
+            # commit path (moves applied, then traffic stopped) does
+            # not retain owner history until the NEXT commit batch —
+            # O(owned ranges), and a no-op on the single-resolver map
+            self.key_resolvers.expire(version - self.key_resolvers.window)
             # chaos station: "GRV handed out" — the kill-mid-commit
             # scenarios arm role deaths here (server/chaos.py)
             fire_station("MasterProxyServer.GRV.AfterReply")
@@ -925,8 +996,8 @@ class Proxy:
             # apply point is a property of the version chain, not of
             # per-proxy delivery timing (ref: keyResolvers riding the
             # commit stream, MasterProxyServer.actor.cpp:204)
-            for eff, mb, me, to_idx in ver.moves:
-                self.key_resolvers.move(mb, me, to_idx, eff)
+            for entry in ver.moves:
+                self.key_resolvers.apply(entry)
             self._moves_seen += len(ver.moves)
             self._mark(dbg,
                        "MasterProxyServer.commitBatch.GotCommitVersion")
@@ -1206,11 +1277,31 @@ class Proxy:
         move); every resolver sees every batch version (possibly with
         no transactions) so its NotifiedVersion ordering advances; a
         transaction's verdict is the min over the resolvers that saw it
-        (ref: ResolutionRequestBuilder :265-341, combine :585-592)."""
+        (ref: ResolutionRequestBuilder :265-341, combine :585-592).
+
+        tooOld is decided HERE, not per-slice (ISSUE 15): a resolver
+        whose clip holds only a tooOld transaction's WRITES would see
+        no read ranges, verdict it committed, and merge phantom writes
+        into its history — writes the unsplit oracle never records (a
+        tooOld txn contributes no ranges at all). The proxy can decide
+        it exactly: resolvers process the gapless version chain in
+        order, so at batch (prev -> v) every resolver's GC watermark is
+        precisely max(0, prev - MWTLV) — or 0 before the epoch's first
+        batch — and all resolvers agree. A tooOld transaction is
+        withheld from every resolver and combined as TOO_OLD."""
         n_res = len(self.resolver_refs)
         self.key_resolvers.prune(ver.version)
+        window = self.key_resolvers.window
+        res_oldest = 0 if ver.prev_version <= self._recovery_version \
+            else max(0, ver.prev_version - window)
         per = [[] for _ in range(n_res)]   # [(orig_idx, clipped_req)]
+        too_old = set()
         for idx, req in enumerate(reqs):
+            if req.read_conflict_ranges and \
+                    req.read_snapshot < res_oldest:
+                flow.cover("proxy.resolve_split.too_old_withheld")
+                too_old.add(idx)
+                continue
             rr_per = self.key_resolvers.clip_per_resolver(
                 req.read_conflict_ranges, n_res)
             wr_per = self.key_resolvers.clip_per_resolver(
@@ -1232,7 +1323,8 @@ class Proxy:
                                [r for _, r in plist])), self.process)
             for ref, plist in zip(self.resolver_refs, per)]
         results = await flow.all_of(futs)
-        combined = [COMMITTED] * len(reqs)
+        combined = [TOO_OLD if i in too_old else COMMITTED
+                    for i in range(len(reqs))]
         ranges: list = [()] * len(reqs)
         for plist, result in zip(per, results):
             verdicts, rngs = self._norm_verdicts(result, len(plist))

@@ -303,6 +303,40 @@ class ResolutionMetricsReply(NamedTuple):
     key_hist: Tuple[int, ...]   # 256 first-byte buckets
 
 
+# -- resolver split/merge handoff (ISSUE 15) ----------------------------
+# The balance loop's state-handoff RPCs: checkpoint-and-clip on the
+# donor, graft-install on the recipient (models/conflict_set.py
+# clip_checkpoint / graft_checkpoint). Both are served by the resolver
+# role's `splits` endpoint.
+
+
+class ResolverCheckpointRequest(NamedTuple):
+    """Donor side: checkpoint the conflict-set state and return the
+    [begin, end) slice as a ConflictRangePiece. `min_version` gates the
+    checkpoint on the resolver's version chain — the donor first
+    resolves every batch below the move's effective version, so the
+    piece provably covers all pre-move writes in the span."""
+
+    begin: bytes
+    end: Optional[bytes]     # None = keyspace tail
+    min_version: int = 0
+
+
+class ResolverCheckpointReply(NamedTuple):
+    piece: tuple             # ConflictRangePiece (wire-registered)
+    version: int             # donor's version when the piece was cut
+
+
+class ResolverInstallRequest(NamedTuple):
+    """Recipient side: graft the piece into the live conflict-set state
+    (pointwise max over the span — exact whatever post-move writes the
+    recipient already recorded). Replies the recipient's version."""
+
+    begin: bytes
+    end: Optional[bytes]
+    piece: tuple             # ConflictRangePiece
+
+
 class TLogLockReply(NamedTuple):
     end_version: int        # highest durable version in this log
     known_committed: int    # highest version known replicated log-set-wide

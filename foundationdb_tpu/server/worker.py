@@ -157,13 +157,13 @@ class Worker:
 
     def recruit_resolver(self, name: str, recovery_version: int,
                          backend: Optional[str] = None):
-        """Returns (resolves_ref, metrics_ref)."""
+        """Returns (resolves_ref, metrics_ref, handoffs_ref)."""
         self._check_alive()
         r = Resolver(self.process, backend=backend or self.conflict_backend,
                      recovery_version=recovery_version)
         r.start()
         self.roles[name] = r
-        return r.resolves.ref(), r.metrics.ref()
+        return r.resolves.ref(), r.metrics.ref(), r.handoffs.ref()
 
     def recruit_proxy(self, name: str, master_ref, resolver_refs, tlog_refs,
                       resolver_splits, storage_splits,

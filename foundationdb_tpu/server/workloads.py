@@ -1113,6 +1113,165 @@ class HotShardStorm:
         return out
 
 
+class SplitStorm:
+    """Seeded skewed workload proving a LOAD-DRIVEN resolver split
+    (ISSUE 15; driven by `tools/smoke.py --splits`): every key lives
+    under a handful of first-byte prefixes owned by ONE resolver of a
+    multi-resolver cluster, so the balance loop sees hard skew and —
+    armed — must split the donor's hottest bucket and hand its state
+    to the recipient live.
+
+    Three oracles ride along: (1) exactness — a slice of the traffic
+    is read-modify-write increments through ordinary retry loops, and
+    the final counter values must equal the increment counts exactly
+    (a lost or phantom conflict across the handoff window would break
+    the sums); (2) load share — the donor's share of resolved
+    transactions is sampled per window BEFORE and AFTER the first
+    split, and must measurably drop; (3) the report carries committed/
+    conflicted totals and a keyspace digest for same-seed comparisons."""
+
+    def __init__(self, cluster, dbs, rng, duration: float = 8.0,
+                 rate: float = 120.0, hot_prefixes: bytes = b"\x10\x18",
+                 counters: int = 3, max_inflight: int = 256,
+                 arm_at: "float | None" = None):
+        self.cluster = cluster
+        self.dbs = list(dbs)
+        self.rng = rng
+        self.duration = duration
+        # drop in the one-shot FORCE mid-storm (sim-seconds from
+        # start) so the donor's load share is sampled both BEFORE and
+        # AFTER the first split; None = caller manages the knobs
+        self.arm_at = arm_at
+        self.rate = rate
+        self.hot_prefixes = hot_prefixes
+        self.counters = counters
+        self.max_inflight = max_inflight
+        self.stats = {"issued": 0, "admitted": 0, "completed": 0,
+                      "conflicted": 0, "shed": 0, "increments": 0}
+
+    def _resolver_roles(self):
+        from .resolver_role import Resolver
+        info = self.cluster.cc.dbinfo.get()
+        from .cluster_controller import epoch_roles
+        return sorted(epoch_roles(self.cluster.cc.workers, info.epoch,
+                                  Resolver), key=lambda p: p[0])
+
+    def _resolved_counts(self) -> list:
+        return [r.stats.snapshot().get("transactions_resolved", 0)
+                for _n, r in self._resolver_roles()]
+
+    async def _one(self, i: int, key: bytes, incr_key) -> None:
+        from ..client import run_transaction
+        db = self.dbs[i % len(self.dbs)]
+        try:
+            if incr_key is not None:
+                async def body(tr):
+                    cur = await tr.get(incr_key)
+                    tr.set(incr_key, b"%d" % (int(cur or b"0") + 1))
+                await run_transaction(db, body, max_retries=500)
+                self.stats["increments"] += 1
+            else:
+                async def body(tr):
+                    tr.set(key, b"v%06d" % i)
+                await run_transaction(db, body, max_retries=50)
+            self.stats["completed"] += 1
+        except flow.FdbError as e:
+            if e.name == "operation_cancelled":
+                raise
+            self.stats["conflicted"] += 1
+        finally:
+            self._inflight -= 1
+
+    async def run(self) -> dict:
+        from .chaos import database_digest
+        from .consistency import check_consistency
+        g = self.rng.fork()
+        self._inflight = 0
+        incr_keys = [bytes([self.hot_prefixes[0]]) + b"ctr%d" % c
+                     for c in range(self.counters)]
+        expected = [0] * self.counters
+        bal0 = dict(self.cluster.cc.balance_stats.snapshot())
+        share_samples: list = []   # (splits_so_far, donor_share)
+        last = self._resolved_counts()
+        t_end = flow.now() + self.duration
+        arm_t = flow.now() + self.arm_at if self.arm_at is not None \
+            else None
+        next_sample = flow.now() + 1.0
+        i = 0
+        while flow.now() < t_end:
+            if arm_t is not None and flow.now() >= arm_t:
+                # the loop itself must already be spawned (the cluster
+                # booted with RESOLVER_BALANCE=1 and an unreachable
+                # MIN_WORK); dropping in the one-shot FORCE here makes
+                # the first split land mid-storm, with load-share
+                # samples on both sides of it
+                arm_t = None
+                flow.SERVER_KNOBS.set("resolver_balance_force", 1)
+            self.stats["issued"] += 1
+            if self._inflight < self.max_inflight:
+                self.stats["admitted"] += 1
+                self._inflight += 1
+                if g.random01() < 0.25:
+                    c = g.random_int(0, self.counters)
+                    expected[c] += 1
+                    flow.spawn(self._one(i, b"", incr_keys[c]))
+                else:
+                    pfx = self.hot_prefixes[
+                        g.random_int(0, len(self.hot_prefixes))]
+                    key = bytes([pfx]) + b"k%06d" % i
+                    flow.spawn(self._one(i, key, None))
+            else:
+                self.stats["shed"] += 1
+            i += 1
+            await flow.delay(g.random_exp(1.0 / self.rate))
+            if flow.now() >= next_sample:
+                next_sample = flow.now() + 1.0
+                cur = self._resolved_counts()
+                delta = [c - l for c, l in zip(cur, last)]
+                last = cur
+                tot = sum(delta)
+                if tot > 0 and delta:
+                    splits = self.cluster.cc.balance_stats.snapshot() \
+                        .get("splits", 0) - bal0.get("splits", 0)
+                    share_samples.append(
+                        (splits, round(max(delta) / tot, 4)))
+        # drain UNCONDITIONALLY before reading the oracle: a deadline
+        # cutoff here would race in-flight increments against the
+        # counter read and fail `exact` spuriously (the harness's
+        # run(timeout_time=) bounds a genuine wedge)
+        while self._inflight > 0:
+            await flow.delay(0.1)
+        # oracle 1: exact sums
+        vals = []
+        from ..client import run_transaction
+        async def read_all(tr):
+            vals.clear()
+            for k in incr_keys:
+                vals.append(int(await tr.get(k) or b"0"))
+        await run_transaction(self.dbs[0], read_all)
+        exact = vals == expected
+        await check_consistency(self.cluster)
+        digest = await database_digest(self.dbs[0])
+        bal = self.cluster.cc.balance_stats.snapshot()
+        # oracle 2: donor load share before vs after the first split
+        before = [s for n, s in share_samples if n == 0]
+        after = [s for n, s in share_samples if n > 0]
+        report = {
+            "stats": dict(self.stats),
+            "expected": expected, "observed": vals, "exact": exact,
+            "balance": {k: bal.get(k, 0) - bal0.get(k, 0)
+                        for k in ("splits", "merges", "releases",
+                                  "handoff_timeouts")},
+            "share_before": round(sum(before) / len(before), 4)
+            if before else None,
+            "share_after": round(sum(after) / len(after), 4)
+            if after else None,
+            "consistency": "ok",
+            "digest": digest,
+        }
+        return report
+
+
 class ChaosStorm:
     """One named chaos scenario applied mid-flight under open-loop
     traffic, healed, quiesced, and VERIFIED (ref: the reference's

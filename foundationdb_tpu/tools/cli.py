@@ -167,6 +167,7 @@ def _render_details(cl: dict) -> str:
                 f"occ={occ if occ is not None else '-'} "
                 f"submit_p50={sub.get('p50', 0):g}s "
                 f"drain_p50={dr.get('p50', 0):g}s")
+    lines.extend(_balance_lines(cl))
     fos = [(r["name"], r["failover"]) for r in cl.get("resolvers", ())
            if r.get("failover")]
     if fos:
@@ -358,6 +359,39 @@ def _sim_perf_lines(cl: dict) -> List[str]:
     return lines
 
 
+def _balance_lines(cl: dict) -> List[str]:
+    """The resolver split/merge view (ISSUE 15) — per-resolver owned
+    ranges + state rows, the balance loop's event counters, and the
+    last split key — shared by `status details` and `top` so skew is
+    visible before and after the balancer acts."""
+    bal = cl.get("resolver_balance") or {}
+    resolvers = cl.get("resolvers") or ()
+    if not bal and not any(r.get("splits") for r in resolvers):
+        return []
+    armed = "armed" if bal.get("enabled") else "off"
+    lines = [f"Resolver balance ({armed}): "
+             f"splits={bal.get('splits', 0)} "
+             f"merges={bal.get('merges', 0)} "
+             f"releases={bal.get('releases', 0)} "
+             f"handoff_timeouts={bal.get('handoff_timeouts', 0)}"]
+    last = bal.get("last_split")
+    if last:
+        lines.append(f"  last split [{last.get('begin')}, "
+                     f"{last.get('end') or 'ff..'}) "
+                     f"resolver {last.get('from')} -> {last.get('to')} "
+                     f"(work moved {last.get('work_moved')})")
+    for r in resolvers:
+        sp = r.get("splits") or {}
+        if sp:
+            lines.append(
+                f"  {r['name']}: owned_ranges="
+                f"{sp.get('owned_ranges', '-')} "
+                f"state_rows={sp.get('state_rows', 0)} "
+                f"checkpoints={sp.get('checkpoints_served', 0)} "
+                f"installs={sp.get('installs', 0)}")
+    return lines
+
+
 def _hot_spot_and_message_lines(cl: dict) -> List[str]:
     """The conflict-hot-spot table + health messages — shared by
     `status details` and `top`."""
@@ -389,6 +423,7 @@ def _render_top(cl: dict) -> str:
     (what an operator looks at when high_conflict_rate fires), then the
     busiest role counters by sampled rate."""
     lines = _hot_spot_and_message_lines(cl)
+    lines.extend(_balance_lines(cl))
     watch = ("transactions_committed", "transactions_conflicted",
              "transactions_started", "batches_resolved",
              "transactions_resolved", "conflict_ranges_attributed",

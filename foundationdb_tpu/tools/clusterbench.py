@@ -1,0 +1,515 @@
+"""System bench: committed-txn/s across N proxies × M resolvers —
+in-process (simulated time) and across OS processes over real TCP.
+
+The kernel benches (bench.py) measure the resolver core; this driver
+measures the SYSTEM the roadmap says to optimize (ROADMAP item 2): a
+seeded open-loop commit workload driven through the whole pipeline —
+GRV, batcher, version authority, resolver fan-out, log push — at every
+shape in {1,2,4} proxies × {1,2,4} resolvers.
+
+Two modes, two honest units:
+
+- **in-process** (`--mode inprocess`): one SimCluster per cell on the
+  virtual clock; committed-txn/s is SIM-time throughput. Saturation
+  comes from the two modeled serial resources: the per-proxy commit
+  cadence (one master version round-trip per batch, batch size capped
+  by COMMIT_TRANSACTION_BATCH_COUNT_MAX for the bench) and the modeled
+  resolver service time (SIM_RESOLVE_COST_PER_TXN — resolution cost is
+  the quantity the source paper scales against, arXiv:1804.00947; the
+  sim otherwise resolves in zero sim time and the resolver axis would
+  be invisible). Adding proxies multiplies batch cadence; adding
+  resolvers divides per-resolver service load (contention-light keys
+  split evenly across the keyspace shards).
+
+- **across-process** (`--mode tcp`, `--processes N`): the cluster —
+  master, resolvers, tlogs, storage — runs wall-clock in THIS process
+  behind a TcpGateway serving PEER endpoints (rpc/gateway.py,
+  ISSUE 15); N proxy WORKER processes each build a real `Proxy` role
+  from the peer-describe document and join the commit pipeline over
+  rpc/tcp.py — resolver and tlog traffic crosses real sockets.
+  Committed-txn/s is WALL-time throughput, and the workload must
+  complete with ZERO divergent verdicts (contention-light disjoint
+  keys: every arrival must commit; any conflict/too-old is a
+  divergence).
+
+`--matrix` runs both modes over the full grid and writes the
+SYSBENCH_rNN.json artifact published in PERF.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from .. import flow
+from ..flow import rng as _rng
+from ..flow.future import Promise
+
+GRID = (1, 2, 4)
+# bench saturation model (see module docstring): commit batches capped
+# small so the per-proxy cadence (one master RTT per batch) binds, and
+# a modeled resolver service time so the resolver axis is real
+BATCH_CAP = 8
+RESOLVE_COST = 400e-6          # seconds per txn at the resolver
+REPORT_PATH = "/tmp/_clusterbench_report.json"
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(len(sorted_vals) * q))
+    return sorted_vals[i]
+
+
+def _lat_ms(vals: list) -> dict:
+    vals = sorted(vals)
+    return {"p50_ms": round(_percentile(vals, 0.50) * 1e3, 3),
+            "p90_ms": round(_percentile(vals, 0.90) * 1e3, 3),
+            "p99_ms": round(_percentile(vals, 0.99) * 1e3, 3)}
+
+
+async def _drive_commits(grv_send, commit_send, *, seed: int,
+                         duration: float, rate: float, key_prefix: bytes,
+                         max_inflight: int = 2048,
+                         clock=None) -> dict:
+    """The shared seeded open-loop commit workload: exponential
+    arrivals at `rate` for `duration` seconds (sim or wall — `clock`
+    decides what the latency numbers mean), each a GRV + a one-key
+    read/write commit on its own UNIQUE key (contention-light by
+    construction: any non-committed verdict is a divergence, not
+    noise). Keys spread uniformly over the first byte so keyspace-split
+    resolvers share the load. Arrivals past `max_inflight` are shed
+    and counted, never hidden (the PR 10 attainment discipline).
+
+    `grv_send(req, reply)` / `commit_send(i, req, reply)` inject into
+    a proxy's streams — in-process these round-robin the SimCluster's
+    proxies; in a TCP worker they feed the worker's own Proxy role."""
+    from ..server.types import (CommitRequest, GetReadVersionRequest,
+                                MutationRef, SET_VALUE)
+    if clock is None:
+        clock = flow.now
+    g = flow.g_random.fork()
+    counts = {"offered": 0, "shed": 0, "committed": 0, "conflicted": 0,
+              "too_old": 0, "errors": 0}
+    grv_lat: List[float] = []
+    commit_lat: List[float] = []
+    inflight = [0]
+    done = flow.Promise()
+
+    async def one(i: int) -> None:
+        # the random byte LEADS the key: resolver ownership splits on
+        # the first byte, so a uniform lead byte spreads the load
+        # across every keyspace shard (the prefix keeps workers'
+        # keyspaces disjoint)
+        key = (bytes([g.random_int(0, 256)]) + key_prefix
+               + b"%08d" % i)
+        try:
+            t0 = clock()
+            reply = Promise()
+            grv_send(GetReadVersionRequest(), reply)
+            ver = (await reply.future).version
+            grv_lat.append(clock() - t0)
+            t1 = clock()
+            reply = Promise()
+            commit_send(i, CommitRequest(
+                ver, ((key, key + b"\x00"),), ((key, key + b"\x00"),),
+                (MutationRef(SET_VALUE, key, b"v"),)), reply)
+            await reply.future
+            commit_lat.append(clock() - t1)
+            counts["committed"] += 1
+        except flow.FdbError as e:
+            if e.name == "operation_cancelled":
+                raise
+            if e.name == "not_committed":
+                counts["conflicted"] += 1
+            elif e.name == "transaction_too_old":
+                counts["too_old"] += 1
+            else:
+                counts["errors"] += 1
+        finally:
+            inflight[0] -= 1
+            if counts["offered"] >= total[0] and inflight[0] == 0 \
+                    and not done.is_set:
+                done.send(None)
+
+    # seeded open-loop schedule: one RNG fork, exponential gaps
+    total = [1 << 30]
+    start = clock()
+    t_end = flow.now() + duration
+    i = 0
+    while flow.now() < t_end:
+        if inflight[0] < max_inflight:
+            counts["offered"] += 1
+            inflight[0] += 1
+            flow.spawn(one(i))
+        else:
+            counts["shed"] += 1
+        i += 1
+        gap = g.random_exp(1.0 / rate) if rate > 0 else 0.001
+        await flow.delay(gap)
+    total[0] = counts["offered"]
+    if inflight[0] > 0 and not done.is_set:
+        await flow.timeout(done.future, 30.0)
+    admitted = counts["offered"]
+    counts["attainment"] = round(
+        admitted / max(1, admitted + counts["shed"]), 4)
+    # throughput over the REAL window (arrivals + drain), not the
+    # nominal duration: a saturated cell's stragglers land after
+    # t_end, and crediting them against `duration` would overstate
+    counts["elapsed"] = round(clock() - start, 3)
+    counts["txn_per_s"] = round(
+        counts["committed"] / max(1e-9, counts["elapsed"]), 1)
+    counts["grv"] = _lat_ms(grv_lat)
+    counts["commit"] = _lat_ms(commit_lat)
+    return counts
+
+
+# ---------------------------------------------------------------- in-process
+def run_inprocess_cell(n_proxies: int, n_resolvers: int, *, seed: int,
+                       duration: float, rate: float,
+                       out=lambda *a, **k: None) -> dict:
+    """One simulated cell: committed-txn/s in SIM time at this shape."""
+    prev_sched = flow.get_scheduler()
+    prev_rng = _rng.rng_state()
+    cluster = None
+    try:
+        from ..server import SimCluster
+        from ..server import dbinfo as dbi
+        from ..server.proxy import Proxy
+        cluster = SimCluster(seed=seed, n_proxies=n_proxies,
+                             n_resolvers=n_resolvers, n_storage=1,
+                             n_logs=1)
+        flow.SERVER_KNOBS.set("sim_resolve_cost_per_txn", RESOLVE_COST)
+        flow.SERVER_KNOBS.set("commit_transaction_batch_count_max",
+                              BATCH_CAP)
+
+        async def main():
+            while cluster.cc.dbinfo.get().recovery_state != \
+                    dbi.FULLY_RECOVERED:
+                await flow.delay(0.05)
+            info = cluster.cc.dbinfo.get()
+            from ..server.cluster_controller import epoch_roles
+            proxies = sorted(
+                epoch_roles(cluster.cc.workers, info.epoch, Proxy),
+                key=lambda p: p[0])
+            objs = [p for _n, p in proxies]
+
+            def grv_send(req, reply):
+                grv_send.rr += 1
+                objs[grv_send.rr % len(objs)].grvs.stream.send(
+                    (req, reply))
+            grv_send.rr = 0
+
+            def commit_send(i, req, reply):
+                objs[i % len(objs)].commits.stream.send((req, reply))
+
+            return await _drive_commits(
+                grv_send, commit_send, seed=seed, duration=duration,
+                rate=rate, key_prefix=b"sb/")
+
+        result = cluster.run(main(), timeout_time=3600)
+        result.update({"proxies": n_proxies, "resolvers": n_resolvers,
+                       "mode": "inprocess", "unit": "sim"})
+        out(f"  inprocess {n_proxies}x{n_resolvers}: "
+            f"{result['txn_per_s']}/s committed={result['committed']} "
+            f"attainment={result['attainment']}")
+        return result
+    finally:
+        if cluster is not None:
+            cluster.shutdown()
+        # the cell mutated the bench knobs (resolve cost, batch cap):
+        # restore defaults so a caller mid-simulation is not left with
+        # a 400µs modeled resolver (same discipline as the scheduler/
+        # RNG restore; smoke's run_once precedent)
+        flow.reset_server_knobs(randomize=False)
+        flow.set_scheduler(prev_sched)
+        _rng.restore_rng_state(prev_rng)
+
+
+# ------------------------------------------------------------ across-process
+def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
+                 duration: float, rate: float,
+                 out=lambda *a, **k: None) -> dict:
+    """One across-process cell: this process hosts the cluster
+    (master/resolvers/tlogs/storage) wall-clock behind a peer-serving
+    TcpGateway; `n_proxies` worker OS processes each run a real Proxy
+    role over rpc/tcp.py and drive their share of the workload."""
+    prev_sched = flow.get_scheduler()
+    prev_rng = _rng.rng_state()
+    cluster = gw = None
+    try:
+        from ..rpc.gateway import TcpGateway
+        from ..server import SimCluster
+        from ..server import dbinfo as dbi
+        cluster = SimCluster(seed=seed, virtual=False, n_proxies=1,
+                             n_resolvers=n_resolvers, n_storage=1,
+                             n_logs=1)
+        gw = TcpGateway(cluster.client("benchgw"), cluster=cluster)
+
+        results: list = []
+        errors: list = []
+
+        def run_worker(idx: int) -> None:
+            cfg = {"host": "127.0.0.1", "port": gw.port,
+                   "seed": seed + 1000 * (idx + 1), "index": idx,
+                   "duration": duration,
+                   "rate": rate / n_proxies}
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-m",
+                     "foundationdb_tpu.tools.clusterbench",
+                     "--worker", json.dumps(cfg)],
+                    capture_output=True, text=True,
+                    timeout=duration + 120)
+                lines = [ln for ln in p.stdout.splitlines() if ln.strip()]
+                if p.returncode != 0 or not lines:
+                    errors.append(f"worker {idx}: rc={p.returncode} "
+                                  f"stderr={p.stderr[-2000:]}")
+                    return
+                results.append(json.loads(lines[-1]))
+            except Exception as e:  # noqa: BLE001 — collected, reported
+                errors.append(f"worker {idx}: {e!r}")
+
+        async def main():
+            gw.start()
+            while cluster.cc.dbinfo.get().recovery_state != \
+                    dbi.FULLY_RECOVERED:
+                await flow.delay(0.05)
+            threads = [threading.Thread(target=run_worker, args=(i,),
+                                        daemon=True)
+                       for i in range(n_proxies)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                await flow.delay(0.1)
+            wall = time.perf_counter() - t0
+            return wall
+
+        wall = cluster.run(main(), timeout_time=duration + 300)
+        if errors:
+            raise RuntimeError("; ".join(errors))
+        agg = {"proxies": n_proxies, "resolvers": n_resolvers,
+               "mode": "tcp", "unit": "wall",
+               "worker_processes": n_proxies,
+               "wall_seconds": round(wall, 2)}
+        for c in ("offered", "shed", "committed", "conflicted",
+                  "too_old", "errors"):
+            agg[c] = sum(r[c] for r in results)
+        agg["divergent_verdicts"] = (agg["conflicted"] + agg["too_old"]
+                                     + agg["errors"])
+        elapsed = max(r.get("elapsed", duration) for r in results) \
+            if results else duration
+        agg["elapsed"] = round(elapsed, 3)
+        agg["txn_per_s"] = round(agg["committed"] / max(1e-9, elapsed), 1)
+        agg["attainment"] = round(
+            agg["offered"] / max(1, agg["offered"] + agg["shed"]), 4)
+        agg["grv"] = results[0]["grv"] if results else {}
+        agg["commit"] = results[0]["commit"] if results else {}
+        out(f"  tcp {n_proxies}x{n_resolvers}: {agg['txn_per_s']}/s "
+            f"committed={agg['committed']} "
+            f"divergent={agg['divergent_verdicts']}")
+        return agg
+    finally:
+        if gw is not None:
+            gw.close()
+        if cluster is not None:
+            cluster.shutdown()
+        flow.set_scheduler(prev_sched)
+        _rng.restore_rng_state(prev_rng)
+
+
+def run_worker(cfg: dict) -> dict:
+    """Proxy-worker entry (one OS process): fetch the peer-describe
+    document, build a real Proxy role whose downstream refs are all
+    TcpRefs into the cluster host, and drive this worker's share of
+    the seeded workload through it. Prints the result JSON as the last
+    stdout line."""
+    prev_sched = flow.get_scheduler()
+    prev_rng = _rng.rng_state()
+    transport = None
+    try:
+        from ..rpc.gateway import DESCRIBE_TOKEN, PEER_DESCRIBE
+        from ..rpc.network import SimNetwork
+        from ..rpc.tcp import TcpTransport
+        from ..server.proxy import Proxy
+        flow.set_seed(int(cfg["seed"]))
+        s = flow.Scheduler(virtual=False)
+        flow.set_scheduler(s)
+        net = SimNetwork(s, flow.g_random)
+        proc = net.new_process(f"benchproxy-{cfg['index']}",
+                               machine=f"benchproxy-{cfg['index']}")
+        transport = TcpTransport()
+        host, port = cfg["host"], int(cfg["port"])
+
+        async def main():
+            transport.start()
+            describe = transport.ref(host, port, DESCRIBE_TOKEN)
+            doc = None
+            for _ in range(50):
+                try:
+                    doc = await flow.timeout_error(
+                        describe.get_reply(PEER_DESCRIBE), 5.0)
+                    break
+                except flow.FdbError:
+                    await flow.delay(0.2)
+            if doc is None:
+                raise RuntimeError("peer describe never became ready")
+
+            def tref(token):
+                return transport.ref(host, port, token)
+
+            proxy = Proxy(
+                proc, tref(doc["master"]),
+                [tref(r["resolves"]) for r in doc["resolvers"]],
+                [tref(t) for t in doc["tlogs"]],
+                resolver_splits=tuple(doc["resolver_splits"]),
+                storage_splits=tuple(doc["storage_splits"]),
+                storage_tags=tuple(doc["storage_tags"]),
+                recovery_version=int(doc["recovery_version"]))
+            proxy.set_peers([tref(t)
+                             for t in doc["proxy_raw_committed"]])
+            proxy.start()
+
+            def grv_send(req, reply):
+                proxy.grvs.stream.send((req, reply))
+
+            def commit_send(_i, req, reply):
+                proxy.commits.stream.send((req, reply))
+
+            counts = await _drive_commits(
+                grv_send, commit_send, seed=int(cfg["seed"]),
+                duration=float(cfg["duration"]),
+                rate=float(cfg["rate"]),
+                key_prefix=b"sb/%d/" % int(cfg["index"]),
+                clock=time.perf_counter)
+            counts["index"] = cfg["index"]
+            return counts
+
+        t = s.spawn(main())
+        return s.run(until=t, timeout_time=float(cfg["duration"]) + 90)
+    finally:
+        if transport is not None:
+            transport.close()
+        flow.set_scheduler(prev_sched)
+        _rng.restore_rng_state(prev_rng)
+
+
+# -------------------------------------------------------------------- driver
+def run_matrix(modes=("inprocess", "tcp"), grid=GRID, *, seed: int = 0,
+               duration: float = 2.0, rate: float = 12000.0,
+               tcp_duration: float = 3.0, tcp_rate: float = 6000.0,
+               out=print) -> dict:
+    cells: dict = {"inprocess": {}, "tcp": {}}
+    for p in grid:
+        for r in grid:
+            if "inprocess" in modes:
+                cells["inprocess"][f"{p}x{r}"] = run_inprocess_cell(
+                    p, r, seed=seed, duration=duration, rate=rate,
+                    out=out)
+            if "tcp" in modes:
+                cells["tcp"][f"{p}x{r}"] = run_tcp_cell(
+                    p, r, seed=seed, duration=tcp_duration,
+                    rate=tcp_rate, out=out)
+    doc = {
+        "metric": "system_committed_txn_per_s",
+        "config": {
+            "seed": seed, "grid": list(grid),
+            "inprocess": {"duration_sim_s": duration,
+                          "offered_rate": rate,
+                          "batch_cap": BATCH_CAP,
+                          "resolve_cost_per_txn_s": RESOLVE_COST},
+            "tcp": {"duration_wall_s": tcp_duration,
+                    "offered_rate": tcp_rate},
+        },
+        "cells": cells,
+    }
+    ip = cells.get("inprocess") or {}
+    if "1x1" in ip and "4x4" in ip:
+        base = ip["1x1"]["txn_per_s"] or 1
+        doc["headline"] = {
+            "inprocess_4x4_vs_1x1": round(ip["4x4"]["txn_per_s"] / base,
+                                          2)}
+    tcp = cells.get("tcp") or {}
+    if tcp:
+        doc.setdefault("headline", {})["tcp_divergent_verdicts"] = sum(
+            c["divergent_verdicts"] for c in tcp.values())
+    return doc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    seed = int(os.environ.get("CLUSTERBENCH_SEED", 0))
+    out_path = REPORT_PATH
+    mode = None
+    processes = None
+    proxies = resolvers = None
+    duration = None
+    rate = None
+    matrix = False
+    while argv:
+        a = argv.pop(0)
+        if a == "--worker":
+            print(json.dumps(run_worker(json.loads(argv.pop(0)))))
+            return 0
+        if a == "--matrix":
+            matrix = True
+        elif a == "--mode":
+            mode = argv.pop(0)
+        elif a == "--processes":
+            processes = int(argv.pop(0))
+        elif a == "--proxies":
+            proxies = int(argv.pop(0))
+        elif a == "--resolvers":
+            resolvers = int(argv.pop(0))
+        elif a == "--duration":
+            duration = float(argv.pop(0))
+        elif a == "--rate":
+            rate = float(argv.pop(0))
+        elif a == "--seed":
+            seed = int(argv.pop(0))
+        elif a == "--out":
+            out_path = argv.pop(0)
+        else:
+            print(f"unknown argument {a!r}")
+            return 2
+    if matrix:
+        modes = (mode,) if mode else ("inprocess", "tcp")
+        doc = run_matrix(modes, seed=seed, out=print)
+    elif processes is not None:
+        # the CI small shape: N proxy worker processes over real TCP
+        doc = {"metric": "system_committed_txn_per_s",
+               "cells": {"tcp": {}}}
+        cell = run_tcp_cell(processes, resolvers or processes,
+                            seed=seed, duration=duration or 3.0,
+                            rate=rate or 2000.0, out=print)
+        doc["cells"]["tcp"][f"{processes}x{resolvers or processes}"] = \
+            cell
+        doc["headline"] = {
+            "tcp_divergent_verdicts": cell["divergent_verdicts"]}
+        if cell["divergent_verdicts"] or cell["committed"] == 0:
+            with open(out_path, "w") as fh:
+                json.dump(doc, fh, indent=2, sort_keys=True)
+            print("FAIL: divergent verdicts or zero commits")
+            return 1
+    else:
+        p, r = proxies or 2, resolvers or 2
+        doc = {"metric": "system_committed_txn_per_s",
+               "cells": {"inprocess": {
+                   f"{p}x{r}": run_inprocess_cell(
+                       p, r, seed=seed, duration=duration or 2.0,
+                       rate=rate or 12000.0, out=print)}}}
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"report -> {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

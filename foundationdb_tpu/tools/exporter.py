@@ -415,6 +415,40 @@ def render_prometheus(status: dict) -> str:
             f.add(f"{_PREFIX}_shadow_resolve_mismatches", "counter",
                   "Sampled batches whose shadow verdicts DIVERGED "
                   "(corruption-grade)", flabels, sh.get("mismatches"))
+        # dynamic resolver split/merge (ISSUE 15): per-resolver skew
+        # surface — owned ranges, state rows, handoff traffic — so a
+        # dashboard shows the balancer's effect before and after
+        sp = r.get("splits") or {}
+        if sp:
+            splabels = {"role": r["name"]}
+            f.add(f"{_PREFIX}_resolver_split_owned_ranges", "gauge",
+                  "Key ranges this resolver currently owns in the "
+                  "keyResolvers map", splabels, sp.get("owned_ranges"))
+            f.add(f"{_PREFIX}_resolver_split_state_rows", "gauge",
+                  "Conflict-history rows held by this resolver's "
+                  "backend", splabels, sp.get("state_rows"))
+            f.add(f"{_PREFIX}_resolver_split_checkpoints", "counter",
+                  "Handoff checkpoints served as split/merge donor",
+                  splabels, sp.get("checkpoints_served"))
+            f.add(f"{_PREFIX}_resolver_split_installs", "counter",
+                  "Handoff pieces grafted in as split/merge recipient",
+                  splabels, sp.get("installs"))
+    bal = cl.get("resolver_balance") or {}
+    if bal:
+        f.add(f"{_PREFIX}_resolver_split_enabled", "gauge",
+              "1 while the RESOLVER_BALANCE loop is armed", {},
+              bal.get("enabled"))
+        for c, help_text in (
+                ("splits", "Balance-loop range splits (donor -> "
+                           "recipient with live state handoff)"),
+                ("merges", "Cooled ranges stitched back to their "
+                           "former owner"),
+                ("releases", "Early former-owner releases (double "
+                             "delivery ended before the MVCC window)"),
+                ("handoff_timeouts",
+                 "Handoffs that fell back to window-only semantics")):
+            f.add(f"{_PREFIX}_resolver_split_{c}", "counter", help_text,
+                  {}, bal.get(c))
     for lg in cl.get("logs", ()):
         _add_counters(f, "tlog", lg.get("store", "?"), lg.get("counters"))
         f.add(f"{_PREFIX}_tlog_queue_length", "gauge",

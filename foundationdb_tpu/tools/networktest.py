@@ -15,6 +15,7 @@ import time
 from typing import List, Optional
 
 from .. import flow
+from ..flow import rng
 from ..rpc.tcp import TcpRequestStream, TcpTransport
 
 
@@ -24,6 +25,12 @@ def run_networktest(requests: int = 2000, parallel: int = 16,
         return {"requests": 0, "parallel": 0, "payload_bytes": payload_bytes,
                 "requests_per_second": 0.0, "p50_ms": 0.0, "p99_ms": 0.0}
     parallel = max(1, min(parallel, requests))
+    # this tool hosts its OWN wall-clock loop and reseeds the ambient
+    # RNG; a caller already running a flow loop (a test, a seeded sim)
+    # must get both back EXACTLY as they were — restore in the finally
+    # below (ISSUE 15 satellite; clusterbench shares the discipline)
+    prev_sched = flow.get_scheduler()
+    prev_rng = rng.rng_state()
     flow.set_seed(0)
     s = flow.Scheduler(virtual=False)
     flow.set_scheduler(s)
@@ -73,7 +80,8 @@ def run_networktest(requests: int = 2000, parallel: int = 16,
     finally:
         server.close()
         client.close()
-        flow.set_scheduler(None)
+        flow.set_scheduler(prev_sched)
+        rng.restore_rng_state(prev_rng)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

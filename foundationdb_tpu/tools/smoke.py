@@ -24,6 +24,7 @@ CONTENTION_REPORT_PATH = "/tmp/_contention_report.txt"
 OVERLOAD_REPORT_PATH = "/tmp/_overload_report.txt"
 HEAT_REPORT_PATH = "/tmp/_heat_report.txt"
 SIMPROF_REPORT_PATH = "/tmp/_simprof_smoke.txt"
+SPLITS_REPORT_PATH = "/tmp/_splits_report.txt"
 SIMPROF_CHAOS_PATH = "/tmp/_simprof_chaos.json"
 SIMPROF_CHAOS_FOLDED_PATH = "/tmp/_simprof_chaos.folded"
 
@@ -491,10 +492,23 @@ def run_smoke_chaos(out=print,
     # is observe-only, so the oracles must hold bit-identically)
     heat = os.environ.get("CHAOS_HEAT", "") not in ("", "0")
 
+    # CHAOS_SPLITS=1: arm the resolver balance loop under the scenario
+    # (ISSUE 15's storm-splits nightly cells — load-driven splits with
+    # live checkpoint/graft handoff race partitions, kills and
+    # recoveries under the same same-seed-replay + check_consistency
+    # oracles; the storm's Zipfian traffic is skewed enough for the
+    # one-shot FORCE to land a split on multi-resolver scenarios)
+    splits = os.environ.get("CHAOS_SPLITS", "") not in ("", "0")
+
     def run_once() -> dict:
         kwargs = dict(SCENARIOS[scenario].cluster_kwargs)
         if buggify:
             kwargs["buggify"] = True
+        if splits:
+            # the balance loop only exists on multi-resolver clusters;
+            # both the run and its replay share this shape, so the
+            # same-seed determinism oracle is unaffected
+            kwargs["n_resolvers"] = 2
         cluster = SimCluster(seed=seed, **kwargs)
         # the sim-perf plane rides every chaos cell: profiling reads
         # only the wall clock (armed-vs-off same-seed equivalence is
@@ -512,6 +526,10 @@ def run_smoke_chaos(out=print,
         if heat:
             flow.SERVER_KNOBS.set("storage_heat_tracking", 1)
             flow.SERVER_KNOBS.set("tag_throttle_storage_busyness", 1)
+        if splits:
+            flow.SERVER_KNOBS.set("resolver_balance", 1)
+            flow.SERVER_KNOBS.set("resolver_balance_force", 1)
+            flow.SERVER_KNOBS.set("resolver_balance_interval", 0.5)
         try:
             dbs = [cluster.client(f"chaos{i}") for i in range(3)]
             storm = ChaosStorm(cluster, dbs, flow.g_random, scenario)
@@ -1313,6 +1331,116 @@ def run_smoke_packed(out=print) -> int:
         cluster.shutdown()
 
 
+def run_smoke_splits(out=print,
+                     report_path: str = SPLITS_REPORT_PATH) -> int:
+    """Dynamic resolver split smoke (ISSUE 15's acceptance cell): the
+    SAME seeded skewed SplitStorm run twice on a 2-proxy × 2-resolver
+    cluster — balance loop armed-but-idle (unreachable MIN_WORK) as
+    the unsplit baseline, then with the one-shot FORCE dropped in
+    mid-storm so exactly one load-driven split (checkpoint → clip →
+    graft-install → early release) lands under live traffic.
+
+    Asserts: the split run's read-modify-write counter sums are EXACT
+    and its keyspace digest equals the unsplit same-seed run's (the
+    bit-exact-handoff acceptance); ≥1 split with the donor's per-batch
+    load share measurably reduced; split counters render in `status
+    details`; and the fdbtpu_resolver_split_* exporter family parses.
+    Report lands at /tmp/_splits_report.txt for the CI artifact."""
+    import json
+    import os
+
+    from .. import flow
+    from ..server import SimCluster
+    from ..server.workloads import SplitStorm
+    from .cli import _render_details
+    from .exporter import parse_prometheus, render_prometheus
+
+    seed = int(os.environ.get("SPLITS_SEED", 4242))
+    duration = float(os.environ.get("SPLITS_DURATION", 10.0))
+
+    def run_once(force_split: bool) -> tuple:
+        cluster = SimCluster(seed=seed, n_resolvers=2, n_proxies=2)
+        # the loop is SPAWNED (so arming mid-storm works) but cannot
+        # trigger: MIN_WORK is unreachable until the storm drops in
+        # the one-shot FORCE; merges disabled so the forced split's
+        # load-share drop is stable for the assert
+        flow.SERVER_KNOBS.set("resolver_balance", 1)
+        flow.SERVER_KNOBS.set("resolver_balance_min_work", 10 ** 12)
+        flow.SERVER_KNOBS.set("resolver_balance_merge_work", -1)
+        flow.SERVER_KNOBS.set("resolver_balance_interval", 0.5)
+        try:
+            dbs = [cluster.client(f"sp{i}") for i in range(4)]
+
+            async def main():
+                storm = SplitStorm(
+                    cluster, dbs, flow.g_random, duration=duration,
+                    arm_at=duration * 0.4 if force_split else None)
+                rep = await storm.run()
+                status = await dbs[0].get_status()
+                return rep, status
+
+            rep, status = cluster.run(main(), timeout_time=900)
+            return rep, status
+        finally:
+            flow.reset_server_knobs(randomize=False)
+            cluster.shutdown()
+
+    base_rep, _base_status = run_once(force_split=False)
+    rep, status = run_once(force_split=True)
+
+    report = {"seed": seed, "duration": duration,
+              "unsplit": base_rep, "split": rep}
+    try:
+        # unsplit baseline really was unsplit; forced run really split
+        assert base_rep["balance"]["splits"] == 0, base_rep["balance"]
+        assert rep["balance"]["splits"] >= 1, rep["balance"]
+        assert rep["balance"]["releases"] >= 1, rep["balance"]
+        # bit-exact across the handoff: exact increment sums AND the
+        # same final keyspace as the same-seed unsplit run
+        assert rep["exact"], (rep["expected"], rep["observed"])
+        assert base_rep["exact"], base_rep
+        assert rep["digest"] == base_rep["digest"], \
+            ("split run diverged from unsplit same-seed run",
+             rep["digest"], base_rep["digest"])
+        assert rep["stats"]["conflicted"] == 0, rep["stats"]
+        # the split measurably reduced the donor's per-batch share
+        assert rep["share_before"] is not None \
+            and rep["share_after"] is not None, rep
+        assert rep["share_after"] <= rep["share_before"] - 0.1, (
+            rep["share_before"], rep["share_after"])
+
+        cl = status["cluster"]
+        bal = cl["resolver_balance"]
+        assert bal["enabled"] == 1 and bal["splits"] >= 1, bal
+        assert bal["last_split"], bal
+        installs = sum(r["splits"].get("installs", 0)
+                       for r in cl["resolvers"])
+        assert installs >= 1, cl["resolvers"]
+        details = _render_details(cl)
+        assert "Resolver balance" in details, details
+        assert "last split" in details, details
+        samples = parse_prometheus(render_prometheus(status))
+        names = {n for n, _l, _v in samples}
+        for need in ("fdbtpu_resolver_split_enabled",
+                     "fdbtpu_resolver_split_splits",
+                     "fdbtpu_resolver_split_releases",
+                     "fdbtpu_resolver_split_owned_ranges",
+                     "fdbtpu_resolver_split_state_rows",
+                     "fdbtpu_resolver_split_installs"):
+            assert need in names, f"exporter missing {need}"
+        splits_total = sum(v for n, _l, v in samples
+                           if n == "fdbtpu_resolver_split_splits")
+        assert splits_total >= 1, "no splits in the exporter"
+    finally:
+        with open(report_path, "w") as fh:
+            fh.write(json.dumps(report, indent=2, sort_keys=True,
+                                default=str) + "\n")
+    out(f"splits smoke OK: {rep['balance']['splits']} split(s), donor "
+        f"share {rep['share_before']} -> {rep['share_after']}, digest "
+        f"matches unsplit run; report -> {report_path}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--profile" in argv:
@@ -1333,6 +1461,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_smoke_heat()
     if "--packed" in argv:
         return run_smoke_packed()
+    if "--splits" in argv:
+        return run_smoke_splits()
     return run_smoke()
 
 

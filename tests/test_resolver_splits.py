@@ -1,0 +1,433 @@
+"""Dynamic resolver split/merge with live state handoff (ISSUE 15).
+
+Directed: KeyResolverMap expire/release/apply retention semantics, the
+clip/graft checkpoint math. Randomized: a two-resolver SPLIT ENSEMBLE
+(the proxy's clip + min-combine mirrored exactly) driven through a
+dynamic split → window → early-release → merge cycle must produce
+verdicts AND attribution unions bit-identical to a single unsplit
+resolver — on every backend, including tooOld and empty-range
+transactions. Cluster-level: the armed balance loop on a seeded skewed
+workload makes ≥1 automatic split with exact increments, and the
+off posture spawns nothing.
+
+Ref: resolutionBalancing (masterserver.actor.cpp:1008), keyResolvers
+history (MasterProxyServer.actor.cpp:204), the ResolverInterface
+split/merge fan-out; state handoff via PR 5's ConflictSetCheckpoint.
+"""
+
+import importlib.util
+import random
+
+import pytest
+
+from foundationdb_tpu import flow
+from foundationdb_tpu.models import (
+    BruteForceConflictSet,
+    PyConflictSet,
+    create_conflict_set,
+    native_available,
+)
+from foundationdb_tpu.models.conflict_set import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    ResolverTransaction,
+    clip_checkpoint,
+    graft_checkpoint,
+)
+from foundationdb_tpu.server.proxy import MWTLV, KeyResolverMap
+
+HAVE_JAX = importlib.util.find_spec("jax") is not None
+WINDOW = 5000
+
+
+def txn(snapshot, reads=(), writes=()):
+    return ResolverTransaction(snapshot, tuple(reads), tuple(writes))
+
+
+# ------------------------------------------------------ KeyResolverMap --
+def test_expire_is_pruned_from_the_gc_watermark():
+    m = KeyResolverMap([b"\x80"], 2)
+    m.move(b"\x10", b"\x11", 1, 1000)
+    # the watermark has not passed the move: both owners stay
+    m.expire(1000)
+    assert m.clip_per_resolver([(b"\x10", b"\x11")], 2) == \
+        [[(b"\x10", b"\x11")], [(b"\x10", b"\x11")]]
+    # watermark past the move version: the former owner retires —
+    # identical semantics to prune(move + window)
+    m.expire(1001)
+    assert m.clip_per_resolver([(b"\x10", b"\x11")], 2) == \
+        [[], [(b"\x10", b"\x11")]]
+
+
+def test_long_idle_history_is_bounded_by_expire():
+    """A burst of moves followed by idleness must not retain owner
+    history forever: one expire() at the GC watermark trims every
+    former owner, however many moves landed (the satellite's leak)."""
+    m = KeyResolverMap([b"\x80"], 2)
+    for i in range(50):
+        m.move(b"\x10", b"\x11", (i + 1) % 2, 1000 + i)
+    assert max(len(ow) for ow in m.owners) > 2
+    m.expire(1000 + 50)
+    assert max(len(ow) for ow in m.owners) == 1
+    # current ownership survived the trim (last move was to 0)
+    assert m.clip_per_resolver([(b"\x10", b"\x11")], 2)[0]
+
+
+def test_release_retires_former_owner_early_and_apply_dispatches():
+    m = KeyResolverMap([b"\x80"], 2)
+    m.apply((1000, b"\x10", b"\x11", 1))          # 4-tuple = move
+    assert m.clip_per_resolver([(b"\x10", b"\x11")], 2) == \
+        [[(b"\x10", b"\x11")], [(b"\x10", b"\x11")]]
+    m.apply((1500, b"\x10", b"\x11", 0, "release"))
+    # double delivery over, a full window early
+    assert m.clip_per_resolver([(b"\x10", b"\x11")], 2) == \
+        [[], [(b"\x10", b"\x11")]]
+    # a release never drops the CURRENT owner
+    m.release(b"\x10", b"\x11", 1)
+    assert m.clip_per_resolver([(b"\x10", b"\x11")], 2)[1]
+
+
+def test_owned_ranges_and_buckets_track_moves():
+    m = KeyResolverMap([b"\x80"], 2)
+    assert m.owned_ranges(2) == [1, 1]
+    assert 0x10 in m.owned_buckets(0) and 0x90 in m.owned_buckets(1)
+    m.move(b"\x10", b"\x11", 1, 100)
+    assert m.owned_ranges(2) == [2, 2]   # [,10) [10,11) [11,80) [80,)
+    assert 0x10 in m.owned_buckets(1)
+
+
+# ------------------------------------------------------- clip / graft --
+def test_clip_graft_roundtrip_and_max_semantics():
+    a = PyConflictSet()
+    a.resolve([txn(0, writes=[(b"\x20a", b"\x20b"), (b"\x90x", b"\x90y")])],
+              100, 0)
+    ck = a.checkpoint()
+    piece = clip_checkpoint(ck, b"\x20", b"\x30")
+    assert piece.keys[0] == b"\x20"
+    b = PyConflictSet()
+    # the recipient already recorded a NEWER write inside the span:
+    # the graft's pointwise max must keep it
+    b.resolve([txn(0, writes=[(b"\x20a", b"\x20a\x01")])], 300, 0)
+    b.restore(graft_checkpoint(b.checkpoint(), piece))
+    v = b.resolve([txn(250, reads=[(b"\x20a", b"\x20a\x01")], writes=()),
+                   txn(150, reads=[(b"\x20a\x01", b"\x20b")], writes=()),
+                   txn(150, reads=[(b"\x90x", b"\x90y")], writes=())],
+                  400, 0)
+    # newer write (300) survived; piece write (100) grafted; outside
+    # the span untouched (no phantom [90x,90y) history)
+    assert v == [CONFLICT, COMMITTED, COMMITTED]
+
+
+def test_clip_graft_keyspace_tail():
+    a = PyConflictSet()
+    a.resolve([txn(0, writes=[(b"\xf0", b"\xf1")])], 100, 0)
+    piece = clip_checkpoint(a.checkpoint(), b"\x80", None)
+    b = PyConflictSet()
+    b.restore(graft_checkpoint(b.checkpoint(), piece))
+    assert b.resolve([txn(50, reads=[(b"\xf0", b"\xf1")], writes=())],
+                     200, 0) == [CONFLICT]
+
+
+# ------------------------------------------------- split-ensemble parity --
+def _clip_with_index(kmap, ranges, n):
+    """clip_per_resolver, but each piece carries its ORIGINAL range
+    index — the attribution-union bookkeeping the proxy keeps via its
+    (idx, req) lists."""
+    out = [[] for _ in range(n)]
+    from bisect import bisect_right
+    nb = len(kmap.bounds)
+    for ri, (b, e) in enumerate(ranges):
+        k = max(0, bisect_right(kmap.bounds, b) - 1)
+        while k < nb and kmap.bounds[k] < e:
+            lo = kmap.bounds[k]
+            hi = kmap.bounds[k + 1] if k + 1 < nb else None
+            b2 = max(b, lo)
+            e2 = e if hi is None else min(e, hi)
+            if b2 < e2:
+                for idx in kmap.live_owners(k):
+                    out[idx].append((b2, e2, ri))
+            k += 1
+    return out
+
+
+class SplitEnsemble:
+    """Two (or more) conflict-set backends behind a KeyResolverMap —
+    the proxy's _resolve_split + the resolver role's handoff endpoint,
+    mirrored exactly: per-resolver clipped sub-transactions, min-
+    combined verdicts, attribution mapped back to ORIGINAL range
+    indices and unioned, prune per batch."""
+
+    def __init__(self, factory, splits=(b"\x80",)):
+        self.n = len(splits) + 1
+        self.sets = [factory() for _ in range(self.n)]
+        self.map = KeyResolverMap(list(splits), self.n, window=WINDOW)
+        # the resolvers' shared GC watermark BEFORE the next batch
+        # (what the proxy derives from prev_version): the split path
+        # decides tooOld itself and withholds those txns, or a
+        # writes-only slice would commit phantom writes
+        self._prev_oldest = 0
+
+    def handoff(self, begin, end, src, dst, at_version,
+                release=True) -> None:
+        """One live split/merge: move at `at_version` (the NEXT batch's
+        version), checkpoint-clip the donor, graft the recipient, and
+        (optionally) release the donor early — exactly the master's
+        _handoff protocol run synchronously between batches."""
+        self.map.move(begin, end, dst, at_version)
+        piece = clip_checkpoint(self.sets[src].checkpoint(), begin, end)
+        self.sets[dst].restore(
+            graft_checkpoint(self.sets[dst].checkpoint(), piece))
+        if release:
+            self.map.release(begin, end, src)
+
+    def resolve_with_attribution(self, txns, version, oldest):
+        self.map.prune(version)
+        per = [[] for _ in range(self.n)]   # (orig_idx, txn, ri_map)
+        withheld = set()
+        for idx, t in enumerate(txns):
+            if t.read_ranges and t.read_snapshot < self._prev_oldest:
+                withheld.add(idx)
+                continue
+            rr = _clip_with_index(self.map, t.read_ranges, self.n)
+            wr = _clip_with_index(self.map, t.write_ranges, self.n)
+            placed = False
+            for i in range(self.n):
+                if rr[i] or wr[i]:
+                    per[i].append((idx, ResolverTransaction(
+                        t.read_snapshot,
+                        tuple((b, e) for b, e, _ in rr[i]),
+                        tuple((b, e) for b, e, _ in wr[i])),
+                        [ri for _b, _e, ri in rr[i]]))
+                    placed = True
+            if not placed:
+                # no clippable ranges at all (degenerate/empty): the
+                # proxy routes the ORIGINAL ranges to resolver 0 so
+                # tooOld semantics survive (len(read_ranges) matters)
+                per[0].append((idx, ResolverTransaction(
+                    t.read_snapshot, t.read_ranges, t.write_ranges),
+                    list(range(len(t.read_ranges)))))
+        verdicts = [TOO_OLD if i in withheld else COMMITTED
+                    for i in range(len(txns))]
+        attrib = [set() for _ in txns]
+        for i in range(self.n):
+            batch = [t for _idx, t, _m in per[i]]
+            v, a = self.sets[i].resolve_with_attribution(
+                batch, version, oldest)
+            for (idx, _t, rmap), verdict, idxs in zip(per[i], v, a):
+                verdicts[idx] = min(verdicts[idx], verdict)
+                for ci in idxs:
+                    attrib[idx].add(rmap[ci])
+        self._prev_oldest = max(self._prev_oldest, oldest)
+        return verdicts, [tuple(sorted(s)) for s in attrib]
+
+
+def _rand_batches(seed, n_batches, point=False, max_txns=6):
+    rng = random.Random(seed)
+    out = []
+    v = 0
+
+    def key():
+        return bytes([rng.randrange(1, 250)]) + b"%02d" % rng.randrange(30)
+
+    def rd():
+        k = key()
+        if point:
+            return (k, k + b"\x00")
+        if rng.random() < 0.1:
+            return (k, k)            # degenerate (empty) range
+        return (k, k + bytes([rng.randrange(1, 8)]))
+
+    for _ in range(n_batches):
+        v += rng.randrange(1, 2000)
+        batch = []
+        for _ in range(rng.randrange(0, max_txns)):
+            reads = [rd() for _ in range(rng.randrange(0, 3))]
+            writes = [rd() for _ in range(rng.randrange(0, 3))]
+            snap = max(0, v - rng.randrange(0, 2 * WINDOW))
+            batch.append(txn(snap, reads, writes))
+        out.append((batch, v, max(0, v - WINDOW)))
+    return out
+
+
+def _backend_params():
+    out = [("python", False), ("brute-oracle", False)]
+    if native_available():
+        out.append(("native", False))
+    if HAVE_JAX:
+        out += [("tpu", False), ("tpu-point", True),
+                ("sharded-tpu", False)]
+    return out
+
+
+@pytest.mark.parametrize("backend,point",
+                         _backend_params(),
+                         ids=[b for b, _p in _backend_params()])
+def test_split_merge_cycle_attribution_parity(backend, point):
+    """Randomized parity across a DYNAMIC split/merge cycle: verdicts
+    and attribution unions (original-index level — the order-
+    insensitive union the proxy assembles) bit-identical to a single
+    unsplit resolver at every batch, through: static split → live
+    split with graft+early release → window-mode split (no release,
+    double delivery until prune) → merge back. Includes tooOld and
+    empty-range transactions."""
+
+    def factory():
+        if backend == "brute-oracle":
+            return BruteForceConflictSet()
+        if backend == "python":
+            return PyConflictSet()
+        if backend == "native":
+            return create_conflict_set("native")
+        if backend == "tpu":
+            from foundationdb_tpu.models.tpu_resolver import \
+                TpuConflictSet
+            return TpuConflictSet()
+        if backend == "tpu-point":
+            from foundationdb_tpu.models.point_resolver import \
+                PointConflictSet
+            return PointConflictSet()
+        from foundationdb_tpu.parallel import ShardedTpuConflictSet
+        return ShardedTpuConflictSet(n_shards=2)
+
+    if backend == "brute-oracle":
+        # the ensemble is brute-force sets; the oracle is python —
+        # cross-model parity, not just self-consistency
+        oracle = PyConflictSet()
+    else:
+        oracle = factory()
+    ens = SplitEnsemble(
+        PyConflictSet if backend == "brute-oracle" else factory)
+    batches = _rand_batches(31337, 40, point=point)
+    phase_at = {10: "split", 20: "window_split", 30: "merge"}
+    for bi, (batch, v, oldest) in enumerate(batches):
+        phase = phase_at.get(bi)
+        if phase == "split":
+            # live handoff: [40,80) moves 0 -> 1 with graft + release
+            ens.handoff(b"\x40", b"\x80", 0, 1, v, release=True)
+        elif phase == "window_split":
+            # window-only mode (a timed-out handoff): the graft still
+            # runs but the donor keeps double delivery until prune
+            ens.handoff(b"\xc0", None, 1, 0, v, release=False)
+        elif phase == "merge":
+            # the symmetric stitch: [40,80) returns to resolver 0
+            ens.handoff(b"\x40", b"\x80", 1, 0, v, release=True)
+        v1, a1 = oracle.resolve_with_attribution(batch, v, oldest)
+        v2, a2 = ens.resolve_with_attribution(batch, v, oldest)
+        assert v1 == v2, (backend, bi, phase, v1, v2, batch)
+        assert [tuple(x) for x in a1] == list(a2), (
+            backend, bi, phase, a1, a2, batch)
+
+
+# ---------------------------------------------------------- cluster e2e --
+def test_off_posture_spawns_nothing_and_counts_nothing():
+    """RESOLVER_BALANCE=0 (default): the balance loop is never
+    spawned — not one timer event, not one counter — and the status
+    rollup reports the off posture."""
+    from foundationdb_tpu.client import run_transaction
+    from foundationdb_tpu.server import SimCluster
+    c = SimCluster(seed=900, n_resolvers=2)
+    try:
+        db = c.client("off")
+
+        async def main():
+            async def body(tr):
+                tr.set(b"\x10k", b"v")
+            await run_transaction(db, body)
+            return await db.get_status()
+
+        status = c.run(main(), timeout_time=120)
+        assert c.cc.balance_stats.snapshot() == {}
+        bal = status["cluster"]["resolver_balance"]
+        assert bal == {"enabled": 0, "splits": 0, "merges": 0,
+                       "releases": 0, "handoff_timeouts": 0,
+                       "last_split": None}
+        aux_names = [t.name for t in c.cc._recovery.aux.tasks]
+        assert not any("resolverBalance" in n for n in aux_names), \
+            aux_names
+        # the legacy work-histogram balancer still runs (unchanged
+        # reference behavior)
+        assert any("resolutionBalancing" in n for n in aux_names), \
+            aux_names
+    finally:
+        c.shutdown()
+
+
+def test_forced_split_cluster_end_to_end():
+    """Armed + one-shot FORCE on a seeded skewed workload: >=1
+    automatic split with live handoff (install + early release), all
+    increments exact across the handoff window, and the donor sheds
+    owned ranges."""
+    from foundationdb_tpu.client import run_transaction
+    from foundationdb_tpu.server import SimCluster
+    c = SimCluster(seed=901, n_resolvers=2)
+    flow.SERVER_KNOBS.set("resolver_balance", 1)
+    flow.SERVER_KNOBS.set("resolver_balance_force", 1)
+    flow.SERVER_KNOBS.set("resolver_balance_interval", 0.5)
+    flow.SERVER_KNOBS.set("resolver_balance_merge_work", -1)
+    try:
+        dbs = [c.client(f"cl{i}") for i in range(3)]
+
+        async def incr(db, key, n):
+            for _ in range(n):
+                async def body(tr):
+                    cur = await tr.get(key)
+                    tr.set(key, b"%d" % (int(cur or b"0") + 1))
+                await run_transaction(db, body, max_retries=500)
+                await flow.delay(0.05)
+
+        async def main():
+            await flow.wait_for_all([
+                flow.spawn(incr(dbs[0], b"\x10hot", 30)),
+                flow.spawn(incr(dbs[1], b"\x20hot", 30)),
+                flow.spawn(incr(dbs[2], b"\x20hot2", 30))])
+            vals = []
+
+            async def rd(tr):
+                vals.clear()
+                for k in (b"\x10hot", b"\x20hot", b"\x20hot2"):
+                    vals.append(await tr.get(k))
+            await run_transaction(dbs[0], rd)
+            return vals, await dbs[0].get_status()
+
+        vals, status = c.run(main(), timeout_time=600)
+        assert vals == [b"30", b"30", b"30"], vals
+        bal = status["cluster"]["resolver_balance"]
+        assert bal["enabled"] == 1
+        assert bal["splits"] >= 1, bal
+        assert bal["releases"] >= 1, bal
+        assert bal["last_split"] is not None
+        resolvers = status["cluster"]["resolvers"]
+        installs = sum(r["splits"]["installs"] for r in resolvers)
+        checkpoints = sum(r["splits"]["checkpoints_served"]
+                          for r in resolvers)
+        assert installs >= 1 and checkpoints >= 1, resolvers
+        owned = [r["splits"].get("owned_ranges") for r in resolvers]
+        assert all(o and o >= 1 for o in owned), owned
+    finally:
+        c.shutdown()
+
+
+# ------------------------------------------------- networktest satellite --
+def test_networktest_restores_ambient_scheduler_and_rng():
+    """run_networktest hosts its own wall-clock loop and reseeds the
+    ambient RNG; the caller's scheduler AND deterministic stream must
+    survive a run exactly (the satellite's leak: set_seed(0) +
+    set_scheduler(None) used to clobber both)."""
+    from foundationdb_tpu.tools.networktest import run_networktest
+    sched = flow.Scheduler()
+    flow.set_scheduler(sched)
+    try:
+        flow.set_seed(12345)
+        flow.g_random.random01()            # advance the stream
+        st = flow.g_random._r.getstate()
+        expected_next = flow.g_random.random01()
+        flow.g_random._r.setstate(st)       # rewind the peek
+        result = run_networktest(requests=40, parallel=4,
+                                 payload_bytes=16)
+        assert result["requests"] == 40
+        assert flow.get_scheduler() is sched
+        assert flow.g_random.seed == 12345
+        assert flow.g_random.random01() == expected_next
+    finally:
+        flow.set_scheduler(None)
