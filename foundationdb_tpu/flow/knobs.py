@@ -133,6 +133,19 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     init("TRACE_ROLL_SIZE", 10 << 20, lambda: 4096)
     # cadence of the per-role *Metrics counter rollup TraceEvents
     init("TRACE_COUNTERS_INTERVAL", 1.0, lambda: 0.1)
+    # cross-process trace propagation (ISSUE 16): 1 = TCP requests that
+    # carry a debug id ride TRACED frames (rpc/tcp.py kinds 3/4) with
+    # the sender's process identity, its open parent span id per debug
+    # id, and the four NTP-style hop timestamps tracemerge uses to
+    # estimate per-process clock offsets; 0 = only kinds 0/1/2 ever
+    # leave the process — wire bytes byte-identical to the pre-knob
+    # transport (the pinned off posture). Deliberately NOT buggified
+    # (same reasoning as INTERVAL_PACKED_FEED: a new buggify site
+    # consumes a draw from the shared buggify stream and would shift
+    # every later knob's randomization on existing seeds, invalidating
+    # the pinned chaos baselines); the armed path is exercised by the
+    # soak harness and tests/test_distributed_trace.py instead
+    init("TRACE_PROPAGATION", 0)
     # conflict hot-spot table (resolver-side attribution aggregation):
     # score half-life seconds, table capacity, rows surfaced in status
     init("HOT_SPOT_HALF_LIFE", 10.0, lambda: 0.5)

@@ -154,6 +154,12 @@ class TraceCollector:
             if limit and self._bytes >= limit and not self._roll_broken:
                 self._roll()
 
+    @property
+    def path(self) -> Optional[str]:
+        """Current output file path (None while memory-only) — callers
+        that retarget the shared collector save this to restore it."""
+        return self._path
+
     def flush(self) -> None:
         if self._fh:
             self._fh.flush()
@@ -190,6 +196,46 @@ def reset_trace(path: Optional[str] = None) -> TraceCollector:
     g_trace_batch.dump()   # sampled events survive into the stream
     g_trace.reset(path)
     return g_trace
+
+
+# -- process identity (ISSUE 16) ------------------------------------------
+# One flow scheduler == one "process" for cross-process tracing. Span ids
+# are per-process sequential, so a span is only globally unique as
+# (process, span_id); roles stamp their identity here once and every
+# span dump / wire hop carries it. None (the default) keeps span dump
+# lines byte-identical to the pre-identity format — in-sim tests and
+# same-seed replay baselines never see the new fields unless a tool
+# opted in.
+_process_identity: Optional[dict] = None
+
+
+def set_process_identity(role: str, pid: Optional[int] = None,
+                         addr: str = "") -> dict:
+    """Stamp this OS process for cross-process trace reassembly: role
+    name, pid, and (optionally) the gateway address it talks to. Emits
+    a ProcessIdentity header event so a trace file is self-describing
+    even before its first span."""
+    global _process_identity
+    if pid is None:
+        import os
+        pid = os.getpid()
+    _process_identity = {"role": role, "pid": int(pid), "addr": addr}
+    TraceEvent("ProcessIdentity", process_name()).detail(
+        Role=role, Pid=int(pid), Addr=addr).log()
+    return _process_identity
+
+
+def clear_process_identity() -> None:
+    global _process_identity
+    _process_identity = None
+
+
+def process_name() -> str:
+    """The compact `role:pid` token spans and wire hops are stamped
+    with ("" while no identity is set)."""
+    if _process_identity is None:
+        return ""
+    return f"{_process_identity['role']}:{_process_identity['pid']}"
 
 
 class TraceEvent:
@@ -248,15 +294,19 @@ class Span:
     time and files the span for ``span_chain`` reassembly."""
 
     __slots__ = ("batch", "debug_id", "location", "span_id", "parent_id",
-                 "begin", "end")
+                 "begin", "end", "remote_parent")
 
     def __init__(self, batch: "TraceBatch", debug_id, location: str,
-                 span_id: int, parent_id: Optional[int]):
+                 span_id: int, parent_id: Optional[int],
+                 remote_parent=None):
         self.batch = batch
         self.debug_id = debug_id
         self.location = location
         self.span_id = span_id
         self.parent_id = parent_id
+        #: (process_name, span_id) in ANOTHER process, when this leg's
+        #: parent arrived over a traced TCP frame (ISSUE 16)
+        self.remote_parent = remote_parent
         self.begin = _now()
         self.end: Optional[float] = None
 
@@ -284,6 +334,7 @@ class TraceBatch:
     of a commit, and `span_chain` rebuilds the tree."""
 
     MAX_BUFFERED = 4096
+    MAX_REMOTE_PARENTS = 4096
 
     def __init__(self):
         self._events: list = []
@@ -292,6 +343,12 @@ class TraceBatch:
         self._spans: list = []            # finished spans
         self._open: dict = {}             # debug_id -> stack of open Spans
         self._span_seq = 0
+        #: debug_id -> (process_name, span_id): the still-open parent
+        #: span in the SENDING process, delivered by a traced TCP frame
+        #: (rpc/tcp.py) just before the request dispatches locally.
+        #: Bounded: sampled ids are rare, but a long soak must not grow
+        #: this without bound — oldest entries evict first
+        self._remote_parents: dict = {}
 
     def add_event(self, event_type: str, debug_id, location: str) -> None:
         self._seq += 1
@@ -323,9 +380,16 @@ class TraceBatch:
         through every RPC type. Same-location open spans are SIBLINGS,
         not ancestors: with two tlogs (or a txn split across
         resolvers), leg B begins while leg A's identical-location span
-        is still open, and both must parent onto the proxy span."""
+        is still open, and both must parent onto the proxy span.
+
+        With NO local parent at all, a remote parent noted for this
+        debug id (ISSUE 16: the sending process's open span, carried by
+        a traced TCP frame) attaches instead, so a cross-process leg
+        still joins the same commit tree when tracemerge reassembles
+        the per-process files."""
         self._span_seq += 1
         stack = self._open.setdefault(debug_id, [])
+        remote = None
         if parent is not None:
             pid = parent.span_id
         else:
@@ -334,9 +398,31 @@ class TraceBatch:
                 if s.location != location:
                     pid = s.span_id
                     break
-        span = Span(self, debug_id, location, self._span_seq, pid)
+            if pid is None:
+                remote = self._remote_parents.get(debug_id)
+        span = Span(self, debug_id, location, self._span_seq, pid,
+                    remote_parent=remote)
         stack.append(span)
         return span
+
+    def note_remote_parent(self, debug_id, process: str,
+                           span_id: int) -> None:
+        """Record that `debug_id`'s innermost open span lives in
+        another process — called by the TCP transport when a traced
+        request frame arrives, BEFORE the request dispatches into the
+        local role (so the role's begin_span sees it)."""
+        if len(self._remote_parents) >= self.MAX_REMOTE_PARENTS and \
+                debug_id not in self._remote_parents:
+            # evict the oldest noted id (insertion order)
+            self._remote_parents.pop(next(iter(self._remote_parents)))
+        self._remote_parents[debug_id] = (process, span_id)
+
+    def open_span_id(self, debug_id) -> Optional[int]:
+        """The innermost still-open span id for one debug id (None when
+        no span is open) — what a traced TCP request carries as the
+        receiving process's remote parent."""
+        stack = self._open.get(debug_id)
+        return stack[-1].span_id if stack else None
 
     def begin_spans(self, debug_ids, location: str) -> list:
         return [self.begin_span(d, location) for d in debug_ids]
@@ -390,6 +476,7 @@ class TraceBatch:
         self._events.clear()
         self._spans.clear()
         self._open.clear()
+        self._remote_parents.clear()
 
     def dump(self, events=None) -> None:
         """Flush events as TraceEvents (ref: TraceBatch::dump); with no
@@ -407,12 +494,21 @@ class TraceBatch:
             self._events.clear()
 
     def _dump_spans(self, spans) -> None:
+        proc = process_name()
         for s in spans:
             ev = TraceEvent("Span", str(s.debug_id))
             if ev._ev is not None:
                 ev._ev["Time"] = s.begin
             ev.detail(Location=s.location, Begin=s.begin, End=s.end,
-                      SpanID=s.span_id, ParentID=s.parent_id).log()
+                      SpanID=s.span_id, ParentID=s.parent_id)
+            # identity-less processes keep the pre-ISSUE-16 line format
+            # byte-for-byte (pinned by the same-seed merge test)
+            if proc:
+                ev.detail(Process=proc)
+            if s.remote_parent is not None:
+                ev.detail(RemoteParentProcess=s.remote_parent[0],
+                          RemoteParentID=s.remote_parent[1])
+            ev.log()
 
 
 g_trace_batch = TraceBatch()

@@ -34,6 +34,42 @@ from . import wire
 _HDR = struct.Struct("<IBQQ")   # len, kind, req_id, token
 PROTOCOL_VERSION = b"fdbtpu01"
 K_REQUEST, K_REPLY, K_ERROR = 0, 1, 2
+# traced variants (ISSUE 16, gated on the TRACE_PROPAGATION knob):
+# kind 3 wraps a request as [trace_ctx, request] — the sender's process
+# identity, its open parent span per debug id, and the send timestamp
+# t0; kind 4 wraps the reply as [hop, value] with the server identity
+# and its recv/send timestamps t1/t2. With the knob off (the default)
+# kinds 3/4 never hit a socket and kinds 0/1/2 frames are byte-
+# identical to the pre-knob transport (pinned in
+# tests/test_distributed_trace.py)
+K_TRACED, K_TRACED_REPLY = 3, 4
+
+
+def _trace_armed() -> bool:
+    from ..flow import SERVER_KNOBS
+    return bool(SERVER_KNOBS.trace_propagation)
+
+
+def _trace_ctx(request):
+    """The trace context a TRACED request frame carries: the sending
+    process identity, (debug_id, open parent span id) pairs for every
+    debug id the request ships, and the local send timestamp t0 (the
+    first of the four NTP-style hop timestamps tracemerge's clock-
+    offset estimator consumes). None when the request samples nothing —
+    an unsampled request rides a plain K_REQUEST frame even while the
+    knob is armed."""
+    ids = getattr(request, "debug_ids", None)
+    if not ids:
+        d = getattr(request, "debug_id", None)
+        ids = (d,) if d is not None else ()
+    ids = tuple(d for d in ids if d is not None)
+    if not ids:
+        return None
+    from ..flow import trace as _trace
+    return {"process": _trace.process_name(),
+            "spans": [[d, _trace.g_trace_batch.open_span_id(d)]
+                      for d in ids],
+            "t0": flow.now()}
 def HANDSHAKE_TIMEOUT():
     from ..flow import SERVER_KNOBS
     return SERVER_KNOBS.tcp_handshake_timeout
@@ -75,15 +111,28 @@ class TlsConfig(NamedTuple):
 
 class TcpReply:
     """Reply handle handed to server actors; send() enqueues the framed
-    value on the originating connection's writer thread."""
+    value on the originating connection's writer thread. A request that
+    arrived on a TRACED frame remembers its receive timestamp here and
+    answers with a TRACED reply carrying this process's identity and
+    the t1/t2 hop timestamps (errors stay plain: the offset estimator
+    only wants clean request/reply pairs)."""
 
-    __slots__ = ("conn", "req_id")
+    __slots__ = ("conn", "req_id", "t_recv")
 
-    def __init__(self, conn: "_Conn", req_id: int):
+    def __init__(self, conn: "_Conn", req_id: int,
+                 t_recv: Optional[float] = None):
         self.conn = conn
         self.req_id = req_id
+        self.t_recv = t_recv
 
     def send(self, value=None) -> None:
+        if self.t_recv is not None:
+            from ..flow import trace as _trace
+            hop = {"process": _trace.process_name(),
+                   "t1": self.t_recv, "t2": flow.now()}
+            self.conn.enqueue(K_TRACED_REPLY, self.req_id, 0,
+                              wire.to_bytes([hop, value]))
+            return
         self.conn.enqueue(K_REPLY, self.req_id, 0, wire.to_bytes(value))
 
     def send_error(self, err) -> None:
@@ -258,6 +307,11 @@ class TcpTransport:
         self._next_token = 1
         self._next_req = 1
         self._pending: Dict[int, Promise] = {}
+        #: req_id -> (t0, debug ids) for in-flight TRACED requests: the
+        #: traced reply joins them with the server's t1/t2 into one
+        #: client-side WireHop event (all four timestamps, both
+        #: identities — everything the offset estimator needs)
+        self._pending_trace: Dict[int, tuple] = {}
         self._conns: Dict[object, _Conn] = {}   # addr -> client conn
         self._lock = threading.Lock()
         self._inbox: deque = deque()
@@ -346,13 +400,15 @@ class TcpTransport:
                     del self._conns[conn.addr]
             for req_id in list(conn.pending):
                 p = self._pending.pop(req_id, None)
+                self._pending_trace.pop(req_id, None)
                 if p is not None and not p.is_set:
                     p.send_error(error("broken_promise"))
             conn.pending.clear()
             return
         _tag, conn, kind, req_id, token, payload = item
-        if kind == K_REQUEST:
-            reply = TcpReply(conn, req_id)
+        if kind in (K_REQUEST, K_TRACED):
+            t_recv = flow.now() if kind == K_TRACED else None
+            reply = TcpReply(conn, req_id, t_recv)
             stream = self._streams.get(token)
             if stream is None:
                 reply.send_error(error("broken_promise"))
@@ -362,9 +418,19 @@ class TcpTransport:
             except wire.WireError as e:
                 reply.send_error(error("unknown_error"))
                 raise e
+            if kind == K_TRACED:
+                # note the sender's open spans BEFORE dispatch, so the
+                # role's begin_span for these ids sees its remote parent
+                ctx, request = request
+                from ..flow import trace as _trace
+                for d, sid in ctx.get("spans", ()):
+                    if sid is not None:
+                        _trace.g_trace_batch.note_remote_parent(
+                            d, ctx.get("process", ""), sid)
             stream.stream.send((request, reply))
         else:
             p = self._pending.pop(req_id, None)
+            tr = self._pending_trace.pop(req_id, None)
             conn.pending.discard(req_id)
             if p is None or p.is_set:
                 return
@@ -373,16 +439,43 @@ class TcpTransport:
             except wire.WireError:
                 p.send_error(error("unknown_error"))
                 return
-            if kind == K_REPLY:
+            if kind == K_TRACED_REPLY:
+                hop, value = value
+                if tr is not None:
+                    self._emit_wire_hop(tr, hop)
+                p.send(value)
+            elif kind == K_REPLY:
                 p.send(value)
             else:
                 p.send_error(error(value))
 
+    @staticmethod
+    def _emit_wire_hop(tr, hop) -> None:
+        """One client-side WireHop event per traced request/reply pair:
+        both process identities plus the four timestamps
+        (t0 client-send, t1 server-recv, t2 server-send, t3
+        client-recv) — tracemerge estimates the per-process-pair clock
+        offset as the median of ((t1-t0)+(t2-t3))/2 over these events
+        (the NTP local-clock-offset formula; no trusted wall clock)."""
+        t0, ids = tr
+        from ..flow import trace as _trace
+        flow.TraceEvent("WireHop", str(ids[0])).detail(
+            DebugIDs=[str(d) for d in ids],
+            Client=_trace.process_name(),
+            Server=hop.get("process", ""),
+            T0=t0, T1=hop.get("t1"), T2=hop.get("t2"),
+            T3=flow.now()).log()
+
     # -- client side -------------------------------------------------------
     def _request(self, addr, token: int, request) -> Future:
         p = Promise()
+        # traced envelope only when the knob is armed AND the request
+        # samples at least one debug id — everything else keeps the
+        # exact pre-knob K_REQUEST bytes
+        ctx = _trace_ctx(request) if _trace_armed() else None
         try:
-            payload = wire.to_bytes(request)
+            payload = (wire.to_bytes(request) if ctx is None
+                       else wire.to_bytes([ctx, request]))
         except wire.WireError:
             return flow.error_future(error("unknown_error"))
         with self._lock:
@@ -396,8 +489,12 @@ class TcpTransport:
             req_id = self._next_req
             self._next_req += 1
             self._pending[req_id] = p
+            if ctx is not None:
+                self._pending_trace[req_id] = (
+                    ctx["t0"], tuple(d for d, _sid in ctx["spans"]))
             conn.pending.add(req_id)
         if fresh:
             conn.start()     # connect happens on the writer thread
-        conn.enqueue(K_REQUEST, req_id, token, payload)
+        conn.enqueue(K_REQUEST if ctx is None else K_TRACED,
+                     req_id, token, payload)
         return p.future

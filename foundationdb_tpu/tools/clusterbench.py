@@ -76,7 +76,9 @@ def _lat_ms(vals: list) -> dict:
 async def _drive_commits(grv_send, commit_send, *, seed: int,
                          duration: float, rate: float, key_prefix: bytes,
                          max_inflight: int = 2048,
-                         clock=None) -> dict:
+                         clock=None, sample_every: int = 0,
+                         debug_prefix: str = "",
+                         live: Optional[dict] = None) -> dict:
     """The shared seeded open-loop commit workload: exponential
     arrivals at `rate` for `duration` seconds (sim or wall — `clock`
     decides what the latency numbers mean), each a GRV + a one-key
@@ -88,7 +90,14 @@ async def _drive_commits(grv_send, commit_send, *, seed: int,
 
     `grv_send(req, reply)` / `commit_send(i, req, reply)` inject into
     a proxy's streams — in-process these round-robin the SimCluster's
-    proxies; in a TCP worker they feed the worker's own Proxy role."""
+    proxies; in a TCP worker they feed the worker's own Proxy role.
+
+    With `sample_every` > 0, every Nth commit carries a debug id
+    (`debug_prefix` + arrival index) and opens the client
+    `NativeAPI.commit` span around its commit leg — the root of the
+    cross-process span tree tracemerge reassembles (ISSUE 16). 0 (the
+    default) changes nothing: no debug ids, no spans, identical
+    requests."""
     from ..server.types import (CommitRequest, GetReadVersionRequest,
                                 MutationRef, SET_VALUE)
     if clock is None:
@@ -100,6 +109,12 @@ async def _drive_commits(grv_send, commit_send, *, seed: int,
     commit_lat: List[float] = []
     inflight = [0]
     done = flow.Promise()
+    if live is not None:
+        # expose the in-flight accumulators so a status endpoint can
+        # snapshot the workload mid-run (federated status, ISSUE 16)
+        live["counts"] = counts
+        live["grv_lat"] = grv_lat
+        live["commit_lat"] = commit_lat
 
     async def one(i: int) -> None:
         # the random byte LEADS the key: resolver ownership splits on
@@ -108,6 +123,10 @@ async def _drive_commits(grv_send, commit_send, *, seed: int,
         # keyspaces disjoint)
         key = (bytes([g.random_int(0, 256)]) + key_prefix
                + b"%08d" % i)
+        debug_id = (f"{debug_prefix}{i}"
+                    if sample_every > 0 and i % sample_every == 0
+                    else None)
+        span = None
         try:
             t0 = clock()
             reply = Promise()
@@ -116,9 +135,13 @@ async def _drive_commits(grv_send, commit_send, *, seed: int,
             grv_lat.append(clock() - t0)
             t1 = clock()
             reply = Promise()
+            if debug_id is not None:
+                span = flow.g_trace_batch.begin_span(debug_id,
+                                                     "NativeAPI.commit")
             commit_send(i, CommitRequest(
                 ver, ((key, key + b"\x00"),), ((key, key + b"\x00"),),
-                (MutationRef(SET_VALUE, key, b"v"),)), reply)
+                (MutationRef(SET_VALUE, key, b"v"),),
+                debug_id=debug_id), reply)
             await reply.future
             commit_lat.append(clock() - t1)
             counts["committed"] += 1
@@ -132,6 +155,8 @@ async def _drive_commits(grv_send, commit_send, *, seed: int,
             else:
                 counts["errors"] += 1
         finally:
+            if span is not None:
+                span.finish()
             inflight[0] -= 1
             if counts["offered"] >= total[0] and inflight[0] == 0 \
                     and not done.is_set:
@@ -233,22 +258,47 @@ def run_inprocess_cell(n_proxies: int, n_resolvers: int, *, seed: int,
 
 # ------------------------------------------------------------ across-process
 def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
-                 duration: float, rate: float,
+                 duration: float, rate: float, run_dir: str = None,
+                 trace: bool = False, sample_every: int = 32,
                  out=lambda *a, **k: None) -> dict:
     """One across-process cell: this process hosts the cluster
     (master/resolvers/tlogs/storage) wall-clock behind a peer-serving
     TcpGateway; `n_proxies` worker OS processes each run a real Proxy
-    role over rpc/tcp.py and drive their share of the workload."""
+    role over rpc/tcp.py and drive their share of the workload.
+
+    Every cell gets a trace RUN DIRECTORY (`run_dir`, fresh tmpdir by
+    default): workers write role+pid-stamped trace files and
+    proc.<role>.<pid>.json discovery stubs there. With `trace=True`
+    the TRACE_PROPAGATION knob arms in host and workers, sampled
+    commits (1-in-`sample_every`) carry debug ids, and
+    tools/tracemerge.py reassembles the cross-process span trees from
+    the directory afterwards."""
     prev_sched = flow.get_scheduler()
     prev_rng = _rng.rng_state()
     cluster = gw = None
+    prev_trace_path = flow.g_trace.path
+    if run_dir is None:
+        import tempfile
+        run_dir = tempfile.mkdtemp(prefix="fdbtpu-run-")
+    else:
+        os.makedirs(run_dir, exist_ok=True)
     try:
         from ..rpc.gateway import TcpGateway
         from ..server import SimCluster
         from ..server import dbinfo as dbi
+        if trace:
+            # host-side trace file in the shared run dir: the
+            # resolver/tlog legs of every sampled commit land here
+            flow.reset_trace(os.path.join(
+                run_dir, f"trace.cluster-host.{os.getpid()}.jsonl"))
+            flow.trace.set_process_identity("cluster-host")
         cluster = SimCluster(seed=seed, virtual=False, n_proxies=1,
                              n_resolvers=n_resolvers, n_storage=1,
                              n_logs=1)
+        if trace:
+            # AFTER cluster construction: SimCluster re-seeds the knob
+            # set, which would silently disarm an earlier set()
+            flow.SERVER_KNOBS.set("trace_propagation", 1)
         gw = TcpGateway(cluster.client("benchgw"), cluster=cluster)
 
         results: list = []
@@ -258,7 +308,10 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
             cfg = {"host": "127.0.0.1", "port": gw.port,
                    "seed": seed + 1000 * (idx + 1), "index": idx,
                    "duration": duration,
-                   "rate": rate / n_proxies}
+                   "rate": rate / n_proxies,
+                   "run_dir": run_dir,
+                   "trace": int(bool(trace)),
+                   "sample_every": sample_every if trace else 0}
             try:
                 p = subprocess.run(
                     [sys.executable, "-m",
@@ -297,6 +350,7 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
         agg = {"proxies": n_proxies, "resolvers": n_resolvers,
                "mode": "tcp", "unit": "wall",
                "worker_processes": n_proxies,
+               "run_dir": run_dir,
                "wall_seconds": round(wall, 2)}
         for c in ("offered", "shed", "committed", "conflicted",
                   "too_old", "errors"):
@@ -313,15 +367,73 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
         agg["commit"] = results[0]["commit"] if results else {}
         out(f"  tcp {n_proxies}x{n_resolvers}: {agg['txn_per_s']}/s "
             f"committed={agg['committed']} "
-            f"divergent={agg['divergent_verdicts']}")
+            f"divergent={agg['divergent_verdicts']} "
+            f"trace-run-dir={run_dir}")
         return agg
     finally:
         if gw is not None:
             gw.close()
         if cluster is not None:
             cluster.shutdown()
+        if trace:
+            # host spans flushed into the run dir, then the shared
+            # collector goes back exactly where the caller had it
+            flow.g_trace_batch.dump()
+            flow.reset_trace(prev_trace_path)
+            flow.trace.clear_process_identity()
+            flow.SERVER_KNOBS.set("trace_propagation", 0)
         flow.set_scheduler(prev_sched)
         _rng.restore_rng_state(prev_rng)
+
+
+def worker_trace_setup(role: str, cfg: dict) -> None:
+    """Per-process TraceCollector hygiene for worker OS processes
+    (ISSUE 16 satellite): a role+pid-stamped trace file under the
+    shared run directory, the TRACE_PROPAGATION knob armed when the
+    driver asked for it, and the trace tail flushed on atexit AND on
+    SIGTERM — a worker the soak harness kills must not lose its spans.
+    (SIGKILL still loses whatever the OS buffers — the collector is
+    line-buffered, so at most the current line.)"""
+    import atexit
+    import signal
+    pid = os.getpid()
+    run_dir = cfg.get("run_dir")
+    if run_dir:
+        flow.reset_trace(os.path.join(run_dir,
+                                      f"trace.{role}.{pid}.jsonl"))
+    flow.trace.set_process_identity(
+        role, addr=f"{cfg['host']}:{cfg['port']}")
+    if cfg.get("trace"):
+        flow.SERVER_KNOBS.set("trace_propagation", 1)
+
+    def _flush_traces() -> None:
+        try:
+            flow.g_trace_batch.dump()
+            flow.g_trace.flush()
+        except Exception:  # noqa: BLE001 — never mask process exit
+            pass
+
+    def _on_sigterm(signum, _frame) -> None:
+        _flush_traces()
+        os._exit(128 + signum)
+
+    atexit.register(_flush_traces)
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
+
+def write_proc_file(run_dir: str, role: str, port: int,
+                    status_token: int) -> str:
+    """The discovery stub federated status readers key on
+    (proc.<role>.<pid>.json): where this worker's StatusRequest
+    endpoint listens."""
+    pid = os.getpid()
+    path = os.path.join(run_dir, f"proc.{role}.{pid}.json")
+    with open(path, "w") as fh:
+        json.dump({"name": f"{role}:{pid}", "role": role, "pid": pid,
+                   "host": "127.0.0.1", "port": port,
+                   "status_token": status_token}, fh)
+        fh.write("\n")
+    return path
 
 
 def run_worker(cfg: dict) -> dict:
@@ -336,19 +448,48 @@ def run_worker(cfg: dict) -> dict:
     try:
         from ..rpc.gateway import DESCRIBE_TOKEN, PEER_DESCRIBE
         from ..rpc.network import SimNetwork
-        from ..rpc.tcp import TcpTransport
+        from ..rpc.tcp import TcpRequestStream, TcpTransport
         from ..server.proxy import Proxy
         flow.set_seed(int(cfg["seed"]))
         s = flow.Scheduler(virtual=False)
         flow.set_scheduler(s)
+        role = cfg.get("role", f"proxy-{cfg['index']}")
+        worker_trace_setup(role, cfg)
         net = SimNetwork(s, flow.g_random)
         proc = net.new_process(f"benchproxy-{cfg['index']}",
                                machine=f"benchproxy-{cfg['index']}")
         transport = TcpTransport()
+        # federated status (ISSUE 16): every worker serves
+        # StatusRequest on its own transport; the proc file tells
+        # exporter --federate / the soak driver where
+        status_stream = TcpRequestStream(transport)
+        if cfg.get("run_dir"):
+            write_proc_file(cfg["run_dir"], role, transport.port,
+                            status_stream.token)
         host, port = cfg["host"], int(cfg["port"])
+        live: dict = {}
+        started = time.perf_counter()
+        pid = os.getpid()
+
+        def worker_status() -> dict:
+            counts = live.get("counts") or {}
+            return {
+                "process": f"{role}:{pid}", "role": role, "pid": pid,
+                "machine_id": f"benchproxy-{cfg['index']}",
+                "uptime_s": round(time.perf_counter() - started, 3),
+                "counters": dict(counts),
+                "grv": _lat_ms(list(live.get("grv_lat") or [])),
+                "commit": _lat_ms(list(live.get("commit_lat") or [])),
+            }
+
+        async def status_loop():
+            while True:
+                _req, reply = await status_stream.pop()
+                reply.send(worker_status())
 
         async def main():
             transport.start()
+            flow.spawn(status_loop())
             describe = transport.ref(host, port, DESCRIBE_TOKEN)
             doc = None
             for _ in range(50):
@@ -387,7 +528,9 @@ def run_worker(cfg: dict) -> dict:
                 duration=float(cfg["duration"]),
                 rate=float(cfg["rate"]),
                 key_prefix=b"sb/%d/" % int(cfg["index"]),
-                clock=time.perf_counter)
+                clock=time.perf_counter,
+                sample_every=int(cfg.get("sample_every", 0)),
+                debug_prefix=f"cb{cfg['index']}-", live=live)
             counts["index"] = cfg["index"]
             return counts
 
@@ -396,6 +539,13 @@ def run_worker(cfg: dict) -> dict:
     finally:
         if transport is not None:
             transport.close()
+        # worker spans belong to the run dir — land them before the
+        # process (and its trace file handle) goes away
+        try:
+            flow.g_trace_batch.dump()
+            flow.g_trace.flush()
+        except Exception:  # noqa: BLE001 — exiting anyway
+            pass
         flow.set_scheduler(prev_sched)
         _rng.restore_rng_state(prev_rng)
 
@@ -452,6 +602,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     duration = None
     rate = None
     matrix = False
+    trace = False
+    run_dir = None
     while argv:
         a = argv.pop(0)
         if a == "--worker":
@@ -475,6 +627,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             seed = int(argv.pop(0))
         elif a == "--out":
             out_path = argv.pop(0)
+        elif a == "--trace":
+            trace = True
+        elif a == "--run-dir":
+            run_dir = argv.pop(0)
         else:
             print(f"unknown argument {a!r}")
             return 2
@@ -487,7 +643,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                "cells": {"tcp": {}}}
         cell = run_tcp_cell(processes, resolvers or processes,
                             seed=seed, duration=duration or 3.0,
-                            rate=rate or 2000.0, out=print)
+                            rate=rate or 2000.0, run_dir=run_dir,
+                            trace=trace, out=print)
         doc["cells"]["tcp"][f"{processes}x{resolvers or processes}"] = \
             cell
         doc["headline"] = {
@@ -507,7 +664,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     with open(out_path, "w") as fh:
         json.dump(doc, fh, indent=2, sort_keys=True)
         fh.write("\n")
-    print(f"report -> {out_path}")
+    # final summary line names the trace run dir when one exists so a
+    # human (or CI log grep) can hand it straight to tracemerge
+    dirs = sorted({c["run_dir"] for cells in doc["cells"].values()
+                   for c in cells.values() if c.get("run_dir")})
+    suffix = f" trace-run-dir={dirs[0]}" if dirs else ""
+    print(f"report -> {out_path}{suffix}")
     return 0
 
 

@@ -33,9 +33,13 @@ class _Families:
     """Accumulate samples grouped by metric family so each family
     renders one # HELP/# TYPE header (the format requires grouping)."""
 
-    def __init__(self):
+    def __init__(self, extra_labels: dict = None):
         self._fams: dict = {}   # name -> (type, help, [(suffix, labels, value)])
         self._order: List[str] = []
+        # labels stamped onto every sample added while set — the
+        # federated render swaps this per process so one accumulator
+        # (and so one HELP/TYPE header per family) covers them all
+        self.extra: dict = dict(extra_labels or {})
 
     def add(self, name: str, mtype: str, help_text: str,
             labels: dict, value, suffix: str = "") -> None:
@@ -44,6 +48,8 @@ class _Families:
         name+suffix, the grouping strict OpenMetrics parsers require."""
         if value is None:
             return
+        if self.extra:
+            labels = {**self.extra, **labels}
         fam = self._fams.get(name)
         if fam is None:
             fam = self._fams[name] = (mtype, help_text, [])
@@ -124,10 +130,14 @@ def _add_counters(f: _Families, kind: str, role: str, counters: dict) -> None:
               {"kind": kind, "role": role, "counter": cname}, value)
 
 
-def render_prometheus(status: dict) -> str:
-    """The status document as Prometheus text exposition format."""
+def render_prometheus(status: dict, f: _Families = None) -> str:
+    """The status document as Prometheus text exposition format.
+    Pass an existing `_Families` to accumulate into it instead (the
+    federated scrape does — it returns "" and the caller renders)."""
     cl = status.get("cluster", status) or {}
-    f = _Families()
+    own = f is None
+    if own:
+        f = _Families()
     f.add(f"{_PREFIX}_cluster_epoch", "gauge",
           "Current recovery epoch", {}, cl.get("epoch"))
     f.add(f"{_PREFIX}_cluster_recovered", "gauge",
@@ -668,7 +678,154 @@ def render_prometheus(status: dict) -> str:
         f.add(f"{_PREFIX}_client_profile", "counter",
               "Sampled-transaction profiler counters",
               {"counter": cname}, value)
+    return f.render() if own else ""
+
+
+# ------------------------------------------------------- federation
+# ISSUE 16: every worker OS process serves a StatusRequest endpoint
+# (tools/clusterbench.py run_worker) and drops a proc.<role>.<pid>.json
+# discovery stub in the shared run directory. The helpers below read
+# the stubs, fetch the per-process docs over real TCP, fold them into
+# one `cluster.processes` status section, and render ONE Prometheus
+# scrape where every sample carries process="role:pid" labels.
+
+def _render_worker_doc(doc: dict, f: _Families) -> None:
+    """One worker-process status doc (clusterbench worker_status shape)
+    into the shared family accumulator. `f.extra` already carries the
+    process label."""
+    labels = {"role": doc.get("role", "?")}
+    f.add(f"{_PREFIX}_process_up", "gauge",
+          "1 while the worker process answers StatusRequest",
+          labels, doc.get("up", 1))
+    f.add(f"{_PREFIX}_process_uptime_seconds", "gauge",
+          "Worker uptime since its workload started", labels,
+          doc.get("uptime_s"))
+    for cname, value in sorted((doc.get("counters") or {}).items()):
+        if isinstance(value, (int, float)):
+            f.add(f"{_PREFIX}_worker_txn", "counter",
+                  "Per-worker workload transaction outcomes",
+                  {**labels, "counter": cname}, value)
+    for req in ("grv", "commit"):
+        snap = doc.get(req) or {}
+        for q, value in sorted(snap.items()):
+            # clusterbench _lat_ms shape: p50_ms/p95_ms/... gauges
+            if q.endswith("_ms") and isinstance(value, (int, float)):
+                f.add(f"{_PREFIX}_worker_latency_ms", "gauge",
+                      "Per-worker request-latency percentiles "
+                      "(milliseconds)",
+                      {**labels, "request": req,
+                       "quantile": q[:-3]}, value)
+
+
+def render_federated(host_status: dict, procs: List[dict],
+                     host_process: str = "cluster-host") -> str:
+    """One Prometheus scrape for the whole multi-process cluster: the
+    host CC status document plus every worker doc, each sample labelled
+    with its process identity. One accumulator keeps one HELP/TYPE
+    header per family even when several processes emit it."""
+    f = _Families()
+    if host_status:
+        f.extra = {"process": host_process}
+        render_prometheus(host_status, f=f)
+    for doc in procs or ():
+        f.extra = {"process": str(doc.get("process", "?"))}
+        _render_worker_doc(doc, f)
+    f.extra = {}
+    f.add(f"{_PREFIX}_federated_processes", "gauge",
+          "Processes folded into this scrape (host + workers)", {},
+          (1 if host_status else 0) + len(procs or ()))
     return f.render()
+
+
+def federate_status(host_status: dict, procs: List[dict],
+                    host_process: str = "cluster-host") -> dict:
+    """Fold per-process docs into the host status document under
+    `cluster.processes` (one section, keyed by "role:pid"), mirroring
+    the reference `status json` processes map."""
+    import copy
+    doc = copy.deepcopy(host_status or {})
+    cl = doc.setdefault("cluster", {})
+    cl["processes"] = {str(p.get("process", f"?:{i}")): p
+                      for i, p in enumerate(procs or ())}
+    cl["federation"] = {"host_process": host_process,
+                        "process_count": 1 + len(procs or ())}
+    return doc
+
+
+def read_proc_files(run_dir: str) -> List[dict]:
+    """The proc.<role>.<pid>.json discovery stubs in a run dir (sorted
+    by filename; unreadable stubs are skipped, not fatal — a worker may
+    die mid-write)."""
+    import json
+    import os
+    out: List[dict] = []
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        return out
+    for fn in names:
+        if not (fn.startswith("proc.") and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(run_dir, fn)) as fh:
+                out.append(json.load(fh))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def fetch_process_docs(run_dir: str, *, timeout: float = 5.0,
+                       stubs: List[dict] = None) -> List[dict]:
+    """Fetch every discovered worker's status doc over real TCP. A
+    worker that no longer answers yields an `up: 0` tombstone carrying
+    its stub identity, so the federated scrape shows the gap instead
+    of silently shrinking. Hosts its own wall-clock loop; the ambient
+    scheduler/RNG are restored on exit (the networktest discipline)."""
+    from .. import flow
+    from ..flow import rng as _rng
+    from ..rpc.tcp import TcpTransport
+    from ..server.types import STATUS_REQUEST
+    if stubs is None:
+        stubs = read_proc_files(run_dir)
+    if not stubs:
+        return []
+    prev_sched = flow.get_scheduler()
+    prev_rng = _rng.rng_state()
+    transport = None
+    try:
+        flow.set_seed(0)
+        s = flow.Scheduler(virtual=False)
+        flow.set_scheduler(s)
+        transport = TcpTransport()
+
+        async def fetch_one(stub: dict) -> dict:
+            ref = transport.ref(stub.get("host", "127.0.0.1"),
+                                int(stub["port"]),
+                                int(stub["status_token"]))
+            try:
+                doc = await flow.timeout_error(
+                    ref.get_reply(STATUS_REQUEST), timeout)
+            except flow.FdbError:
+                return {"process": stub.get("name", "?"),
+                        "role": stub.get("role", "?"),
+                        "pid": stub.get("pid"), "up": 0}
+            doc = dict(doc)
+            doc.setdefault("process", stub.get("name", "?"))
+            doc["up"] = 1
+            return doc
+
+        async def main():
+            transport.start()
+            return list(await flow.wait_for_all(
+                [flow.spawn(fetch_one(st)) for st in stubs]))
+
+        t = s.spawn(main())
+        return s.run(until=t, timeout_time=timeout * len(stubs) + 30)
+    finally:
+        if transport is not None:
+            transport.close()
+        flow.set_scheduler(prev_sched)
+        _rng.restore_rng_state(prev_rng)
 
 
 def parse_prometheus(text: str) -> List[Tuple[str, dict, float]]:
@@ -756,26 +913,52 @@ class ExporterServer:
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     connect = None
+    federate = None
     listen_port = 9090
     once = False
     while argv:
         a = argv.pop(0)
         if a == "--connect":
             connect = argv.pop(0)
+        elif a == "--federate":
+            federate = argv.pop(0)   # a run dir with proc.*.json stubs
         elif a == "--listen-port":
             listen_port = int(argv.pop(0))
         elif a == "--once":
             once = True   # print one scrape and exit (smoke / cron)
-    if connect is None:
-        print("usage: exporter --connect host:port [--listen-port N] "
-              "[--once]", file=sys.stderr)
+    if connect is None and federate is None:
+        print("usage: exporter (--connect host:port | --federate "
+              "run_dir) [--listen-port N] [--once]", file=sys.stderr)
         return 2
+    if federate is not None and connect is None:
+        # federate-only: fold every live worker in the run dir into
+        # one scrape (no host CC — e.g. scraping a soak's workers)
+        def scrape() -> str:
+            return render_federated({}, fetch_process_docs(federate))
+
+        if once:
+            print(scrape(), end="")
+            return 0
+        server = ExporterServer(scrape, port=listen_port)
+        server.start()
+        print(f"serving /metrics on :{server.port}", flush=True)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.close()
+        return 0
     from ..client.remote import RemoteCluster
     host, _, port = connect.partition(":")
     remote = RemoteCluster(host or "127.0.0.1", int(port))
 
     def scrape() -> str:
-        return render_prometheus(remote.call(remote.db.get_status()))
+        status = remote.call(remote.db.get_status())
+        if federate is not None:
+            return render_federated(status,
+                                    fetch_process_docs(federate))
+        return render_prometheus(status)
 
     try:
         if once:

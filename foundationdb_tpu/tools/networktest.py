@@ -87,6 +87,7 @@ def run_networktest(requests: int = 2000, parallel: int = 16,
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     kw = {}
+    run_dir = None
     while argv:
         a = argv.pop(0)
         if a == "--requests":
@@ -95,8 +96,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             kw["parallel"] = int(argv.pop(0))
         elif a == "--bytes":
             kw["payload_bytes"] = int(argv.pop(0))
-    result = run_networktest(**kw)
+        elif a == "--run-dir":
+            run_dir = argv.pop(0)
+    # CLI runs land their trace events in a run directory and name it
+    # in the final summary line, same contract as clusterbench
+    # (ISSUE 16 satellite) — tracemerge takes the directory as-is
     import json
+    import os
+    import tempfile
+    if run_dir is None:
+        run_dir = tempfile.mkdtemp(prefix="fdbtpu-run-")
+    else:
+        os.makedirs(run_dir, exist_ok=True)
+    prev_trace_path = flow.g_trace.path
+    flow.reset_trace(os.path.join(
+        run_dir, f"trace.networktest.{os.getpid()}.jsonl"))
+    flow.trace.set_process_identity("networktest")
+    try:
+        result = run_networktest(**kw)
+    finally:
+        flow.g_trace_batch.dump()
+        flow.reset_trace(prev_trace_path)
+        flow.trace.clear_process_identity()
+    result["trace_run_dir"] = run_dir
     print(json.dumps(result))
     return 0
 

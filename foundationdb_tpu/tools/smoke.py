@@ -25,6 +25,7 @@ OVERLOAD_REPORT_PATH = "/tmp/_overload_report.txt"
 HEAT_REPORT_PATH = "/tmp/_heat_report.txt"
 SIMPROF_REPORT_PATH = "/tmp/_simprof_smoke.txt"
 SPLITS_REPORT_PATH = "/tmp/_splits_report.txt"
+SOAK_REPORT_PATH = "/tmp/_soak_report.txt"
 SIMPROF_CHAOS_PATH = "/tmp/_simprof_chaos.json"
 SIMPROF_CHAOS_FOLDED_PATH = "/tmp/_simprof_chaos.folded"
 
@@ -1441,8 +1442,60 @@ def run_smoke_splits(out=print,
     return 0
 
 
+def run_smoke_soak(out=print,
+                   report_path: str = SOAK_REPORT_PATH) -> int:
+    """Short multi-OS-process soak (ISSUE 16's acceptance cell): a
+    real 2-client-worker soak over TCP with one SIGKILL+respawn armed
+    and tracing on.
+
+    Asserts: commits landed with ZERO divergent verdicts; the kill
+    recovered (recovery time recorded); the keyspace digest is stable
+    across two passes; the mid-run federated scrape covered host +
+    workers and parsed cleanly; and tools/tracemerge.py reassembled at
+    least one FULL client->proxy->resolver->tlog span chain across the
+    OS-process boundary from the run directory's trace files."""
+    import json
+    import os
+
+    from .soak import render_soak_report, run_soak
+
+    seed = int(os.environ.get("SOAK_SEED", 11))
+    duration = float(os.environ.get("SOAK_DURATION", 8.0))
+    doc = run_soak(processes=2, resolvers=2, duration=duration,
+                   rate=400.0, kills=1, seed=seed, out=out)
+    try:
+        assert not doc["errors"], doc["errors"]
+        assert doc["totals"]["committed"] > 0, doc["totals"]
+        assert doc["totals"]["divergent_verdicts"] == 0, doc["totals"]
+        assert doc["digest"]["consistent"], doc["digest"]
+        assert len(doc["kills"]) == 1, doc["kills"]
+        assert "recovery_s" in doc["kills"][0], doc["kills"]
+        fed = doc["federation"]
+        # host + >=2 worker entries, and the scrape parsed (the parse
+        # runs inside run_soak; a malformed scrape lands in errors)
+        assert fed.get("process_count", 0) >= 3, fed
+        assert fed.get("scrape_samples", 0) > 0, fed
+        tr = doc["trace"]
+        assert tr["full_commit_chains"] >= 1, tr
+        assert len(tr["processes"]) >= 2, tr
+        assert doc["ok"], "soak self-check failed"
+    finally:
+        with open(report_path, "w") as fh:
+            fh.write(json.dumps(doc, indent=2, sort_keys=True,
+                                default=str) + "\n")
+            fh.write(render_soak_report(doc))
+    out(f"soak smoke OK: {doc['totals']['committed']} committed, "
+        f"kill recovered in {doc['kills'][0]['recovery_s']}s, "
+        f"{doc['trace']['full_commit_chains']} cross-process commit "
+        f"chains; report -> {report_path} "
+        f"trace-run-dir={doc['run_dir']}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--soak" in argv:
+        return run_smoke_soak()
     if "--profile" in argv:
         return run_smoke_profile()
     if "--faults" in argv:

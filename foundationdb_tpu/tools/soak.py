@@ -1,0 +1,647 @@
+"""SOAK_r01: a long-horizon multi-OS-process soak with kills armed.
+
+Reference: the reference project's nightly "soak" runs — a real
+cluster held under load for hours with failures injected, watching
+throughput, latency, recovery time, and end-state consistency. Here
+the host process runs the full commit pipeline wall-clock behind a
+peer-serving TcpGateway (the PR 15 plumbing) and `--processes` client
+worker OS processes drive a seeded open-loop workload over real TCP.
+At a scheduled point the harness SIGKILLs a worker and respawns it,
+measuring recovery time (kill -> first committed transaction of the
+replacement). Throughout, it samples committed-txn/s and latency
+bands into time-series rows, fetches every worker's StatusRequest doc
+mid-run for the federated status/metrics surface (ISSUE 16), and at
+the end asserts ZERO divergent verdicts and a digest that is stable
+across two full keyspace passes. With tracing armed (the default)
+every worker writes role+pid-stamped trace files into the shared run
+directory and tools/tracemerge.py must reassemble at least one full
+client->proxy->resolver->tlog commit chain across the process
+boundary.
+
+CLI:
+  python -m foundationdb_tpu.tools.soak [--processes N] [--duration S]
+      [--rate R] [--resolvers N] [--kills N] [--seed S]
+      [--sample-period S] [--run-dir D] [--no-trace]
+      [--out SOAK_r01.json] [--report SOAK_r01.md]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from .. import flow
+from ..flow import rng as _rng
+from ..flow.future import Promise
+from .clusterbench import (_drive_commits, _lat_ms, worker_trace_setup,
+                           write_proc_file)
+
+OUT_PATH = "SOAK_r01.json"
+REPORT_PATH = "SOAK_r01.md"
+COUNT_KEYS = ("offered", "shed", "committed", "conflicted", "too_old",
+              "errors")
+
+
+# ------------------------------------------------------------- worker
+def run_soak_worker(cfg: dict) -> dict:
+    """Client-worker entry (one OS process): fetch the CLIENT describe
+    document from the gateway and drive a share of the open-loop
+    workload against the HOST's proxies over real TCP — so every
+    sampled commit's span tree crosses the process boundary at the
+    client->proxy hop. Emits a cumulative-count JSON sample line every
+    `sample_period` seconds (cumulative so the driver's accounting
+    survives a SIGKILL mid-run) and a final line when the horizon
+    ends."""
+    prev_sched = flow.get_scheduler()
+    prev_rng = _rng.rng_state()
+    transport = None
+    try:
+        from ..rpc.gateway import DESCRIBE_TOKEN
+        from ..rpc.tcp import TcpRequestStream, TcpTransport
+        flow.set_seed(int(cfg["seed"]))
+        s = flow.Scheduler(virtual=False)
+        flow.set_scheduler(s)
+        idx = int(cfg["index"])
+        gen = int(cfg.get("generation", 0))
+        role = f"client-{idx}"
+        pid = os.getpid()
+        worker_trace_setup(role, cfg)
+        transport = TcpTransport()
+        status_stream = TcpRequestStream(transport)
+        if cfg.get("run_dir"):
+            write_proc_file(cfg["run_dir"], role, transport.port,
+                            status_stream.token)
+        host, port = cfg["host"], int(cfg["port"])
+        live: dict = {}
+        started = time.perf_counter()
+
+        def worker_status() -> dict:
+            counts = live.get("counts") or {}
+            return {
+                "process": f"{role}:{pid}", "role": role, "pid": pid,
+                "generation": gen,
+                "uptime_s": round(time.perf_counter() - started, 3),
+                "counters": dict(counts),
+                "grv": _lat_ms(list(live.get("grv_lat") or [])),
+                "commit": _lat_ms(list(live.get("commit_lat") or [])),
+            }
+
+        async def status_loop():
+            while True:
+                _req, reply = await status_stream.pop()
+                reply.send(worker_status())
+
+        async def pipe(fut, promise: Promise) -> None:
+            try:
+                promise.send(await fut)
+            except flow.FdbError as e:
+                promise.send_error(e)
+
+        async def sampler():
+            period = float(cfg.get("sample_period", 1.0))
+            gi = ci = 0
+            while True:
+                await flow.delay(period)
+                counts = dict(live.get("counts") or {})
+                grv_lat = live.get("grv_lat") or []
+                commit_lat = live.get("commit_lat") or []
+                row = {"type": "sample", "index": idx, "pid": pid,
+                       "generation": gen,
+                       "t": round(time.perf_counter() - started, 3)}
+                for k in COUNT_KEYS:
+                    row[k] = counts.get(k, 0)
+                # latency over the window since the LAST sample — a
+                # time series of bands, not one run-wide smear
+                if len(grv_lat) > gi:
+                    row["grv"] = _lat_ms(list(grv_lat[gi:]))
+                if len(commit_lat) > ci:
+                    row["commit"] = _lat_ms(list(commit_lat[ci:]))
+                gi, ci = len(grv_lat), len(commit_lat)
+                print(json.dumps(row), flush=True)
+
+        async def main():
+            transport.start()
+            flow.spawn(status_loop())
+            describe = transport.ref(host, port, DESCRIBE_TOKEN)
+            doc = None
+            for _ in range(50):
+                try:
+                    doc = await flow.timeout_error(
+                        describe.get_reply(-1), 5.0)
+                    if doc.get("proxies"):
+                        break
+                    doc = None
+                except flow.FdbError:
+                    pass
+                await flow.delay(0.2)
+            if doc is None:
+                raise RuntimeError("client describe never became ready")
+            grv_refs = [transport.ref(host, port, p["grvs"])
+                        for p in doc["proxies"]]
+            commit_refs = [transport.ref(host, port, p["commits"])
+                           for p in doc["proxies"]]
+
+            def grv_send(req, reply):
+                flow.spawn(pipe(grv_refs[0].get_reply(req), reply))
+
+            def commit_send(i, req, reply):
+                ref = commit_refs[i % len(commit_refs)]
+                # get_reply is called HERE, synchronously, while the
+                # NativeAPI.commit span _drive_commits opened is still
+                # the top of this debug id's stack — the transport
+                # captures it as the cross-process parent
+                flow.spawn(pipe(ref.get_reply(req), reply))
+
+            flow.spawn(sampler())
+            counts = await _drive_commits(
+                grv_send, commit_send, seed=int(cfg["seed"]),
+                duration=float(cfg["duration"]),
+                rate=float(cfg["rate"]),
+                key_prefix=b"soak/%d/%d/" % (idx, gen),
+                clock=time.perf_counter,
+                sample_every=int(cfg.get("sample_every", 0)),
+                debug_prefix=f"soak{idx}g{gen}-", live=live)
+            counts["type"] = "final"
+            counts["index"] = idx
+            counts["pid"] = pid
+            counts["generation"] = gen
+            return counts
+
+        t = s.spawn(main())
+        result = s.run(until=t, timeout_time=float(cfg["duration"]) + 90)
+        print(json.dumps(result), flush=True)
+        return result
+    finally:
+        if transport is not None:
+            transport.close()
+        try:
+            flow.g_trace_batch.dump()
+            flow.g_trace.flush()
+        except Exception:  # noqa: BLE001 — exiting anyway
+            pass
+        flow.set_scheduler(prev_sched)
+        _rng.restore_rng_state(prev_rng)
+
+
+# ------------------------------------------------------------- driver
+class _Slot:
+    """One worker seat: the live Popen, its reader thread, the latest
+    cumulative sample, and the counts already banked from previous
+    (killed or finished) generations."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.generation = -1
+        self.proc: Optional[subprocess.Popen] = None
+        self.pid = 0
+        self.last: Optional[dict] = None       # latest sample row
+        self.final: Optional[dict] = None      # final row, if any
+        self.banked = {k: 0 for k in COUNT_KEYS}
+        self.kill_time: Optional[float] = None  # awaiting recovery
+
+    def live_counts(self) -> dict:
+        row = self.final or self.last or {}
+        return {k: self.banked[k] + row.get(k, 0) for k in COUNT_KEYS}
+
+
+def run_soak(*, processes: int = 2, resolvers: int = 2,
+             duration: float = 20.0, rate: float = 600.0,
+             kills: int = 1, seed: int = 0, sample_period: float = 1.0,
+             sample_every: int = 32, trace: bool = True,
+             run_dir: str = None, out=print) -> dict:
+    """The soak: host cluster + gateway in this process, `processes`
+    client workers as real OS processes, `kills` SIGKILL+respawn
+    rounds at evenly spaced points of the horizon. Returns the
+    SOAK_r01 document (see module docstring for what it asserts)."""
+    if processes < 1:
+        raise ValueError("soak needs at least one worker process")
+    prev_sched = flow.get_scheduler()
+    prev_rng = _rng.rng_state()
+    prev_trace_path = flow.g_trace.path
+    cluster = gw = fed_transport = None
+    if run_dir is None:
+        import tempfile
+        run_dir = tempfile.mkdtemp(prefix="fdbtpu-soak-")
+    else:
+        os.makedirs(run_dir, exist_ok=True)
+    lock = threading.Lock()
+    slots = [_Slot(i) for i in range(processes)]
+    kill_rows: List[dict] = []
+    errors: List[str] = []
+    t_start = [0.0]
+    try:
+        from ..rpc.gateway import TcpGateway
+        from ..rpc.tcp import TcpTransport
+        from ..server import SimCluster
+        from ..server import dbinfo as dbi
+        from ..server.chaos import database_digest
+        from ..server.types import STATUS_REQUEST
+        from . import exporter, tracemerge
+        if trace:
+            flow.reset_trace(os.path.join(
+                run_dir, f"trace.cluster-host.{os.getpid()}.jsonl"))
+            flow.trace.set_process_identity("cluster-host")
+        cluster = SimCluster(seed=seed, virtual=False, n_proxies=1,
+                             n_resolvers=resolvers, n_storage=1,
+                             n_logs=1)
+        if trace:
+            # AFTER construction — SimCluster re-seeds the knob set
+            flow.SERVER_KNOBS.set("trace_propagation", 1)
+        db = cluster.client("soak-status")
+        gw = TcpGateway(cluster.client("soakgw"), cluster=cluster)
+
+        def reader(slot: _Slot, p: subprocess.Popen) -> None:
+            for line in p.stdout:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                with lock:
+                    if row.get("pid") != slot.pid:
+                        continue   # a straggler line from an old gen
+                    if row.get("type") == "sample":
+                        slot.last = row
+                        if slot.kill_time is not None and \
+                                row.get("committed", 0) > 0:
+                            kill_rows[-1]["recovery_s"] = round(
+                                time.perf_counter() - slot.kill_time,
+                                3)
+                            kill_rows[-1]["recovered_pid"] = slot.pid
+                            slot.kill_time = None
+                    elif row.get("type") == "final":
+                        slot.final = row
+
+        def spawn_worker(slot: _Slot, remaining: float) -> None:
+            with lock:
+                slot.generation += 1
+                slot.last = slot.final = None
+                cfg = {"host": "127.0.0.1", "port": gw.port,
+                       "seed": seed + 1000 * (slot.index + 1)
+                       + 71 * slot.generation,
+                       "index": slot.index,
+                       "generation": slot.generation,
+                       "duration": round(remaining, 3),
+                       "rate": rate / processes,
+                       "run_dir": run_dir,
+                       "trace": int(bool(trace)),
+                       "sample_every": sample_every if trace else 0,
+                       "sample_period": sample_period}
+            err_path = os.path.join(
+                run_dir, f"worker-{slot.index}.{slot.generation}.stderr")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "foundationdb_tpu.tools.soak",
+                 "--worker", json.dumps(cfg)],
+                stdout=subprocess.PIPE,
+                stderr=open(err_path, "w"),
+                text=True, bufsize=1)
+            with lock:
+                slot.proc = p
+                slot.pid = p.pid
+            threading.Thread(target=reader, args=(slot, p),
+                             daemon=True).start()
+
+        def kill_worker(slot: _Slot) -> None:
+            with lock:
+                p, pid, gen = slot.proc, slot.pid, slot.generation
+                row = slot.last or {}
+                for k in COUNT_KEYS:
+                    slot.banked[k] += row.get(k, 0)
+                slot.last = slot.final = None
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait(timeout=30)
+            with lock:
+                slot.kill_time = time.perf_counter()
+                kill_rows.append({
+                    "t": round(time.perf_counter() - t_start[0], 3),
+                    "slot": slot.index, "killed_pid": pid,
+                    "killed_generation": gen,
+                    "committed_before_kill": row.get("committed", 0)})
+
+        timeline: List[dict] = []
+        federation: dict = {}
+
+        async def fetch_federation() -> None:
+            """Mid-run: every worker's StatusRequest doc over the
+            host's own client TCP transport, folded with the CC
+            status into one federated doc + one Prometheus scrape."""
+            stubs = exporter.read_proc_files(run_dir)
+            procs: List[dict] = []
+            for stub in stubs:
+                ref = fed_transport.ref(stub.get("host", "127.0.0.1"),
+                                        int(stub["port"]),
+                                        int(stub["status_token"]))
+                try:
+                    doc = await flow.timeout_error(
+                        ref.get_reply(STATUS_REQUEST), 5.0)
+                    doc = dict(doc)
+                    doc.setdefault("process", stub.get("name", "?"))
+                    doc["up"] = 1
+                except flow.FdbError:
+                    doc = {"process": stub.get("name", "?"),
+                           "role": stub.get("role", "?"),
+                           "pid": stub.get("pid"), "up": 0}
+                procs.append(doc)
+            host_status = await db.get_status()
+            fed_doc = exporter.federate_status(
+                host_status, procs,
+                host_process=f"cluster-host:{os.getpid()}")
+            scrape = exporter.render_federated(
+                host_status, procs,
+                host_process=f"cluster-host:{os.getpid()}")
+            samples = exporter.parse_prometheus(scrape)  # well-formed?
+            federation["processes"] = sorted(
+                fed_doc["cluster"]["processes"])
+            federation["process_count"] = \
+                fed_doc["cluster"]["federation"]["process_count"]
+            federation["up"] = sum(
+                1 for p in procs if p.get("up"))
+            federation["scrape_samples"] = len(samples)
+
+        async def main():
+            gw.start()
+            while cluster.cc.dbinfo.get().recovery_state != \
+                    dbi.FULLY_RECOVERED:
+                await flow.delay(0.05)
+            fed_transport.start()
+            t0 = time.perf_counter()
+            t_start[0] = t0
+            for slot in slots:
+                spawn_worker(slot, duration)
+            kill_at = [t0 + duration * (k + 1) / (kills + 1)
+                       for k in range(kills)]
+            fed_at = t0 + duration * 0.75
+            fed_done = False
+            next_sample = t0 + sample_period
+            prev_committed = 0
+            prev_t = t0
+            while time.perf_counter() < t0 + duration:
+                await flow.delay(0.1)
+                wall = time.perf_counter()
+                while kill_at and wall >= kill_at[0]:
+                    kill_at.pop(0)
+                    victim = slots[len(kill_rows) % processes]
+                    kill_worker(victim)
+                    spawn_worker(victim,
+                                 t0 + duration - time.perf_counter())
+                if not fed_done and wall >= fed_at:
+                    fed_done = True
+                    try:
+                        await fetch_federation()
+                    except Exception as e:  # noqa: BLE001 — recorded
+                        errors.append(f"federation: {e!r}")
+                if wall >= next_sample:
+                    next_sample += sample_period
+                    with lock:
+                        totals = {k: 0 for k in COUNT_KEYS}
+                        lat = {}
+                        up = 0
+                        for slot in slots:
+                            for k, v in slot.live_counts().items():
+                                totals[k] += v
+                            row = slot.last or {}
+                            if slot.proc is not None and \
+                                    slot.proc.poll() is None:
+                                up += 1
+                            for req in ("grv", "commit"):
+                                for q, v in (row.get(req)
+                                             or {}).items():
+                                    key = f"{req}_{q}"
+                                    lat[key] = max(lat.get(key, 0.0),
+                                                   v)
+                    trow = {"t": round(wall - t0, 3),
+                            "committed": totals["committed"],
+                            "txn_per_s": round(
+                                (totals["committed"] - prev_committed)
+                                / max(1e-9, wall - prev_t), 1),
+                            "divergent": totals["conflicted"]
+                            + totals["too_old"] + totals["errors"],
+                            "workers_up": up}
+                    trow.update({k: round(v, 3)
+                                 for k, v in sorted(lat.items())})
+                    timeline.append(trow)
+                    prev_committed = totals["committed"]
+                    prev_t = wall
+            # horizon over: let the workers publish their final rows
+            grace = time.perf_counter() + 30
+            while time.perf_counter() < grace:
+                with lock:
+                    if all(s.final is not None or s.proc is None
+                           or s.proc.poll() is not None
+                           for s in slots):
+                        break
+                await flow.delay(0.2)
+            if not fed_done:
+                try:
+                    await fetch_federation()
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errors.append(f"federation: {e!r}")
+            # end-state consistency: two full keyspace passes must
+            # hash identically (quiesced database, stable digest)
+            d1 = await database_digest(db)
+            d2 = await database_digest(db)
+            return d1, d2, round(time.perf_counter() - t0, 3)
+
+        fed_transport = TcpTransport()
+        d1, d2, wall = cluster.run(main(), timeout_time=duration + 300)
+        for slot in slots:
+            if slot.proc is not None and slot.proc.poll() is None:
+                slot.proc.send_signal(signal.SIGKILL)
+                slot.proc.wait(timeout=30)
+        with lock:
+            totals = {k: 0 for k in COUNT_KEYS}
+            for slot in slots:
+                for k, v in slot.live_counts().items():
+                    totals[k] += v
+            finals = [s.final for s in slots if s.final is not None]
+        totals["divergent_verdicts"] = (totals["conflicted"]
+                                        + totals["too_old"]
+                                        + totals["errors"])
+        doc = {
+            "metric": "soak_multi_process",
+            "config": {"processes": processes, "resolvers": resolvers,
+                       "duration_wall_s": duration, "offered_rate": rate,
+                       "kills": kills, "seed": seed,
+                       "sample_period_s": sample_period,
+                       "sample_every": sample_every,
+                       "trace": bool(trace)},
+            "run_dir": run_dir,
+            "wall_seconds": wall,
+            "timeline": timeline,
+            "kills": kill_rows,
+            "totals": totals,
+            "txn_per_s": round(totals["committed"] / max(1e-9, wall), 1),
+            "latency_ms": {
+                "grv": finals[0].get("grv", {}) if finals else {},
+                "commit": finals[0].get("commit", {}) if finals else {},
+            },
+            "digest": {"first": d1, "second": d2,
+                       "consistent": d1 == d2},
+            "federation": federation,
+            "errors": errors,
+        }
+        if trace:
+            # the cross-process proof: merge the run dir and demand at
+            # least one complete client->proxy->resolver->tlog chain
+            flow.g_trace_batch.dump()
+            flow.g_trace.flush()
+            merged = tracemerge.merge(run_dir)
+            full = tracemerge.full_commit_chains(merged)
+            doc["trace"] = {
+                "run_dir": run_dir,
+                "processes": merged["processes"],
+                "chains": len(merged["chains"]),
+                "cross_process_chains": len(
+                    tracemerge.cross_process_chains(merged)),
+                "full_commit_chains": len(full),
+                "clock_offsets_s": merged["clock_offsets_s"],
+            }
+        ok = (not errors
+              and totals["divergent_verdicts"] == 0
+              and totals["committed"] > 0
+              and doc["digest"]["consistent"]
+              and all("recovery_s" in k for k in kill_rows)
+              and (not trace
+                   or doc["trace"]["full_commit_chains"] >= 1))
+        doc["ok"] = ok
+        out(f"  soak {processes}p x {duration}s: "
+            f"{doc['txn_per_s']}/s committed={totals['committed']} "
+            f"divergent={totals['divergent_verdicts']} "
+            f"kills={len(kill_rows)} "
+            f"digest_consistent={doc['digest']['consistent']} "
+            f"ok={ok} trace-run-dir={run_dir}")
+        return doc
+    finally:
+        for slot in slots:
+            if slot.proc is not None and slot.proc.poll() is None:
+                slot.proc.send_signal(signal.SIGKILL)
+        if fed_transport is not None:
+            fed_transport.close()
+        if gw is not None:
+            gw.close()
+        if cluster is not None:
+            cluster.shutdown()
+        if trace:
+            flow.reset_trace(prev_trace_path)
+            flow.trace.clear_process_identity()
+            flow.SERVER_KNOBS.set("trace_propagation", 0)
+        flow.set_scheduler(prev_sched)
+        _rng.restore_rng_state(prev_rng)
+
+
+def render_soak_report(doc: dict) -> str:
+    """SOAK_r01.md: the document as a human report."""
+    cfg = doc["config"]
+    lines = [
+        "# SOAK_r01 — multi-process soak",
+        "",
+        f"- processes: {cfg['processes']} client workers + 1 cluster "
+        f"host, resolvers={cfg['resolvers']}, seed={cfg['seed']}",
+        f"- horizon: {cfg['duration_wall_s']}s wall at "
+        f"{cfg['offered_rate']} offered txn/s, kills armed: "
+        f"{cfg['kills']}",
+        f"- committed: {doc['totals']['committed']} "
+        f"({doc['txn_per_s']}/s), divergent verdicts: "
+        f"{doc['totals']['divergent_verdicts']}",
+        f"- digest: consistent={doc['digest']['consistent']} "
+        f"({doc['digest']['first'][:16]}...)",
+        f"- verdict: {'PASS' if doc.get('ok') else 'FAIL'}",
+        "",
+        "## Kills",
+        "",
+    ]
+    for k in doc["kills"]:
+        rec = k.get("recovery_s")
+        lines.append(
+            f"- t={k['t']}s slot {k['slot']}: SIGKILL pid "
+            f"{k['killed_pid']} (gen {k['killed_generation']}, "
+            f"{k['committed_before_kill']} committed) -> recovered in "
+            f"{rec if rec is not None else 'NEVER'}s")
+    if not doc["kills"]:
+        lines.append("- none armed")
+    fed = doc.get("federation") or {}
+    lines += [
+        "",
+        "## Federation",
+        "",
+        f"- processes in status.cluster.processes: "
+        f"{fed.get('process_count', 0)} "
+        f"({fed.get('up', 0)} up), scrape samples: "
+        f"{fed.get('scrape_samples', 0)}",
+    ]
+    tr = doc.get("trace") or {}
+    if tr:
+        lines += [
+            "",
+            "## Cross-process traces",
+            "",
+            f"- merged chains: {tr['chains']} "
+            f"({tr['cross_process_chains']} cross-process, "
+            f"{tr['full_commit_chains']} full "
+            f"client->proxy->resolver->tlog paths)",
+            f"- processes: {', '.join(tr['processes'])}",
+        ]
+    lines += ["", "## Timeline", "",
+              "| t (s) | committed | txn/s | divergent | workers up |",
+              "|---|---|---|---|---|"]
+    for row in doc["timeline"]:
+        lines.append(
+            f"| {row['t']} | {row['committed']} | {row['txn_per_s']} "
+            f"| {row['divergent']} | {row['workers_up']} |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    kw: dict = {}
+    out_path = OUT_PATH
+    report_path = REPORT_PATH
+    while argv:
+        a = argv.pop(0)
+        if a == "--worker":
+            run_soak_worker(json.loads(argv.pop(0)))
+            return 0
+        if a == "--processes":
+            kw["processes"] = int(argv.pop(0))
+        elif a == "--resolvers":
+            kw["resolvers"] = int(argv.pop(0))
+        elif a == "--duration":
+            kw["duration"] = float(argv.pop(0))
+        elif a == "--rate":
+            kw["rate"] = float(argv.pop(0))
+        elif a == "--kills":
+            kw["kills"] = int(argv.pop(0))
+        elif a == "--seed":
+            kw["seed"] = int(argv.pop(0))
+        elif a == "--sample-period":
+            kw["sample_period"] = float(argv.pop(0))
+        elif a == "--run-dir":
+            kw["run_dir"] = argv.pop(0)
+        elif a == "--no-trace":
+            kw["trace"] = False
+        elif a == "--out":
+            out_path = argv.pop(0)
+        elif a == "--report":
+            report_path = argv.pop(0)
+        else:
+            print(f"unknown argument {a!r}")
+            return 2
+    doc = run_soak(out=print, **kw)
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    with open(report_path, "w") as fh:
+        fh.write(render_soak_report(doc))
+    print(f"report -> {out_path} + {report_path} "
+          f"trace-run-dir={doc['run_dir']}")
+    return 0 if doc.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
