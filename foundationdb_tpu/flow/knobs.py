@@ -146,6 +146,52 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # the pinned chaos baselines); the armed path is exercised by the
     # soak harness and tests/test_distributed_trace.py instead
     init("TRACE_PROPAGATION", 0)
+    # -- longitudinal observability (ISSUE 17): TimeKeeper + metric
+    # history + SLO engine. METRIC_HISTORY is the master gate: 0 (the
+    # default) spawns NONE of the plane's actors — the cluster is
+    # byte-identical to the pre-plane behavior (the pinned off
+    # posture). Deliberately NOT buggified (the INTERVAL_PACKED_FEED /
+    # TRACE_PROPAGATION discipline: a new buggify site consumes a draw
+    # from the shared buggify stream and would shift every later
+    # knob's randomization on existing seeds, invalidating the pinned
+    # chaos baselines); the armed paths are exercised by the soak
+    # harness, smoke --slo, and tests/test_longitudinal.py instead.
+    init("METRIC_HISTORY", 0)
+    # version<->wallclock map cadence + retention (ref: the reference's
+    # fdbserver/TimeKeeper.actor.cpp writing \xff\x02/timeKeeper/ every
+    # SYSTEM_MONITOR_FREQUENCY with a bounded day count; sim-scaled)
+    init("TIMEKEEPER_INTERVAL", 1.0)
+    init("TIMEKEEPER_RETENTION", 120.0)
+    # metric-history recorder: sample cadence, samples per persisted
+    # chunk row, and the shared retention window the janitor trims BOTH
+    # the new \xff\x02/metrics/ series and the legacy tuple-space
+    # counter series to (satellite: one bounded-scan janitor)
+    init("METRIC_HISTORY_INTERVAL", 1.0)
+    init("METRIC_HISTORY_CHUNK", 8)
+    init("METRIC_RETENTION_SECONDS", 300.0)
+    init("METRIC_JANITOR_INTERVAL", 10.0)
+    # SLO engine (server/slo.py): evaluation cadence, p99 ceilings for
+    # the commit/GRV probes (milliseconds), the recovery-time bound,
+    # the error budget (fraction of requests allowed over the latency
+    # band edge), and the multiwindow burn-rate alert shape (a la the
+    # SRE-workbook fast/slow windows: page only when BOTH windows burn
+    # the budget faster than their rate)
+    init("SLO_EVAL_INTERVAL", 1.0)
+    init("SLO_COMMIT_P99_MS", 250.0)
+    init("SLO_GRV_P99_MS", 250.0)
+    init("SLO_RECOVERY_SECONDS", 120.0)
+    init("SLO_ERROR_BUDGET", 0.01)
+    init("SLO_BURN_FAST_WINDOW", 10.0)
+    init("SLO_BURN_SLOW_WINDOW", 60.0)
+    init("SLO_BURN_FAST_RATE", 14.0)
+    init("SLO_BURN_SLOW_RATE", 3.0)
+    # breach-drill latency injection (tools/soak.py --breach-at): extra
+    # seconds added to every proxy commit batch while armed, so a soak
+    # can prove the burn-rate alert actually fires. 0 = off (one knob
+    # read per batch, no delay, no schedule change). Not buggified —
+    # chaos storms inject latency through the network plane; this knob
+    # exists for the DIRECTED drill whose detection time is asserted.
+    init("COMMIT_LATENCY_INJECTION", 0.0)
     # conflict hot-spot table (resolver-side attribution aggregation):
     # score half-life seconds, table capacity, rows surfaced in status
     init("HOT_SPOT_HALF_LIFE", 10.0, lambda: 0.5)

@@ -135,6 +135,17 @@ class TraceCollector:
             # over-limit file
             self._roll_broken = True
         self._set_file(self._path)
+        if _process_identity is not None and self._fh:
+            # the rolled-away segment carried the ProcessIdentity
+            # header; re-stamp the fresh file so every segment is
+            # self-describing (tracemerge attributes spans per segment
+            # group, and a headerless segment would fall back to the
+            # local-process bucket)
+            self.emit({"Severity": SevInfo, "Time": _now(),
+                       "Type": "ProcessIdentity", "ID": process_name(),
+                       "Role": _process_identity["role"],
+                       "Pid": _process_identity["pid"],
+                       "Addr": _process_identity["addr"]})
 
     def emit(self, ev: dict) -> None:
         self.counts[ev["Type"]] = self.counts.get(ev["Type"], 0) + 1

@@ -75,3 +75,179 @@ async def metric_logger(db, collections, interval: float = None,
         await flow.delay(interval)
         await log_counters(db, collections, space,
                            extra=extra_fn() if extra_fn else None)
+
+
+# -- the \xff\x02/metrics/ history series (ISSUE 17) ----------------------
+# Written by the CC's MetricHistoryRecorder (server/metric_history.py)
+# in delta-encoded chunk rows; read back here by anything with a
+# database handle — the soak's restart-safe verdict, incident bundles,
+# dashboards.
+
+async def read_history(db, signal: str, start_ms: int = None,
+                       end_ms: int = None, limit: int = 100_000):
+    """One signal's persisted samples: [(ts_ms, int_value)], optionally
+    bounded to start_ms <= ts < end_ms. Chunks are self-contained, so
+    the row range is cut at chunk granularity and samples filtered —
+    a chunk straddling the window still contributes its inside part."""
+    from ..server.systemkeys import (decode_metric_chunk,
+                                     metric_history_signal_prefix)
+    prefix = metric_history_signal_prefix(signal)
+
+    async def body(tr):
+        tr.set_option("access_system_keys")
+        return await tr.get_range(prefix, prefix + b"\xff", limit=limit)
+
+    rows = await run_transaction(db, body)
+    out = []
+    for _k, v in rows:
+        samples = decode_metric_chunk(v)
+        if samples is None:
+            continue
+        for ts, val in samples:
+            if start_ms is not None and ts < start_ms:
+                continue
+            if end_ms is not None and ts >= end_ms:
+                continue
+            out.append((ts, val))
+    return out
+
+
+async def list_history_signals(db, limit: int = 100_000):
+    """Every signal with at least one persisted chunk, sorted."""
+    from ..server.systemkeys import (METRIC_HISTORY_END,
+                                     METRIC_HISTORY_PREFIX,
+                                     parse_metric_history_key)
+
+    async def body(tr):
+        tr.set_option("access_system_keys")
+        return await tr.get_range(METRIC_HISTORY_PREFIX,
+                                  METRIC_HISTORY_END, limit=limit)
+
+    rows = await run_transaction(db, body)
+    signals = set()
+    for k, _v in rows:
+        parsed = parse_metric_history_key(k)
+        if parsed is not None:
+            signals.add(parsed[1])
+    return sorted(signals)
+
+
+async def trim_history(db, cutoff_ms: int, max_retries: int = 100,
+                       scan_limit: int = 10_000) -> int:
+    """Trim every signal's series to the retention window: one bounded
+    scan to discover the live signals, then one clear_range per signal
+    up to its cutoff chunk (the clientlog-janitor shape; chunks are
+    keyed by their FIRST sample, so a straddling chunk survives whole)."""
+    from ..server.systemkeys import (METRIC_HISTORY_END,
+                                     METRIC_HISTORY_PREFIX,
+                                     metric_history_cutoff_key,
+                                     metric_history_signal_prefix,
+                                     parse_metric_history_key)
+
+    async def body(tr):
+        tr.set_option("access_system_keys")
+        rows = await tr.get_range(METRIC_HISTORY_PREFIX,
+                                  METRIC_HISTORY_END, limit=scan_limit)
+        doomed = 0
+        signals = set()
+        for k, _v in rows:
+            parsed = parse_metric_history_key(k)
+            if parsed is None:
+                continue
+            signals.add(parsed[1])
+            if parsed[2] < cutoff_ms:
+                doomed += 1
+        for signal in signals:
+            tr.clear_range(metric_history_signal_prefix(signal),
+                           metric_history_cutoff_key(signal, cutoff_ms))
+        return doomed
+
+    return await run_transaction(db, body, max_retries=max_retries)
+
+
+async def trim_series(db, cutoff_ms: int, space: Subspace = DEFAULT_SPACE,
+                      max_retries: int = 100,
+                      scan_limit: int = 10_000) -> int:
+    """Trim the LEGACY tuple-space counter series (log_counters above)
+    to the same retention window: keys order as (role, counter, ts), so
+    old rows interleave per pair — one bounded scan discovers the live
+    (role, counter) pairs, then one clear_range per pair trims its tail."""
+    b, e = space.range(())
+
+    async def body(tr):
+        rows = await tr.get_range(b, e, limit=scan_limit)
+        doomed = 0
+        pairs = set()
+        for k, _v in rows:
+            try:
+                role, counter, ts = space.unpack(k)
+            except Exception:  # noqa: BLE001 — foreign rows are skipped
+                continue
+            pairs.add((role, counter))
+            if ts < cutoff_ms:
+                doomed += 1
+        for role, counter in pairs:
+            pb, _pe = space.range((role, counter))
+            tr.clear_range(pb, space.pack((role, counter, cutoff_ms)))
+        return doomed
+
+    return await run_transaction(db, body, max_retries=max_retries)
+
+
+class MetricsJanitor:
+    """ONE retention janitor for every longitudinal keyspace (the
+    ISSUE 17 satellite: trimming was ad hoc per series): the
+    \\xff\\x02/metrics/ history and the legacy tuple-space counter
+    series share METRIC_RETENTION_SECONDS; the TimeKeeper map keeps
+    its own TIMEKEEPER_RETENTION (operators want version translation
+    to reach further back than dense samples). Lifecycle mirrors
+    ClientLogJanitor."""
+
+    def __init__(self, cluster, retention: float = None,
+                 interval: float = None, space: Subspace = DEFAULT_SPACE):
+        self.cluster = cluster
+        self.db = cluster.client("metrics-janitor")
+        self.retention = retention
+        self.interval = interval
+        self.space = space
+        self.rows_trimmed = 0
+        self.rounds = 0
+        self._task = None
+
+    def start(self) -> None:
+        from ..flow import TaskPriority
+        self._task = flow.spawn(self._run(), TaskPriority.LOW_PRIORITY,
+                                name="metricsJanitor")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        from ..flow import TaskPriority
+        from ..server.timekeeper import trim_timekeeper
+        while True:
+            await flow.delay(
+                self.interval if self.interval is not None
+                else flow.SERVER_KNOBS.metric_janitor_interval,
+                TaskPriority.LOW_PRIORITY)
+            retention = (self.retention if self.retention is not None
+                         else flow.SERVER_KNOBS.metric_retention_seconds)
+            cutoff_ms = int((flow.now() - retention) * 1000)
+            try:
+                trimmed = await trim_history(self.db, cutoff_ms)
+                trimmed += await trim_series(self.db, cutoff_ms,
+                                             self.space)
+                trimmed += await trim_timekeeper(
+                    self.db,
+                    flow.now() - flow.SERVER_KNOBS.timekeeper_retention)
+                if trimmed:
+                    flow.TraceEvent("MetricsTrimmed").detail(
+                        Rows=trimmed, CutoffMs=cutoff_ms).log()
+                self.rows_trimmed += trimmed
+                self.rounds += 1
+            except flow.FdbError as e:
+                if e.name == "operation_cancelled":
+                    raise
+                # a trim round losing to a recovery waits for the next

@@ -44,7 +44,9 @@ class SimCluster:
                  virtual: bool = True, data_dir: Optional[str] = None,
                  workers_per_machine: int = 1, n_zones: int = 0,
                  storage_policy=None, backup_driver: bool = False,
-                 profile_janitor: bool = False):
+                 profile_janitor: bool = False,
+                 metric_history: bool = False,
+                 metrics_janitor: bool = False):
         if storage_policy is not None and \
                 storage_policy.replica_count() != max(1, storage_replicas):
             raise ValueError(
@@ -141,6 +143,14 @@ class SimCluster:
             c.start()
             self.coordinators.append(c)
 
+        # the longitudinal plane (ISSUE 17): must be armed BETWEEN the
+        # knob reset above and CC construction — cc.start() decides at
+        # spawn time whether the TimeKeeper/recorder/SLO loops exist at
+        # all (the byte-identical off posture), so a post-construction
+        # SERVER_KNOBS.set would be too late
+        if metric_history:
+            flow.SERVER_KNOBS.set("metric_history", 1)
+
         # the cluster controller (single candidate; contested elections
         # are exercised in the coordination unit tests)
         self.cc = ClusterController(
@@ -186,6 +196,15 @@ class SimCluster:
             from ..layers.clientlog import ClientLogJanitor
             self.client_log_janitor = ClientLogJanitor(self)
             self.client_log_janitor.start()
+        # retention trimming for the longitudinal keyspaces — the
+        # metric history, the legacy counter series, AND the TimeKeeper
+        # map through ONE bounded-scan janitor (layers/metrics.py);
+        # opt-in like the two drivers above
+        self.metrics_janitor = None
+        if metrics_janitor:
+            from ..layers.metrics import MetricsJanitor
+            self.metrics_janitor = MetricsJanitor(self)
+            self.metrics_janitor.start()
         self.workers: dict = {}
         for i in range(n_workers):
             if self.workers_per_machine > 1 or n_zones > 0:

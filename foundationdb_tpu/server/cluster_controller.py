@@ -287,6 +287,15 @@ class ClusterController:
         # (`status.cluster.resolver_balance`), not a trace grep
         self.balance_stats = flow.CounterCollection("resolver_balance")
         self.balance_last: "dict | None" = None
+        # the longitudinal plane (ISSUE 17, armed via METRIC_HISTORY):
+        # the metric-history recorder, the SLO engine's latest verdict,
+        # and TimeKeeper accounting. All stay empty/zero while the knob
+        # is 0 — the plane's loops are then never even spawned, so the
+        # off posture is byte-identical to pre-plane behavior
+        self.metric_recorder = None
+        self.slo_verdict: dict = {}
+        self.slo_breaches = 0
+        self._timekeeper_rows = 0
         # (instance name, counter) -> TimeSeries (ref: TDMetric levels)
         self.metrics: dict = {}
         self._metric_gauges: set = set()   # (rn, cn) sampled via set()
@@ -303,19 +312,28 @@ class ClusterController:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
-        for coro, name in ((self._run(), "run"),
-                           (self._registration_loop(), "register"),
-                           (self._open_db_loop(), "openDatabase"),
-                           (self._status_loop(), "status"),
-                           (self._management_loop(), "management"),
-                           (self._dd_loop(), "dataDistribution"),
-                           (self._failure_monitor_loop(), "failureMonitor"),
-                           (self._metric_sampler_loop(), "metricSampler"),
-                           (self._qos_sampler_loop(), "qosSampler"),
-                           (self._hot_spot_push_loop(), "hotSpotPush"),
-                           (self._trace_counters_loop(), "traceCounters"),
-                           (self._latency_probe_loop(), "latencyProbe"),
-                           (self._conf_sync_loop(), "confSync")):
+        loops = [(self._run(), "run"),
+                 (self._registration_loop(), "register"),
+                 (self._open_db_loop(), "openDatabase"),
+                 (self._status_loop(), "status"),
+                 (self._management_loop(), "management"),
+                 (self._dd_loop(), "dataDistribution"),
+                 (self._failure_monitor_loop(), "failureMonitor"),
+                 (self._metric_sampler_loop(), "metricSampler"),
+                 (self._qos_sampler_loop(), "qosSampler"),
+                 (self._hot_spot_push_loop(), "hotSpotPush"),
+                 (self._trace_counters_loop(), "traceCounters"),
+                 (self._latency_probe_loop(), "latencyProbe"),
+                 (self._conf_sync_loop(), "confSync")]
+        # the longitudinal plane's loops exist ONLY while armed: gating
+        # at spawn time (not inside the loop) keeps the METRIC_HISTORY=0
+        # posture byte-identical — zero extra actors, zero extra timers,
+        # identical scheduler step counts (the pinned off posture)
+        if flow.SERVER_KNOBS.metric_history:
+            loops += [(self._timekeeper_loop(), "timeKeeper"),
+                      (self._metric_history_loop(), "metricHistory"),
+                      (self._slo_loop(), "sloEngine")]
+        for coro, name in loops:
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
         self.process.on_kill(self._actors.cancel_all)
@@ -1256,6 +1274,98 @@ class ClusterController:
             except flow.FdbError:
                 pass  # a probe racing a recovery just skips a round
 
+    # -- the longitudinal plane (ISSUE 17; spawned only when armed) ------
+    async def _timekeeper_loop(self):
+        """Commit the version<->wallclock map row by row through the
+        ordinary pipeline (ref: fdbserver/TimeKeeper.actor.cpp). Writes
+        only while the cluster is seeing OTHER commits — the latency
+        probe's quiescence pattern: the row's own commit version is
+        remembered so an idle cluster can still go fully quiet."""
+        from ..client import Database
+        from .systemkeys import timekeeper_key
+        db = Database(self.process, self.open_db.ref())
+        seen_committed = -1
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.timekeeper_interval,
+                             TaskPriority.LOW_PRIORITY)
+            if self.dbinfo.get().recovery_state != FULLY_RECOVERED or \
+                    self.probe_paused:
+                continue
+            committed = max((p.committed_version.get()
+                             for p in self._current_proxies()),
+                            default=-1)
+            if committed < 0 or committed == seen_committed:
+                continue
+            try:
+                tr = db.create_transaction()
+                tr.set_option("access_system_keys")
+                tr.set(timekeeper_key(int(flow.now() * 1000)),
+                       b"%d" % committed)
+                seen_committed = await tr.commit()
+                self._timekeeper_rows += 1
+            except flow.FdbError:
+                pass  # a row racing a recovery just skips a round
+
+    async def _metric_history_loop(self):
+        """Sample the status signals into the recorder each tick and
+        flush full chunks into \\xff\\x02/metrics/ (schema:
+        systemkeys.py; recorder: server/metric_history.py). Sampling
+        always runs (the SLO engine reads the in-memory tail even
+        mid-recovery); flushing needs a recovered pipeline."""
+        from ..client import Database
+        from .metric_history import MetricHistoryRecorder
+        self.metric_recorder = rec = MetricHistoryRecorder(self)
+        db = Database(self.process, self.open_db.ref())
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.metric_history_interval,
+                             TaskPriority.LOW_PRIORITY)
+            rec.record(flow.now())
+            if self.dbinfo.get().recovery_state != FULLY_RECOVERED or \
+                    self.probe_paused:
+                continue
+            try:
+                await rec.flush(db)
+            except flow.FdbError:
+                pass  # buffered samples flush on a later round
+
+    async def _slo_loop(self):
+        """Evaluate the SLO rule table over the recorder's in-memory
+        tail every SLO_EVAL_INTERVAL (server/slo.py — the same pure
+        math the soak's post-hoc read-back runs over the persisted
+        series). Breach transitions are counted and traced; the
+        verdict rides status.cluster.slo + health messages."""
+        from . import slo as slo_mod
+        rules = slo_mod.default_rules()
+        prev_state = "ok"
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.slo_eval_interval,
+                             TaskPriority.LOW_PRIORITY)
+            rec = self.metric_recorder
+            if rec is None:
+                continue
+            verdict = slo_mod.evaluate(rules, rec.tail_series(),
+                                       int(flow.now() * 1000))
+            self.slo_verdict = verdict
+            if verdict["state"] == "breach" and prev_state != "breach":
+                self.slo_breaches += 1
+                flow.cover("slo.breach")
+                flow.TraceEvent("SLOBreach", self.process.name).detail(
+                    Rules=",".join(verdict["breached"])).log()
+            prev_state = verdict["state"]
+
+    def _current_ratekeeper(self):
+        """The current epoch's Ratekeeper role, or None mid-recovery
+        (the recorder's rk/* signals read its rate + last decision)."""
+        from .ratekeeper import Ratekeeper
+        ep = self.dbinfo.get().epoch
+        for wi in self.workers.values():
+            if not wi.worker.process.alive:
+                continue
+            for rn, role in wi.worker.roles.items():
+                if isinstance(role, Ratekeeper) and rn.endswith(f"-e{ep}"):
+                    return role
+        return None
+
     def _health_messages(self, info) -> list:
         """Event-driven health rollup: the status document's `messages`
         array (ref: the messages JSON clusterGetStatus assembles —
@@ -1363,6 +1473,22 @@ class ClusterController:
                     f"Storage {name} trails the log frontier by "
                     f"{lag} versions",
                 "storage": name, "lag_versions": lag})
+        # SLO breaches (ISSUE 17): one message per tripped rule while
+        # the longitudinal plane is armed and breaching — empty (and
+        # free) otherwise, so the off posture's messages are unchanged
+        if self.slo_verdict.get("state") == "breach":
+            for r in self.slo_verdict.get("rules", ()):
+                if r.get("ok"):
+                    continue
+                msgs.append({
+                    "name": "slo_breach",
+                    "severity": flow.trace.SevWarnAlways,
+                    "description":
+                        f"SLO rule {r['name']} breached "
+                        f"(value {r.get('value')}, "
+                        f"threshold {r.get('threshold')})",
+                    "rule": r["name"], "value": r.get("value"),
+                    "threshold": r.get("threshold")})
         return msgs
 
     # -- status ----------------------------------------------------------
@@ -1619,6 +1745,10 @@ class ClusterController:
                 "admission_control": self._admission_doc(proxies,
                                                          rk_role),
                 "latency_probe": probe,
+                # the longitudinal plane's rollup (ISSUE 17): SLO
+                # verdict + recorder/TimeKeeper accounting while
+                # METRIC_HISTORY is armed; {"enabled": 0} otherwise
+                "slo": self._slo_doc(),
                 # hottest conflict-causing key ranges, cluster-wide
                 # (per-resolver tables under resolvers[*].hot_spots)
                 "conflict_hot_spots": hot_rows[
@@ -1683,6 +1813,23 @@ class ClusterController:
                     "excluded": sorted(self.excluded),
                 },
             },
+        }
+
+    def _slo_doc(self) -> dict:
+        """status.cluster.slo: the engine's latest verdict + the
+        recorder's and TimeKeeper's accounting."""
+        enabled = int(bool(flow.SERVER_KNOBS.metric_history))
+        if not enabled:
+            return {"enabled": 0}
+        return {
+            "enabled": 1,
+            "state": self.slo_verdict.get("state", "ok"),
+            "breached": self.slo_verdict.get("breached", []),
+            "breaches": self.slo_breaches,
+            "rules": self.slo_verdict.get("rules", []),
+            "recorder": (self.metric_recorder.status()
+                         if self.metric_recorder is not None else {}),
+            "timekeeper_rows": self._timekeeper_rows,
         }
 
     def _balance_doc(self) -> dict:

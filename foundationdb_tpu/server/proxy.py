@@ -1077,6 +1077,14 @@ class Proxy:
                     self._management_ref.send(
                         MetadataMutations(ver.version, meta), self.process)
 
+            # breach-drill injection (COMMIT_LATENCY_INJECTION, ISSUE
+            # 17): a directed soak arms this to prove the burn-rate SLO
+            # pages — 0 (the default) is one knob read, no delay, no
+            # schedule change
+            inj = SERVER_KNOBS.commit_latency_injection
+            if inj:
+                await flow.delay(inj)
+
             # phase 5: per-transaction replies
             st = self.stats
             st.counter("commit_batches").add(1)

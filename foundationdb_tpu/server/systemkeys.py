@@ -139,6 +139,138 @@ def parse_tag_throttle_value(value: bytes):
         return None
 
 
+# \xff\x02/timeKeeper/ — the version<->wallclock map (ref:
+# fdbserver/TimeKeeper.actor.cpp writing timeKeeperPrefixRange: a CC
+# actor periodically commits (time -> read version) rows through the
+# ordinary pipeline so tools can translate between the two axes).
+# Keys are ordered by wallclock:
+#
+#   <prefix><version>/<ts_ms 16-hex>
+#
+# with the commit version as an ascii decimal value. Fixed-width hex
+# keeps the rows range-scannable in time order so `version_at_time`
+# is one bounded range read and retention trimming is one clear_range.
+TIMEKEEPER_PREFIX = STORED_SYSTEM_PREFIX + b"/timeKeeper/"
+TIMEKEEPER_END = STORED_SYSTEM_PREFIX + b"/timeKeeper0"
+TIMEKEEPER_VERSION = 1
+
+
+def timekeeper_key(ts_ms: int, version: int = TIMEKEEPER_VERSION) -> bytes:
+    return TIMEKEEPER_PREFIX + b"%d/%016x" % (version, ts_ms)
+
+
+def parse_timekeeper_key(key: bytes):
+    """-> (version, ts_ms) or None for a foreign key."""
+    if not key.startswith(TIMEKEEPER_PREFIX):
+        return None
+    parts = key[len(TIMEKEEPER_PREFIX):].split(b"/")
+    if len(parts) != 2:
+        return None
+    try:
+        return (int(parts[0]), int(parts[1], 16))
+    except ValueError:
+        return None
+
+
+def timekeeper_cutoff_key(ts_ms: int,
+                          version: int = TIMEKEEPER_VERSION) -> bytes:
+    """First possible key at `ts_ms` — clear_range(PREFIX + version row,
+    this) removes every map entry older than the cutoff."""
+    return TIMEKEEPER_PREFIX + b"%d/%016x" % (version, ts_ms)
+
+
+# \xff\x02/metrics/<signal>/<ts> — persisted metric history (the
+# longitudinal twin of the status doc: the CC's recorder samples the
+# signals status already computes and commits them through the
+# ordinary pipeline, the same "metrics keyspace" idiom the reference
+# uses for latency-band and DD metrics). Series rows are CHUNKED like
+# the client_latency records — each row holds METRIC_HISTORY_CHUNK
+# consecutive samples delta-encoded against the chunk's base — and
+# each chunk is self-contained, so retention trimming stays one
+# clear_range per signal and a partial read still decodes.
+#
+#   <prefix><version>/<signal ascii>/<first_ts_ms 16-hex>
+#
+# Value (ascii, '|'-separated like the tag-throttle rows):
+#
+#   <version>|<base_ts_ms>|<base_value>|<dt:dv,dt:dv,...>
+#
+# where (dt, dv) are per-sample deltas against the PREVIOUS sample.
+# Values are integers (fixed-point: float signals are stored x1000).
+METRIC_HISTORY_PREFIX = STORED_SYSTEM_PREFIX + b"/metrics/"
+METRIC_HISTORY_END = STORED_SYSTEM_PREFIX + b"/metrics0"
+METRIC_HISTORY_VERSION = 1
+
+
+def metric_history_key(signal: str, first_ts_ms: int,
+                       version: int = METRIC_HISTORY_VERSION) -> bytes:
+    return METRIC_HISTORY_PREFIX + (
+        b"%d/%s/%016x" % (version, signal.encode(), first_ts_ms))
+
+
+def parse_metric_history_key(key: bytes):
+    """-> (version, signal, first_ts_ms) or None for a foreign key.
+    Signals may themselves contain '/' (e.g. latency/commit/p99_ms), so
+    the timestamp is split off the RIGHT."""
+    if not key.startswith(METRIC_HISTORY_PREFIX):
+        return None
+    rest = key[len(METRIC_HISTORY_PREFIX):]
+    head, sep, ts = rest.rpartition(b"/")
+    ver, sep2, signal = head.partition(b"/")
+    if not sep or not sep2 or not signal:
+        return None
+    try:
+        return (int(ver), signal.decode(), int(ts, 16))
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+def metric_history_signal_prefix(signal: str,
+                                 version: int = METRIC_HISTORY_VERSION) -> bytes:
+    return METRIC_HISTORY_PREFIX + b"%d/%s/" % (version, signal.encode())
+
+
+def metric_history_cutoff_key(signal: str, first_ts_ms: int,
+                              version: int = METRIC_HISTORY_VERSION) -> bytes:
+    """Trim bound for one signal's series: clear_range(signal prefix,
+    this) removes every chunk whose FIRST sample is older than the
+    cutoff (a chunk straddling the cutoff survives whole — chunks are
+    self-contained, so readers just filter samples by timestamp)."""
+    return metric_history_key(signal, first_ts_ms, version)
+
+
+def encode_metric_chunk(samples) -> bytes:
+    """samples: non-empty [(ts_ms, int_value), ...] in time order."""
+    base_ts, base_v = samples[0]
+    deltas = []
+    prev_ts, prev_v = base_ts, base_v
+    for ts, v in samples[1:]:
+        deltas.append(b"%d:%d" % (ts - prev_ts, v - prev_v))
+        prev_ts, prev_v = ts, v
+    return b"%d|%d|%d|%s" % (METRIC_HISTORY_VERSION, base_ts, base_v,
+                             b",".join(deltas))
+
+
+def decode_metric_chunk(value: bytes):
+    """-> [(ts_ms, int_value), ...] or None for a foreign/unknown-version
+    row (readers skip those — the client_latency contract)."""
+    try:
+        parts = value.split(b"|")
+        if len(parts) != 4 or int(parts[0]) != METRIC_HISTORY_VERSION:
+            return None
+        ts, v = int(parts[1]), int(parts[2])
+        out = [(ts, v)]
+        if parts[3]:
+            for pair in parts[3].split(b","):
+                dt, dv = pair.split(b":")
+                ts += int(dt)
+                v += int(dv)
+                out.append((ts, v))
+        return out
+    except (ValueError, TypeError):
+        return None
+
+
 # \xff/conf/<row> -> ClusterConfig field. The first four are
 # operator-mutable (what `configure` accepts); the rest are seeded
 # informational rows.

@@ -43,6 +43,9 @@ Commands (ref: fdbcli):
   heat                       storage heat: per-server read/write
                              bandwidth + shard bytes, read-hot
                              sub-ranges, busiest read tag per server
+  slo                        SLO engine verdict: per-rule ok/BREACH,
+                             burn rates, recorder + TimeKeeper write
+                             accounting (needs METRIC_HISTORY armed)
 
   throttle on <tag> <tps> [prio] [secs]   manually throttle a tag
                              (prio: default | batch; secs: how long
@@ -560,6 +563,41 @@ def _render_metrics(cl: dict) -> str:
     return "\n".join(lines)
 
 
+def _render_slo(cl: dict) -> str:
+    """`slo`: the longitudinal-observability verdict (ISSUE 17) — the
+    online SLO engine's per-rule state, the recorder/TimeKeeper write
+    accounting, and how many ok->breach transitions the run has seen
+    (what an operator reads to answer 'is the cluster meeting its
+    objectives, and if not which rule broke first')."""
+    slo = cl.get("slo") or {}
+    if not slo.get("enabled"):
+        return ("SLO engine off — arm METRIC_HISTORY to start the "
+                "TimeKeeper, the metric-history recorder, and the "
+                "burn-rate rules")
+    lines = [f"SLO: {slo.get('state', '?')} "
+             f"(breaches this run: {slo.get('breaches', 0)})"]
+    for r in slo.get("rules", ()):
+        val = r.get("value")
+        thr = r.get("threshold")
+        extra = ""
+        if r.get("kind") == "burn_rate" and \
+                r.get("slow_value") is not None:
+            extra = (f"  slow={r['slow_value']:g}"
+                     f"/{r.get('slow_threshold', 0):g}")
+        lines.append(
+            f"  {'ok    ' if r.get('ok') else 'BREACH'} "
+            f"{r.get('name', '?'):<22} {r.get('kind', ''):<10} "
+            f"value={val if val is not None else '-':<10} "
+            f"threshold={thr if thr is not None else '-'}{extra}")
+    rec = slo.get("recorder") or {}
+    lines.append(
+        f"  recorder: {rec.get('signals', 0)} signals, "
+        f"{rec.get('samples', 0)} samples taken, "
+        f"{rec.get('rows_written', 0)} chunk rows flushed; "
+        f"timekeeper rows: {slo.get('timekeeper_rows', 0)}")
+    return "\n".join(lines)
+
+
 class Cli:
     def __init__(self, db, runner, cluster=None):
         """`db` is any Database-shaped handle (in-sim or remote);
@@ -674,6 +712,10 @@ class Cli:
             async def ht():
                 return await self.db.get_status()
             return _render_heat(self._run(ht())["cluster"])
+        if cmd == "slo":
+            async def sl():
+                return await self.db.get_status()
+            return _render_slo(self._run(sl())["cluster"])
         if cmd == "status":
             async def st():
                 return await self.db.get_status()

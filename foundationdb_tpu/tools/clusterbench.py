@@ -311,6 +311,8 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
                    "rate": rate / n_proxies,
                    "run_dir": run_dir,
                    "trace": int(bool(trace)),
+                   "trace_roll_size":
+                       int(flow.SERVER_KNOBS.trace_roll_size),
                    "sample_every": sample_every if trace else 0}
             try:
                 p = subprocess.run(
@@ -398,6 +400,14 @@ def worker_trace_setup(role: str, cfg: dict) -> None:
     import signal
     pid = os.getpid()
     run_dir = cfg.get("run_dir")
+    # the HOST collector's roll size governs the workers too (ISSUE 17
+    # satellite): the driver ships its trace_roll_size knob in the
+    # worker cfg, so an hours-long soak's per-worker trace files rotate
+    # into .N segments instead of growing unbounded — set BEFORE
+    # reset_trace so the fresh collector sizes against it
+    if cfg.get("trace_roll_size"):
+        flow.SERVER_KNOBS.set("trace_roll_size",
+                              int(cfg["trace_roll_size"]))
     if run_dir:
         flow.reset_trace(os.path.join(run_dir,
                                       f"trace.{role}.{pid}.jsonl"))

@@ -573,6 +573,35 @@ def render_prometheus(status: dict, f: _Families = None) -> str:
               "One-way datagrams duplicated by swizzled links", {},
               chaos.get("messages_duplicated"))
 
+    # the SLO engine's verdict (server/slo.py, METRIC_HISTORY armed):
+    # overall state + per-rule ok/value so a dashboard alerts on the
+    # same burn-rate math the cluster controller evaluates in-process
+    slo = cl.get("slo") or {}
+    if slo.get("enabled"):
+        f.add(f"{_PREFIX}_slo_ok", "gauge",
+              "1 when every SLO rule currently holds, 0 on breach", {},
+              1 if slo.get("state") == "ok" else 0)
+        f.add(f"{_PREFIX}_slo_breaches", "counter",
+              "ok->breach transitions seen by the online SLO engine",
+              {}, slo.get("breaches"))
+        f.add(f"{_PREFIX}_slo_timekeeper_rows", "counter",
+              "version<->wallclock rows committed by the TimeKeeper",
+              {}, slo.get("timekeeper_rows"))
+        rec = slo.get("recorder") or {}
+        f.add(f"{_PREFIX}_slo_metric_rows", "counter",
+              "Metric-history chunk rows flushed to the keyspace", {},
+              rec.get("rows_written"))
+        for r in slo.get("rules", ()):
+            rl = {"rule": r.get("name", "?")}
+            f.add(f"{_PREFIX}_slo_rule_ok", "gauge",
+                  "1 while this SLO rule holds, 0 while breached", rl,
+                  1 if r.get("ok") else 0)
+            if r.get("value") is not None:
+                f.add(f"{_PREFIX}_slo_rule_value", "gauge",
+                      "Current evaluated value for this SLO rule "
+                      "(fixed-point: floats scaled x1000)", rl,
+                      r.get("value"))
+
     msgs = cl.get("messages", ())
     f.add(f"{_PREFIX}_health_messages", "gauge",
           "Active health messages in the status rollup", {}, len(msgs))

@@ -26,6 +26,7 @@ HEAT_REPORT_PATH = "/tmp/_heat_report.txt"
 SIMPROF_REPORT_PATH = "/tmp/_simprof_smoke.txt"
 SPLITS_REPORT_PATH = "/tmp/_splits_report.txt"
 SOAK_REPORT_PATH = "/tmp/_soak_report.txt"
+SLO_REPORT_PATH = "/tmp/_slo_report.txt"
 SIMPROF_CHAOS_PATH = "/tmp/_simprof_chaos.json"
 SIMPROF_CHAOS_FOLDED_PATH = "/tmp/_simprof_chaos.folded"
 
@@ -1492,10 +1493,72 @@ def run_smoke_soak(out=print,
     return 0
 
 
+def run_smoke_slo(out=print,
+                  report_path: str = SLO_REPORT_PATH) -> int:
+    """Longitudinal-observability cell (ISSUE 17's acceptance): the
+    soak run with the metric-history plane armed and a mid-run commit
+    latency breach injected.
+
+    Asserts: TimeKeeper rows landed and the clock<->version round trip
+    holds; the \\xff\\x02/metrics/ keyspace holds enough signal series
+    to rebuild the throughput timeline after the horizon (restart-safe
+    accounting — read back from the database, not host memory); the
+    ONLINE burn-rate SLO engine tripped during the injected breach (at
+    least one ok->breach transition in status.cluster.slo); and the
+    incident bundle covering the breach window was written with the
+    version-aligned series, status/chaos docs, and the tracemerge
+    report. The run is judged on DETECTION, not on ending green: the
+    p99 reservoir decays slowly after the injection lifts, so the
+    final evaluated state may legitimately still show the ceiling
+    rules red."""
+    import json
+    import os
+
+    from .soak import render_soak_report, run_soak
+
+    seed = int(os.environ.get("SOAK_SEED", 11))
+    duration = float(os.environ.get("SOAK_DURATION", 10.0))
+    doc = run_soak(processes=2, resolvers=2, duration=duration,
+                   rate=400.0, kills=0, seed=seed, slo=True,
+                   breach_at=duration * 0.45,
+                   breach_len=duration * 0.3, out=out)
+    try:
+        assert not doc["errors"], doc["errors"]
+        assert doc["totals"]["committed"] > 0, doc["totals"]
+        assert doc["totals"]["divergent_verdicts"] == 0, doc["totals"]
+        assert doc["digest"]["consistent"], doc["digest"]
+        sl = doc["slo"]
+        assert sl["signals"] > 0, sl
+        assert sl["timekeeper_rows"] > 0, sl
+        assert sl["timekeeper_ok"], sl
+        assert sl["rebuilt_samples"] > 0, sl
+        assert sl["online_breaches"] >= 1, sl
+        assert sl["posthoc_breaches"] >= 1, sl
+        b = sl.get("bundle") or {}
+        assert b, sl
+        for name in ("manifest.json", "series.json",
+                     "timekeeper.json", "status.json"):
+            assert os.path.exists(os.path.join(b["dir"], name)), b
+        assert b["samples"] > 0, b
+        assert doc["ok"], "slo soak self-check failed"
+    finally:
+        with open(report_path, "w") as fh:
+            fh.write(json.dumps(doc, indent=2, sort_keys=True,
+                                default=str) + "\n")
+            fh.write(render_soak_report(doc))
+    out(f"slo smoke OK: {doc['slo']['signals']} signals, "
+        f"{doc['slo']['timekeeper_rows']} timekeeper rows, "
+        f"{doc['slo']['online_breaches']} online breach(es), bundle -> "
+        f"{doc['slo']['bundle']['dir']}; report -> {report_path}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--soak" in argv:
         return run_smoke_soak()
+    if "--slo" in argv:
+        return run_smoke_slo()
     if "--profile" in argv:
         return run_smoke_profile()
     if "--faults" in argv:

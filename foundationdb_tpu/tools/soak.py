@@ -18,10 +18,24 @@ directory and tools/tracemerge.py must reassemble at least one full
 client->proxy->resolver->tlog commit chain across the process
 boundary.
 
+With `--slo` (ISSUE 17) the cluster runs the longitudinal plane:
+TimeKeeper + metric-history recorder + SLO engine armed
+(METRIC_HISTORY=1), per-sample timeline rows streamed to
+<run_dir>/timeline.jsonl and cumulative counts banked to banked.json
+(an hours-long run's accounting survives a host crash, not just
+client SIGKILLs), and the final timeline + verdict REBUILT from the
+persistent \\xff\\x02/metrics/ + \\xff\\x02/timeKeeper/ keyspaces — the
+run is judged by what the database recorded about itself, not by the
+driver's memory. `--breach-at T` arms COMMIT_LATENCY_INJECTION for
+`--breach-len` seconds mid-run: the burn-rate SLO must trip online
+and an incident bundle (tools/incident.py) must cover the window.
+`--hours H` is the long-horizon spelling of --duration.
+
 CLI:
   python -m foundationdb_tpu.tools.soak [--processes N] [--duration S]
-      [--rate R] [--resolvers N] [--kills N] [--seed S]
-      [--sample-period S] [--run-dir D] [--no-trace]
+      [--hours H] [--rate R] [--resolvers N] [--kills N] [--seed S]
+      [--sample-period S] [--run-dir D] [--no-trace] [--slo]
+      [--breach-at T] [--breach-len S]
       [--out SOAK_r01.json] [--report SOAK_r01.md]
 """
 
@@ -211,20 +225,28 @@ class _Slot:
 
 
 def run_soak(*, processes: int = 2, resolvers: int = 2,
-             duration: float = 20.0, rate: float = 600.0,
+             duration: float = 20.0, hours: float = None,
+             rate: float = 600.0,
              kills: int = 1, seed: int = 0, sample_period: float = 1.0,
              sample_every: int = 32, trace: bool = True,
-             run_dir: str = None, out=print) -> dict:
+             run_dir: str = None, slo: bool = False,
+             breach_at: float = None, breach_len: float = 4.0,
+             breach_delay: float = 0.4, out=print) -> dict:
     """The soak: host cluster + gateway in this process, `processes`
     client workers as real OS processes, `kills` SIGKILL+respawn
     rounds at evenly spaced points of the horizon. Returns the
     SOAK_r01 document (see module docstring for what it asserts)."""
     if processes < 1:
         raise ValueError("soak needs at least one worker process")
+    if hours is not None:
+        duration = hours * 3600.0
+    if breach_at is not None and not slo:
+        raise ValueError("--breach-at needs --slo (nothing would "
+                         "detect the breach)")
     prev_sched = flow.get_scheduler()
     prev_rng = _rng.rng_state()
     prev_trace_path = flow.g_trace.path
-    cluster = gw = fed_transport = None
+    cluster = gw = fed_transport = timeline_fh = None
     if run_dir is None:
         import tempfile
         run_dir = tempfile.mkdtemp(prefix="fdbtpu-soak-")
@@ -249,10 +271,31 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             flow.trace.set_process_identity("cluster-host")
         cluster = SimCluster(seed=seed, virtual=False, n_proxies=1,
                              n_resolvers=resolvers, n_storage=1,
-                             n_logs=1)
+                             n_logs=1, metric_history=slo,
+                             metrics_janitor=slo)
         if trace:
             # AFTER construction — SimCluster re-seeds the knob set
             flow.SERVER_KNOBS.set("trace_propagation", 1)
+        if slo:
+            # scale the longitudinal plane to the horizon (also AFTER
+            # construction): small chunks + burn windows that fit a
+            # smoke-length run, their defaults for long runs. Both
+            # retentions must out-live the run — the end-of-run
+            # read-back and the breach-window version alignment need
+            # the WHOLE timeline still in the keyspace (the janitor's
+            # trim math is unit-tested; here it must not eat evidence)
+            flow.SERVER_KNOBS.set("metric_history_chunk",
+                                  4 if duration < 60 else 8)
+            fast = max(2.0, min(10.0, duration * 0.2))
+            flow.SERVER_KNOBS.set("slo_burn_fast_window", fast)
+            flow.SERVER_KNOBS.set("slo_burn_slow_window",
+                                  max(2 * fast, min(60.0,
+                                                    duration * 0.5)))
+            flow.SERVER_KNOBS.set("slo_eval_interval", 0.5)
+            flow.SERVER_KNOBS.set("metric_retention_seconds",
+                                  duration * 2 + 600.0)
+            flow.SERVER_KNOBS.set("timekeeper_retention",
+                                  duration * 2 + 600.0)
         db = cluster.client("soak-status")
         gw = TcpGateway(cluster.client("soakgw"), cluster=cluster)
 
@@ -290,6 +333,11 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                        "rate": rate / processes,
                        "run_dir": run_dir,
                        "trace": int(bool(trace)),
+                       # the HOST's roll size governs worker trace
+                       # files too: an hours-long worker rotates into
+                       # .N segments tracemerge reads back in order
+                       "trace_roll_size":
+                           int(flow.SERVER_KNOBS.trace_roll_size),
                        "sample_every": sample_every if trace else 0,
                        "sample_period": sample_period}
             err_path = os.path.join(
@@ -324,8 +372,32 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                     "killed_generation": gen,
                     "committed_before_kill": row.get("committed", 0)})
 
+        # long-horizon accounting (ISSUE 17 satellite): per-sample rows
+        # STREAM to disk as JSON lines and only a bounded tail stays in
+        # memory for the report; cumulative totals + kill rows bank to
+        # banked.json every tick so a host crash loses at most one
+        # sample period of accounting
         timeline: List[dict] = []
+        timeline_tail = 720
+        timeline_rows = [0]
+        timeline_path = os.path.join(run_dir, "timeline.jsonl")
+        timeline_fh = open(timeline_path, "a", buffering=1)
         federation: dict = {}
+        breach = {"t0": None, "t1": None}
+
+        def note_sample(trow: dict) -> None:
+            timeline_fh.write(json.dumps(trow) + "\n")
+            timeline_rows[0] += 1
+            timeline.append(trow)
+            if len(timeline) > timeline_tail:
+                del timeline[: len(timeline) - timeline_tail]
+
+        def bank_totals(totals: dict) -> None:
+            tmp = os.path.join(run_dir, ".banked.json.tmp")
+            with open(tmp, "w") as fh:
+                json.dump({"totals": totals, "kills": kill_rows,
+                           "samples": timeline_rows[0]}, fh)
+            os.replace(tmp, os.path.join(run_dir, "banked.json"))
 
         async def fetch_federation() -> None:
             """Mid-run: every worker's StatusRequest doc over the
@@ -364,6 +436,100 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                 1 for p in procs if p.get("up"))
             federation["scrape_samples"] = len(samples)
 
+        async def slo_read_back(run_t0_clock: float) -> dict:
+            """ISSUE 17 acceptance: the timeline and the final verdict
+            must be reconstructable from the PERSISTENT plane alone —
+            the \\xff\\x02/metrics/ series plus the TimeKeeper map, not
+            host memory — so a restarted observer reaches the same
+            conclusion the live SLO engine did."""
+            from ..layers import metrics as metrics_layer
+            from ..server import slo as slo_mod
+            from ..server import timekeeper
+            from . import incident
+            status = await db.get_status()
+            slo_status = (status.get("cluster") or {}).get("slo") or {}
+            signals = await metrics_layer.list_history_signals(db)
+            series = {}
+            for sig in signals:
+                series[sig] = await metrics_layer.read_history(db, sig)
+            # the rebuilt timeline: throughput from the keyspace series
+            rebuilt = []
+            prev = None
+            for ts_ms, committed in series.get("cluster/txn_committed",
+                                               []):
+                row = {"t": round(ts_ms / 1000.0 - run_t0_clock, 3),
+                       "committed": committed}
+                if prev is not None and ts_ms > prev[0]:
+                    row["txn_per_s"] = round(
+                        (committed - prev[1]) * 1000.0
+                        / (ts_ms - prev[0]), 1)
+                rebuilt.append(row)
+                prev = (ts_ms, committed)
+            rules = slo_mod.default_rules()
+            sample_ts = sorted({ts for s in series.values()
+                                for ts, _ in s})
+            final_verdict = (slo_mod.evaluate(rules, series,
+                                              sample_ts[-1])
+                             if sample_ts else {"state": "no-data",
+                                                "breached": []})
+            # post-hoc sweep: replay the rules over the persisted
+            # series (strided so an hours-long run stays O(samples))
+            posthoc_breaches = 0
+            prev_state = "ok"
+            for ts in sample_ts[::max(1, len(sample_ts) // 600)]:
+                v = slo_mod.evaluate(rules, series, ts)
+                if v["state"] == "breach" and prev_state == "ok":
+                    posthoc_breaches += 1
+                prev_state = v["state"]
+            # TimeKeeper sanity: clock -> version -> clock round trip
+            tk_map = await timekeeper.read_time_map(db)
+            tk_ok = len(tk_map) > 0
+            if sample_ts and tk_map:
+                mid = sample_ts[len(sample_ts) // 2] / 1000.0
+                v_mid = timekeeper.version_at_time_from_map(tk_map, mid)
+                t_back = timekeeper.time_at_version_from_map(tk_map,
+                                                             v_mid)
+                tk_ok = v_mid > 0 and abs(t_back - mid) < 5.0
+            sdoc = {
+                "signals": len(signals),
+                "series_samples": sum(len(s) for s in series.values()),
+                "timekeeper_rows": len(tk_map),
+                "timekeeper_ok": tk_ok,
+                "rebuilt_samples": len(rebuilt),
+                "rebuilt_tail": rebuilt[-5:],
+                "timeline_source": "metric-history keyspace",
+                "final_state": final_verdict.get("state"),
+                "final_breached": final_verdict.get("breached", []),
+                "posthoc_breaches": posthoc_breaches,
+                "online_state": slo_status.get("state"),
+                "online_breaches": slo_status.get("breaches", 0),
+                "breach_window": dict(breach),
+            }
+            if breach["t0"] is not None or \
+                    final_verdict.get("state") == "breach":
+                # red run (or breach drill): snapshot the window
+                if trace:
+                    flow.g_trace_batch.dump()
+                    flow.g_trace.flush()
+                w0 = (breach["t0"] if breach["t0"] is not None
+                      else (sample_ts[0] / 1000.0 if sample_ts
+                            else run_t0_clock))
+                w1 = (breach["t1"] if breach["t1"] is not None
+                      else flow.now())
+                bundle_dir = os.path.join(run_dir, "incident")
+                manifest = await incident.capture_bundle(
+                    db, bundle_dir, (w0, w1),
+                    run_dir=run_dir if trace else None,
+                    status_doc=status, verdict=final_verdict,
+                    reason=("breach_drill" if breach["t0"] is not None
+                            else "slo_breach"))
+                sdoc["bundle"] = {
+                    "dir": bundle_dir,
+                    "samples": manifest.get("samples", 0),
+                    "signals": len(manifest.get("signals", [])),
+                    "contents": manifest.get("contents", [])}
+            return sdoc
+
         async def main():
             gw.start()
             while cluster.cc.dbinfo.get().recovery_state != \
@@ -372,6 +538,7 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             fed_transport.start()
             t0 = time.perf_counter()
             t_start[0] = t0
+            run_t0_clock = flow.now()
             for slot in slots:
                 spawn_worker(slot, duration)
             kill_at = [t0 + duration * (k + 1) / (kills + 1)
@@ -381,9 +548,27 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             next_sample = t0 + sample_period
             prev_committed = 0
             prev_t = t0
+            breach_on_at = (t0 + breach_at if breach_at is not None
+                            else None)
+            breach_off_at = None
             while time.perf_counter() < t0 + duration:
                 await flow.delay(0.1)
                 wall = time.perf_counter()
+                if breach_on_at is not None and wall >= breach_on_at:
+                    # the drill: every commit batch slowed past the
+                    # latency-band edge until breach_len elapses — the
+                    # ONLINE SLO engine must notice within its fast
+                    # window (asserted below from the status doc)
+                    breach_on_at = None
+                    breach_off_at = wall + breach_len
+                    breach["t0"] = flow.now()
+                    flow.SERVER_KNOBS.set("commit_latency_injection",
+                                          breach_delay)
+                if breach_off_at is not None and wall >= breach_off_at:
+                    breach_off_at = None
+                    breach["t1"] = flow.now()
+                    flow.SERVER_KNOBS.set("commit_latency_injection",
+                                          0.0)
                 while kill_at and wall >= kill_at[0]:
                     kill_at.pop(0)
                     victim = slots[len(kill_rows) % processes]
@@ -425,7 +610,8 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                             "workers_up": up}
                     trow.update({k: round(v, 3)
                                  for k, v in sorted(lat.items())})
-                    timeline.append(trow)
+                    note_sample(trow)
+                    bank_totals(totals)
                     prev_committed = totals["committed"]
                     prev_t = wall
             # horizon over: let the workers publish their final rows
@@ -446,10 +632,17 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             # hash identically (quiesced database, stable digest)
             d1 = await database_digest(db)
             d2 = await database_digest(db)
-            return d1, d2, round(time.perf_counter() - t0, 3)
+            sdoc = None
+            if slo:
+                try:
+                    sdoc = await slo_read_back(run_t0_clock)
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errors.append(f"slo_read_back: {e!r}")
+            return d1, d2, round(time.perf_counter() - t0, 3), sdoc
 
         fed_transport = TcpTransport()
-        d1, d2, wall = cluster.run(main(), timeout_time=duration + 300)
+        d1, d2, wall, slo_doc = cluster.run(main(),
+                                            timeout_time=duration + 300)
         for slot in slots:
             if slot.proc is not None and slot.proc.poll() is None:
                 slot.proc.send_signal(signal.SIGKILL)
@@ -470,10 +663,14 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                        "kills": kills, "seed": seed,
                        "sample_period_s": sample_period,
                        "sample_every": sample_every,
-                       "trace": bool(trace)},
+                       "trace": bool(trace), "slo": bool(slo),
+                       "hours": hours, "breach_at": breach_at,
+                       "breach_len": breach_len},
             "run_dir": run_dir,
             "wall_seconds": wall,
             "timeline": timeline,
+            "timeline_path": timeline_path,
+            "timeline_rows": timeline_rows[0],
             "kills": kill_rows,
             "totals": totals,
             "txn_per_s": round(totals["committed"] / max(1e-9, wall), 1),
@@ -502,6 +699,8 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                 "full_commit_chains": len(full),
                 "clock_offsets_s": merged["clock_offsets_s"],
             }
+        if slo_doc is not None:
+            doc["slo"] = slo_doc
         ok = (not errors
               and totals["divergent_verdicts"] == 0
               and totals["committed"] > 0
@@ -509,18 +708,42 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
               and all("recovery_s" in k for k in kill_rows)
               and (not trace
                    or doc["trace"]["full_commit_chains"] >= 1))
+        if slo:
+            # the self-watching contract: the persistent plane must
+            # hold a readable timeline, a sane TimeKeeper map, and —
+            # when the drill armed — the online engine must have
+            # tripped AND the incident bundle must exist. A drill run
+            # is judged on detection, not on ending green (the p99
+            # reservoir decays slowly after the injection lifts).
+            ok = (ok and slo_doc is not None
+                  and slo_doc["signals"] > 0
+                  and slo_doc["timekeeper_rows"] > 0
+                  and slo_doc["timekeeper_ok"]
+                  and slo_doc["rebuilt_samples"] > 0)
+            if ok and breach_at is not None:
+                ok = (slo_doc["online_breaches"] >= 1
+                      and "bundle" in slo_doc)
+            elif ok:
+                ok = slo_doc["final_state"] == "ok"
         doc["ok"] = ok
+        slo_note = ""
+        if slo_doc is not None:
+            slo_note = (f"slo={slo_doc['final_state']} "
+                        f"online_breaches={slo_doc['online_breaches']} "
+                        f"signals={slo_doc['signals']} ")
         out(f"  soak {processes}p x {duration}s: "
             f"{doc['txn_per_s']}/s committed={totals['committed']} "
             f"divergent={totals['divergent_verdicts']} "
             f"kills={len(kill_rows)} "
             f"digest_consistent={doc['digest']['consistent']} "
-            f"ok={ok} trace-run-dir={run_dir}")
+            f"{slo_note}ok={ok} trace-run-dir={run_dir}")
         return doc
     finally:
         for slot in slots:
             if slot.proc is not None and slot.proc.poll() is None:
                 slot.proc.send_signal(signal.SIGKILL)
+        if timeline_fh is not None:
+            timeline_fh.close()
         if fed_transport is not None:
             fed_transport.close()
         if gw is not None:
@@ -531,6 +754,9 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             flow.reset_trace(prev_trace_path)
             flow.trace.clear_process_identity()
             flow.SERVER_KNOBS.set("trace_propagation", 0)
+        if slo:
+            flow.SERVER_KNOBS.set("commit_latency_injection", 0.0)
+            flow.SERVER_KNOBS.set("metric_history", 0)
         flow.set_scheduler(prev_sched)
         _rng.restore_rng_state(prev_rng)
 
@@ -587,8 +813,34 @@ def render_soak_report(doc: dict) -> str:
             f"client->proxy->resolver->tlog paths)",
             f"- processes: {', '.join(tr['processes'])}",
         ]
-    lines += ["", "## Timeline", "",
-              "| t (s) | committed | txn/s | divergent | workers up |",
+    sl = doc.get("slo") or {}
+    if sl:
+        lines += [
+            "",
+            "## SLO (read back from the persistent plane)",
+            "",
+            f"- signals: {sl.get('signals', 0)} "
+            f"({sl.get('series_samples', 0)} samples), timekeeper rows: "
+            f"{sl.get('timekeeper_rows', 0)} "
+            f"(round-trip ok={sl.get('timekeeper_ok')})",
+            f"- final verdict: {sl.get('final_state')} "
+            f"breached={sl.get('final_breached')}",
+            f"- breaches: online={sl.get('online_breaches', 0)} "
+            f"post-hoc={sl.get('posthoc_breaches', 0)}, drill window: "
+            f"{sl.get('breach_window')}",
+        ]
+        if sl.get("bundle"):
+            b = sl["bundle"]
+            lines.append(
+                f"- incident bundle: {b['dir']} "
+                f"({b['samples']} samples over {b['signals']} signals)")
+    lines += ["", "## Timeline", ""]
+    total_rows = doc.get("timeline_rows", len(doc["timeline"]))
+    if total_rows > len(doc["timeline"]):
+        lines += [f"(tail of {total_rows} rows — full series streams "
+                  f"to {doc.get('timeline_path', 'timeline.jsonl')})",
+                  ""]
+    lines += ["| t (s) | committed | txn/s | divergent | workers up |",
               "|---|---|---|---|---|"]
     for row in doc["timeline"]:
         lines.append(
@@ -621,6 +873,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             kw["seed"] = int(argv.pop(0))
         elif a == "--sample-period":
             kw["sample_period"] = float(argv.pop(0))
+        elif a == "--hours":
+            kw["hours"] = float(argv.pop(0))
+        elif a == "--slo":
+            kw["slo"] = True
+        elif a == "--breach-at":
+            kw["breach_at"] = float(argv.pop(0))
+        elif a == "--breach-len":
+            kw["breach_len"] = float(argv.pop(0))
         elif a == "--run-dir":
             kw["run_dir"] = argv.pop(0)
         elif a == "--no-trace":

@@ -37,34 +37,59 @@ LOCAL_PROCESS = "local"
 
 
 # ---------------------------------------------------------------- loading
+def _segment_index(name: str) -> int:
+    """Rolled-generation ordinal: `trace.x.jsonl.N` segments are older
+    than the bare `trace.x.jsonl` (flow/trace.py rolls aside as .1, .2,
+    ... with the bare path always newest), so N orders and the bare
+    file sorts last."""
+    tail = name.rsplit(".", 1)[-1]
+    return int(tail) if tail.isdigit() else (1 << 62)
+
+
+def trace_file_groups(run_dir: str) -> List[List[str]]:
+    """Trace files grouped per base file, each group's rolled segments
+    in WRITE order — .1 (oldest), .2, ..., bare (newest). Numeric
+    ordering matters: a lexicographic sort reads .10 before .2 and
+    would interleave an hours-long worker's spans out of order."""
+    groups: Dict[str, List[str]] = {}
+    for name in os.listdir(run_dir):
+        if not (name.startswith("trace.") and ".jsonl" in name):
+            continue
+        base = name[:name.index(".jsonl") + len(".jsonl")]
+        groups.setdefault(base, []).append(name)
+    return [[os.path.join(run_dir, n)
+             for n in sorted(groups[base], key=_segment_index)]
+            for base in sorted(groups)]
+
+
 def trace_files(run_dir: str) -> List[str]:
     """Every trace file in the run directory, rolled generations
-    included (trace.<role>.<pid>.jsonl and .jsonl.N)."""
-    out = []
-    for name in sorted(os.listdir(run_dir)):
-        if name.startswith("trace.") and ".jsonl" in name:
-            out.append(os.path.join(run_dir, name))
-    return out
+    included (trace.<role>.<pid>.jsonl and .jsonl.N), in read order."""
+    return [p for group in trace_file_groups(run_dir) for p in group]
 
 
 def load_run(run_dir: str) -> dict:
     """Parse every trace file: span rows, wire-hop rows, and the
     per-process span counts. A broken line is skipped, never fatal — a
-    kill -9 mid-write must not hide the rest of the run."""
+    kill -9 mid-write must not hide the rest of the run. Rolled
+    segments of one base file are read as ONE stream sharing one
+    ProcessIdentity: a pre-fix segment without its own header still
+    attributes to its file group, not to the local-process bucket."""
     spans: List[dict] = []
     hops: List[dict] = []
     skipped = 0
-    for path in trace_files(run_dir):
+    for group in trace_file_groups(run_dir):
         rows = []
-        with open(path) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rows.append(json.loads(line))
-                except ValueError:
-                    skipped += 1
+        for path in group:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        skipped += 1
         default_proc = LOCAL_PROCESS
         for ev in rows:
             if ev.get("Type") == "ProcessIdentity" and ev.get("ID"):
