@@ -27,6 +27,7 @@ from .latency import (DEFAULT_BANDS, LatencyBands, LatencySample,
                       RequestLatency)
 from .trace import Span, g_trace_batch
 from .trace import TraceEvent, g_trace, reset_trace
+from .flightrec import FlightRecorder, g_flightrec
 from .coverage import cover, declare
 from . import coverage, trace
 
@@ -46,4 +47,5 @@ __all__ = [
     "Smoother", "SmoothedQueue", "SmoothedRate",
     "DEFAULT_BANDS", "LatencyBands", "LatencySample", "RequestLatency",
     "Span", "g_trace_batch",
+    "FlightRecorder", "g_flightrec",
 ]

@@ -192,6 +192,42 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # chaos storms inject latency through the network plane; this knob
     # exists for the DIRECTED drill whose detection time is asserted.
     init("COMMIT_LATENCY_INJECTION", 0.0)
+    # -- latency forensics (ISSUE 18): commit critical-path
+    # decomposition + per-process resource telemetry + the flight
+    # recorder. CRITICAL_PATH is the master gate: 0 (the default)
+    # records nothing, spawns no CC loop, and keeps the commit path
+    # byte-identical to the pre-plane behavior (the pinned off
+    # posture). Deliberately NOT buggified (the TRACE_PROPAGATION /
+    # METRIC_HISTORY discipline: a new buggify site consumes a draw
+    # from the shared buggify stream and would shift every later
+    # knob's randomization on existing seeds, invalidating the pinned
+    # chaos baselines); the armed paths are exercised by smoke --path
+    # and tests/test_critical_path.py instead.
+    init("CRITICAL_PATH", 0)
+    # CC cadence for folding the per-role path recorders into the
+    # cluster-wide decaying top-cause table
+    init("CRITICAL_PATH_INTERVAL", 2.0)
+    # decomposition-invariant bound: |sum(stations) - end_to_end| must
+    # stay within this FRACTION of the end-to-end latency (the station
+    # timestamps are consecutive flow.now() reads, so the residual is
+    # float rounding, not missing time — the bound is pinned by test)
+    init("CRITICAL_PATH_TOLERANCE", 0.05)
+    # decaying dominant-station table (ConflictHotSpots bounds)
+    init("CRITICAL_PATH_HALF_LIFE", 10.0)
+    # per-role recorder sample buffer drained by the CC loop
+    init("CRITICAL_PATH_SAMPLE_MAX", 512)
+    # per-OS-process resource sampling cadence (tools/soak + bench
+    # workers; wall-clock domain, so never determinism-sensitive)
+    init("PROCESS_METRICS_INTERVAL", 2.0)
+    # flight recorder ring capacity (flow/flightrec.py): recent trace
+    # events kept in memory per process, independent of file rotation
+    init("FLIGHTREC_SIZE", 512)
+    # directed fsync-stall injection: extra seconds added inside every
+    # TLog durability leg while armed — COMMIT_LATENCY_INJECTION's
+    # tlog twin, so a smoke cell can force tlog_fsync to dominate the
+    # critical-path table. 0 = off (one knob read per fsync, no delay,
+    # no schedule change). Not buggified, same reasoning as the gate.
+    init("TLOG_FSYNC_INJECTION", 0.0)
     # conflict hot-spot table (resolver-side attribution aggregation):
     # score half-life seconds, table capacity, rows surfaced in status
     init("HOT_SPOT_HALF_LIFE", 10.0, lambda: 0.5)

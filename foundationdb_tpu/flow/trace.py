@@ -16,6 +16,8 @@ import atexit
 import json
 from typing import Any, Optional
 
+from .flightrec import g_flightrec as _flightrec
+
 SevDebug = 5
 SevInfo = 10
 SevWarn = 20
@@ -149,6 +151,8 @@ class TraceCollector:
 
     def emit(self, ev: dict) -> None:
         self.counts[ev["Type"]] = self.counts.get(ev["Type"], 0) + 1
+        if _flightrec.armed:   # one attribute check while disarmed
+            _flightrec.note(ev)
         if self.keep:
             self.events.append(ev)
             if len(self.events) > self.keep:

@@ -46,7 +46,8 @@ class SimCluster:
                  storage_policy=None, backup_driver: bool = False,
                  profile_janitor: bool = False,
                  metric_history: bool = False,
-                 metrics_janitor: bool = False):
+                 metrics_janitor: bool = False,
+                 critical_path: bool = False):
         if storage_policy is not None and \
                 storage_policy.replica_count() != max(1, storage_replicas):
             raise ValueError(
@@ -86,6 +87,9 @@ class SimCluster:
             # never leak into this one (process-global, like the knobs)
             from .chaos import clear_stations
             clear_stations()
+            # the flight recorder is process-global like the stations:
+            # a prior run's ring (and arming) must not leak in
+            flow.g_flightrec.disarm()
             # virtual=False runs the same cluster on the wall clock so
             # real-socket peers (the TCP gateway + C binding) can attach
             self.sched = flow.Scheduler(start_time=start_time,
@@ -150,6 +154,13 @@ class SimCluster:
         # SERVER_KNOBS.set would be too late
         if metric_history:
             flow.SERVER_KNOBS.set("metric_history", 1)
+        # latency forensics (ISSUE 18): same arming window as above —
+        # cc.start() gates the fold loop at spawn time. The flight
+        # recorder rides along: a forensics run wants the recent-event
+        # ring available for `cli flightrec` / incident dumps
+        if critical_path:
+            flow.SERVER_KNOBS.set("critical_path", 1)
+            flow.g_flightrec.arm()
 
         # the cluster controller (single candidate; contested elections
         # are exercised in the coordination unit tests)

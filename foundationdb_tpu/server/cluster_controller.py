@@ -296,6 +296,13 @@ class ClusterController:
         self.slo_verdict: dict = {}
         self.slo_breaches = 0
         self._timekeeper_rows = 0
+        # latency-forensics plane (ISSUE 18, armed via CRITICAL_PATH):
+        # the decaying dominant-station table fed by the proxies' path
+        # recorders, plus the host process's resource sampler. Same
+        # off discipline as the longitudinal plane above.
+        self.critical_path_table = None
+        self._path_samples_folded = 0
+        self.host_process_metrics = None
         # (instance name, counter) -> TimeSeries (ref: TDMetric levels)
         self.metrics: dict = {}
         self._metric_gauges: set = set()   # (rn, cn) sampled via set()
@@ -333,6 +340,14 @@ class ClusterController:
             loops += [(self._timekeeper_loop(), "timeKeeper"),
                       (self._metric_history_loop(), "metricHistory"),
                       (self._slo_loop(), "sloEngine")]
+        # latency-forensics fold loop (ISSUE 18): same spawn-time
+        # gating — CRITICAL_PATH=0 means the loop never exists
+        if flow.SERVER_KNOBS.critical_path:
+            from .critical_path import CriticalPathTable
+            from .process_metrics import ProcessMetrics
+            self.critical_path_table = CriticalPathTable()
+            self.host_process_metrics = ProcessMetrics(role="cc")
+            loops += [(self._critical_path_loop(), "criticalPath")]
         for coro, name in loops:
             self._actors.add(flow.spawn(coro, TaskPriority.CLUSTER_CONTROLLER,
                                         name=f"{self.process.name}.{name}"))
@@ -1353,6 +1368,24 @@ class ClusterController:
                     Rules=",".join(verdict["breached"])).log()
             prev_state = verdict["state"]
 
+    # -- the latency-forensics plane (ISSUE 18; spawned only armed) ------
+    async def _critical_path_loop(self):
+        """Fold the proxies' buffered decomposition samples into the
+        decaying dominant-station table every CRITICAL_PATH_INTERVAL,
+        and refresh the host process's resource sample on the same
+        cadence (the status doc serves the latest without re-sampling
+        per request)."""
+        while True:
+            await flow.delay(flow.SERVER_KNOBS.critical_path_interval,
+                             TaskPriority.LOW_PRIORITY)
+            now = flow.now()
+            for p in self._current_proxies():
+                for dom, seconds, _e2e in p.path.drain_samples():
+                    self.critical_path_table.record(dom, seconds, now)
+                    self._path_samples_folded += 1
+            if self.host_process_metrics is not None:
+                self.host_process_metrics.sample()
+
     def _current_ratekeeper(self):
         """The current epoch's Ratekeeper role, or None mid-recovery
         (the recorder's rk/* signals read its rate + last decision)."""
@@ -1526,6 +1559,10 @@ class ClusterController:
                         counters=obj.stats.snapshot(),
                         latency_bands={
                             "commit": obj.commit_bands.snapshot()})
+                    if flow.SERVER_KNOBS.critical_path:
+                        # queue-vs-service split: version-ordering wait
+                        # vs fsync service (ISSUE 18)
+                        entry["path"] = obj.path.snapshot()
             logs.append(entry)
         storages = []
         for s in info.storages:
@@ -1557,6 +1594,7 @@ class ClusterController:
         from .proxy import Proxy
         from .ratekeeper import Ratekeeper
         from .resolver_role import Resolver
+        path_armed = bool(flow.SERVER_KNOBS.critical_path)
         proxies = []
         resolvers = []
         rate = None
@@ -1582,6 +1620,12 @@ class ClusterController:
                         # admission.py): per-class admission counters,
                         # queue bounds, and the live tag-throttle rows
                         "admission": role.admission_status()})
+                    if path_armed:
+                        # per-proxy critical-path decomposition
+                        # (ISSUE 18): station bands, dominant counts,
+                        # residual bound — the raw feed behind
+                        # cluster.critical_path
+                        proxies[-1]["path"] = role.path.snapshot()
                 elif isinstance(role, Resolver) and \
                         f"-e{info.epoch}-" in rn:
                     kern = role.kernel_stats()
@@ -1619,6 +1663,10 @@ class ClusterController:
                         # device faults/failovers/replay, shadow
                         # validation ({} for bare host backends)
                         "failover": role.failover_stats()})
+                    if path_armed:
+                        # queue-vs-service split: version-ordering wait
+                        # vs resolve service (ISSUE 18)
+                        resolvers[-1]["path"] = role.path.snapshot()
                 elif isinstance(role, Ratekeeper) and \
                         rn.endswith(f"-e{info.epoch}"):
                     rate = role.rate
@@ -1749,6 +1797,15 @@ class ClusterController:
                 # verdict + recorder/TimeKeeper accounting while
                 # METRIC_HISTORY is armed; {"enabled": 0} otherwise
                 "slo": self._slo_doc(),
+                # latency forensics (ISSUE 18): the decaying dominant-
+                # station table + per-station splits while
+                # CRITICAL_PATH is armed; {"enabled": 0} otherwise
+                "critical_path": self._critical_path_doc(proxies, logs,
+                                                         resolvers),
+                # per-process resource telemetry: the host's sampler
+                # here; OS-process workers report their own via
+                # federation into cluster.processes
+                "process_metrics": self._process_metrics_doc(),
                 # hottest conflict-causing key ranges, cluster-wide
                 # (per-resolver tables under resolvers[*].hot_spots)
                 "conflict_hot_spots": hot_rows[
@@ -1831,6 +1888,82 @@ class ClusterController:
                          if self.metric_recorder is not None else {}),
             "timekeeper_rows": self._timekeeper_rows,
         }
+
+    def _critical_path_doc(self, proxies: list, logs: list,
+                           resolvers: list) -> dict:
+        """status.cluster.critical_path: the decaying top-cause table
+        plus a cluster-wide fold of the per-role path sections already
+        assembled for this status doc (proxy station segments, and the
+        resolver/tlog queue-vs-service splits)."""
+        if not flow.SERVER_KNOBS.critical_path or \
+                self.critical_path_table is None:
+            return {"enabled": 0}
+        from .critical_path import STATIONS
+        samples = 0
+        max_residual = 0.0
+        dominant = {s: 0 for s in STATIONS}
+        station_seconds = {s: 0.0 for s in STATIONS}
+        for p in proxies:
+            path = p.get("path") or {}
+            samples += path.get("samples", 0)
+            max_residual = max(max_residual,
+                               path.get("max_residual_seconds", 0.0))
+            for s, n in (path.get("dominant") or {}).items():
+                dominant[s] = dominant.get(s, 0) + n
+            for s, ent in (path.get("stations") or {}).items():
+                station_seconds[s] = (station_seconds.get(s, 0.0)
+                                      + ent.get("seconds", 0.0))
+
+        def _split(entries):
+            wait = {"total": 0, "sum_seconds": 0.0}
+            service = {"total": 0, "sum_seconds": 0.0}
+            for e in entries:
+                path = e.get("path") or {}
+                for kind, acc in (("wait", wait), ("service", service)):
+                    snap = path.get(kind) or {}
+                    acc["total"] += snap.get("total", 0)
+                    acc["sum_seconds"] += snap.get("sum_seconds", 0.0)
+            wait["sum_seconds"] = round(wait["sum_seconds"], 6)
+            service["sum_seconds"] = round(service["sum_seconds"], 6)
+            return {"wait": wait, "service": service}
+
+        top = self.critical_path_table.top()
+        return {
+            "enabled": 1,
+            "samples": samples,
+            "samples_folded": self._path_samples_folded,
+            "max_residual_seconds": round(max_residual, 9),
+            "tolerance": flow.SERVER_KNOBS.critical_path_tolerance,
+            "dominant": dominant,
+            "dominant_now": top[0]["station"] if top else None,
+            "top": top,
+            "station_seconds": {s: round(v, 6)
+                                for s, v in station_seconds.items()},
+            # queue-vs-service from the serving side: did the time go
+            # to version-ordering (upstream pressure) or to the work
+            "splits": {"resolve": _split(resolvers),
+                       "tlog_fsync": _split(logs)},
+        }
+
+    def _process_metrics_doc(self) -> dict:
+        """status.cluster.process_metrics: the host process's latest
+        resource sample (per-OS-process docs federate into
+        cluster.processes, tools/exporter.py)."""
+        if not flow.SERVER_KNOBS.critical_path or \
+                self.host_process_metrics is None:
+            return {"enabled": 0}
+        return {"enabled": 1,
+                "interval": flow.SERVER_KNOBS.process_metrics_interval,
+                "host": dict(self.host_process_metrics.latest),
+                "role_cpu_share": self._role_cpu_share()}
+
+    def _role_cpu_share(self) -> dict:
+        """Per-role CPU share inside this host process, folded from the
+        SIM_TASK_STATS busy table when armed ({} otherwise) — the
+        proxy-vs-resolver number ROADMAP item 2 is judged against."""
+        from .process_metrics import role_cpu_share
+        rl = _run_loop_status()
+        return role_cpu_share((rl.get("task_stats") or {}).get("tasks"))
 
     def _balance_doc(self) -> dict:
         """status.cluster.resolver_balance: knob posture + the balance

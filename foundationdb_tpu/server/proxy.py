@@ -28,6 +28,7 @@ from ..models import COMMITTED, CONFLICT, TOO_OLD
 from ..rpc import NetworkRef, RequestStream, SimProcess
 from .admission import GrvAdmissionQueues
 from .chaos import fire_station
+from .critical_path import ProxyPathRecorder
 from .repair import RepairManager
 from .scheduler import AdmissionScheduler
 from .types import (ATOMIC_OPS, CLEAR_RANGE, INERT_OPS, PRIORITY_BATCH,
@@ -364,6 +365,10 @@ class Proxy:
         # the LatencySample percentile surface)
         self.grv_bands = flow.RequestLatency("grv")
         self.commit_bands = flow.RequestLatency("commit")
+        # commit critical-path decomposition (ISSUE 18): per-station
+        # latency split for EVERY batch while CRITICAL_PATH is armed;
+        # off, the commit path pays one knob read per batch
+        self.path = ProxyPathRecorder()
         # per-tag / per-priority traffic accounting (ref:
         # TransactionTagCounter + the per-class started counters in
         # ProxyStats); gated by QOS_TAG_ACCOUNTING — off, the commit
@@ -922,6 +927,17 @@ class Proxy:
         bytes_max = SERVER_KNOBS.commit_transaction_batch_bytes_max
         while True:
             req, reply = await self.commits.pop()
+            if SERVER_KNOBS.critical_path:
+                # queue-entry stamp, keyed by the reply promise (it
+                # survives scheduler deferral; setdefault keeps the
+                # FIRST arrival so deferral time counts as batcher wait)
+                self.path.note_arrival(reply, flow.now())
+                if getattr(req, "debug_id", None) is not None:
+                    # bare add_event: no fire_station — the armed-only
+                    # extra station must not interact with chaos kills
+                    flow.g_trace_batch.add_event(
+                        "CommitDebug", req.debug_id,
+                        "MasterProxyServer.batcher.Arrived")
             if self.scheduler.consider(req, reply):
                 continue
             batch: List = [(req, reply)]
@@ -934,6 +950,12 @@ class Proxy:
                 if got[0] == 1:  # window expired
                     break
                 r2, p2 = got[1]
+                if SERVER_KNOBS.critical_path:
+                    self.path.note_arrival(p2, flow.now())
+                    if getattr(r2, "debug_id", None) is not None:
+                        flow.g_trace_batch.add_event(
+                            "CommitDebug", r2.debug_id,
+                            "MasterProxyServer.batcher.Arrived")
                 if self.scheduler.consider(r2, p2):
                     continue
                 batch.append((r2, p2))
@@ -960,6 +982,12 @@ class Proxy:
         t0 = flow.now()
         reqs = [r for r, _ in batch]
         replies = [p for _, p in batch]
+        # critical-path decomposition (ISSUE 18): consecutive clock
+        # reads at the phase boundaries below telescope to the batch's
+        # end-to-end latency, so per-station segments sum to the
+        # measured total by construction
+        path_armed = bool(SERVER_KNOBS.critical_path)
+        t_ver = t_res = t_push = t0
         dbg = self._debug_ids(reqs)
         self._mark(dbg, "MasterProxyServer.commitBatch.Before")
         # span per sampled txn: the proxy leg of the commit tree; the
@@ -999,6 +1027,8 @@ class Proxy:
             for entry in ver.moves:
                 self.key_resolvers.apply(entry)
             self._moves_seen += len(ver.moves)
+            if path_armed:
+                t_ver = flow.now()
             self._mark(dbg,
                        "MasterProxyServer.commitBatch.GotCommitVersion")
 
@@ -1024,6 +1054,8 @@ class Proxy:
                     await vf, len(reqs))
             finally:
                 self._note_resolving(-1)
+            if path_armed:
+                t_res = flow.now()
             self._mark(dbg,
                        "MasterProxyServer.commitBatch.AfterResolution")
 
@@ -1061,6 +1093,8 @@ class Proxy:
                                     for ref in self.tlog_refs])
             self._advance(self.batch_logging, local)
             await log_done
+            if path_armed:
+                t_push = flow.now()
             self._mark(dbg, "MasterProxyServer.commitBatch.AfterLogPush")
             if self.committed_version.get() < ver.version:
                 self.committed_version.set(ver.version)
@@ -1092,8 +1126,21 @@ class Proxy:
             account = bool(SERVER_KNOBS.qos_tag_accounting)
             now_acct = flow.now() if account else 0.0
             elapsed = flow.now() - t0
+            t_end = t0 + elapsed
             for idx, (verdict, reply) in enumerate(zip(verdicts, replies)):
                 self.commit_bands.record(elapsed)
+                if path_armed:
+                    # per-txn decomposition: batcher wait is THIS txn's
+                    # (from its arrival stamp), the downstream segments
+                    # are the batch's shared phase boundaries
+                    arr = self.path.take_arrival(reply, t0)
+                    self.path.record(
+                        {"proxy_batcher": t0 - arr,
+                         "commit_version": t_ver - t0,
+                         "resolve": t_res - t_ver,
+                         "tlog_fsync": t_push - t_res,
+                         "reply": t_end - t_push},
+                        t_end - arr)
                 # server-side repair first (server/repair.py): a
                 # conflicted-but-repairable transaction is re-executed
                 # at THIS batch's version and resubmitted instead of
@@ -1169,6 +1216,11 @@ class Proxy:
             flow.g_trace_batch.finish_spans(spans)
             self._advance(self.batch_resolving, local)
             self._advance(self.batch_logging, local)
+            if path_armed:
+                # error paths skip phase 5: drop their arrival stamps
+                # so the bounded map never carries dead replies
+                for reply in replies:
+                    self.path.take_arrival(reply, 0.0)
 
     @staticmethod
     def _advance(nv: NotifiedVersion, to: int) -> None:

@@ -19,6 +19,7 @@ from ..flow import SERVER_KNOBS, NotifiedVersion, TaskPriority
 from ..models import ResolverTransaction, create_resilient_conflict_set
 from ..models.conflict_set import clip_checkpoint, graft_checkpoint
 from ..rpc import RequestStream, SimProcess
+from .critical_path import RolePathRecorder
 from .types import (ResolutionMetricsReply, ResolveReply, ResolveRequest,
                     ResolverCheckpointReply, ResolverCheckpointRequest,
                     ResolverInstallRequest)
@@ -129,6 +130,10 @@ class Resolver:
         # banded + sampled batch-resolve latency (the resolver stage of
         # the commit pipeline; ref: LatencyBands in status)
         self.resolve_bands = flow.RequestLatency("resolve")
+        # critical-path split (ISSUE 18): version-ordering wait vs
+        # actual resolve service, recorded per accepted first delivery
+        # while CRITICAL_PATH is armed
+        self.path = RolePathRecorder("resolver")
         # decaying top-K table of conflict-causing key ranges, fed by
         # the backend's attribution on every batch (ref: the conflict
         # telemetry report_conflicting_keys exists to provide; the
@@ -291,6 +296,9 @@ class Resolver:
         # in-resolver ordering wait, not proxy->resolver network time.
         # Spans auto-parent onto the proxy's open commitBatch span.
         self._mark(req, "Resolver.resolveBatch.AfterQueueSorted")
+        # wait segment closed: everything before this point was
+        # version-ordering; everything after is service
+        t_sorted = flow.now() if SERVER_KNOBS.critical_path else t0
         spans = flow.g_trace_batch.begin_spans(
             getattr(req, "debug_ids", ()), "Resolver.resolveBatch")
         try:
@@ -366,7 +374,10 @@ class Resolver:
             self._mark(req, "Resolver.resolveBatch.After")
             self.stats.counter("batches_resolved").add(1)
             self.stats.counter("transactions_resolved").add(len(txns))
-            self.resolve_bands.record(flow.now() - t0)
+            done = flow.now()
+            self.resolve_bands.record(done - t0)
+            if SERVER_KNOBS.critical_path:
+                self.path.record(t_sorted - t0, done - t_sorted)
             reply.send(payload)
             self._check_state_pressure(req.version)
         finally:

@@ -25,6 +25,7 @@ from ..flow import FlowLock, NotifiedVersion, TaskPriority, error
 from ..rpc import RequestStream, SimProcess
 from ..rpc.disk import SimDisk
 from .chaos import fire_station
+from .critical_path import RolePathRecorder
 from .diskqueue import DiskQueue
 from .types import (DurableFrontierRequest,
                     TLogCommitRequest, TLogLockReply, TLogLockRequest,
@@ -86,6 +87,10 @@ class TLog:
         self.stats = flow.CounterCollection("tlog")
         # banded + sampled commit durability latency (accept -> fsync ack)
         self.commit_bands = flow.RequestLatency("commit")
+        # critical-path split (ISSUE 18): version-ordering wait in
+        # _handle_commit vs fsync service in _make_durable, bridged by
+        # a per-request enter stamp; armed via CRITICAL_PATH only
+        self.path = RolePathRecorder("tlog")
         # QoS saturation signals (ref: TLogQueuingMetricsReply — the
         # smoothed queue surface the Ratekeeper polls). Pull model:
         # qos_sample() reads raw state at the collection cadence; the
@@ -172,9 +177,16 @@ class TLog:
                        TaskPriority.TLOG_COMMIT)
 
     async def _handle_commit(self, req: TLogCommitRequest, reply):
+        path_armed = bool(flow.SERVER_KNOBS.critical_path)
+        if path_armed:
+            # queue-entry stamp: the gap to _make_durable's start is
+            # this commit's version-ordering wait (popped by every
+            # early-return path so the bounded map never leaks)
+            self.path.note_enter(req, flow.now())
         if self.stopped:
             flow.cover("tlog.commit.stopped")
             reply.send_error(error("tlog_stopped"))
+            self.path.take_enter(req, 0.0)
             return
         # strict version ordering (ref: tLogCommit waits for
         # logData->version == req.prevVersion). A lock wakes parked
@@ -185,6 +197,7 @@ class TLog:
             self._stop_future)
         if self.stopped and self.queue_version.get() < req.prev_version:
             reply.send_error(error("tlog_stopped"))
+            self.path.take_enter(req, 0.0)
             return
         if req.known_committed > self.known_committed:
             self.known_committed = req.known_committed
@@ -193,11 +206,13 @@ class TLog:
             # not yet fsynced) — ack only once it IS durable, never
             # append twice (ADVICE r1: comparing against the durable
             # version raced the in-flight fsync)
+            self.path.take_enter(req, 0.0)
             await self._ack_when_durable(req.version, reply)
             return
         if self.stopped:
             flow.cover("tlog.commit.stopped")
             reply.send_error(error("tlog_stopped"))
+            self.path.take_enter(req, 0.0)
             return
         # the log-leg stations fire only on ACCEPTED first deliveries:
         # a stopped rejection or a duplicate proxy retry must not file
@@ -240,7 +255,11 @@ class TLog:
         flow.g_trace_batch.add_events(
             dbg, "CommitDebug", "TLog.tLogCommit.AfterTLogCommit")
         fire_station("TLog.tLogCommit.AfterTLogCommit")
-        self.commit_bands.record(flow.now() - t0)
+        done = flow.now()
+        self.commit_bands.record(done - t0)
+        if flow.SERVER_KNOBS.critical_path:
+            enter = self.path.take_enter(req, t0)
+            self.path.record(t0 - enter, done - t0)
         reply.send(version)
 
     async def _do_durable(self, req: TLogCommitRequest):
@@ -256,6 +275,13 @@ class TLog:
                            * flow.SERVER_KNOBS.buggify_tlog_commit_delay_max,
                                  TaskPriority.TLOG_COMMIT_REPLY)
             await flow.delay(self.fsync_delay, TaskPriority.TLOG_COMMIT_REPLY)
+            # directed fsync-stall injection (ISSUE 18): the tlog twin
+            # of COMMIT_LATENCY_INJECTION — a path drill arms this to
+            # prove tlog_fsync shows up dominant in the decomposition.
+            # 0 (the default) is one knob read, no delay
+            inj = flow.SERVER_KNOBS.tlog_fsync_injection
+            if inj:
+                await flow.delay(inj, TaskPriority.TLOG_COMMIT_REPLY)
             # variable delays must not reorder durability acks
             await self.version.when_at_least(req.prev_version)
         else:
@@ -272,6 +298,12 @@ class TLog:
                 seq = await self._dq.push(
                     encode_log_entry(version, req.mutations))
                 await self._dq.commit()
+                # fsync-stall injection INSIDE the FIFO lock: a real
+                # stalled disk serializes everything behind it, and the
+                # drill must reproduce that shape (ISSUE 18)
+                inj = flow.SERVER_KNOBS.tlog_fsync_injection
+                if inj:
+                    await flow.delay(inj, TaskPriority.TLOG_COMMIT_REPLY)
             finally:
                 self._dq_lock.release()
             i = bisect_left(self._versions, version)

@@ -46,6 +46,14 @@ Commands (ref: fdbcli):
   slo                        SLO engine verdict: per-rule ok/BREACH,
                              burn rates, recorder + TimeKeeper write
                              accounting (needs METRIC_HISTORY armed)
+  path                       commit critical-path decomposition: the
+                             dominant latency station, per-station
+                             seconds with queue-vs-service splits,
+                             and per-process resource telemetry
+                             (needs CRITICAL_PATH armed)
+  flightrec [dump [dir]]     flight-recorder status, or dump the
+                             recent-trace-event ring to a directory
+                             (in-process recorder)
 
   throttle on <tag> <tps> [prio] [secs]   manually throttle a tag
                              (prio: default | batch; secs: how long
@@ -598,6 +606,64 @@ def _render_slo(cl: dict) -> str:
     return "\n".join(lines)
 
 
+def _render_path(cl: dict) -> str:
+    """`path`: the latency-forensics view (ISSUE 18) — which pipeline
+    station commits spend their time in, the queue-vs-service split
+    where the serving role keeps one, the telescoping-sum residual
+    bound, and per-process resource telemetry. Every read is .get:
+    a federated doc from an older worker simply shows dashes."""
+    cp = cl.get("critical_path") or {}
+    if not cp.get("enabled"):
+        return ("critical-path decomposition off — arm CRITICAL_PATH "
+                "to decompose every commit into per-station segments "
+                "(batcher, version, resolve, fsync, reply)")
+    lines = [
+        f"Critical path: dominant now = {cp.get('dominant_now') or '-'}"
+        f"  ({cp.get('samples', 0)} commits decomposed; max residual "
+        f"{cp.get('max_residual_seconds', 0):g}s, tolerance "
+        f"{cp.get('tolerance', 0):g})",
+        f"  {'station':<16} {'seconds':>9} {'dominant':>9} "
+        f"{'decayed':>9}"]
+    dom = cp.get("dominant") or {}
+    secs = cp.get("station_seconds") or {}
+    decayed = {r.get("station"): r.get("score", 0.0)
+               for r in cp.get("top") or ()}
+    from ..server.critical_path import STATIONS
+    for s in STATIONS:
+        lines.append(f"  {s:<16} {secs.get(s, 0.0):>9g} "
+                     f"{dom.get(s, 0):>9} {decayed.get(s, 0.0):>9g}")
+    splits = cp.get("splits") or {}
+    for station, split in sorted(splits.items()):
+        w = (split.get("wait") or {}).get("sum_seconds", 0.0)
+        sv = (split.get("service") or {}).get("sum_seconds", 0.0)
+        lines.append(f"  {station}: queue {w:g}s vs service {sv:g}s "
+                     f"(serving-role split)")
+    pm = cl.get("process_metrics") or {}
+    if pm.get("enabled"):
+        share = pm.get("role_cpu_share") or {}
+        if share:
+            lines.append("  host cpu share: " + "  ".join(
+                f"{r}={v:.0%}" for r, v in share.items()))
+        host = pm.get("host") or {}
+        if host:
+            lines.append(
+                f"  host process: cpu={host.get('cpu_seconds', 0):g}s "
+                f"rss={host.get('rss_bytes', 0)} "
+                f"fds={host.get('open_fds', 0)} "
+                f"lag={host.get('loop_lag_ms', 0):g}ms")
+    for pname, p in sorted((cl.get("processes") or {}).items()):
+        s = p.get("process_metrics") or {}
+        if not s:
+            lines.append(f"  {pname}: (no process metrics)")
+            continue
+        lines.append(
+            f"  {pname}: cpu={s.get('cpu_seconds', 0):g}s "
+            f"rss={s.get('rss_bytes', 0)} fds={s.get('open_fds', 0)} "
+            f"lag={s.get('loop_lag_ms', 0):g}ms "
+            f"up={p.get('up', 1)}")
+    return "\n".join(lines)
+
+
 class Cli:
     def __init__(self, db, runner, cluster=None):
         """`db` is any Database-shaped handle (in-sim or remote);
@@ -716,6 +782,24 @@ class Cli:
             async def sl():
                 return await self.db.get_status()
             return _render_slo(self._run(sl())["cluster"])
+        if cmd == "path":
+            async def pt():
+                return await self.db.get_status()
+            return _render_path(self._run(pt())["cluster"])
+        if cmd == "flightrec":
+            from ..flow import g_flightrec as fr
+            if raw and raw[0] == "dump":
+                directory = raw[1] if len(raw) > 1 else None
+                path = fr.dump(directory=directory, reason="cli")
+                if path is None:
+                    return ("ERROR: nothing to dump (ring empty, or "
+                            "no directory given/armed)")
+                return f"dumped {len(fr.snapshot())} events to {path}"
+            st = fr.status()
+            return (f"flight recorder: "
+                    f"{'armed' if st['armed'] else 'disarmed'}  "
+                    f"ring={st['buffered']}/{st['size']} events  "
+                    f"noted={st['noted']}  dumps={st['dumps']}")
         if cmd == "status":
             async def st():
                 return await self.db.get_status()

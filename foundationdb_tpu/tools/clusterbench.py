@@ -411,6 +411,10 @@ def worker_trace_setup(role: str, cfg: dict) -> None:
     if run_dir:
         flow.reset_trace(os.path.join(run_dir,
                                       f"trace.{role}.{pid}.jsonl"))
+        # always-on flight recorder (ISSUE 18): ring of recent trace
+        # events, auto-dumped into the shared run dir on SevError so a
+        # worker that dies screaming leaves its last moments behind
+        flow.g_flightrec.arm(dump_dir=run_dir, name=f"{role}.{pid}")
     flow.trace.set_process_identity(
         role, addr=f"{cfg['host']}:{cfg['port']}")
     if cfg.get("trace"):
@@ -459,6 +463,8 @@ def run_worker(cfg: dict) -> dict:
         from ..rpc.gateway import DESCRIBE_TOKEN, PEER_DESCRIBE
         from ..rpc.network import SimNetwork
         from ..rpc.tcp import TcpRequestStream, TcpTransport
+        from ..server.process_metrics import ProcessMetrics, \
+            loop_lag_probe
         from ..server.proxy import Proxy
         flow.set_seed(int(cfg["seed"]))
         s = flow.Scheduler(virtual=False)
@@ -480,6 +486,7 @@ def run_worker(cfg: dict) -> dict:
         live: dict = {}
         started = time.perf_counter()
         pid = os.getpid()
+        metrics = ProcessMetrics(role=role)
 
         def worker_status() -> dict:
             counts = live.get("counts") or {}
@@ -490,6 +497,8 @@ def run_worker(cfg: dict) -> dict:
                 "counters": dict(counts),
                 "grv": _lat_ms(list(live.get("grv_lat") or [])),
                 "commit": _lat_ms(list(live.get("commit_lat") or [])),
+                "process_metrics": metrics.sample(),
+                "flightrec": flow.g_flightrec.status(),
             }
 
         async def status_loop():
@@ -500,6 +509,7 @@ def run_worker(cfg: dict) -> dict:
         async def main():
             transport.start()
             flow.spawn(status_loop())
+            flow.spawn(loop_lag_probe(metrics))
             describe = transport.ref(host, port, DESCRIBE_TOKEN)
             doc = None
             for _ in range(50):
@@ -556,6 +566,7 @@ def run_worker(cfg: dict) -> dict:
             flow.g_trace.flush()
         except Exception:  # noqa: BLE001 — exiting anyway
             pass
+        flow.g_flightrec.disarm()
         flow.set_scheduler(prev_sched)
         _rng.restore_rng_state(prev_rng)
 

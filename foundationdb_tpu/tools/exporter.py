@@ -123,6 +123,31 @@ def _add_latency(f: _Families, kind: str, role: str, request: str,
                   {**labels, "quantile": "0." + q[1:]}, snap[q])
 
 
+def _add_process_metrics(f: _Families, sample: dict) -> None:
+    """One ProcessMetrics sample (server/process_metrics.py) into the
+    fdbtpu_process_* family set — shared by the host render and the
+    federated per-worker render so the families line up."""
+    if not sample:
+        return
+    labels = {"role": str(sample.get("role", "?")),
+              "pid": str(sample.get("pid", "?"))}
+    for field, mtype, help_text in (
+            ("cpu_seconds", "counter",
+             "Process CPU seconds (user+system) since sampling began"),
+            ("rss_bytes", "gauge",
+             "Resident set size in bytes (-1 where unreadable)"),
+            ("open_fds", "gauge",
+             "Open file descriptors (-1 where unreadable)"),
+            ("gc_collections", "counter",
+             "Cumulative Python GC collections across generations"),
+            ("loop_lag_ms", "gauge",
+             "Run-loop lag: how late a fixed real-time sleep fired"),
+            ("uptime_seconds", "counter",
+             "Wall seconds since the process's sampler started")):
+        f.add(f"{_PREFIX}_process_{field}", mtype, help_text, labels,
+              sample.get(field))
+
+
 def _add_counters(f: _Families, kind: str, role: str, counters: dict) -> None:
     for cname, value in sorted((counters or {}).items()):
         f.add(f"{_PREFIX}_role_counter", "counter",
@@ -602,6 +627,58 @@ def render_prometheus(status: dict, f: _Families = None) -> str:
                       "(fixed-point: floats scaled x1000)", rl,
                       r.get("value"))
 
+    # the latency-forensics plane (ISSUE 18, CRITICAL_PATH armed):
+    # commit critical-path decomposition — per-station seconds with a
+    # wait/service split where the serving side keeps one, dominant-
+    # station attribution, the decaying top-cause table, and the
+    # telescoping-sum residual bound
+    cp = cl.get("critical_path") or {}
+    if cp.get("enabled"):
+        f.add(f"{_PREFIX}_path_samples_total", "counter",
+              "Commits decomposed into critical-path stations", {},
+              cp.get("samples"))
+        f.add(f"{_PREFIX}_path_residual_seconds_max", "gauge",
+              "Largest |sum(stations) - end_to_end| seen (the "
+              "telescoping-decomposition error bound)", {},
+              cp.get("max_residual_seconds"))
+        for s, n in sorted((cp.get("dominant") or {}).items()):
+            f.add(f"{_PREFIX}_path_dominant_total", "counter",
+                  "Decomposed commits whose largest segment was this "
+                  "station", {"station": s}, n)
+        for s, v in sorted((cp.get("station_seconds") or {}).items()):
+            f.add(f"{_PREFIX}_path_station_seconds_total", "counter",
+                  "Cumulative seconds attributed per pipeline station "
+                  "(kind: total from the proxy decomposition, "
+                  "wait/service from the serving role's split)",
+                  {"station": s, "kind": "total"}, v)
+        for station, split in sorted((cp.get("splits") or {}).items()):
+            for kind in ("wait", "service"):
+                f.add(f"{_PREFIX}_path_station_seconds_total", "counter",
+                      "Cumulative seconds attributed per pipeline "
+                      "station (kind: total from the proxy "
+                      "decomposition, wait/service from the serving "
+                      "role's split)",
+                      {"station": station, "kind": kind},
+                      (split.get(kind) or {}).get("sum_seconds"))
+        for i, row in enumerate(cp.get("top", ())):
+            f.add(f"{_PREFIX}_path_cause_score", "gauge",
+                  "Decaying dominant-cause score per station (rank 0 "
+                  "= the cluster's current primary latency cause)",
+                  {"rank": str(i), "station": row.get("station", "?")},
+                  row.get("score"))
+
+    # per-process resource telemetry (ISSUE 18): the host's sample
+    # here; every worker's rides the federated render
+    pm = cl.get("process_metrics") or {}
+    if pm.get("enabled"):
+        _add_process_metrics(f, pm.get("host") or {})
+        for role, share in sorted((pm.get("role_cpu_share")
+                                   or {}).items()):
+            f.add(f"{_PREFIX}_process_role_cpu_share", "gauge",
+                  "Run-loop busy-time share per sim role inside this "
+                  "host process (SIM_TASK_STATS fold)",
+                  {"sim_role": role}, share)
+
     msgs = cl.get("messages", ())
     f.add(f"{_PREFIX}_health_messages", "gauge",
           "Active health messages in the status rollup", {}, len(msgs))
@@ -744,6 +821,22 @@ def _render_worker_doc(doc: dict, f: _Families) -> None:
                       "(milliseconds)",
                       {**labels, "request": req,
                        "quantile": q[:-3]}, value)
+    # per-process resource telemetry (ISSUE 18) — .get throughout:
+    # a worker running an OLDER build has no process_metrics section,
+    # and the federated scrape must render it with defaults, not fail
+    # (version-skew tolerance)
+    _add_process_metrics(f, doc.get("process_metrics") or {})
+    fr = doc.get("flightrec") or {}
+    if fr:
+        f.add(f"{_PREFIX}_flightrec_buffered", "gauge",
+              "Trace events currently held in the flight-recorder "
+              "ring", labels, fr.get("buffered"))
+        f.add(f"{_PREFIX}_flightrec_noted_total", "counter",
+              "Trace events ever filed into the flight recorder",
+              labels, fr.get("noted"))
+        f.add(f"{_PREFIX}_flightrec_dumps_total", "counter",
+              "Flight-recorder dumps written by this process", labels,
+              fr.get("dumps"))
 
 
 def render_federated(host_status: dict, procs: List[dict],
@@ -766,16 +859,42 @@ def render_federated(host_status: dict, procs: List[dict],
     return f.render()
 
 
+#: sections every federated process doc is normalized to carry —
+#: version-skew tolerance: a worker running an OLDER build (or a
+#: tombstone for a dead one) simply lacks the newer sections, and the
+#: consumers (cli, exporter, soak timeline) must see defaults, never
+#: a KeyError
+_PROC_DOC_DEFAULTS = (
+    ("role", "?"), ("pid", None), ("up", 1), ("uptime_s", None),
+    ("counters", {}), ("grv", {}), ("commit", {}),
+    ("process_metrics", {}), ("flightrec", {}),
+)
+
+
+def normalize_proc_doc(p: dict) -> dict:
+    """Fill a worker status doc's missing sections with defaults (a
+    fresh dict per doc — shared mutable defaults would alias)."""
+    out = dict(p or {})
+    for key, default in _PROC_DOC_DEFAULTS:
+        if key not in out:
+            out[key] = dict(default) if isinstance(default, dict) \
+                else default
+    return out
+
+
 def federate_status(host_status: dict, procs: List[dict],
                     host_process: str = "cluster-host") -> dict:
     """Fold per-process docs into the host status document under
     `cluster.processes` (one section, keyed by "role:pid"), mirroring
-    the reference `status json` processes map."""
+    the reference `status json` processes map. Docs are normalized
+    first (normalize_proc_doc), so a mixed-version cluster federates
+    cleanly."""
     import copy
     doc = copy.deepcopy(host_status or {})
     cl = doc.setdefault("cluster", {})
-    cl["processes"] = {str(p.get("process", f"?:{i}")): p
-                      for i, p in enumerate(procs or ())}
+    cl["processes"] = {str(p.get("process", f"?:{i}")):
+                       normalize_proc_doc(p)
+                       for i, p in enumerate(procs or ())}
     cl["federation"] = {"host_process": host_process,
                         "process_count": 1 + len(procs or ())}
     return doc
@@ -857,28 +976,64 @@ def fetch_process_docs(run_dir: str, *, timeout: float = 5.0,
         _rng.restore_rng_state(prev_rng)
 
 
+_ESCAPES = {"\\": "\\", '"': '"', "n": "\n"}
+
+
 def parse_prometheus(text: str) -> List[Tuple[str, dict, float]]:
-    """Minimal exposition-format parser: [(name, labels, value)].
-    Raises ValueError on a malformed line — the CI smoke and the tests
-    use it as the well-formedness check."""
+    """Exposition-format parser: [(name, labels, value)]. Raises
+    ValueError on a malformed line — the CI smoke and the tests use it
+    as the well-formedness check. Label values are scanned with full
+    escape awareness (the format's \\\\, \\" and \\n sequences), so a
+    tag, signal or stack label carrying a quote, comma, brace or
+    newline round-trips through _esc exactly — the old tokenizer split
+    the body on commas and never unescaped, silently corrupting any
+    such value."""
     out: List[Tuple[str, dict, float]] = []
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        rest = line
         labels: dict = {}
         if "{" in line:
-            name, _, rest = line.partition("{")
-            body, _, rest = rest.partition("}")
-            for part in body.split(","):
-                if not part:
-                    continue
-                k, _, v = part.partition("=")
-                if not (v.startswith('"') and v.endswith('"')):
+            name, _, body = line.partition("{")
+            i, n = 0, len(body)
+            while True:
+                if i >= n:
+                    raise ValueError(f"unterminated label set: {line!r}")
+                if body[i] == "}":
+                    break
+                j = body.find("=", i)
+                if j < 0:
+                    raise ValueError(f"label without '=': {line!r}")
+                key = body[i:j].strip()
+                i = j + 1
+                if i >= n or body[i] != '"':
                     raise ValueError(f"unquoted label value: {line!r}")
-                labels[k] = v[1:-1]
-            value = rest.strip()
+                i += 1
+                buf: List[str] = []
+                while i < n and body[i] != '"':
+                    c = body[i]
+                    if c == "\\":
+                        if i + 1 >= n:
+                            raise ValueError(
+                                f"dangling escape: {line!r}")
+                        nxt = body[i + 1]
+                        if nxt not in _ESCAPES:
+                            raise ValueError(
+                                f"bad escape \\{nxt}: {line!r}")
+                        buf.append(_ESCAPES[nxt])
+                        i += 2
+                    else:
+                        buf.append(c)
+                        i += 1
+                if i >= n:
+                    raise ValueError(
+                        f"unterminated label value: {line!r}")
+                labels[key] = "".join(buf)
+                i += 1          # closing quote
+                if i < n and body[i] == ",":
+                    i += 1
+            value = body[i + 1:].strip()
         else:
             name, _, value = line.partition(" ")
             value = value.strip()

@@ -95,6 +95,15 @@ async def capture_bundle(db, out_dir: str,
 
     inventory = _trace_docs(run_dir, out_dir)
 
+    # flight recorder (ISSUE 18): the capturing process's ring of
+    # recent trace events joins the bundle — the seconds leading INTO
+    # the breach, finer-grained than the sampled series
+    from ..flow import g_flightrec
+    rec_path = g_flightrec.dump(directory=out_dir,
+                                reason=f"incident:{reason}")
+    if rec_path is not None:
+        inventory["flightrec"] = os.path.basename(rec_path)
+
     manifest = {
         "reason": reason,
         "window": {"t0": t0, "t1": t1,

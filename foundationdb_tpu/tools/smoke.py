@@ -27,6 +27,7 @@ SIMPROF_REPORT_PATH = "/tmp/_simprof_smoke.txt"
 SPLITS_REPORT_PATH = "/tmp/_splits_report.txt"
 SOAK_REPORT_PATH = "/tmp/_soak_report.txt"
 SLO_REPORT_PATH = "/tmp/_slo_report.txt"
+PATH_REPORT_PATH = "/tmp/_path_report.txt"
 SIMPROF_CHAOS_PATH = "/tmp/_simprof_chaos.json"
 SIMPROF_CHAOS_FOLDED_PATH = "/tmp/_simprof_chaos.folded"
 
@@ -1553,8 +1554,107 @@ def run_smoke_slo(out=print,
     return 0
 
 
+def run_smoke_path(out=print,
+                   report_path: str = PATH_REPORT_PATH) -> int:
+    """Latency-forensics cell (ISSUE 18's acceptance): a CRITICAL_PATH-
+    armed cluster with every tlog fsync stalled by an injected delay.
+
+    Asserts: every commit batch was decomposed into consecutive
+    pipeline stations and the per-txn segments telescope to the
+    end-to-end latency within the pinned tolerance; the injected stall
+    makes `tlog_fsync` the attributed dominant cause — per-commit
+    counts, the decaying top-cause table, AND the queue-vs-service
+    split all agree; the host ProcessMetrics sample rides the status
+    doc; the fdbtpu_path_* / fdbtpu_process_* exporter families parse
+    cleanly; and the `cli path` view renders. The report lands in
+    /tmp/_path_report.txt for the CI artifact."""
+    import json
+
+    from .. import flow
+    from ..client import run_transaction
+    from ..server import SimCluster
+    from .cli import Cli
+    from .exporter import parse_prometheus, render_prometheus
+
+    cluster = SimCluster(seed=7, durable=True, critical_path=True)
+    # the stall: 3ms added to every fsync — set AFTER construction
+    # (SimCluster re-initializes the knob set)
+    flow.SERVER_KNOBS.set("tlog_fsync_injection", 0.003)
+    cli = Cli.for_cluster(cluster)
+    try:
+        db = cluster.client("path-smoke")
+
+        async def workload():
+            for i in range(40):
+                async def w(tr, i=i):
+                    tr.set(b"path/%04d" % i, b"v%d" % i)
+                await run_transaction(db, w)
+            # past CRITICAL_PATH_INTERVAL so the CC folds the proxies'
+            # samples into the decaying cause table at least once
+            await flow.delay(5.0)
+            return await db.get_status()
+
+        status = cluster.run(workload(), timeout_time=300)
+        cl = status["cluster"]
+        cp = cl["critical_path"]
+        assert cp["enabled"] == 1, cp
+        assert cp["samples"] >= 40, cp
+        # the decomposition invariant: station segments sum to the
+        # end-to-end latency within the pinned tolerance
+        assert cp["max_residual_seconds"] <= cp["tolerance"], cp
+        # the injected stall must be ATTRIBUTED: tlog_fsync dominant
+        # per-commit, now, and in the decayed table
+        dom_share = (cp["dominant"].get("tlog_fsync", 0)
+                     / max(1, cp["samples"]))
+        assert dom_share >= 0.9, cp["dominant"]
+        assert cp["dominant_now"] == "tlog_fsync", cp
+        assert cp["top"] and cp["top"][0]["station"] == "tlog_fsync", \
+            cp["top"]
+        split = cp["splits"]["tlog_fsync"]
+        assert split["service"]["total"] > 0, split
+        assert split["service"]["sum_seconds"] > 0, split
+        pm = cl["process_metrics"]
+        assert pm["enabled"] == 1, pm
+        assert (pm.get("host") or {}).get("samples", 0) >= 1, pm
+
+        text = render_prometheus(status)
+        samples = parse_prometheus(text)   # raises on malformed lines
+        names = {n for n, _, _ in samples}
+        for need in ("fdbtpu_path_samples_total",
+                     "fdbtpu_path_residual_seconds_max",
+                     "fdbtpu_path_dominant_total",
+                     "fdbtpu_path_station_seconds_total",
+                     "fdbtpu_path_cause_score",
+                     "fdbtpu_process_cpu_seconds"):
+            assert need in names, f"exporter missing {need}"
+        dom = {lb["station"]: v for n, lb, v in samples
+               if n == "fdbtpu_path_dominant_total"}
+        assert max(dom, key=dom.get) == "tlog_fsync", dom
+
+        view = cli.execute("path")
+        assert "tlog_fsync" in view, view
+        rec = flow.g_flightrec.status()
+        assert rec["armed"] == 1 and rec["buffered"] > 0, rec
+        with open(report_path, "w") as fh:
+            fh.write(json.dumps({"critical_path": cp,
+                                 "process_metrics": pm,
+                                 "flightrec": rec},
+                                indent=2, sort_keys=True,
+                                default=str) + "\n\n")
+            fh.write(view + "\n")
+        out(f"path smoke OK: {cp['samples']} commits decomposed, "
+            f"dominant=tlog_fsync ({dom_share:.0%} of commits), "
+            f"max residual {cp['max_residual_seconds']}s <= "
+            f"tolerance {cp['tolerance']}s; report -> {report_path}")
+        return 0
+    finally:
+        cluster.shutdown()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--path" in argv:
+        return run_smoke_path()
     if "--soak" in argv:
         return run_smoke_soak()
     if "--slo" in argv:

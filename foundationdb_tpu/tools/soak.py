@@ -78,6 +78,8 @@ def run_soak_worker(cfg: dict) -> dict:
     try:
         from ..rpc.gateway import DESCRIBE_TOKEN
         from ..rpc.tcp import TcpRequestStream, TcpTransport
+        from ..server.process_metrics import ProcessMetrics, \
+            loop_lag_probe
         flow.set_seed(int(cfg["seed"]))
         s = flow.Scheduler(virtual=False)
         flow.set_scheduler(s)
@@ -85,7 +87,10 @@ def run_soak_worker(cfg: dict) -> dict:
         gen = int(cfg.get("generation", 0))
         role = f"client-{idx}"
         pid = os.getpid()
+        # worker_trace_setup also arms the flight recorder against the
+        # shared run dir (auto-dump on SevError)
         worker_trace_setup(role, cfg)
+        metrics = ProcessMetrics(role=role)
         transport = TcpTransport()
         status_stream = TcpRequestStream(transport)
         if cfg.get("run_dir"):
@@ -104,6 +109,8 @@ def run_soak_worker(cfg: dict) -> dict:
                 "counters": dict(counts),
                 "grv": _lat_ms(list(live.get("grv_lat") or [])),
                 "commit": _lat_ms(list(live.get("commit_lat") or [])),
+                "process_metrics": metrics.sample(),
+                "flightrec": flow.g_flightrec.status(),
             }
 
         async def status_loop():
@@ -137,11 +144,13 @@ def run_soak_worker(cfg: dict) -> dict:
                 if len(commit_lat) > ci:
                     row["commit"] = _lat_ms(list(commit_lat[ci:]))
                 gi, ci = len(grv_lat), len(commit_lat)
+                row["proc"] = metrics.sample()
                 print(json.dumps(row), flush=True)
 
         async def main():
             transport.start()
             flow.spawn(status_loop())
+            flow.spawn(loop_lag_probe(metrics))
             describe = transport.ref(host, port, DESCRIBE_TOKEN)
             doc = None
             for _ in range(50):
@@ -199,6 +208,7 @@ def run_soak_worker(cfg: dict) -> dict:
             flow.g_trace.flush()
         except Exception:  # noqa: BLE001 — exiting anyway
             pass
+        flow.g_flightrec.disarm()
         flow.set_scheduler(prev_sched)
         _rng.restore_rng_state(prev_rng)
 
@@ -263,6 +273,8 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
         from ..server import SimCluster
         from ..server import dbinfo as dbi
         from ..server.chaos import database_digest
+        from ..server.process_metrics import ProcessMetrics, \
+            loop_lag_probe
         from ..server.types import STATUS_REQUEST
         from . import exporter, tracemerge
         if trace:
@@ -296,6 +308,12 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                                   duration * 2 + 600.0)
             flow.SERVER_KNOBS.set("timekeeper_retention",
                                   duration * 2 + 600.0)
+        # host-side telemetry + flight recorder (ISSUE 18) — armed
+        # AFTER construction (SimCluster disarms the process-global
+        # recorder to keep pinned sims clean)
+        host_metrics = ProcessMetrics(role="cluster-host")
+        flow.g_flightrec.arm(dump_dir=run_dir,
+                             name=f"cluster-host.{os.getpid()}")
         db = cluster.client("soak-status")
         gw = TcpGateway(cluster.client("soakgw"), cluster=cluster)
 
@@ -421,6 +439,16 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                            "pid": stub.get("pid"), "up": 0}
                 procs.append(doc)
             host_status = await db.get_status()
+            # the host's own resource sample rides the cluster doc —
+            # when the sim's CRITICAL_PATH plane is off (the soak's
+            # default), inject it so the federated scrape still covers
+            # EVERY OS process with fdbtpu_process_* samples
+            cl_doc = host_status.setdefault("cluster", {})
+            if not (cl_doc.get("process_metrics") or {}).get("enabled"):
+                cl_doc["process_metrics"] = {
+                    "enabled": 1, "interval": sample_period,
+                    "host": host_metrics.sample(),
+                    "role_cpu_share": {}}
             fed_doc = exporter.federate_status(
                 host_status, procs,
                 host_process=f"cluster-host:{os.getpid()}")
@@ -435,6 +463,9 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             federation["up"] = sum(
                 1 for p in procs if p.get("up"))
             federation["scrape_samples"] = len(samples)
+            federation["process_metric_pids"] = sorted(
+                {lb.get("pid") for name, lb, _v in samples
+                 if name == "fdbtpu_process_cpu_seconds"})
 
         async def slo_read_back(run_t0_clock: float) -> dict:
             """ISSUE 17 acceptance: the timeline and the final verdict
@@ -587,6 +618,7 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                         totals = {k: 0 for k in COUNT_KEYS}
                         lat = {}
                         up = 0
+                        procs_row = {}
                         for slot in slots:
                             for k, v in slot.live_counts().items():
                                 totals[k] += v
@@ -594,12 +626,23 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                             if slot.proc is not None and \
                                     slot.proc.poll() is None:
                                 up += 1
+                            prow = row.get("proc") or {}
+                            if prow:
+                                procs_row[f"client-{slot.index}"] = {
+                                    k: prow.get(k) for k in
+                                    ("cpu_seconds", "rss_bytes",
+                                     "open_fds", "loop_lag_ms")}
                             for req in ("grv", "commit"):
                                 for q, v in (row.get(req)
                                              or {}).items():
                                     key = f"{req}_{q}"
                                     lat[key] = max(lat.get(key, 0.0),
                                                    v)
+                    hrow = host_metrics.sample()
+                    procs_row["cluster-host"] = {
+                        k: hrow.get(k) for k in
+                        ("cpu_seconds", "rss_bytes", "open_fds",
+                         "loop_lag_ms")}
                     trow = {"t": round(wall - t0, 3),
                             "committed": totals["committed"],
                             "txn_per_s": round(
@@ -610,6 +653,7 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                             "workers_up": up}
                     trow.update({k: round(v, 3)
                                  for k, v in sorted(lat.items())})
+                    trow["proc"] = procs_row
                     note_sample(trow)
                     bank_totals(totals)
                     prev_committed = totals["committed"]
@@ -726,6 +770,14 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             elif ok:
                 ok = slo_doc["final_state"] == "ok"
         doc["ok"] = ok
+        if not ok:
+            # red run: the host's flight-recorder ring joins the run
+            # dir (the workers' rings already auto-dump on SevError) —
+            # nightly CI uploads the whole directory on failure
+            dump_path = flow.g_flightrec.dump(directory=run_dir,
+                                              reason="soak_red")
+            if dump_path:
+                doc["flightrec_dump"] = dump_path
         slo_note = ""
         if slo_doc is not None:
             slo_note = (f"slo={slo_doc['final_state']} "
@@ -757,6 +809,7 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
         if slo:
             flow.SERVER_KNOBS.set("commit_latency_injection", 0.0)
             flow.SERVER_KNOBS.set("metric_history", 0)
+        flow.g_flightrec.disarm()
         flow.set_scheduler(prev_sched)
         _rng.restore_rng_state(prev_rng)
 
@@ -800,6 +853,10 @@ def render_soak_report(doc: dict) -> str:
         f"{fed.get('process_count', 0)} "
         f"({fed.get('up', 0)} up), scrape samples: "
         f"{fed.get('scrape_samples', 0)}",
+        "- fdbtpu_process_* coverage (pids): "
+        + (", ".join(str(p)
+                     for p in fed.get("process_metric_pids", ()))
+           or "-"),
     ]
     tr = doc.get("trace") or {}
     if tr:

@@ -288,6 +288,75 @@ def full_commit_chains(merged: dict) -> List[dict]:
     return out
 
 
+# ------------------------------------------------ critical-path stations
+#: cross-process commit stations in path order. Boundary timestamps are
+#: read off the merged (clock-rebased) span tree of one full commit
+#: chain; consecutive boundaries telescope to the chain's client-side
+#: extent, the offline analogue of the live in-process decomposition
+#: (server/critical_path.py STATIONS).
+PATH_STATIONS = ("client_to_proxy", "proxy_batcher", "resolve",
+                 "log_push", "tlog_fsync", "reply")
+
+
+def path_decomposition(merged: dict, tolerance: float = 0.05) -> dict:
+    """Decompose every full commit chain into critical-path station
+    segments.
+
+    Boundaries, in path order: client span begin, proxy commitBatch
+    begin, first resolver begin, last resolver end, first tlog begin,
+    last tlog end, client span end. Residual clock skew can push a
+    boundary backwards; boundaries are made monotone (running max) so
+    segments are non-negative AND still telescope exactly to the
+    client-observed extent — any skew shows up as a zero-width station,
+    never a negative one. `residual_s` per chain is the difference
+    between the chain's merged end-to-end and the telescoped sum (the
+    tree may extend past the client span on either side)."""
+    rows: List[dict] = []
+    seconds = {s: 0.0 for s in PATH_STATIONS}
+    dominant: Dict[str, int] = {}
+    max_residual = 0.0
+    chains = full_commit_chains(merged)
+    for c in chains:
+        by_loc: Dict[str, List[dict]] = {}
+        for r in c["spans"]:
+            by_loc.setdefault(r["location"], []).append(r)
+        client = by_loc["NativeAPI.commit"][0]
+        proxy = by_loc["MasterProxyServer.commitBatch"][0]
+        res = by_loc["Resolver.resolveBatch"]
+        tlog = by_loc["TLog.tLogCommit"]
+        bounds = (client["begin"], proxy["begin"],
+                  min(r["begin"] for r in res),
+                  max(r["end"] for r in res),
+                  min(r["begin"] for r in tlog),
+                  max(r["end"] for r in tlog),
+                  client["end"])
+        cuts = [bounds[0]]
+        for b in bounds[1:]:
+            cuts.append(max(cuts[-1], b))
+        segments = {s: round(cuts[i + 1] - cuts[i], 6)
+                    for i, s in enumerate(PATH_STATIONS)}
+        dom = max(PATH_STATIONS, key=lambda s: segments[s])
+        residual = c["end_to_end_s"] - (cuts[-1] - cuts[0])
+        for s in PATH_STATIONS:
+            seconds[s] += segments[s]
+        dominant[dom] = dominant.get(dom, 0) + 1
+        max_residual = max(max_residual, abs(residual))
+        rows.append({"debug_id": c["debug_id"],
+                     "end_to_end_s": c["end_to_end_s"],
+                     "segments": segments,
+                     "dominant": dom,
+                     "residual_s": round(residual, 6)})
+    return {
+        "chains": len(chains),
+        "decomposed": len(rows),
+        "stations": {s: round(v, 6) for s, v in seconds.items()},
+        "dominant": dominant,
+        "max_residual_seconds": round(max_residual, 6),
+        "tolerance": tolerance,
+        "rows": rows,
+    }
+
+
 # ----------------------------------------------------------------- output
 def render_report(merged: dict, top: int = 5) -> str:
     lines = [f"tracemerge: {merged['run_dir']}"]
@@ -305,6 +374,18 @@ def render_report(merged: dict, top: int = 5) -> str:
     full = len(full_commit_chains(merged))
     lines.append(f"chains: {len(chains)} total, {cross} cross-process, "
                  f"{full} full commit paths")
+    if full:
+        path = path_decomposition(merged)
+        doms = ", ".join(f"{s}={n}" for s, n in
+                         sorted(path["dominant"].items(),
+                                key=lambda kv: -kv[1]))
+        lines.append(f"critical path ({path['decomposed']} commits "
+                     f"decomposed, max residual "
+                     f"{path['max_residual_seconds'] * 1e3:.3f} ms): "
+                     f"dominant {doms or '-'}")
+        for s in PATH_STATIONS:
+            lines.append(f"  {s:<16} {path['stations'][s] * 1e3:9.3f} ms"
+                         " total")
     lines.append(f"slowest commits (top {min(top, len(chains))}):")
     for c in chains[:top]:
         lines.append(f"  {c['debug_id']}: "

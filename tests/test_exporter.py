@@ -272,3 +272,76 @@ def test_http_server_survives_scrape_errors():
             url, timeout=10).read() == b"ok_metric 1\n"
     finally:
         srv.close()
+
+
+# -- ISSUE 18: escaping + version-skew federation --------------------------
+
+def test_hostile_label_values_round_trip():
+    """Backslashes, quotes, newlines, commas and braces in a label
+    value must survive render -> parse EXACTLY: the exposition format
+    escapes only \\, " and newline, and commas/braces are legal raw
+    inside a quoted value — the parser must scan the quoted string,
+    not split the body on commas."""
+    hostile = 'a\\b"c\nd,e{f}=g'
+    st = _canned_status()
+    st["cluster"]["proxies"][0]["name"] = hostile
+    samples = parse_prometheus(render_prometheus(st))
+    roles = {l["role"] for _n, l, _v in samples if "role" in l}
+    assert hostile in roles, roles
+
+
+def test_parse_rejects_bad_escapes_and_unterminated():
+    with pytest.raises(ValueError):
+        parse_prometheus('m{a="bad\\q"} 1')      # unknown escape
+    with pytest.raises(ValueError):
+        parse_prometheus('m{a="dangling\\')
+    with pytest.raises(ValueError):
+        parse_prometheus('m{a="unterminated} 1')
+
+
+def test_federate_tolerates_version_skew():
+    """A worker doc from an OLDER build lacks the newer sections
+    (process_metrics, flightrec, even counters): federation must fill
+    defaults — no KeyError anywhere downstream — and the filled
+    defaults must not alias between docs."""
+    from foundationdb_tpu.tools.exporter import (federate_status,
+                                                 normalize_proc_doc,
+                                                 render_federated)
+    old_worker = {"process": "client-0:100", "role": "client-0",
+                  "pid": 100}
+    new_worker = {"process": "client-1:200", "role": "client-1",
+                  "pid": 200, "up": 1, "counters": {"committed": 7},
+                  "process_metrics": {"role": "client-1", "pid": 200,
+                                      "cpu_seconds": 1.5,
+                                      "rss_bytes": 1024,
+                                      "open_fds": 9,
+                                      "gc_collections": 3,
+                                      "loop_lag_ms": 0.25,
+                                      "uptime_seconds": 12.0},
+                  "flightrec": {"armed": 1, "size": 512,
+                                "buffered": 40, "noted": 99,
+                                "dumps": 1}}
+    fed = federate_status(_canned_status(), [old_worker, new_worker])
+    procs = fed["cluster"]["processes"]
+    for name, p in procs.items():
+        for key in ("counters", "grv", "commit", "process_metrics",
+                    "flightrec", "up", "uptime_s"):
+            assert key in p, (name, key)
+    # filled defaults are fresh dicts, never shared
+    procs["client-0:100"]["counters"]["x"] = 1
+    assert "x" not in normalize_proc_doc({})["counters"]
+
+    # the federated scrape renders BOTH docs and parses; the new
+    # worker's telemetry families carry its identity labels
+    text = render_federated(_canned_status(), [old_worker, new_worker])
+    samples = parse_prometheus(text)
+    cpu = {(l.get("role"), l.get("pid")): v for n, l, v in samples
+           if n == "fdbtpu_process_cpu_seconds"}
+    assert cpu.get(("client-1", "200")) == 1.5, cpu
+    rec = {n for n, _l, _v in samples if n.startswith("fdbtpu_flightrec")}
+    assert {"fdbtpu_flightrec_buffered", "fdbtpu_flightrec_noted_total",
+            "fdbtpu_flightrec_dumps_total"} <= rec, rec
+    # the old worker still contributes its liveness row
+    ups = {l.get("role") for n, l, _v in samples
+           if n == "fdbtpu_process_up"}
+    assert "client-0" in ups, ups
