@@ -23,10 +23,13 @@ Prints exactly one JSON line:
 Env overrides: FDBTPU_BENCH_TXNS (batch size), FDBTPU_BENCH_BATCHES
 (timed batches), FDBTPU_BENCH_KEYS (keyspace), FDBTPU_BENCH_READS
 (reads per txn), FDBTPU_BENCH_BACKEND (tpu-point|tpu|tpu-streamed|
-tpu-pipelined|tpu-packed|python|native — CPU baselines for comparison
-runs; tpu-packed is the packed single-buffer interval feed vs its
-unpacked baseline), FDBTPU_BENCH_PIPELINE_DEPTH (headline K for the
-tpu-pipelined submit/drain window; `all` mode sweeps K in {1,2,4,8}).
+tpu-pipelined|tpu-packed|python|native|native-streamed — CPU
+baselines for comparison runs; tpu-packed is the packed single-buffer
+interval feed vs its unpacked baseline; native-streamed is the
+first-class C-ABI row with pre-marshalled batches and its own
+ABI-call ceiling math, ROADMAP item 1's tunnel-down pivot),
+FDBTPU_BENCH_PIPELINE_DEPTH (headline K for the tpu-pipelined
+submit/drain window; `all` mode sweeps K in {1,2,4,8}).
 
 `bench.py --dry` runs the packed/unpacked interval parity gate instead
 of a bench round (CI: a feed-path divergence fails the gate, not a
@@ -417,27 +420,36 @@ def _compact_pipeline_stats(pipe: dict) -> dict:
     return out
 
 
+def _obj_batch(rng, n_txns, keyspace, v):
+    """One object-API batch (shared by the CPU baselines and the
+    native streamed row so their conflict counts are comparable:
+    same rng, same draw order, same 16-byte point keys)."""
+    from foundationdb_tpu.models import ResolverTransaction
+
+    txns = []
+    for _ in range(n_txns):
+        reads = []
+        for _ in range(READS_PER_TXN):
+            k = int(rng.integers(0, keyspace))
+            kb = k.to_bytes(KEY_BYTES, "big")
+            reads.append((kb, kb + b"\x00"))
+        k = int(rng.integers(0, keyspace))
+        kb = k.to_bytes(KEY_BYTES, "big")
+        txns.append(ResolverTransaction(v - VERSION_STEP, tuple(reads),
+                                        ((kb, kb + b"\x00"),)))
+    return txns
+
+
 def bench_cpu(backend, n_txns, n_batches, keyspace):
     """CPU baselines through the generic object API (for comparison)."""
-    from foundationdb_tpu.models import ResolverTransaction, create_conflict_set
+    from foundationdb_tpu.models import create_conflict_set
 
     rng = np.random.default_rng(20260729)
     cs = create_conflict_set(backend)
     version = VERSION_STEP
 
     def obj_batch(v):
-        txns = []
-        for _ in range(n_txns):
-            reads = []
-            for _ in range(READS_PER_TXN):
-                k = int(rng.integers(0, keyspace))
-                kb = k.to_bytes(KEY_BYTES, "big")
-                reads.append((kb, kb + b"\x00"))
-            k = int(rng.integers(0, keyspace))
-            kb = k.to_bytes(KEY_BYTES, "big")
-            txns.append(ResolverTransaction(v - VERSION_STEP, tuple(reads),
-                                            ((kb, kb + b"\x00"),)))
-        return txns
+        return _obj_batch(rng, n_txns, keyspace, v)
 
     # batch construction stays OUTSIDE the timed region (the streamed
     # device path pre-encodes its batches too) so the baseline measures
@@ -451,6 +463,94 @@ def bench_cpu(backend, n_txns, n_batches, keyspace):
         verdicts = cs.resolve(txns, v, max(0, v - MWTLV))
         n_conflicts += sum(1 for x in verdicts if x == 0)
     return n_batches * n_txns / (time.perf_counter() - t0), n_conflicts
+
+
+def bench_native_streamed(n_txns, n_batches, keyspace):
+    """First-class native row (ROADMAP item 1 pivot): the C-ABI hot
+    path measured the way the device streamed rows are — marshalling
+    hoisted OUT of the timed region, so the loop pays exactly what a
+    native resolver role pays per batch: one ctypes call into
+    libfdbtpu_native.so plus the skip-probe kernel. The object-API
+    `native` baseline re-marshals every batch inside resolve(), so it
+    measures Python flattening more than the kernel; this row is the
+    backend's honest number.
+
+    Ceiling math (this backend has no link ceiling — the bound is the
+    per-batch ABI call): the floor of an EMPTY-batch call (ctypes
+    dispatch + GC-window advance, zero conflict work) is measured
+    after the timed region, and `abi_ceiling_txn_per_s` =
+    n_txns / floor is the throughput if the kernel were free — the
+    native analog of `dispatch_roundtrip_ms` bounding the streamed
+    device path. `pct_of_abi_ceiling` says how far the kernel itself
+    is from that bound.
+
+    Returns (txn_per_s, n_conflicts, detail). Conflict counts are
+    comparable to the object-API `native` row at equal batch counts
+    (same rng seed + draw order) — `all` mode refuses to publish on a
+    divergence."""
+    import ctypes
+
+    from foundationdb_tpu.models.native_backend import (NativeConflictSet,
+                                                        _marshal)
+
+    rng = np.random.default_rng(20260729)
+    cs = NativeConflictSet()
+    lib, handle = cs._lib, cs._handle
+    version = VERSION_STEP
+
+    p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))  # noqa: E731
+
+    # pre-marshalled C-ABI arrays, outside the timed region (the
+    # device streamed path pre-encodes with make_batch for the same
+    # reason); the transient Python objects are dropped immediately.
+    # NO keyed warmup batches: this row must stay bit-comparable to
+    # the object-API `native` row (same rng stream, same versions,
+    # same window state), so the warmup below uses empty batches at
+    # version 0 — they draw nothing and insert nothing
+    pre = []
+    for i in range(n_batches):
+        v = version + i * VERSION_STEP
+        arrays = _marshal(_obj_batch(rng, n_txns, keyspace, v))
+        pre.append((v, arrays, np.empty(n_txns, np.uint8)))
+
+    def call(v, arrays, out, n):
+        snapshots, rc, wc, blob, rr, wr = arrays
+        lib.fdbtpu_conflictset_resolve(
+            handle, v, max(0, v - MWTLV), n,
+            p(snapshots, ctypes.c_int64), p(rc, ctypes.c_int32),
+            p(wc, ctypes.c_int32), p(blob, ctypes.c_uint8),
+            p(rr, ctypes.c_int64), p(wr, ctypes.c_int64),
+            p(out, ctypes.c_uint8))
+
+    empty = _marshal([])
+    eout = np.empty(1, np.uint8)
+    for _ in range(10):           # warm icache/ctypes, window untouched
+        call(0, empty, eout, 0)
+    t0 = time.perf_counter()
+    for v, arrays, out in pre:
+        call(v, arrays, out, n_txns)
+    elapsed = time.perf_counter() - t0
+    txn_per_s = n_batches * n_txns / elapsed
+    # verdict 0 == conflict (the ConflictSetBase convention)
+    n_conflicts = int(sum(int((out == 0).sum())
+                          for _v, _arrays, out in pre))
+
+    # ABI call floor: empty batches at still-advancing versions (the
+    # window keeps moving exactly like a real idle resolver tick)
+    v = pre[-1][0]
+    n_probe = 500
+    t0 = time.perf_counter()
+    for j in range(n_probe):
+        call(v + (j + 1) * VERSION_STEP, empty, eout, 0)
+    abi_floor_s = (time.perf_counter() - t0) / n_probe
+    ceiling = n_txns / abi_floor_s if abi_floor_s > 0 else None
+    return txn_per_s, n_conflicts, {
+        "abi_call_floor_us": round(abi_floor_s * 1e6, 2),
+        "abi_ceiling_txn_per_s": round(ceiling, 1) if ceiling else None,
+        "pct_of_abi_ceiling": round(100.0 * txn_per_s / ceiling, 2)
+        if ceiling else None,
+        "batch_wall_us": round(elapsed / n_batches * 1e6, 1),
+    }
 
 
 def _jax_platform() -> str:
@@ -477,6 +577,8 @@ def _run_backend(backend, n_txns, n_batches, keyspace):
                                   "interval")[:2]
     if backend == "tpu-packed":
         return bench_tpu_packed(n_txns, n_batches, keyspace)[:2]
+    if backend == "native-streamed":
+        return bench_native_streamed(n_txns, n_batches, keyspace)[:2]
     return bench_cpu(backend, n_txns, n_batches, keyspace)
 
 
@@ -715,6 +817,25 @@ def main():
             out[name] = {"txn_per_s": round(tps, 1),
                          "vs_baseline": round(tps / TARGET_TXN_PER_S, 4),
                          "batches": nb, "conflicts": nc}
+        # the first-class native streamed row (ROADMAP item 1 pivot):
+        # same batch count and seed as the object-API `native` row, so
+        # equal conflict counts are a parity gate, and the row carries
+        # its own ceiling math (the empty-batch ABI call floor)
+        nb = min(n_batches, 25)
+        try:
+            tps, nc, detail = bench_native_streamed(n_txns, nb, keyspace)
+        except Exception as e:
+            out["native-streamed"] = {"error": str(e)}
+            return out
+        obj_nc = out.get("native", {}).get("conflicts")
+        if obj_nc is not None and nc != obj_nc:
+            raise RuntimeError(
+                f"native streamed vs object-API conflict counts "
+                f"diverged: {nc} vs {obj_nc} — refusing to publish")
+        out["native-streamed"] = {
+            "txn_per_s": round(tps, 1),
+            "vs_baseline": round(tps / TARGET_TXN_PER_S, 4),
+            "batches": nb, "conflicts": nc, **detail}
         return out
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -830,6 +951,23 @@ def main():
         txn_per_s, n_conflicts, packed_detail = bench_tpu_packed(
             n_txns, n_batches, keyspace)
         sub["tpu-packed"] = packed_detail
+        backend_name = backend
+    elif backend == "native-streamed":
+        # single-backend native streamed run: the ABI ceiling evidence
+        # rides sub_metrics here too, plus the object-API `native`
+        # baseline at the same shape so the marshalling tax is a
+        # measured delta, not an assertion
+        txn_per_s, n_conflicts, native_detail = bench_native_streamed(
+            n_txns, n_batches, keyspace)
+        sub["native-streamed"] = native_detail
+        nb_obj = min(n_batches, 25)
+        tps_obj, nc_obj = bench_cpu("native", n_txns, nb_obj, keyspace)
+        sub["native"] = {"txn_per_s": round(tps_obj, 1),
+                         "batches": nb_obj, "conflicts": nc_obj,
+                         "note": "object API: per-batch Python "
+                                 "marshalling inside the timed region"}
+        sub["native-streamed"]["speedup_vs_object_api"] = \
+            round(txn_per_s / tps_obj, 2) if tps_obj else None
         backend_name = backend
     else:
         txn_per_s, n_conflicts = _run_backend(backend, n_txns, n_batches,

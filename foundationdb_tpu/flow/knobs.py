@@ -634,6 +634,17 @@ def make_server_knobs(randomize: bool = False, into: "Knobs | None" = None) -> K
     # and the sim otherwise resolves in zero sim time, hiding the
     # resolver axis entirely. Default 0.0 = off = byte-identical.
     init("SIM_RESOLVE_COST_PER_TXN", 0.0)
+    # modeled proxy commit-pipeline service time per transaction
+    # (seconds) — the proxy-side twin of SIM_RESOLVE_COST_PER_TXN so
+    # the role-per-process bench (SYSBENCH r02) has BOTH axes of the
+    # capacity model min(R/resolve_cost, P/commit_cost) binding.
+    # Default 0.0 = off = byte-identical.
+    init("SIM_COMMIT_COST_PER_TXN", 0.0)
+    # wall-clock deadline for a RetryingTcpRef (rpc/tcp.py) to keep
+    # re-issuing a request whose connection died — bridges role-process
+    # kill -9 windows (respawn on the same port) via role idempotency.
+    # Never BUGGIFY-distorted: retries ride real TCP only.
+    init("ROLE_RETRY_DEADLINE", 30.0)
 
     # -- conflict-backend fault tolerance (models/failover.py) ---------
     # per-seam probability of a simulated device fault at the

@@ -172,23 +172,40 @@ class TcpGateway:
         def by_index(pairs):
             return sorted(pairs, key=lambda p: int(p[0].rsplit("-", 1)[1]))
 
-        resolvers = by_index(list(
-            epoch_roles(cc.workers, info.epoch, Resolver)))
         proxies = by_index(list(
             epoch_roles(cc.workers, info.epoch, Proxy)))
-        n_res = len(resolvers)
         first_proxy = proxies[0][1]
+        # role-per-process deployment (tools/rolehost.py): recruitment
+        # stashed addr-carrying descriptors — a worker proxy connects
+        # DIRECTLY to each role process instead of through this
+        # gateway's forwarders. In-process roles keep the original
+        # gateway-token shape; an entry is a dict with an "addr" iff
+        # the role is external (tlog entries: bare int = gateway token,
+        # dict = external commit endpoint).
+        ext_resolvers = getattr(rec, "peer_resolvers", None)
+        if ext_resolvers is not None:
+            resolvers_doc = [dict(e) for e in ext_resolvers]
+        else:
+            resolvers = by_index(list(
+                epoch_roles(cc.workers, info.epoch, Resolver)))
+            resolvers_doc = [
+                {"name": rn,
+                 "resolves": self._expose(r.resolves.ref()),
+                 "handoffs": self._expose(r.handoffs.ref())}
+                for rn, r in resolvers]
+        ext_tlogs = getattr(rec, "peer_tlogs", None)
+        if ext_tlogs is not None:
+            tlogs_doc = [dict(e) for e in ext_tlogs]
+        else:
+            tlogs_doc = [self._expose(lr.commits)
+                         for lr in info.logs.logs]
+        n_res = len(resolvers_doc)
         return {
             "epoch": info.epoch,
             "recovery_version": info.recovery_version,
             "master": self._expose(rec.master.version_requests.ref()),
-            "resolvers": [
-                {"name": rn,
-                 "resolves": self._expose(r.resolves.ref()),
-                 "handoffs": self._expose(r.handoffs.ref())}
-                for rn, r in resolvers],
-            "tlogs": [self._expose(lr.commits)
-                      for lr in info.logs.logs],
+            "resolvers": resolvers_doc,
+            "tlogs": tlogs_doc,
             "proxy_raw_committed": [
                 self._expose(p.raw_committed.ref())
                 for _rn, p in proxies],

@@ -166,6 +166,75 @@ class TcpRef:
     def get_reply(self, request, _src=None) -> Future:
         return self.transport._request(self.addr, self.token, request)
 
+    def send(self, request, _src=None) -> None:
+        """Fire-and-forget (the NetworkRef.send mirror): the frame rides
+        a normal request id, but no promise is registered — a reply (or
+        the connection dying) is silently dropped, matching the sim
+        transport's best-effort datagram semantics."""
+        self.transport._request(self.addr, self.token, request,
+                                oneway=True)
+
+
+class RetryingTcpRef:
+    """A TcpRef that re-issues a request when the underlying connection
+    dies mid-flight (broken_promise), with exponential backoff up to the
+    ROLE_RETRY_DEADLINE wall-clock budget.
+
+    This is the client half of role-process fault tolerance: an
+    externally-hosted resolver/tlog killed with SIGKILL respawns on the
+    SAME addr:port (SO_REUSEADDR) and recovers from its checkpoint +
+    journal, so a retried request lands on a role whose reply cache /
+    version chain make the re-delivery idempotent (the reference's
+    model: endpoint tokens survive process restart only through
+    recruitment, but OUR role hosts pin their token layout, so the
+    ref stays valid across the respawn). Requests that fail with any
+    error OTHER than broken_promise propagate immediately — retry is
+    for dead transport, not for application verdicts."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, ref: TcpRef):
+        self.ref = ref
+
+    @property
+    def addr(self):
+        return self.ref.addr
+
+    @property
+    def token(self):
+        return self.ref.token
+
+    def get_reply(self, request, _src=None) -> Future:
+        p = Promise()
+        flow.spawn(self._drive(request, _src, p), TaskPriority.READ_SOCKET,
+                   name="tcp.retry")
+        return p.future
+
+    def send(self, request, _src=None) -> None:
+        self.ref.send(request, _src)
+
+    async def _drive(self, request, src, p: Promise):
+        from ..flow import SERVER_KNOBS
+        deadline = flow.now() + float(SERVER_KNOBS.role_retry_deadline)
+        backoff = 0.05
+        while True:
+            try:
+                value = await self.ref.get_reply(request, src)
+            except flow.FdbError as e:
+                name = e.name
+                if name != "broken_promise" or flow.now() >= deadline:
+                    if not p.is_set:
+                        p.send_error(e)
+                    return
+                await flow.delay(
+                    min(backoff, max(0.0, deadline - flow.now())),
+                    TaskPriority.READ_SOCKET)
+                backoff = min(backoff * 2.0, 1.0)
+                continue
+            if not p.is_set:
+                p.send(value)
+            return
+
 
 class _Conn:
     """One socket + its reader/writer threads (ref: connectionReader /
@@ -467,7 +536,8 @@ class TcpTransport:
             T3=flow.now()).log()
 
     # -- client side -------------------------------------------------------
-    def _request(self, addr, token: int, request) -> Future:
+    def _request(self, addr, token: int, request,
+                 oneway: bool = False) -> Optional[Future]:
         p = Promise()
         # traced envelope only when the knob is armed AND the request
         # samples at least one debug id — everything else keeps the
@@ -488,13 +558,14 @@ class TcpTransport:
                 fresh = False
             req_id = self._next_req
             self._next_req += 1
-            self._pending[req_id] = p
-            if ctx is not None:
-                self._pending_trace[req_id] = (
-                    ctx["t0"], tuple(d for d, _sid in ctx["spans"]))
-            conn.pending.add(req_id)
+            if not oneway:
+                self._pending[req_id] = p
+                if ctx is not None:
+                    self._pending_trace[req_id] = (
+                        ctx["t0"], tuple(d for d, _sid in ctx["spans"]))
+                conn.pending.add(req_id)
         if fresh:
             conn.start()     # connect happens on the writer thread
         conn.enqueue(K_REQUEST if ctx is None else K_TRACED,
                      req_id, token, payload)
-        return p.future
+        return None if oneway else p.future

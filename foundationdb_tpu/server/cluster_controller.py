@@ -2757,9 +2757,37 @@ class ClusterController:
             info = self.dbinfo.get()
             if info.seq > req.known_seq and \
                     info.recovery_state == FULLY_RECOVERED and info.storages:
-                reply.send(info)
+                reply.send(_client_safe_info(info))
                 return
             await self.dbinfo.on_change()
+
+
+def _client_safe_info(info):
+    """The CLIENT-facing dbinfo reply rides the sim's wire round trip
+    (the serialization oracle). With externally-hosted tlogs
+    (tools/rolehost.py) the log refs are RetryingTcpRefs — process-
+    local handles with no wire encoding, and nothing a client could
+    use anyway (clients reach tlogs only THROUGH proxies). Blank them
+    here; with in-process logs this returns `info` itself untouched,
+    so the default posture stays byte-identical."""
+
+    def is_ext(lr):
+        return lr.commits is not None and \
+            type(lr.commits).__name__ != "NetworkRef"
+
+    def strip(ls):
+        if not any(is_ext(lr) for lr in ls.logs):
+            return ls
+        return ls._replace(logs=tuple(
+            lr._replace(commits=None, peeks=None, pops=None, locks=None)
+            if is_ext(lr) else lr for lr in ls.logs))
+
+    logs = strip(info.logs)
+    old_logs = tuple(strip(ls) for ls in info.old_logs)
+    if logs is info.logs and all(
+            a is b for a, b in zip(old_logs, info.old_logs)):
+        return info
+    return info._replace(logs=logs, old_logs=old_logs)
 
 from ..rpc import wire as _wire
 

@@ -251,18 +251,36 @@ class MasterRecovery:
         # usable_regions gating the fearless log topology)
         if getattr(cfg, "usable_regions", 1) < 2:
             region = None
-        log_workers = self.cc.pick_workers(cfg.n_logs, role="tlog")
+        # role-per-process deployment (ROADMAP item 2): a driver that
+        # attached an ExternalRoles directory (tools/rolehost.py) hosts
+        # resolvers/tlogs in their own OS processes — recruitment
+        # becomes an init RPC and every ref below is a RetryingTcpRef.
+        # With no directory attached (the default), this path adds
+        # zero awaits and zero draws: the posture is byte-identical.
+        ext = getattr(self.cc, "external_roles", None)
         new_logs = []
         new_log_stores = []
         log_recruits = []       # (worker, store) incl. satellites
-        for i, w in enumerate(log_workers):
-            store = f"tlog-e{self.epoch}-{i}"
-            refs = w.recruit_tlog(store, recovery_version)
-            self.cc.log_stores[store] = refs
-            new_logs.append(refs)
-            new_log_stores.append((store, w.process.machine))
-            log_recruits.append((w, store))
-            self.critical_procs.add(w.process)
+        if ext is not None and ext.n_tlogs:
+            assert region is None, \
+                "external tlogs + region topologies are not supported"
+            assert ext.n_tlogs == cfg.n_logs, (ext.n_tlogs, cfg.n_logs)
+            for i in range(cfg.n_logs):
+                store = f"tlog-e{self.epoch}-{i}"
+                refs = await ext.recruit_tlog(i, store, recovery_version)
+                self.cc.log_stores[store] = refs
+                new_logs.append(refs)
+                new_log_stores.append((store, refs.machine))
+        else:
+            log_workers = self.cc.pick_workers(cfg.n_logs, role="tlog")
+            for i, w in enumerate(log_workers):
+                store = f"tlog-e{self.epoch}-{i}"
+                refs = w.recruit_tlog(store, recovery_version)
+                self.cc.log_stores[store] = refs
+                new_logs.append(refs)
+                new_log_stores.append((store, w.process.machine))
+                log_recruits.append((w, store))
+                self.critical_procs.add(w.process)
         # satellite log replicas (ref: satelliteTagLocations — one more
         # full replica of the stream per satellite DC, so the acked
         # tail survives a primary-DC blackout). Full log-set members:
@@ -285,18 +303,38 @@ class MasterRecovery:
                 new_log_stores.append((store, sw.process.machine))
                 log_recruits.append((sw, store))
                 self.critical_procs.add(sw.process)
-        res_workers = self.cc.pick_workers(cfg.n_resolvers, role="resolver")
         resolver_refs = []
         resolver_metrics = []
         resolver_handoffs = []
-        for i, w in enumerate(res_workers):
-            rref, mref, href = w.recruit_resolver(
-                f"resolver-e{self.epoch}-{i}", recovery_version,
-                backend=cfg.conflict_backend)
-            resolver_refs.append(rref)
-            resolver_metrics.append(mref)
-            resolver_handoffs.append(href)
-            self.critical_procs.add(w.process)
+        if ext is not None and ext.n_resolvers:
+            assert ext.n_resolvers == cfg.n_resolvers, \
+                (ext.n_resolvers, cfg.n_resolvers)
+            for i in range(cfg.n_resolvers):
+                rref, mref, href = await ext.recruit_resolver(
+                    i, f"resolver-e{self.epoch}-{i}", recovery_version,
+                    cfg.conflict_backend)
+                resolver_refs.append(rref)
+                resolver_metrics.append(mref)
+                resolver_handoffs.append(href)
+        else:
+            res_workers = self.cc.pick_workers(cfg.n_resolvers,
+                                               role="resolver")
+            for i, w in enumerate(res_workers):
+                rref, mref, href = w.recruit_resolver(
+                    f"resolver-e{self.epoch}-{i}", recovery_version,
+                    backend=cfg.conflict_backend)
+                resolver_refs.append(rref)
+                resolver_metrics.append(mref)
+                resolver_handoffs.append(href)
+                self.critical_procs.add(w.process)
+        # addr-carrying peer descriptors for the TcpGateway's PEER
+        # describe: worker proxies connect DIRECTLY to external role
+        # processes instead of trombone-ing through the gateway
+        self.peer_resolvers = (ext.resolver_descriptors()
+                               if ext is not None and ext.n_resolvers
+                               else None)
+        self.peer_tlogs = (ext.tlog_descriptors()
+                           if ext is not None and ext.n_tlogs else None)
         resolver_splits = initial_resolver_splits(cfg.n_resolvers)
         self.cc.recruit_initial_storages()
         # every tag's records are held until ALL of its replicas pop
@@ -311,6 +349,12 @@ class MasterRecovery:
         if region is not None:
             from .proxy import REGION_TAG
             expected[REGION_TAG] = (region.router_name,)
+        if ext is not None and ext.n_tlogs:
+            # external tlogs take the replica expectation over their
+            # control token (in-process recruitment's direct method
+            # call, made an RPC)
+            for i in range(cfg.n_logs):
+                await ext.set_expected_replicas(i, expected)
         for w, store in log_recruits:
             w.roles[store].set_expected_replicas(expected)
         storage_splits = self.cc.storage_splits()

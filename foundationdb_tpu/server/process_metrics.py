@@ -130,3 +130,51 @@ def role_cpu_share(task_rows: list) -> dict:
         return {}
     return {r: round(b / total, 4) for r, b in
             sorted(busy.items(), key=lambda kv: -kv[1])}
+
+
+def _norm_role(role) -> str:
+    """Collapse per-instance role names onto the role family: strip a
+    trailing "-<digits>" instance suffix, then the "ext-" prefix a
+    role-per-process host (tools/rolehost.py) prepends — so
+    "ext-resolver-1" and an in-host "resolver" fold into one row."""
+    r = str(role or "other")
+    head, _, tail = r.partition(":")
+    if tail:
+        r = head        # "tcp:41025" / "gateway:<port>" -> family
+    head, _, tail = r.rpartition("-")
+    if head and tail.isdigit():
+        r = head
+    if r.startswith("ext-"):
+        r = r[4:]
+    return r or "other"
+
+
+def federated_role_cpu_share(host_share: dict, host_cpu_seconds,
+                             proc_docs: list) -> dict:
+    """Cross-OS-process role CPU shares (ISSUE 19 satellite): the
+    host's in-process share (`role_cpu_share` over SIM_TASK_STATS) is
+    weighted by the host's measured `cpu_seconds`, and every worker or
+    role process contributes its whole `cpu_seconds` under its role —
+    so once resolvers and tlogs run in their own OS processes their CPU
+    shows up in the same per-role table the in-process split-out was
+    judged against, instead of vanishing from the host's fold."""
+    busy: dict = {}
+    host_cpu = max(0.0, float(host_cpu_seconds or 0.0))
+    for role, share in (host_share or {}).items():
+        r = _norm_role(role)
+        try:
+            busy[r] = busy.get(r, 0.0) + float(share) * host_cpu
+        except (TypeError, ValueError):
+            continue
+    for doc in proc_docs or ():
+        pm = (doc or {}).get("process_metrics") or {}
+        cpu = pm.get("cpu_seconds")
+        if not isinstance(cpu, (int, float)) or cpu < 0:
+            continue
+        r = _norm_role(doc.get("role") or pm.get("role"))
+        busy[r] = busy.get(r, 0.0) + float(cpu)
+    total = sum(busy.values())
+    if total <= 0:
+        return {}
+    return {r: round(b / total, 4) for r, b in
+            sorted(busy.items(), key=lambda kv: -kv[1])}

@@ -339,6 +339,10 @@ class Proxy:
         # deadlocking on each other's versions.
         self.batch_resolving = NotifiedVersion(0)
         self.batch_logging = NotifiedVersion(0)
+        # wall-clock deadline pacer for SIM_COMMIT_COST_PER_TXN (the
+        # proxy-side modeled service time, role-per-process bench):
+        # next-free instant of this proxy as a serial commit server
+        self._pace_free = 0.0
         self._local_batch = 0
         self._peers = []               # other proxies' raw-committed refs
         self._ratekeeper_ref = ratekeeper_ref
@@ -978,6 +982,23 @@ class Proxy:
         # pipeline stations (server/chaos.py; no-op while unarmed)
         fire_station(location)
 
+    async def _charge_commit_cost(self, amount: float):
+        """Charge modeled commit service time. Wall-clock schedulers use
+        a deadline pacer (the proxy as a serial server whose next-free
+        instant advances by `amount` per batch — sleeping to the
+        deadline absorbs per-sleep OS overshoot); virtual schedulers
+        charge a plain delay. Knob default 0 means this never runs in
+        the pinned posture."""
+        sched = flow.get_scheduler()
+        if sched is not None and not sched.virtual:
+            now = flow.now()
+            self._pace_free = max(self._pace_free, now) + amount
+            wait = self._pace_free - now
+            if wait > 0:
+                await flow.delay(wait, TaskPriority.PROXY_COMMIT)
+            return
+        await flow.delay(amount, TaskPriority.PROXY_COMMIT)
+
     async def _commit_batch(self, batch, local: int):
         t0 = flow.now()
         reqs = [r for r, _ in batch]
@@ -1058,6 +1079,15 @@ class Proxy:
                 t_res = flow.now()
             self._mark(dbg,
                        "MasterProxyServer.commitBatch.AfterResolution")
+            # modeled proxy commit-pipeline service time
+            # (SIM_COMMIT_COST_PER_TXN, default 0 = off): the proxy-side
+            # twin of the resolver's modeled cost, charged per
+            # transaction after resolution — mutation assembly + push
+            # are the proxy's own CPU in the role-per-process capacity
+            # model min(R/resolve_cost, P/commit_cost)
+            ccost = float(SERVER_KNOBS.sim_commit_cost_per_txn)
+            if ccost > 0 and reqs:
+                await self._charge_commit_cost(ccost * len(reqs))
 
             # phase 3: assemble mutations of committed transactions with
             # their destination storage tags, resolving versionstamped

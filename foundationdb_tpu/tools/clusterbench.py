@@ -256,10 +256,203 @@ def run_inprocess_cell(n_proxies: int, n_resolvers: int, *, seed: int,
         _rng.restore_rng_state(prev_rng)
 
 
+# ----------------------------------------------------------- role processes
+# r02 capacity model for role-per-process cells: each external resolver
+# charges ROLE_RESOLVE_COST wall-seconds per txn and each worker proxy
+# ROLE_COMMIT_COST, so a cell's modeled capacity is
+# min(R / resolve_cost, P / commit_cost) committed/s and the grid's
+# scaling is governed by genuinely-overlapping OS processes, not the
+# host GIL. Offered load runs ROLE_HEADROOM past capacity with a small
+# per-worker inflight window so the drain tail stays bounded.
+ROLE_RESOLVE_COST = 10e-3
+ROLE_COMMIT_COST = 6.8e-3
+ROLE_HEADROOM = 2.5
+ROLE_MAX_INFLIGHT = 128
+
+
+def role_cell_capacity(n_proxies: int, n_resolvers: int,
+                       resolve_cost: float = ROLE_RESOLVE_COST,
+                       commit_cost: float = ROLE_COMMIT_COST) -> float:
+    """Modeled committed-txn/s ceiling of a role-per-process cell."""
+    caps = []
+    if resolve_cost > 0:
+        caps.append(n_resolvers / resolve_cost)
+    if commit_cost > 0:
+        caps.append(n_proxies / commit_cost)
+    return min(caps) if caps else float("inf")
+
+
+class RoleProcs:
+    """Role-per-process supervisor: one OS process per external
+    resolver/tlog (tools/rolehost.py --worker), spawned BEFORE the
+    cluster host so recruitment finds live control endpoints. kill() /
+    respawn() drive the chaos path: a respawn pins the dead host's
+    port, so every outstanding TcpRef — the host's recruitment refs and
+    the worker proxies' RetryingTcpRefs alike — heals onto the
+    recovered process without re-describing."""
+
+    def __init__(self, n_resolvers: int = 0, n_tlogs: int = 0, *,
+                 run_dir: str, state_root: str = None, seed: int = 0,
+                 backend: str = "python", resolve_cost: float = 0.0,
+                 checkpoint_every: float = 1.0, trace: bool = False):
+        self.run_dir = run_dir
+        self.state_root = state_root
+        self.seed = seed
+        self.backend = backend
+        self.resolve_cost = resolve_cost
+        self.checkpoint_every = checkpoint_every
+        self.trace = trace
+        self.keys = ([("resolver", i) for i in range(n_resolvers)]
+                     + [("tlog", i) for i in range(n_tlogs)])
+        self.procs: dict = {}
+        self.ready: dict = {}
+        self.kills = 0
+
+    @property
+    def n_resolvers(self) -> int:
+        return sum(1 for k, _ in self.keys if k == "resolver")
+
+    @property
+    def n_tlogs(self) -> int:
+        return sum(1 for k, _ in self.keys if k == "tlog")
+
+    def name(self, kind: str, i: int) -> str:
+        return f"ext-{kind}-{i}"
+
+    def _ready_path(self, kind: str, i: int) -> str:
+        return os.path.join(self.run_dir,
+                            f"ready.{self.name(kind, i)}.json")
+
+    def spawn(self, kind: str, i: int, port: int = 0) -> None:
+        name = self.name(kind, i)
+        cfg = {"role": kind, "name": name, "index": i, "port": port,
+               "host": "127.0.0.1", "run_dir": self.run_dir,
+               "seed": self.seed + 7000
+               + i + (0 if kind == "resolver" else 100),
+               "trace": int(bool(self.trace)),
+               "trace_roll_size":
+                   int(flow.SERVER_KNOBS.trace_roll_size),
+               "checkpoint_every": self.checkpoint_every}
+        if kind == "resolver":
+            cfg["backend"] = self.backend
+            cfg["resolve_cost"] = self.resolve_cost
+            if self.state_root:
+                cfg["state_dir"] = os.path.join(self.state_root, name)
+        try:
+            os.unlink(self._ready_path(kind, i))
+        except OSError:
+            pass
+        log = open(os.path.join(self.run_dir,
+                                f"rolehost.{name}.log"), "ab")
+        try:
+            self.procs[(kind, i)] = subprocess.Popen(
+                [sys.executable, "-m",
+                 "foundationdb_tpu.tools.rolehost",
+                 "--worker", json.dumps(cfg)],
+                stdout=log, stderr=log)
+        finally:
+            log.close()     # the child holds its own dup
+
+    def spawn_all(self) -> "RoleProcs":
+        for kind, i in self.keys:
+            self.spawn(kind, i)
+        return self
+
+    def check_ready(self, kind: str, i: int):
+        """Non-blocking: the ready doc once the CURRENT incarnation
+        (pid match) has written it, else None. Raises if the process
+        exited — a role host never exits on its own."""
+        p = self.procs[(kind, i)]
+        if p.poll() is not None:
+            raise RuntimeError(
+                f"rolehost {self.name(kind, i)} exited "
+                f"rc={p.returncode} (see rolehost log in "
+                f"{self.run_dir})")
+        try:
+            with open(self._ready_path(kind, i)) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if doc.get("pid") != p.pid:
+            return None     # a previous incarnation's ready file
+        self.ready[(kind, i)] = doc
+        return doc
+
+    def wait_ready(self, which=None, timeout: float = 60.0) \
+            -> "RoleProcs":
+        """Blocking (pre-scheduler) readiness wait."""
+        deadline = time.time() + timeout
+        for kind, i in (which or self.keys):
+            while self.check_ready(kind, i) is None:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"rolehost {self.name(kind, i)} never became "
+                        f"ready")
+                time.sleep(0.05)
+        return self
+
+    async def wait_ready_async(self, which=None,
+                               timeout: float = 60.0) -> None:
+        """Scheduler-friendly readiness wait (soak/test kill paths —
+        the host loop keeps serving while the role host reboots)."""
+        deadline = time.time() + timeout
+        for kind, i in (which or self.keys):
+            while self.check_ready(kind, i) is None:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"rolehost {self.name(kind, i)} never became "
+                        f"ready")
+                await flow.delay(0.05)
+
+    def kill(self, kind: str, i: int) -> int:
+        """SIGKILL — the chaos primitive. Returns the dead pid."""
+        p = self.procs[(kind, i)]
+        p.kill()
+        p.wait()
+        self.kills += 1
+        return p.pid
+
+    def respawn(self, kind: str, i: int) -> None:
+        """Relaunch on the SAME port (from the dead incarnation's
+        ready doc) so existing refs heal; follow with wait_ready[_
+        async] before expecting replies."""
+        self.spawn(kind, i, port=int(self.ready[(kind, i)]["port"]))
+
+    def external_roles(self):
+        from .rolehost import ExternalRoles
+        return ExternalRoles(
+            [self.ready[("resolver", i)]
+             for i in range(self.n_resolvers)],
+            [self.ready[("tlog", i)] for i in range(self.n_tlogs)])
+
+    def status_stubs(self) -> list:
+        """proc-file-shaped stubs for exporter.fetch_process_docs —
+        current incarnations only (self.ready tracks respawns)."""
+        return [{"name": d["name"], "role": d["role"],
+                 "pid": d["pid"], "host": d["host"], "port": d["port"],
+                 "status_token": d["tokens"]["status"]}
+                for d in (self.ready.get(k) for k in self.keys) if d]
+
+    def terminate_all(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — escalate, never hang
+                p.kill()
+                p.wait()
+
+
 # ------------------------------------------------------------ across-process
 def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
                  duration: float, rate: float, run_dir: str = None,
                  trace: bool = False, sample_every: int = 32,
+                 role_processes: bool = False,
+                 resolve_cost: float = 0.0, commit_cost: float = 0.0,
+                 batch_cap: int = 0, max_inflight: int = 2048,
+                 state_root: str = None,
                  out=lambda *a, **k: None) -> dict:
     """One across-process cell: this process hosts the cluster
     (master/resolvers/tlogs/storage) wall-clock behind a peer-serving
@@ -272,10 +465,23 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
     the TRACE_PROPAGATION knob arms in host and workers, sampled
     commits (1-in-`sample_every`) carry debug ids, and
     tools/tracemerge.py reassembles the cross-process span trees from
-    the directory afterwards."""
+    the directory afterwards.
+
+    With `role_processes=True` (ISSUE 19) the cell goes FULLY
+    role-per-process: every resolver and the tlog run as their own
+    rolehost OS processes (spawned before the cluster, recruited by
+    the master through `ExternalRoles`), worker proxies connect to
+    them DIRECTLY over TCP, `resolve_cost` arms in the resolver
+    processes and `commit_cost` in the worker proxies (the r02
+    capacity model — see `role_cell_capacity`), and the cell doc gains
+    per-OS-process CPU/RSS rows plus the federated `role_cpu_share`
+    fold."""
+    from ..server.process_metrics import (ProcessMetrics,
+                                          federated_role_cpu_share,
+                                          role_cpu_share)
     prev_sched = flow.get_scheduler()
     prev_rng = _rng.rng_state()
-    cluster = gw = None
+    cluster = gw = roles = ext = None
     prev_trace_path = flow.g_trace.path
     if run_dir is None:
         import tempfile
@@ -292,13 +498,31 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
             flow.reset_trace(os.path.join(
                 run_dir, f"trace.cluster-host.{os.getpid()}.jsonl"))
             flow.trace.set_process_identity("cluster-host")
+        if role_processes:
+            # role hosts first: recruitment needs their control
+            # endpoints live before the master's first epoch
+            roles = RoleProcs(
+                n_resolvers=n_resolvers, n_tlogs=1, run_dir=run_dir,
+                state_root=state_root
+                or os.path.join(run_dir, "state"),
+                seed=seed, resolve_cost=resolve_cost, trace=trace)
+            roles.spawn_all().wait_ready()
         cluster = SimCluster(seed=seed, virtual=False, n_proxies=1,
                              n_resolvers=n_resolvers, n_storage=1,
                              n_logs=1)
+        if roles is not None:
+            # attach point: constructed but not yet ticked — the
+            # master's recruitment phase sees it on its first epoch
+            ext = roles.external_roles()
+            cluster.cc.external_roles = ext
         if trace:
             # AFTER cluster construction: SimCluster re-seeds the knob
             # set, which would silently disarm an earlier set()
             flow.SERVER_KNOBS.set("trace_propagation", 1)
+        # host-side CPU attribution for the role_cpu_share fold: the
+        # scheduler's per-task busy table + this process's OS counters
+        flow.get_scheduler().start_task_stats()
+        host_pm = ProcessMetrics(role="cluster-host")
         gw = TcpGateway(cluster.client("benchgw"), cluster=cluster)
 
         results: list = []
@@ -313,7 +537,10 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
                    "trace": int(bool(trace)),
                    "trace_roll_size":
                        int(flow.SERVER_KNOBS.trace_roll_size),
-                   "sample_every": sample_every if trace else 0}
+                   "sample_every": sample_every if trace else 0,
+                   "commit_cost": commit_cost,
+                   "batch_cap": batch_cap,
+                   "max_inflight": max_inflight}
             try:
                 p = subprocess.run(
                     [sys.executable, "-m",
@@ -367,7 +594,37 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
             agg["offered"] / max(1, agg["offered"] + agg["shed"]), 4)
         agg["grv"] = results[0]["grv"] if results else {}
         agg["commit"] = results[0]["commit"] if results else {}
-        out(f"  tcp {n_proxies}x{n_resolvers}: {agg['txn_per_s']}/s "
+        # per-OS-process telemetry + the federated role CPU fold
+        # (ISSUE 19): host sim-task share weighted by host CPU, worker
+        # proxies' and role hosts' whole CPU under their roles
+        host_share = role_cpu_share(
+            flow.get_scheduler().task_stats_report().get("tasks"))
+        host_sample = host_pm.sample()
+        agg["host_proc"] = host_sample
+        agg["worker_procs"] = [r["proc"] for r in results
+                               if r.get("proc")]
+        role_docs: list = []
+        if roles is not None:
+            from .exporter import fetch_process_docs
+            role_docs = fetch_process_docs(
+                run_dir, stubs=roles.status_stubs())
+            agg["role_processes"] = {"resolvers": roles.n_resolvers,
+                                     "tlogs": roles.n_tlogs}
+            agg["role_procs"] = [
+                {k: d.get(k) for k in
+                 ("process", "role", "name", "pid", "up", "uptime_s",
+                  "counters", "version", "process_metrics")}
+                for d in role_docs]
+            cap = role_cell_capacity(n_proxies, n_resolvers,
+                                     resolve_cost, commit_cost)
+            if cap != float("inf"):
+                agg["capacity_model_txn_per_s"] = round(cap, 1)
+        agg["role_cpu_share"] = federated_role_cpu_share(
+            host_share, host_sample.get("cpu_seconds"),
+            [{"role": s.get("role"), "process_metrics": s}
+             for s in agg["worker_procs"]] + role_docs)
+        out(f"  tcp{'-roleproc' if roles is not None else ''} "
+            f"{n_proxies}x{n_resolvers}: {agg['txn_per_s']}/s "
             f"committed={agg['committed']} "
             f"divergent={agg['divergent_verdicts']} "
             f"trace-run-dir={run_dir}")
@@ -375,8 +632,12 @@ def run_tcp_cell(n_proxies: int, n_resolvers: int, *, seed: int,
     finally:
         if gw is not None:
             gw.close()
+        if ext is not None:
+            ext.close()
         if cluster is not None:
             cluster.shutdown()
+        if roles is not None:
+            roles.terminate_all()
         if trace:
             # host spans flushed into the run dir, then the shared
             # collector goes back exactly where the caller had it
@@ -462,7 +723,8 @@ def run_worker(cfg: dict) -> dict:
     try:
         from ..rpc.gateway import DESCRIBE_TOKEN, PEER_DESCRIBE
         from ..rpc.network import SimNetwork
-        from ..rpc.tcp import TcpRequestStream, TcpTransport
+        from ..rpc.tcp import (RetryingTcpRef, TcpRequestStream,
+                               TcpTransport)
         from ..server.process_metrics import ProcessMetrics, \
             loop_lag_probe
         from ..server.proxy import Proxy
@@ -471,6 +733,15 @@ def run_worker(cfg: dict) -> dict:
         flow.set_scheduler(s)
         role = cfg.get("role", f"proxy-{cfg['index']}")
         worker_trace_setup(role, cfg)
+        # bench knob arming shipped from the driver (role-per-process
+        # cells model BOTH serial resources: the external resolver's
+        # resolve cost and this worker proxy's commit cost)
+        if cfg.get("commit_cost"):
+            flow.SERVER_KNOBS.set("sim_commit_cost_per_txn",
+                                  float(cfg["commit_cost"]))
+        if cfg.get("batch_cap"):
+            flow.SERVER_KNOBS.set("commit_transaction_batch_count_max",
+                                  int(cfg["batch_cap"]))
         net = SimNetwork(s, flow.g_random)
         proc = net.new_process(f"benchproxy-{cfg['index']}",
                                machine=f"benchproxy-{cfg['index']}")
@@ -525,10 +796,23 @@ def run_worker(cfg: dict) -> dict:
             def tref(token):
                 return transport.ref(host, port, token)
 
+            def pref(entry, key):
+                # role-per-process entries carry the role host's OWN
+                # addr (tools/rolehost.py): connect directly, wrapped
+                # in a retrying ref so a role kill -9 + same-port
+                # respawn heals through role idempotency. Plain int
+                # entries are classic gateway tokens.
+                if isinstance(entry, dict) and "addr" in entry:
+                    h, p = entry["addr"]
+                    return RetryingTcpRef(
+                        transport.ref(h, int(p), int(entry[key])))
+                return tref(entry[key] if isinstance(entry, dict)
+                            else entry)
+
             proxy = Proxy(
                 proc, tref(doc["master"]),
-                [tref(r["resolves"]) for r in doc["resolvers"]],
-                [tref(t) for t in doc["tlogs"]],
+                [pref(r, "resolves") for r in doc["resolvers"]],
+                [pref(t, "commits") for t in doc["tlogs"]],
                 resolver_splits=tuple(doc["resolver_splits"]),
                 storage_splits=tuple(doc["storage_splits"]),
                 storage_tags=tuple(doc["storage_tags"]),
@@ -543,15 +827,41 @@ def run_worker(cfg: dict) -> dict:
             def commit_send(_i, req, reply):
                 proxy.commits.stream.send((req, reply))
 
+            # priming commit: this worker may start several wall
+            # seconds after recovery (subprocess + import time), when
+            # the cluster-wide committed version still dates from the
+            # recovery epoch while the master's next assignment tracks
+            # the wall clock — a read txn driven off that stale first
+            # GRV would resolve outside the MVCC window and surface as
+            # a spurious too_old "divergence". One blind write (no
+            # read ranges: never too_old by definition) advances the
+            # committed version to now before the measured workload.
+            from ..server.types import (CommitRequest,
+                                        GetReadVersionRequest,
+                                        MutationRef, SET_VALUE)
+            pk = b"\x00sb-prime/%d" % int(cfg["index"])
+            reply = Promise()
+            grv_send(GetReadVersionRequest(), reply)
+            ver0 = (await reply.future).version
+            reply = Promise()
+            commit_send(0, CommitRequest(
+                ver0, (), ((pk, pk + b"\x00"),),
+                (MutationRef(SET_VALUE, pk, b"p"),)), reply)
+            await reply.future
+
             counts = await _drive_commits(
                 grv_send, commit_send, seed=int(cfg["seed"]),
                 duration=float(cfg["duration"]),
                 rate=float(cfg["rate"]),
                 key_prefix=b"sb/%d/" % int(cfg["index"]),
                 clock=time.perf_counter,
+                max_inflight=int(cfg.get("max_inflight", 2048)),
                 sample_every=int(cfg.get("sample_every", 0)),
                 debug_prefix=f"cb{cfg['index']}-", live=live)
             counts["index"] = cfg["index"]
+            # per-OS-process CPU/RSS for the cell artifact: the
+            # role_cpu_share fold and the SYSBENCH before/after rows
+            counts["proc"] = metrics.sample()
             return counts
 
         t = s.spawn(main())
@@ -575,6 +885,11 @@ def run_worker(cfg: dict) -> dict:
 def run_matrix(modes=("inprocess", "tcp"), grid=GRID, *, seed: int = 0,
                duration: float = 2.0, rate: float = 12000.0,
                tcp_duration: float = 3.0, tcp_rate: float = 6000.0,
+               role_processes: bool = False,
+               role_resolve_cost: float = ROLE_RESOLVE_COST,
+               role_commit_cost: float = ROLE_COMMIT_COST,
+               role_headroom: float = ROLE_HEADROOM,
+               role_max_inflight: int = ROLE_MAX_INFLIGHT,
                out=print) -> dict:
     cells: dict = {"inprocess": {}, "tcp": {}}
     for p in grid:
@@ -584,9 +899,34 @@ def run_matrix(modes=("inprocess", "tcp"), grid=GRID, *, seed: int = 0,
                     p, r, seed=seed, duration=duration, rate=rate,
                     out=out)
             if "tcp" in modes:
+                if role_processes:
+                    # offered load tracks the CELL's modeled capacity
+                    # (role_cell_capacity) at a fixed headroom — a flat
+                    # grid-wide rate would either starve the big cells
+                    # or drown the small ones in drain tail
+                    cell_rate = role_headroom * role_cell_capacity(
+                        p, r, role_resolve_cost, role_commit_cost)
+                else:
+                    cell_rate = tcp_rate
                 cells["tcp"][f"{p}x{r}"] = run_tcp_cell(
                     p, r, seed=seed, duration=tcp_duration,
-                    rate=tcp_rate, out=out)
+                    rate=cell_rate, role_processes=role_processes,
+                    resolve_cost=(role_resolve_cost
+                                  if role_processes else 0.0),
+                    commit_cost=(role_commit_cost
+                                 if role_processes else 0.0),
+                    max_inflight=(role_max_inflight
+                                  if role_processes else 2048),
+                    out=out)
+    tcp_config = {"duration_wall_s": tcp_duration,
+                  "offered_rate": tcp_rate}
+    if role_processes:
+        tcp_config = {"duration_wall_s": tcp_duration,
+                      "role_processes": True,
+                      "resolve_cost_per_txn_s": role_resolve_cost,
+                      "commit_cost_per_txn_s": role_commit_cost,
+                      "offered_headroom": role_headroom,
+                      "max_inflight_per_worker": role_max_inflight}
     doc = {
         "metric": "system_committed_txn_per_s",
         "config": {
@@ -595,8 +935,7 @@ def run_matrix(modes=("inprocess", "tcp"), grid=GRID, *, seed: int = 0,
                           "offered_rate": rate,
                           "batch_cap": BATCH_CAP,
                           "resolve_cost_per_txn_s": RESOLVE_COST},
-            "tcp": {"duration_wall_s": tcp_duration,
-                    "offered_rate": tcp_rate},
+            "tcp": tcp_config,
         },
         "cells": cells,
     }
@@ -610,6 +949,10 @@ def run_matrix(modes=("inprocess", "tcp"), grid=GRID, *, seed: int = 0,
     if tcp:
         doc.setdefault("headline", {})["tcp_divergent_verdicts"] = sum(
             c["divergent_verdicts"] for c in tcp.values())
+    if "1x1" in tcp and "4x4" in tcp:
+        base = tcp["1x1"]["txn_per_s"] or 1
+        doc.setdefault("headline", {})["tcp_4x4_vs_1x1"] = round(
+            tcp["4x4"]["txn_per_s"] / base, 2)
     return doc
 
 
@@ -625,6 +968,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     matrix = False
     trace = False
     run_dir = None
+    role_procs = False
+    resolve_cost = commit_cost = None
+    max_inflight = None
     while argv:
         a = argv.pop(0)
         if a == "--worker":
@@ -652,20 +998,38 @@ def main(argv: Optional[List[str]] = None) -> int:
             trace = True
         elif a == "--run-dir":
             run_dir = argv.pop(0)
+        elif a == "--role-processes":
+            role_procs = True
+        elif a == "--resolve-cost":
+            resolve_cost = float(argv.pop(0))
+        elif a == "--commit-cost":
+            commit_cost = float(argv.pop(0))
+        elif a == "--max-inflight":
+            max_inflight = int(argv.pop(0))
         else:
             print(f"unknown argument {a!r}")
             return 2
     if matrix:
         modes = (mode,) if mode else ("inprocess", "tcp")
-        doc = run_matrix(modes, seed=seed, out=print)
+        doc = run_matrix(
+            modes, seed=seed, role_processes=role_procs,
+            duration=duration or 2.0,
+            tcp_duration=12.0 if role_procs else 3.0, out=print)
     elif processes is not None:
         # the CI small shape: N proxy worker processes over real TCP
+        # (--role-processes puts the resolvers and the tlog in their
+        # own OS processes too; costs default to 0 — CI measures the
+        # zero-divergence property, not the capacity model)
         doc = {"metric": "system_committed_txn_per_s",
                "cells": {"tcp": {}}}
         cell = run_tcp_cell(processes, resolvers or processes,
                             seed=seed, duration=duration or 3.0,
                             rate=rate or 2000.0, run_dir=run_dir,
-                            trace=trace, out=print)
+                            trace=trace, role_processes=role_procs,
+                            resolve_cost=resolve_cost or 0.0,
+                            commit_cost=commit_cost or 0.0,
+                            max_inflight=max_inflight or 2048,
+                            out=print)
         doc["cells"]["tcp"][f"{processes}x{resolvers or processes}"] = \
             cell
         doc["headline"] = {

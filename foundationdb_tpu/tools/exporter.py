@@ -856,6 +856,20 @@ def render_federated(host_status: dict, procs: List[dict],
     f.add(f"{_PREFIX}_federated_processes", "gauge",
           "Processes folded into this scrape (host + workers)", {},
           (1 if host_status else 0) + len(procs or ()))
+    # cross-process role CPU share (ISSUE 19): host in-process fold
+    # weighted by host cpu_seconds plus every worker/role process's
+    # measured cpu_seconds under its role
+    from ..server.process_metrics import federated_role_cpu_share
+    pm = ((host_status or {}).get("cluster") or {}) \
+        .get("process_metrics") or {}
+    for role, share in federated_role_cpu_share(
+            pm.get("role_cpu_share"),
+            (pm.get("host") or {}).get("cpu_seconds"),
+            list(procs or ())).items():
+        f.add(f"{_PREFIX}_federated_role_cpu_share", "gauge",
+              "CPU-seconds share per role across every OS process in "
+              "the deployment (host sim-fold x host CPU + each "
+              "worker/role process's own CPU)", {"role": role}, share)
     return f.render()
 
 
@@ -895,8 +909,14 @@ def federate_status(host_status: dict, procs: List[dict],
     cl["processes"] = {str(p.get("process", f"?:{i}")):
                        normalize_proc_doc(p)
                        for i, p in enumerate(procs or ())}
+    from ..server.process_metrics import federated_role_cpu_share
+    pm = cl.get("process_metrics") or {}
     cl["federation"] = {"host_process": host_process,
-                        "process_count": 1 + len(procs or ())}
+                        "process_count": 1 + len(procs or ()),
+                        "role_cpu_share": federated_role_cpu_share(
+                            pm.get("role_cpu_share"),
+                            (pm.get("host") or {}).get("cpu_seconds"),
+                            list(cl["processes"].values()))}
     return doc
 
 

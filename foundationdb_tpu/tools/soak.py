@@ -36,7 +36,18 @@ CLI:
       [--hours H] [--rate R] [--resolvers N] [--kills N] [--seed S]
       [--sample-period S] [--run-dir D] [--no-trace] [--slo]
       [--breach-at T] [--breach-len S]
+      [--resolver-processes N] [--tlog-processes N] [--kill-resolver N]
       [--out SOAK_r01.json] [--report SOAK_r01.md]
+
+Role-per-process arming (ISSUE 19): `--resolver-processes N` hosts
+every resolver in its own rolehost OS process (tools/rolehost.py) and
+`--tlog-processes 1` does the same for the tlog — the host's proxies
+fan out resolve/commit over real TCP with per-request retry, and the
+role processes' proc stubs join the same federation fetch.
+`--kill-resolver K` SIGKILLs a live resolver process K times at evenly
+spaced points and respawns it on its pinned port: recovery (checkpoint
+restore + deterministic journal replay, kill -> first cluster-wide
+committed advance) must land inside CHAOS_RECOVERY_BOUND.
 """
 
 from __future__ import annotations
@@ -53,8 +64,8 @@ from typing import List, Optional
 from .. import flow
 from ..flow import rng as _rng
 from ..flow.future import Promise
-from .clusterbench import (_drive_commits, _lat_ms, worker_trace_setup,
-                           write_proc_file)
+from .clusterbench import (RoleProcs, _drive_commits, _lat_ms,
+                           worker_trace_setup, write_proc_file)
 
 OUT_PATH = "SOAK_r01.json"
 REPORT_PATH = "SOAK_r01.md"
@@ -181,6 +192,24 @@ def run_soak_worker(cfg: dict) -> dict:
                 # captures it as the cross-process parent
                 flow.spawn(pipe(ref.get_reply(req), reply))
 
+            # priming commit: a blind write (no read ranges — immune
+            # to the MVCC too_old window) advances the cluster's
+            # committed version past the idle gap this process's own
+            # startup opened, so the measured workload's first GRVs
+            # land inside max_write_transaction_life_versions
+            from ..server.types import (CommitRequest,
+                                        GetReadVersionRequest,
+                                        MutationRef, SET_VALUE)
+            pk = b"\x00soak-prime/%d" % idx
+            reply = Promise()
+            grv_send(GetReadVersionRequest(), reply)
+            ver0 = (await reply.future).version
+            reply = Promise()
+            commit_send(0, CommitRequest(
+                ver0, (), ((pk, pk + b"\x00"),),
+                (MutationRef(SET_VALUE, pk, b"p"),)), reply)
+            await reply.future
+
             flow.spawn(sampler())
             counts = await _drive_commits(
                 grv_send, commit_send, seed=int(cfg["seed"]),
@@ -241,11 +270,22 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
              sample_every: int = 32, trace: bool = True,
              run_dir: str = None, slo: bool = False,
              breach_at: float = None, breach_len: float = 4.0,
-             breach_delay: float = 0.4, out=print) -> dict:
+             breach_delay: float = 0.4,
+             resolver_processes: int = 0, tlog_processes: int = 0,
+             kill_resolver: int = 0, out=print) -> dict:
     """The soak: host cluster + gateway in this process, `processes`
     client workers as real OS processes, `kills` SIGKILL+respawn
     rounds at evenly spaced points of the horizon. Returns the
-    SOAK_r01 document (see module docstring for what it asserts)."""
+    SOAK_r01 document (see module docstring for what it asserts).
+
+    Role-per-process arming (ISSUE 19): `resolver_processes` > 0 puts
+    every resolver in its own rolehost OS process (and overrides
+    `resolvers`), `tlog_processes` > 0 does the same for the tlog.
+    `kill_resolver` rounds SIGKILL a LIVE resolver process at evenly
+    spaced points and respawn it on its pinned port: the commit
+    pipeline must resume — checkpoint restore + deterministic journal
+    replay — within CHAOS_RECOVERY_BOUND wall seconds, measured as
+    kill -> first cluster-wide committed-count advance."""
     if processes < 1:
         raise ValueError("soak needs at least one worker process")
     if hours is not None:
@@ -253,10 +293,16 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
     if breach_at is not None and not slo:
         raise ValueError("--breach-at needs --slo (nothing would "
                          "detect the breach)")
+    if resolver_processes:
+        resolvers = resolver_processes
+    if kill_resolver and not resolver_processes:
+        raise ValueError("--kill-resolver needs --resolver-processes "
+                         "(in-host resolvers have no pid to SIGKILL)")
     prev_sched = flow.get_scheduler()
     prev_rng = _rng.rng_state()
     prev_trace_path = flow.g_trace.path
     cluster = gw = fed_transport = timeline_fh = None
+    roles = ext = None
     if run_dir is None:
         import tempfile
         run_dir = tempfile.mkdtemp(prefix="fdbtpu-soak-")
@@ -265,6 +311,7 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
     lock = threading.Lock()
     slots = [_Slot(i) for i in range(processes)]
     kill_rows: List[dict] = []
+    resolver_kill_rows: List[dict] = []
     errors: List[str] = []
     t_start = [0.0]
     try:
@@ -281,10 +328,23 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             flow.reset_trace(os.path.join(
                 run_dir, f"trace.cluster-host.{os.getpid()}.jsonl"))
             flow.trace.set_process_identity("cluster-host")
+        if resolver_processes or tlog_processes:
+            # role hosts first: the master's recruitment phase needs
+            # their control endpoints live before the first epoch
+            roles = RoleProcs(
+                n_resolvers=resolver_processes,
+                n_tlogs=1 if tlog_processes else 0,
+                run_dir=run_dir,
+                state_root=os.path.join(run_dir, "state"),
+                seed=seed, trace=trace)
+            roles.spawn_all().wait_ready()
         cluster = SimCluster(seed=seed, virtual=False, n_proxies=1,
                              n_resolvers=resolvers, n_storage=1,
                              n_logs=1, metric_history=slo,
                              metrics_janitor=slo)
+        if roles is not None:
+            ext = roles.external_roles()
+            cluster.cc.external_roles = ext
         if trace:
             # AFTER construction — SimCluster re-seeds the knob set
             flow.SERVER_KNOBS.set("trace_propagation", 1)
@@ -466,6 +526,9 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             federation["process_metric_pids"] = sorted(
                 {lb.get("pid") for name, lb, _v in samples
                  if name == "fdbtpu_process_cpu_seconds"})
+            federation["role_cpu_share"] = \
+                fed_doc["cluster"]["federation"].get(
+                    "role_cpu_share") or {}
 
         async def slo_read_back(run_t0_clock: float) -> dict:
             """ISSUE 17 acceptance: the timeline and the final verdict
@@ -574,6 +637,20 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                 spawn_worker(slot, duration)
             kill_at = [t0 + duration * (k + 1) / (kills + 1)
                        for k in range(kills)]
+            rkill_at = [t0 + duration * (k + 1) / (kill_resolver + 1)
+                        for k in range(kill_resolver)]
+
+            async def rewait_resolver(i: int, row: dict) -> None:
+                # scheduler-friendly: the host keeps serving while the
+                # replacement boots, recovers, and re-writes ready
+                await roles.wait_ready_async(
+                    [("resolver", i)],
+                    timeout=float(
+                        flow.SERVER_KNOBS.chaos_recovery_bound))
+                rdoc = roles.ready[("resolver", i)]
+                row["respawned_pid"] = rdoc["pid"]
+                row["respawn_recovered_state"] = bool(
+                    rdoc.get("recovered"))
             fed_at = t0 + duration * 0.75
             fed_done = False
             next_sample = t0 + sample_period
@@ -606,6 +683,27 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                     kill_worker(victim)
                     spawn_worker(victim,
                                  t0 + duration - time.perf_counter())
+                while rkill_at and wall >= rkill_at[0]:
+                    # resolver chaos (ISSUE 19): SIGKILL a LIVE
+                    # resolver role process mid-load, respawn on its
+                    # pinned port; recovery is judged by the whole
+                    # pipeline committing again (every commit fans out
+                    # to every resolver, so a dead one stalls all)
+                    rkill_at.pop(0)
+                    ri = len(resolver_kill_rows) % roles.n_resolvers
+                    with lock:
+                        before = 0
+                        for slot in slots:
+                            before += slot.live_counts()["committed"]
+                    dead = roles.kill("resolver", ri)
+                    rrow = {"t": round(wall - t0, 3), "resolver": ri,
+                            "name": roles.name("resolver", ri),
+                            "killed_pid": dead,
+                            "committed_before_kill": before,
+                            "wall_at_kill": wall}
+                    resolver_kill_rows.append(rrow)
+                    roles.respawn("resolver", ri)
+                    flow.spawn(rewait_resolver(ri, rrow))
                 if not fed_done and wall >= fed_at:
                     fed_done = True
                     try:
@@ -654,6 +752,17 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                     trow.update({k: round(v, 3)
                                  for k, v in sorted(lat.items())})
                     trow["proc"] = procs_row
+                    # resolver-kill recovery: the pipeline is
+                    # recovered once the CLUSTER-WIDE committed count
+                    # moves past its pre-kill snapshot (every commit
+                    # fans out to every resolver, so a dead one stalls
+                    # all workers, not a share)
+                    for rrow in resolver_kill_rows:
+                        if "recovery_s" not in rrow and \
+                                totals["committed"] > \
+                                rrow["committed_before_kill"]:
+                            rrow["recovery_s"] = round(
+                                wall - rrow.pop("wall_at_kill"), 3)
                     note_sample(trow)
                     bank_totals(totals)
                     prev_committed = totals["committed"]
@@ -709,7 +818,10 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
                        "sample_every": sample_every,
                        "trace": bool(trace), "slo": bool(slo),
                        "hours": hours, "breach_at": breach_at,
-                       "breach_len": breach_len},
+                       "breach_len": breach_len,
+                       "resolver_processes": resolver_processes,
+                       "tlog_processes": tlog_processes,
+                       "kill_resolver": kill_resolver},
             "run_dir": run_dir,
             "wall_seconds": wall,
             "timeline": timeline,
@@ -727,11 +839,41 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             "federation": federation,
             "errors": errors,
         }
+        if roles is not None:
+            doc["resolver_kills"] = resolver_kill_rows
+            try:
+                role_docs = exporter.fetch_process_docs(
+                    run_dir, stubs=roles.status_stubs())
+            except Exception as e:  # noqa: BLE001 — recorded
+                role_docs = []
+                errors.append(f"role_status: {e!r}")
+            doc["role_processes"] = {
+                "resolvers": roles.n_resolvers,
+                "tlogs": roles.n_tlogs,
+                "kills": roles.kills,
+                "status": [
+                    {k: d.get(k) for k in
+                     ("process", "role", "name", "pid", "up",
+                      "uptime_s", "counters", "version",
+                      "process_metrics") if k in d}
+                    for d in role_docs],
+            }
         if trace:
             # the cross-process proof: merge the run dir and demand at
             # least one complete client->proxy->resolver->tlog chain
             flow.g_trace_batch.dump()
             flow.g_trace.flush()
+            if roles is not None:
+                # role processes are still serving: their resolver/tlog
+                # span legs sit in per-process TraceBatch buffers until
+                # asked — flush before reading the run dir or the merge
+                # sees client+host legs only
+                from . import rolehost
+                try:
+                    rolehost.flush_role_traces(
+                        list(roles.ready.values()))
+                except Exception as e:  # noqa: BLE001 — recorded
+                    errors.append(f"trace_flush: {e!r}")
             merged = tracemerge.merge(run_dir)
             full = tracemerge.full_commit_chains(merged)
             doc["trace"] = {
@@ -752,6 +894,16 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
               and all("recovery_s" in k for k in kill_rows)
               and (not trace
                    or doc["trace"]["full_commit_chains"] >= 1))
+        if kill_resolver:
+            # every resolver SIGKILL must have recovered — checkpoint
+            # restore + deterministic journal replay — within the same
+            # bound the in-sim chaos workloads are held to
+            bound = float(flow.SERVER_KNOBS.chaos_recovery_bound)
+            ok = (ok and len(resolver_kill_rows) == kill_resolver
+                  and all("recovery_s" in r
+                          for r in resolver_kill_rows)
+                  and max(r["recovery_s"]
+                          for r in resolver_kill_rows) <= bound)
         if slo:
             # the self-watching contract: the persistent plane must
             # hold a readable timeline, a sane TimeKeeper map, and —
@@ -787,6 +939,7 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             f"{doc['txn_per_s']}/s committed={totals['committed']} "
             f"divergent={totals['divergent_verdicts']} "
             f"kills={len(kill_rows)} "
+            f"resolver_kills={len(resolver_kill_rows)} "
             f"digest_consistent={doc['digest']['consistent']} "
             f"{slo_note}ok={ok} trace-run-dir={run_dir}")
         return doc
@@ -800,8 +953,12 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
             fed_transport.close()
         if gw is not None:
             gw.close()
+        if ext is not None:
+            ext.close()
         if cluster is not None:
             cluster.shutdown()
+        if roles is not None:
+            roles.terminate_all()
         if trace:
             flow.reset_trace(prev_trace_path)
             flow.trace.clear_process_identity()
@@ -815,10 +972,14 @@ def run_soak(*, processes: int = 2, resolvers: int = 2,
 
 
 def render_soak_report(doc: dict) -> str:
-    """SOAK_r01.md: the document as a human report."""
+    """SOAK_rNN.md: the document as a human report."""
     cfg = doc["config"]
+    role_proc = cfg.get("resolver_processes", 0)
+    name = "SOAK_r02" if role_proc else "SOAK_r01"
+    kind = ("role-per-process soak" if role_proc
+            else "multi-process soak")
     lines = [
-        "# SOAK_r01 — multi-process soak",
+        f"# {name} — {kind}",
         "",
         f"- processes: {cfg['processes']} client workers + 1 cluster "
         f"host, resolvers={cfg['resolvers']}, seed={cfg['seed']}",
@@ -844,6 +1005,38 @@ def render_soak_report(doc: dict) -> str:
             f"{rec if rec is not None else 'NEVER'}s")
     if not doc["kills"]:
         lines.append("- none armed")
+    rp = doc.get("role_processes") or {}
+    if rp:
+        lines += [
+            "",
+            "## Role processes",
+            "",
+            f"- externally hosted: {rp.get('resolvers', 0)} "
+            f"resolver(s) + {rp.get('tlogs', 0)} tlog(s) "
+            f"(rolehost OS processes), resolver SIGKILLs armed: "
+            f"{cfg.get('kill_resolver', 0)}",
+        ]
+        for r in doc.get("resolver_kills") or []:
+            rec = r.get("recovery_s")
+            lines.append(
+                f"- t={r['t']}s {r['name']}: SIGKILL pid "
+                f"{r['killed_pid']} ({r['committed_before_kill']} "
+                f"committed cluster-wide) -> pipeline recovered in "
+                f"{rec if rec is not None else 'NEVER'}s "
+                f"(respawned pid {r.get('respawned_pid', '?')}, "
+                f"recovered_state="
+                f"{r.get('respawn_recovered_state', '?')})")
+        for d in rp.get("status") or []:
+            c = d.get("counters") or {}
+            pm = d.get("process_metrics") or {}
+            lines.append(
+                f"- {d.get('process', '?')}: requests="
+                f"{c.get('requests', 0)} journaled="
+                f"{c.get('journaled', 0)} replayed="
+                f"{c.get('replayed', 0)} checkpoints="
+                f"{c.get('checkpoints', 0)} cpu_s="
+                f"{pm.get('cpu_seconds', '?')} rss="
+                f"{pm.get('rss_bytes', '?')}")
     fed = doc.get("federation") or {}
     lines += [
         "",
@@ -858,6 +1051,11 @@ def render_soak_report(doc: dict) -> str:
                      for p in fed.get("process_metric_pids", ()))
            or "-"),
     ]
+    if fed.get("role_cpu_share"):
+        lines.append(
+            "- federated role_cpu_share: "
+            + ", ".join(f"{r}={v}" for r, v in
+                        fed["role_cpu_share"].items()))
     tr = doc.get("trace") or {}
     if tr:
         lines += [
@@ -938,6 +1136,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             kw["breach_at"] = float(argv.pop(0))
         elif a == "--breach-len":
             kw["breach_len"] = float(argv.pop(0))
+        elif a == "--resolver-processes":
+            kw["resolver_processes"] = int(argv.pop(0))
+        elif a == "--tlog-processes":
+            kw["tlog_processes"] = int(argv.pop(0))
+        elif a == "--kill-resolver":
+            kw["kill_resolver"] = int(argv.pop(0))
         elif a == "--run-dir":
             kw["run_dir"] = argv.pop(0)
         elif a == "--no-trace":
